@@ -1,0 +1,28 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A ground-up rebuild of the capabilities of Tendermint Core (reference:
+Switcheo/tendermint) designed TPU-first: the dense-compute plane (ed25519
+commit-signature verification) runs as batched JAX/Pallas programs over
+Edwards25519, sharded across a `jax.sharding.Mesh` with verdicts AND-reduced
+over ICI; the host plane (consensus, p2p, mempool, stores, RPC) is an
+asyncio-structured runtime mirroring the reference's goroutine architecture.
+
+Layout:
+  proto/     deterministic protobuf wire runtime + message schemas
+  crypto/    keys, signatures, merkle, batch-verifier seam (ref: crypto/)
+  ops/       TPU compute kernels: GF(2^255-19) limb field arithmetic,
+             Edwards25519 group ops, batched verification (ref: the
+             curve25519-voi dependency, go.mod:22)
+  parallel/  mesh/sharding: shard_map batch verify, psum AND-reduce
+  models/    end-to-end jittable verification programs ("flagship model")
+  types/     Block/Vote/Commit/ValidatorSet/... (ref: types/)
+  utils/     base libs (ref: libs/)
+"""
+
+__version__ = "0.1.0"
+
+# Version anchors mirroring reference version/version.go:13-27.
+TM_VERSION_DEFAULT = "0.35.0-tpu"
+ABCI_SEM_VER = "0.17.0"
+P2P_PROTOCOL = 8
+BLOCK_PROTOCOL = 11

@@ -1,0 +1,147 @@
+"""RFC-6962 merkle trees + inclusion proofs (ref: crypto/merkle/tree.go,
+crypto/merkle/proof.go).
+
+Leaf hash = SHA256(0x00 || leaf); inner hash = SHA256(0x01 || left || right).
+Trees over n items split at the largest power of two < n.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n (ref: tree.go:93)."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Merkle root (ref: HashFromByteSlices, crypto/merkle/tree.go:11).
+    Empty list hashes to SHA256 of the empty string."""
+    n = len(items)
+    if n == 0:
+        return _sha256(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+
+
+class Proof:
+    """Inclusion proof (ref: crypto/merkle/proof.go:26)."""
+
+    __slots__ = ("total", "index", "leaf_hash", "aunts")
+
+    def __init__(self, total: int, index: int, leaf_hash_: bytes, aunts: list[bytes]):
+        self.total = total
+        self.index = index
+        self.leaf_hash = leaf_hash_
+        self.aunts = aunts
+
+    def compute_root_hash(self) -> bytes | None:
+        return _compute_hash_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or self.index < 0:
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        return self.compute_root_hash() == root_hash
+
+    def to_proto(self):
+        from ..proto import messages as pb
+
+        return pb.Proof(total=self.total, index=self.index, leaf_hash=self.leaf_hash, aunts=list(self.aunts))
+
+    @classmethod
+    def from_proto(cls, p):
+        return cls(p.total, p.index, p.leaf_hash, list(p.aunts))
+
+
+def _compute_hash_from_aunts(index: int, total: int, leaf: bytes, aunts: list[bytes]) -> bytes | None:
+    if index >= total or index < 0 or total <= 0:
+        return None
+    if total == 1:
+        if aunts:
+            return None
+        return leaf
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_hash_from_aunts(index, k, leaf, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_hash_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """Root plus one inclusion proof per item
+    (ref: ProofsFromByteSlices, crypto/merkle/proof.go:82)."""
+    trails, root = _trails_from_byte_slices(items)
+    root_hash = root.hash
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(Proof(len(items), i, trail.hash, trail.flatten_aunts()))
+    return root_hash, proofs
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent = None
+        self.left = None  # sibling pointers while walking up
+        self.right = None
+
+    def flatten_aunts(self) -> list[bytes]:
+        aunts = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                aunts.append(node.left.hash)
+            elif node.right is not None:
+                aunts.append(node.right.hash)
+            node = node.parent
+        return aunts
+
+
+def _trails_from_byte_slices(items: list[bytes]):
+    n = len(items)
+    if n == 0:
+        return [], _Node(_sha256(b""))
+    if n == 1:
+        node = _Node(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root
+    right_root.parent = root
+    right_root.left = left_root
+    return lefts + rights, root

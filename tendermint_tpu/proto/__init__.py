@@ -1,0 +1,21 @@
+"""Deterministic protobuf wire runtime + message schemas.
+
+Replaces the reference's gogo/protobuf generated code (proto/tendermint/*,
+~35.7k LoC generated Go). Hand-rolled here because sign-bytes must be
+byte-identical to the reference's canonical encoding (types/canonical.go:57,
+types/vote.go:149) and the full generated surface is unnecessary: messages
+are declared declaratively in `messages.py` and encoded by `wire.py`.
+"""
+
+from .wire import (  # noqa: F401
+    encode_varint,
+    decode_varint,
+    encode_zigzag,
+    decode_zigzag,
+    encode_tag,
+    WIRE_VARINT,
+    WIRE_FIXED64,
+    WIRE_BYTES,
+    WIRE_FIXED32,
+)
+from .message import Message, Field  # noqa: F401

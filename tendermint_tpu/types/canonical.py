@@ -1,0 +1,71 @@
+"""Canonical sign-bytes construction (ref: types/canonical.go, types/vote.go:149).
+
+The byte layout here is the contract the TPU verifier checks signatures
+over; it is golden-tested against the reference's types/vote_test.go
+vectors and must never drift.
+"""
+
+from __future__ import annotations
+
+from ..proto import messages as pb
+
+
+def canonicalize_block_id(bid: pb.BlockID | None) -> pb.CanonicalBlockID | None:
+    """Nil/empty block IDs canonicalize to an absent field
+    (ref: types/canonical.go:18-34)."""
+    if bid is None:
+        return None
+    psh = bid.part_set_header or pb.PartSetHeader()
+    is_zero = not bid.hash and not psh.hash and not psh.total
+    if is_zero:
+        return None
+    return pb.CanonicalBlockID(
+        hash=bid.hash,
+        part_set_header=pb.CanonicalPartSetHeader(total=psh.total, hash=psh.hash),
+    )
+
+
+def canonicalize_vote(chain_id: str, vote: pb.Vote) -> pb.CanonicalVote:
+    return pb.CanonicalVote(
+        type=vote.type,
+        height=vote.height,
+        round=vote.round,
+        block_id=canonicalize_block_id(vote.block_id),
+        timestamp=vote.timestamp.copy() if vote.timestamp else pb.Timestamp(),
+        chain_id=chain_id,
+    )
+
+
+def canonicalize_proposal(chain_id: str, proposal: pb.Proposal) -> pb.CanonicalProposal:
+    return pb.CanonicalProposal(
+        type=pb.SIGNED_MSG_TYPE_PROPOSAL,
+        height=proposal.height,
+        round=proposal.round,
+        pol_round=proposal.pol_round,
+        block_id=canonicalize_block_id(proposal.block_id),
+        timestamp=proposal.timestamp.copy() if proposal.timestamp else pb.Timestamp(),
+        chain_id=chain_id,
+    )
+
+
+def canonicalize_vote_extension(chain_id: str, vote: pb.Vote) -> pb.CanonicalVoteExtension:
+    return pb.CanonicalVoteExtension(
+        extension=vote.extension,
+        height=vote.height,
+        round=vote.round,
+        chain_id=chain_id,
+    )
+
+
+def vote_sign_bytes(chain_id: str, vote: pb.Vote) -> bytes:
+    """Varint-length-prefixed canonical vote encoding
+    (ref: types/vote.go:149 VoteSignBytes)."""
+    return canonicalize_vote(chain_id, vote).encode_delimited()
+
+
+def vote_extension_sign_bytes(chain_id: str, vote: pb.Vote) -> bytes:
+    return canonicalize_vote_extension(chain_id, vote).encode_delimited()
+
+
+def proposal_sign_bytes(chain_id: str, proposal: pb.Proposal) -> bytes:
+    return canonicalize_proposal(chain_id, proposal).encode_delimited()

@@ -1,0 +1,1 @@
+"""Base libraries (ref: libs/ and internal/libs/)."""

@@ -1,0 +1,102 @@
+"""Nanosecond-precision UTC time (ref: libs/time/time.go).
+
+Python's datetime only carries microseconds; consensus timestamps are
+nanosecond-precision protobuf Timestamps (seconds since the unix epoch +
+nanos), and the zero value is the Go zero time 0001-01-01T00:00:00Z
+(seconds = -62135596800). `Time` stores (seconds, nanos) exactly.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass
+from datetime import datetime, timezone
+
+GO_ZERO_SECONDS = -62135596800  # 0001-01-01T00:00:00Z relative to unix epoch
+_NS = 1_000_000_000
+
+
+@dataclass(frozen=True, order=True)
+class Time:
+    seconds: int = GO_ZERO_SECONDS
+    nanos: int = 0
+
+    def __post_init__(self):
+        if not 0 <= self.nanos < _NS:
+            total = self.seconds * _NS + self.nanos
+            object.__setattr__(self, "seconds", total // _NS)
+            object.__setattr__(self, "nanos", total % _NS)
+
+    # -- constructors -----------------------------------------------------
+
+    @classmethod
+    def now(cls) -> "Time":
+        return cls.from_unix_ns(_time.time_ns())
+
+    @classmethod
+    def from_unix_ns(cls, ns: int) -> "Time":
+        return cls(ns // _NS, ns % _NS)
+
+    @classmethod
+    def from_datetime(cls, dt: datetime) -> "Time":
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        epoch = datetime(1970, 1, 1, tzinfo=timezone.utc)
+        delta = dt - epoch
+        ns = (delta.days * 86400 + delta.seconds) * _NS + delta.microseconds * 1000
+        return cls.from_unix_ns(ns)
+
+    @classmethod
+    def parse_rfc3339(cls, s: str) -> "Time":
+        # Handle nanosecond fractional seconds, which datetime can't.
+        frac_ns = 0
+        if "." in s:
+            head, rest = s.split(".", 1)
+            digits = ""
+            idx = 0
+            while idx < len(rest) and rest[idx].isdigit():
+                digits += rest[idx]
+                idx += 1
+            tail = rest[idx:]
+            frac_ns = int((digits + "000000000")[:9])
+            s = head + tail
+        if s.endswith("Z"):
+            s = s[:-1] + "+00:00"
+        dt = datetime.fromisoformat(s)
+        base = cls.from_datetime(dt.replace(microsecond=0))
+        return cls(base.seconds, frac_ns)
+
+    # -- accessors --------------------------------------------------------
+
+    def unix_ns(self) -> int:
+        return self.seconds * _NS + self.nanos
+
+    def is_zero(self) -> bool:
+        return self.seconds == GO_ZERO_SECONDS and self.nanos == 0
+
+    def add(self, ns: int) -> "Time":
+        return Time.from_unix_ns(self.unix_ns() + ns)
+
+    def sub(self, other: "Time") -> int:
+        """Difference in nanoseconds."""
+        return self.unix_ns() - other.unix_ns()
+
+    def rfc3339(self) -> str:
+        """RFC3339Nano rendering (trailing fractional zeros trimmed)."""
+        dt = datetime.fromtimestamp(self.seconds, tz=timezone.utc) if self.seconds >= 0 else None
+        if dt is None:
+            epoch = datetime(1970, 1, 1, tzinfo=timezone.utc)
+            from datetime import timedelta
+
+            dt = epoch + timedelta(seconds=self.seconds)
+        base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+        if self.nanos:
+            frac = f"{self.nanos:09d}".rstrip("0")
+            return f"{base}.{frac}Z"
+        return base + "Z"
+
+    def __str__(self):
+        return self.rfc3339()
+
+
+ZERO = Time()

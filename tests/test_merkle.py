@@ -1,0 +1,49 @@
+"""Merkle tests, including the reference's known-answer structure checks
+(ref: crypto/merkle/tree_test.go)."""
+
+import hashlib
+
+from tendermint_tpu.crypto import merkle
+
+
+def test_empty_root():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+def test_single_leaf():
+    assert merkle.hash_from_byte_slices([b"abc"]) == hashlib.sha256(b"\x00abc").digest()
+
+
+def test_two_leaves():
+    l0 = hashlib.sha256(b"\x00a").digest()
+    l1 = hashlib.sha256(b"\x00b").digest()
+    want = hashlib.sha256(b"\x01" + l0 + l1).digest()
+    assert merkle.hash_from_byte_slices([b"a", b"b"]) == want
+
+
+def test_split_point():
+    # ref: crypto/merkle/tree_test.go getSplitPoint cases
+    for n, want in [(2, 1), (3, 2), (4, 2), (5, 4), (10, 8), (20, 16), (100, 64), (255, 128), (256, 128), (257, 256)]:
+        assert merkle._split_point(n) == want, n
+
+
+def test_proofs_verify():
+    for n in [1, 2, 3, 5, 8, 13, 100]:
+        items = [bytes([i]) * (i % 7 + 1) for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, item in enumerate(items):
+            assert proofs[i].total == n
+            assert proofs[i].index == i
+            assert proofs[i].verify(root, item), (n, i)
+            assert not proofs[i].verify(root, item + b"x")
+            if n > 1:
+                other = (i + 1) % n
+                assert not proofs[i].verify(root, items[other])
+
+
+def test_proof_proto_roundtrip():
+    items = [b"a", b"b", b"c"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    p = merkle.Proof.from_proto(proofs[1].to_proto())
+    assert p.verify(root, b"b")
