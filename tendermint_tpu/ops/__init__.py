@@ -1,0 +1,13 @@
+"""TPU compute kernels.
+
+The dense-compute plane of the framework: GF(2^255-19) limb arithmetic,
+Edwards25519 group operations, and batched ed25519 verification, written
+as pure jax.numpy programs (TPU-native: int32 limb vectors on the VPU,
+static shapes, lax control flow) with Pallas variants for the hot paths.
+
+This replaces the reference's curve25519-voi dependency (go.mod:22, used
+by crypto/ed25519/ed25519.go) with a TPU-first design: instead of a
+randomized combined batch equation, every signature's cofactored ZIP-215
+equation is checked data-parallel across lanes, which is both stronger
+(deterministic, no randomizers) and byte-identical in acceptance.
+"""

@@ -1,0 +1,230 @@
+"""Edwards25519 group operations on limb vectors (TPU-native).
+
+Points are extended homogeneous coordinates stacked on axis -2: an array
+of shape (..., 4, 32) int32 holding (X, Y, Z, T) with x = X/Z, y = Y/Z,
+T = XY/Z. The unified addition law is complete for ed25519 (a = -1 is a
+square mod p, d is not), so small-order / mixed-order points — which
+ZIP-215 admits — need no special-casing anywhere.
+
+Scalar multiplication is windowed (4-bit), built on lax.fori_loop so the
+traced program stays small and XLA compiles one loop body:
+  - fixed-base: 64 table lookups into a host-precomputed (64, 16) table
+    of j*16^i*B multiples — no doublings at all.
+  - variable-base: per-point 16-entry table (15 additions), then 63x
+    (4 doublings + windowed add).
+
+Replaces the scalar/point layer of curve25519-voi
+(ref: crypto/ed25519/ed25519.go verification internals).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import field as F
+
+# -- point layout helpers -------------------------------------------------
+
+
+def make_point(x, y, z, t):
+    return jnp.stack([x, y, z, t], axis=-2)
+
+
+def identity_point(batch_shape=()):
+    pt = np.zeros(batch_shape + (4, 32), np.int32)
+    pt[..., 1, 0] = 1  # Y = 1
+    pt[..., 2, 0] = 1  # Z = 1
+    return jnp.asarray(pt)
+
+
+def point_add(p, q):
+    """Unified complete addition (add-2008-hwcd-3 shape, a = -1)."""
+    xp, yp, zp, tp = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    xq, yq, zq, tq = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    a = F.fe_mul(F.fe_sub(yp, xp), F.fe_sub(yq, xq))
+    b = F.fe_mul(F.fe_add(yp, xp), F.fe_add(yq, xq))
+    c = F.fe_mul(F.fe_mul(tp, tq), jnp.asarray(F.D2_LIMBS))
+    d = F.fe_mul(zp, zq)
+    # One carry pass on 2*Z1*Z2 keeps |D+-C| under 2^10 with 2x headroom
+    # (otherwise the E*F / G*H convolutions sit within 9% of int32 max).
+    d = F.fe_carry(F.fe_add(d, d), passes=1)
+    e = F.fe_sub(b, a)
+    f = F.fe_sub(d, c)
+    g = F.fe_add(d, c)
+    h = F.fe_add(b, a)
+    return make_point(F.fe_mul(e, f), F.fe_mul(g, h), F.fe_mul(f, g), F.fe_mul(e, h))
+
+
+def point_double(p):
+    return point_add(p, p)
+
+
+def point_neg(p):
+    x, y, z, t = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    return make_point(F.fe_neg(x), y, z, F.fe_neg(t))
+
+
+def point_select(mask, p, q):
+    """mask ? p : q with mask of batch shape."""
+    return jnp.where(mask[..., None, None], p, q)
+
+
+def point_is_identity(p):
+    """X == 0 and Y == Z (projective identity test)."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    return F.fe_is_zero(x) & F.fe_is_zero(F.fe_sub(y, z))
+
+
+def point_equal(p, q):
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    cross_x = F.fe_sub(F.fe_mul(x1, z2), F.fe_mul(x2, z1))
+    cross_y = F.fe_sub(F.fe_mul(y1, z2), F.fe_mul(y2, z1))
+    return F.fe_is_zero(cross_x) & F.fe_is_zero(cross_y)
+
+
+# -- decompression (ZIP-215 decoding) -------------------------------------
+
+
+def decompress(enc_bytes, zip215: bool = True):
+    """Decode point encodings: enc_bytes (..., 32) int32 byte values.
+
+    Returns (point, ok). ZIP-215 semantics (the reference's verify config,
+    crypto/ed25519/ed25519.go:24-31): the 255-bit y is NOT checked for
+    canonicity, and x = 0 with sign bit set is accepted (x := -0). The
+    only rejection is a non-square x^2 candidate. zip215=False adds the
+    RFC 8032 strict checks (canonical y, no -0).
+    """
+    sign = (enc_bytes[..., 31] >> 7) & 1
+    y = enc_bytes.at[..., 31].add(-(enc_bytes[..., 31] & 0x80)).astype(jnp.int32)
+    yy = F.fe_mul(y, y)
+    u = F.fe_sub(yy, jnp.asarray(F.ONE_LIMBS))  # y^2 - 1
+    v = F.fe_add(F.fe_mul(yy, jnp.asarray(F.D_LIMBS)), jnp.asarray(F.ONE_LIMBS))  # d*y^2 + 1
+    v3 = F.fe_mul(F.fe_mul(v, v), v)
+    v7 = F.fe_mul(F.fe_mul(v3, v3), v)
+    uv7 = F.fe_mul(u, v7)
+    x = F.fe_mul(F.fe_mul(u, v3), F.fe_pow_p58(uv7))  # u*v^3*(u*v^7)^((p-5)/8)
+    vxx = F.fe_mul(v, F.fe_mul(x, x))
+    is_root = F.fe_eq(vxx, u)
+    is_neg_root = F.fe_is_zero(F.fe_add(vxx, u))
+    x_alt = F.fe_mul(x, jnp.asarray(F.SQRT_M1_LIMBS))
+    x = F.fe_select(is_root, x, x_alt)
+    ok = is_root | is_neg_root
+    # Normalize x and fix parity to the sign bit.
+    x = F.fe_canonical(x)
+    parity = x[..., 0] & 1
+    neg_x = F.fe_canonical(jnp.asarray(F.P_LIMBS) - x)  # p - x; (p-0) canonicalizes to 0
+    x = F.fe_select(parity != sign, neg_x, x)
+    if not zip215:
+        y_canon = F.fe_canonical(y)
+        canonical_y = jnp.all(y_canon == y, axis=-1)
+        x_zero = F.fe_is_zero(x)
+        ok = ok & canonical_y & ~(x_zero & (sign == 1))
+    pt = make_point(x, F.fe_canonical(y), jnp.broadcast_to(jnp.asarray(F.ONE_LIMBS), x.shape), F.fe_mul(x, F.fe_canonical(y)))
+    return pt, ok
+
+
+# -- scalar multiplication ------------------------------------------------
+
+_NIBBLES = 64
+
+
+def scalar_to_nibbles(s_bytes):
+    """(..., 32) byte values -> (..., 64) little-endian 4-bit windows."""
+    lo = s_bytes & 0x0F
+    hi = (s_bytes >> 4) & 0x0F
+    return jnp.stack([lo, hi], axis=-1).reshape(s_bytes.shape[:-1] + (_NIBBLES,))
+
+
+def _select_from_table(table, nibble):
+    """table: (..., 16, 4, 32); nibble: (...,) -> (..., 4, 32) via one-hot
+    multiply-accumulate (gather-free: TPU-friendly)."""
+    onehot = (nibble[..., None] == jnp.arange(16)).astype(jnp.int32)  # (..., 16)
+    return jnp.sum(table * onehot[..., None, None], axis=-3)
+
+
+def _build_var_table(p):
+    """Multiples 0..15 of p: (..., 16, 4, 32)."""
+    batch = p.shape[:-2]
+    entries = [jnp.broadcast_to(identity_point(), batch + (4, 32)), p]
+    for i in range(2, 16):
+        entries.append(point_add(entries[i - 1], p))
+    return jnp.stack(entries, axis=-3)
+
+
+def variable_base_mul(s_bytes, p):
+    """[s]P for per-batch points: 63 iterations of (4 doublings + windowed
+    add), processed from the most significant nibble down."""
+    nibbles = scalar_to_nibbles(s_bytes)  # (..., 64) little-endian
+    table = _build_var_table(p)
+    batch = p.shape[:-2]
+
+    def body(i, acc):
+        # nibble index 63-i (most significant first)
+        nib = jnp.take_along_axis(
+            nibbles, jnp.broadcast_to(63 - i, batch + (1,)), axis=-1
+        )[..., 0]
+        acc = point_double(point_double(point_double(point_double(acc))))
+        return point_add(acc, _select_from_table(table, nib))
+
+    acc0 = jnp.broadcast_to(identity_point(), batch + (4, 32)).astype(jnp.int32)
+    # First window without the leading doublings (acc is identity).
+    acc0 = point_add(acc0, _select_from_table(table, nibbles[..., 63]))
+    return lax.fori_loop(1, _NIBBLES, body, acc0)
+
+
+# Host-side precomputed fixed-base table: FIXED_TABLE[i][j] = j * 16^i * B.
+def _precompute_fixed_table() -> np.ndarray:
+    from ..crypto import ed25519_ref as ref
+
+    table = np.zeros((_NIBBLES, 16, 4, 32), np.int32)
+    for i in range(_NIBBLES):
+        base = ref.scalar_mult(16**i, ref.BASE)
+        for j in range(16):
+            pt = ref.scalar_mult(j, base) if j else ref.IDENTITY
+            x, y, z, t = pt
+            zinv = pow(z, ref.P - 2, ref.P)
+            xa, ya = x * zinv % ref.P, y * zinv % ref.P
+            for limb in range(32):
+                table[i, j, 0, limb] = (xa >> (8 * limb)) & 0xFF
+                table[i, j, 1, limb] = (ya >> (8 * limb)) & 0xFF
+                table[i, j, 2, limb] = (1 >> (8 * limb)) & 0xFF if limb else 1
+                table[i, j, 3, limb] = ((xa * ya % ref.P) >> (8 * limb)) & 0xFF
+    return table
+
+
+_FIXED_TABLE: np.ndarray | None = None
+
+
+def fixed_base_table() -> np.ndarray:
+    global _FIXED_TABLE
+    if _FIXED_TABLE is None:
+        _FIXED_TABLE = _precompute_fixed_table()
+    return _FIXED_TABLE
+
+
+def fixed_base_mul(s_bytes):
+    """[s]B via 64 windowed table additions (no doublings)."""
+    nibbles = scalar_to_nibbles(s_bytes)  # (..., 64)
+    table = jnp.asarray(fixed_base_table())  # (64, 16, 4, 32)
+    batch = s_bytes.shape[:-1]
+
+    def body(i, acc):
+        nib = jnp.take_along_axis(nibbles, jnp.broadcast_to(i, batch + (1,)), axis=-1)[..., 0]
+        entry = _select_from_table(lax.dynamic_index_in_dim(table, i, keepdims=False), nib)
+        return point_add(acc, entry)
+
+    acc0 = jnp.broadcast_to(identity_point(), batch + (4, 32)).astype(jnp.int32)
+    return lax.fori_loop(0, _NIBBLES, body, acc0)
+
+
+def compress(p):
+    """Canonical 32-byte encoding (device-side; needs one inversion)."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    zinv = F.fe_invert(z)
+    xa = F.fe_canonical(F.fe_mul(x, zinv))
+    ya = F.fe_canonical(F.fe_mul(y, zinv))
+    return ya.at[..., 31].add((xa[..., 0] & 1) << 7)

@@ -1,0 +1,236 @@
+"""GF(2^255-19) arithmetic on int32 limb vectors (TPU-native).
+
+Representation: a field element is a vector of 32 limbs in radix 2^8,
+little-endian, dtype int32, with a trailing axis of length 32 — so the
+canonical form of an element is exactly its 32-byte little-endian
+encoding. Limbs are *signed*: subtraction is plain limb-wise subtraction,
+and carries use floor division, which keeps every operation branch-free
+and XLA-friendly.
+
+Bounds contract (|limb| = magnitude bound):
+  - inputs to `fe_mul` must satisfy |limb| <= 2^10
+  - `fe_mul` output is carry-normalized to limbs in [0, 2^9)
+  - one add/sub of two mul outputs stays within the mul input contract
+  - `fe_canonical` accepts |limb| <= 2^13 and returns the unique
+    canonical representative (limbs in [0, 255], value < p)
+
+Why radix 2^8 / int32: TPU has no native 64-bit multiply; 8-bit limb
+products accumulate to at most 32*39*(2^10)^2 < 2^31 in the worst case
+(32 partial products, x38 reduction fold), so the whole convolution fits
+int32 MACs on the VPU. The 2^8 radix also makes encode/decode free.
+
+Reference semantics being replaced: the field layer of curve25519-voi
+(crypto/ed25519/ed25519.go's verifier).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+LIMBS = 32
+NUM_CONV = 2 * LIMBS - 1  # 63
+
+P_INT = 2**255 - 19
+D_INT = (-121665 * pow(121666, P_INT - 2, P_INT)) % P_INT
+D2_INT = (2 * D_INT) % P_INT
+SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
+
+
+def _int_to_limbs(v: int) -> np.ndarray:
+    return np.array([(v >> (8 * i)) & 0xFF for i in range(LIMBS)], dtype=np.int32)
+
+
+def limbs_to_int(z) -> int:
+    """Host-side helper: interpret a limb vector as a Python int."""
+    arr = np.asarray(z, dtype=np.int64)
+    return sum(int(arr[..., i]) << (8 * i) for i in range(LIMBS))
+
+
+P_LIMBS = _int_to_limbs(P_INT)
+D_LIMBS = _int_to_limbs(D_INT)
+D2_LIMBS = _int_to_limbs(D2_INT)
+SQRT_M1_LIMBS = _int_to_limbs(SQRT_M1_INT)
+ONE_LIMBS = _int_to_limbs(1)
+ZERO_LIMBS = _int_to_limbs(0)
+
+# Canonicalization bias: a multiple of p whose limbs are all >= 2^14, so
+# adding it to any |limb| <= 2^13 value makes every limb positive and the
+# subsequent carry chain monotone (no borrow ping-pong across passes).
+_V0 = sum((1 << 14) << (8 * i) for i in range(LIMBS))
+_A = (-_V0) % P_INT
+BIAS_LIMBS = np.array([(1 << 14) + ((_A >> (8 * i)) & 0xFF) for i in range(LIMBS)], dtype=np.int32)
+assert (sum(int(b) << (8 * i) for i, b in enumerate(BIAS_LIMBS)) % P_INT) == 0
+
+
+def fe_from_int(v: int) -> jnp.ndarray:
+    return jnp.asarray(_int_to_limbs(v % P_INT))
+
+
+def fe_carry(z, passes: int = 4):
+    """Wrapping carry propagation: carries flow limb i -> i+1, and the
+    carry out of limb 31 (weight 2^256 === 38 mod p) wraps to limb 0
+    with a factor of 38. Floor-division semantics handle signed limbs."""
+    for _ in range(passes):
+        c = z >> 8  # arithmetic shift = floor division by 256
+        z = z - (c << 8)
+        z = z.at[..., 1:].add(c[..., :-1])
+        z = z.at[..., 0].add(38 * c[..., -1])
+    return z
+
+
+def fe_mul(x, y):
+    """Field multiplication: 63-coefficient schoolbook convolution, fold
+    coefficients 32..62 back with x38 (2^256 === 38), then carry.
+
+    The convolution is phrased as padded partial products summed in a
+    balanced tree (no serial dynamic-update-slice chain — XLA compiles
+    and schedules this orders of magnitude faster, and the adds fuse)."""
+    shape = jnp.broadcast_shapes(x.shape, y.shape)
+    x = jnp.broadcast_to(x, shape)
+    y = jnp.broadcast_to(y, shape)
+    pad_cfg = [(0, 0, 0)] * (len(shape) - 1)
+    terms = [
+        lax.pad(x[..., i : i + 1] * y, jnp.int32(0), pad_cfg + [(i, NUM_CONV - LIMBS - i, 0)])
+        for i in range(LIMBS)
+    ]
+    while len(terms) > 1:  # balanced reduction tree
+        nxt = [terms[i] + terms[i + 1] for i in range(0, len(terms) - 1, 2)]
+        if len(terms) % 2:
+            nxt.append(terms[-1])
+        terms = nxt
+    z = terms[0]
+    lo = z[..., :LIMBS]
+    hi = z[..., LIMBS:]
+    lo = lo.at[..., : LIMBS - 1].add(38 * hi)
+    return fe_carry(lo, passes=4)
+
+
+def fe_square(x):
+    return fe_mul(x, x)
+
+
+def fe_add(x, y):
+    return x + y
+
+
+def fe_sub(x, y):
+    return x - y
+
+
+def fe_neg(x):
+    return -x
+
+
+def fe_mul_const(x, c_limbs):
+    """Multiply by a canonical constant (host numpy limb array)."""
+    return fe_mul(x, jnp.asarray(c_limbs))
+
+
+def _exact_carry(z):
+    """Full ripple-carry via lax.scan over the limb axis; returns byte
+    limbs plus the carry out of limb 31 (weight 2^256)."""
+    from jax import lax
+
+    zt = jnp.moveaxis(z, -1, 0)  # (32, ...)
+
+    def step(carry, limb):
+        total = limb + carry
+        return total >> 8, total & 255
+
+    carry_out, limbs = lax.scan(step, jnp.zeros_like(zt[0]), zt)
+    return jnp.moveaxis(limbs, 0, -1), carry_out
+
+
+def fe_canonical(z):
+    """Unique canonical representative: limbs in [0,255], value < p.
+    Accepts |limb| <= 2^13 (the bias keeps everything positive). Uses
+    exact scans — called only a handful of times per verification, so the
+    sequential ripple is irrelevant to throughput."""
+    z = z + jnp.asarray(BIAS_LIMBS)
+    for _ in range(3):
+        z, c = _exact_carry(z)
+        z = z.at[..., 0].add(38 * c)
+    # Fold bit 255 (weight === 19 mod p); twice for the wrap-into-[2^255,
+    # 2^255+19) edge.
+    for _ in range(2):
+        hi = z[..., 31] >> 7
+        z = z.at[..., 31].add(-(hi << 7))
+        z = z.at[..., 0].add(19 * hi)
+        z, _ = _exact_carry(z)
+    # Conditional subtract p. Here z has byte limbs and z < 2^255, so
+    # z >= p iff limb0 >= 237 and limbs 1..30 == 255 and limb31 == 127 —
+    # and then z - p is in [0, 19), i.e. just limb0 - 237.
+    ge = (
+        (z[..., 0] >= 237)
+        & jnp.all(z[..., 1:31] == 255, axis=-1)
+        & (z[..., 31] == 127)
+    )
+    sub = jnp.zeros_like(z).at[..., 0].set(z[..., 0] - 237)
+    return jnp.where(ge[..., None], sub, z)
+
+
+def fe_is_zero(z):
+    """Boolean mask (shape = batch shape): z === 0 mod p."""
+    return jnp.all(fe_canonical(z) == 0, axis=-1)
+
+
+def fe_eq(x, y):
+    return fe_is_zero(fe_sub(x, y))
+
+
+def fe_select(mask, x, y):
+    """mask ? x : y, with mask of batch shape (broadcast over limbs)."""
+    return jnp.where(mask[..., None], x, y)
+
+
+def _pow2k(x, k: int):
+    """x^(2^k) via a fori_loop so exponentiation chains trace one square
+    body instead of k copies (compile-time control)."""
+    from jax import lax as _lax
+
+    if k <= 2:
+        for _ in range(k):
+            x = fe_square(x)
+        return x
+    return _lax.fori_loop(0, k, lambda _, v: fe_square(v), x)
+
+
+def fe_pow_p58(z):
+    """z^((p-5)/8) = z^(2^252 - 3), standard curve25519 addition chain."""
+    z2 = fe_square(z)  # 2
+    z4 = fe_square(z2)  # 4
+    z8 = fe_square(z4)  # 8
+    z9 = fe_mul(z8, z)  # 9
+    z11 = fe_mul(z9, z2)  # 11
+    z22 = fe_square(z11)  # 22
+    z_5_0 = fe_mul(z22, z9)  # 2^5 - 1
+    z_10_0 = fe_mul(_pow2k(z_5_0, 5), z_5_0)  # 2^10 - 1
+    z_20_0 = fe_mul(_pow2k(z_10_0, 10), z_10_0)  # 2^20 - 1
+    z_40_0 = fe_mul(_pow2k(z_20_0, 20), z_20_0)  # 2^40 - 1
+    z_50_0 = fe_mul(_pow2k(z_40_0, 10), z_10_0)  # 2^50 - 1
+    z_100_0 = fe_mul(_pow2k(z_50_0, 50), z_50_0)  # 2^100 - 1
+    z_200_0 = fe_mul(_pow2k(z_100_0, 100), z_100_0)  # 2^200 - 1
+    z_250_0 = fe_mul(_pow2k(z_200_0, 50), z_50_0)  # 2^250 - 1
+    return fe_mul(_pow2k(z_250_0, 2), z)  # 2^252 - 3
+
+
+def fe_invert(z):
+    """z^(p-2) = z^(2^255 - 21): reuse the p58 chain structure."""
+    z2 = fe_square(z)
+    z4 = fe_square(z2)
+    z8 = fe_square(z4)
+    z9 = fe_mul(z8, z)
+    z11 = fe_mul(z9, z2)
+    z22 = fe_square(z11)
+    z_5_0 = fe_mul(z22, z9)
+    z_10_0 = fe_mul(_pow2k(z_5_0, 5), z_5_0)
+    z_20_0 = fe_mul(_pow2k(z_10_0, 10), z_10_0)
+    z_40_0 = fe_mul(_pow2k(z_20_0, 20), z_20_0)
+    z_50_0 = fe_mul(_pow2k(z_40_0, 10), z_10_0)
+    z_100_0 = fe_mul(_pow2k(z_50_0, 50), z_50_0)
+    z_200_0 = fe_mul(_pow2k(z_100_0, 100), z_100_0)
+    z_250_0 = fe_mul(_pow2k(z_200_0, 50), z_50_0)
+    return fe_mul(_pow2k(z_250_0, 5), z11)  # 2^255 - 21
