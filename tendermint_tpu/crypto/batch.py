@@ -1,0 +1,26 @@
+"""Key-type -> BatchVerifier dispatch (ref: crypto/batch/batch.go:12-33).
+
+This is the seam the verification layer (types/validation) plugs into:
+ed25519 and sr25519 support batching; secp256k1 falls back to serial
+verification at the caller (types/validation.go:267 semantics).
+"""
+
+from __future__ import annotations
+
+from . import BatchVerifier, PubKey
+from .ed25519 import KEY_TYPE as ED25519_TYPE
+from .ed25519 import Ed25519BatchVerifier
+
+
+def create_batch_verifier(pk: PubKey) -> BatchVerifier:
+    """ref: CreateBatchVerifier crypto/batch/batch.go:12."""
+    if pk.type_name == ED25519_TYPE:
+        return Ed25519BatchVerifier()
+    raise ValueError(f"key type {pk.type_name} does not support batch verification")
+
+
+def supports_batch_verifier(pk: PubKey | None) -> bool:
+    """ref: SupportsBatchVerifier crypto/batch/batch.go:26."""
+    if pk is None:
+        return False
+    return pk.type_name == ED25519_TYPE

@@ -1,0 +1,122 @@
+"""ed25519 keys + TPU-backed batch verifier (ref: crypto/ed25519/ed25519.go).
+
+Key/signature formats match the reference exactly: 32-byte pubkeys,
+64-byte privkeys (seed || pubkey), 64-byte signatures, address =
+SHA256(pubkey)[:20]. Single verification uses ZIP-215 semantics
+(ed25519.go:24-31); batch verification routes through the JAX kernel
+(ops/verify.py) — data-parallel cofactored checks, identical acceptance.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import BatchVerifier, PrivKey, PubKey, address_hash
+from . import ed25519_ref as ref
+
+KEY_TYPE = "ed25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64
+SIG_SIZE = 64
+
+
+class Ed25519PubKey(PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes, got {len(data)}")
+        self._bytes = bytes(data)
+
+    def address(self) -> bytes:
+        return address_hash(self._bytes)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIG_SIZE:
+            return False
+        return _single_verify(self._bytes, msg, sig)
+
+    @property
+    def type_name(self) -> str:
+        return KEY_TYPE
+
+    def __repr__(self):
+        return f"PubKeyEd25519{{{self._bytes.hex().upper()}}}"
+
+
+class Ed25519PrivKey(PrivKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIVKEY_SIZE:
+            raise ValueError(f"ed25519 privkey must be {PRIVKEY_SIZE} bytes, got {len(data)}")
+        self._bytes = bytes(data)
+
+    @classmethod
+    def generate(cls, seed: bytes | None = None) -> "Ed25519PrivKey":
+        return cls(ref.gen_privkey(seed))
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        return ref.sign(self._bytes, msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(self._bytes[32:])
+
+    @property
+    def type_name(self) -> str:
+        return KEY_TYPE
+
+
+def _use_device() -> bool:
+    """Batch verification backend: the JAX kernel unless explicitly
+    disabled (TM_TPU_CRYPTO=off forces the pure-Python oracle — the
+    equivalent of the reference running without its batch path)."""
+    return os.environ.get("TM_TPU_CRYPTO", "on") != "off"
+
+
+def _single_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    return ref.verify(pub, msg, sig, zip215=True)
+
+
+class Ed25519BatchVerifier(BatchVerifier):
+    """Accumulate jobs, verify in one device launch (ref: BatchVerifier
+    crypto/ed25519/ed25519.go:198-233; acceptance is byte-identical, and
+    unlike the reference the per-signature bitmap needs no serial
+    re-verification pass)."""
+
+    def __init__(self):
+        self._pks: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def __len__(self):
+        return len(self._sigs)
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        pk = pub_key.bytes()
+        if len(pk) != PUBKEY_SIZE:
+            raise ValueError("invalid pubkey size")
+        if len(sig) != SIG_SIZE:
+            raise ValueError("invalid signature size")
+        self._pks.append(pk)
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        n = len(self._sigs)
+        if n == 0:
+            return False, []
+        if _use_device():
+            from ..ops import verify as dev
+
+            bitmap = dev.verify_batch(self._pks, self._msgs, self._sigs)
+            bools = [bool(b) for b in bitmap]
+        else:
+            bools = [_single_verify(p, m, s) for p, m, s in zip(self._pks, self._msgs, self._sigs)]
+        return all(bools), bools

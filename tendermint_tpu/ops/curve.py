@@ -171,6 +171,7 @@ def variable_base_mul(s_bytes, p):
         return point_add(acc, _select_from_table(table, nib))
 
     acc0 = jnp.broadcast_to(identity_point(), batch + (4, 32)).astype(jnp.int32)
+    acc0 = acc0 + 0 * s_bytes[..., :1, None]  # shard_map vma consistency
     # First window without the leading doublings (acc is identity).
     acc0 = point_add(acc0, _select_from_table(table, nibbles[..., 63]))
     return lax.fori_loop(1, _NIBBLES, body, acc0)
@@ -218,6 +219,9 @@ def fixed_base_mul(s_bytes):
         return point_add(acc, entry)
 
     acc0 = jnp.broadcast_to(identity_point(), batch + (4, 32)).astype(jnp.int32)
+    # Tie the carry to the input so it carries the same varying-manual-axes
+    # type as the loop body output under shard_map.
+    acc0 = acc0 + 0 * s_bytes[..., :1, None]
     return lax.fori_loop(0, _NIBBLES, body, acc0)
 
 

@@ -1,0 +1,105 @@
+"""Batched ed25519 verification (the north-star kernel).
+
+Replaces curve25519-voi's randomized batch equation
+(ref: crypto/ed25519/ed25519.go:198-233) with a TPU-native design: every
+signature's cofactored ZIP-215 equation
+
+    [8]([s]B - [k]A - R) == identity,  k = SHA512(R || A || M) mod L
+
+is evaluated data-parallel across the batch. This is deterministic (no
+Z-randomizers), yields the per-signature validity bitmap directly (the
+reference needs a serial re-verify pass to find bad indices —
+types/validation.go:245-255), and accepts exactly the same signatures.
+
+Split of labor:
+  host   — SHA-512 challenges (cheap vs curve math), s < L range check,
+           input shaping/padding
+  device — point decompression, double-scalar multiplication, cofactor
+           clearing, identity test: one fused XLA program
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import curve as C
+
+L = 2**252 + 27742317777372353535851937790883648493
+
+
+def verify_kernel_impl(a_enc, r_enc, s_bytes, k_bytes):
+    """Device kernel: (B, 32) int32 byte arrays -> (B,) bool validity.
+
+    a_enc/r_enc are raw encodings (ZIP-215 decoding on device); s_bytes
+    must be pre-checked < L on host; k_bytes is the SHA-512 challenge
+    already reduced mod L.
+    """
+    a_pt, a_ok = C.decompress(a_enc, zip215=True)
+    r_pt, r_ok = C.decompress(r_enc, zip215=True)
+    sb = C.fixed_base_mul(s_bytes)  # [s]B
+    ka = C.variable_base_mul(k_bytes, a_pt)  # [k]A
+    q = C.point_add(C.point_add(sb, C.point_neg(ka)), C.point_neg(r_pt))
+    q = C.point_double(C.point_double(C.point_double(q)))  # clear cofactor
+    return a_ok & r_ok & C.point_is_identity(q)
+
+
+verify_kernel = jax.jit(verify_kernel_impl)
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+def prepare_batch(pubkeys, msgs, sigs):
+    """Host-side shaping: returns (a_enc, r_enc, s_bytes, k_bytes,
+    precheck) numpy arrays of shape (B, 32)/(B,). Malformed inputs fail
+    precheck instead of raising (callers map them to invalid)."""
+    n = len(sigs)
+    a_enc = np.zeros((n, 32), np.int32)
+    r_enc = np.zeros((n, 32), np.int32)
+    s_bytes = np.zeros((n, 32), np.int32)
+    k_bytes = np.zeros((n, 32), np.int32)
+    precheck = np.zeros((n,), bool)
+    for i in range(n):
+        pk, msg, sig = pubkeys[i], msgs[i], sigs[i]
+        if len(pk) != 32 or len(sig) != 64:
+            continue
+        s = int.from_bytes(sig[32:], "little")
+        if s >= L:
+            continue
+        k = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
+        a_enc[i] = np.frombuffer(pk, np.uint8)
+        r_enc[i] = np.frombuffer(sig[:32], np.uint8)
+        s_bytes[i] = np.frombuffer(sig[32:], np.uint8)
+        k_bytes[i] = np.frombuffer(int.to_bytes(k, 32, "little"), np.uint8)
+        precheck[i] = True
+    return a_enc, r_enc, s_bytes, k_bytes, precheck
+
+
+def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
+    """End-to-end batched verification. Returns (n,) bool numpy array.
+
+    Batches are padded to the next power of two (with a self-consistent
+    dummy job) so jit caches a small set of program shapes.
+    """
+    n = len(sigs)
+    if n == 0:
+        return np.zeros((0,), bool)
+    a_enc, r_enc, s_bytes, k_bytes, precheck = prepare_batch(pubkeys, msgs, sigs)
+    size = _pad_pow2(n)
+    if size != n:
+        pad = size - n
+        a_enc = np.pad(a_enc, ((0, pad), (0, 0)))
+        r_enc = np.pad(r_enc, ((0, pad), (0, 0)))
+        s_bytes = np.pad(s_bytes, ((0, pad), (0, 0)))
+        k_bytes = np.pad(k_bytes, ((0, pad), (0, 0)))
+    ok = np.asarray(verify_kernel(jnp.asarray(a_enc), jnp.asarray(r_enc), jnp.asarray(s_bytes), jnp.asarray(k_bytes)))
+    return ok[:n] & precheck

@@ -1,0 +1,8 @@
+"""Mesh / sharding layer.
+
+Multi-chip scaling of the verification plane: validator signatures for a
+height are sharded across a `jax.sharding.Mesh` batch axis, each chip
+verifies its shard, and verdicts are AND-reduced over ICI with `psum`
+(SURVEY.md §5.8: the TPU-native analog of the reference's communication
+backend for the compute plane).
+"""

@@ -1,0 +1,98 @@
+"""Batched TPU-kernel verification vs the oracle, incl. ZIP-215 edges and
+the sharded multi-device path."""
+
+import secrets
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.crypto.batch import create_batch_verifier, supports_batch_verifier
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
+from tendermint_tpu.ops import verify as V
+
+
+def make_jobs(n, tamper_idx=()):
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = ref.gen_privkey(secrets.token_bytes(32))
+        msg = b"block-vote-%d" % i + secrets.token_bytes(16)
+        sig = ref.sign(priv, msg)
+        if i in tamper_idx:
+            sig = sig[:10] + bytes([sig[10] ^ 0xFF]) + sig[11:]
+        pks.append(priv[32:])
+        msgs.append(msg)
+        sigs.append(sig)
+    return pks, msgs, sigs
+
+
+def test_verify_batch_all_valid():
+    pks, msgs, sigs = make_jobs(5)
+    got = V.verify_batch(pks, msgs, sigs)
+    assert got.all()
+
+
+def test_verify_batch_bad_indices():
+    pks, msgs, sigs = make_jobs(7, tamper_idx={2, 5})
+    got = V.verify_batch(pks, msgs, sigs)
+    for i in range(7):
+        assert bool(got[i]) == (i not in {2, 5}), i
+
+
+def test_verify_batch_matches_oracle_on_edges():
+    # s >= L rejected; small-order pubkeys accepted per ZIP-215; garbage
+    # encodings rejected — all must match the oracle exactly.
+    pks, msgs, sigs = make_jobs(2)
+    # s + L malleability
+    s = int.from_bytes(sigs[0][32:], "little")
+    sigs.append(sigs[0][:32] + int.to_bytes(s + ref.L, 32, "little"))
+    pks.append(pks[0])
+    msgs.append(msgs[0])
+    # small-order pubkey, identity R, s = 0 (valid under cofactored eq)
+    so = ref.small_order_points()[1]
+    pks.append(so)
+    msgs.append(b"anything")
+    sigs.append(ref.compress(ref.IDENTITY) + b"\x00" * 32)
+    # non-point pubkey
+    y = 2
+    while ref.decompress(int.to_bytes(y, 32, "little")) is not None:
+        y += 1
+    pks.append(int.to_bytes(y, 32, "little"))
+    msgs.append(b"x")
+    sigs.append(sigs[0])
+    got = V.verify_batch(pks, msgs, sigs)
+    want = [ref.verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+    assert [bool(b) for b in got] == want
+    assert want == [True, True, False, True, False]
+
+
+def test_batch_verifier_interface():
+    pks, msgs, sigs = make_jobs(4, tamper_idx={1})
+    bv = create_batch_verifier(Ed25519PubKey(pks[0]))
+    for p, m, s in zip(pks, msgs, sigs):
+        bv.add(Ed25519PubKey(p), m, s)
+    all_ok, bitmap = bv.verify()
+    assert not all_ok
+    assert bitmap == [True, False, True, True]
+    assert supports_batch_verifier(Ed25519PubKey(pks[0]))
+
+
+def test_single_verify_pubkey():
+    priv = Ed25519PrivKey.generate()
+    msg = b"hello"
+    sig = priv.sign(msg)
+    assert priv.pub_key().verify_signature(msg, sig)
+    assert not priv.pub_key().verify_signature(msg + b"!", sig)
+    assert len(priv.pub_key().address()) == 20
+
+
+def test_sharded_verify_8_devices():
+    import jax
+
+    from tendermint_tpu.parallel import sharded_verify as S
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    mesh = S.make_mesh()
+    pks, msgs, sigs = make_jobs(19, tamper_idx={3})
+    bitmap, all_valid = S.verify_batch_sharded(mesh, pks, msgs, sigs)
+    assert not all_valid
+    assert [bool(b) for b in bitmap] == [i != 3 for i in range(19)]
+    bitmap2, all_valid2 = S.verify_batch_sharded(mesh, *make_jobs(8))
+    assert all_valid2 and bitmap2.all()

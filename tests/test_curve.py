@@ -1,0 +1,145 @@
+"""Curve ops vs the pure-Python oracle."""
+
+import secrets
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import curve as C
+from tendermint_tpu.ops import field as F
+
+_jdecomp = jax.jit(lambda e: C.decompress(e, zip215=True))
+_jdecomp_strict = jax.jit(lambda e: C.decompress(e, zip215=False))
+_jvarmul = jax.jit(C.variable_base_mul)
+_jfixmul = jax.jit(C.fixed_base_mul)
+_jcompress = jax.jit(C.compress)
+
+
+def enc_to_dev(enc: bytes):
+    return jnp.asarray(np.frombuffer(enc, dtype=np.uint8).astype(np.int32)[None, :])
+
+
+def scalar_to_dev(s: int):
+    return jnp.asarray(np.array([[(s >> (8 * i)) & 0xFF for i in range(32)]], dtype=np.int32))
+
+
+def dev_point_to_affine(p):
+    arr = np.asarray(p)[0]
+    x = F.limbs_to_int(arr[0]) % ref.P
+    y = F.limbs_to_int(arr[1]) % ref.P
+    z = F.limbs_to_int(arr[2]) % ref.P
+    zinv = pow(z, ref.P - 2, ref.P)
+    return (x * zinv % ref.P, y * zinv % ref.P)
+
+
+def ref_affine(p):
+    x, y, z, _ = p
+    zinv = pow(z, ref.P - 2, ref.P)
+    return (x * zinv % ref.P, y * zinv % ref.P)
+
+
+def test_decompress_random_points():
+    for _ in range(4):
+        k = secrets.randbelow(ref.L)
+        enc = ref.compress(ref.scalar_mult(k, ref.BASE))
+        pt, ok = _jdecomp(enc_to_dev(enc))
+        assert bool(ok[0])
+        want = ref_affine(ref.decompress(enc))
+        assert dev_point_to_affine(pt) == want
+
+
+def test_decompress_invalid():
+    # y with no valid x (scan for a non-point encoding)
+    y = 2
+    while ref.decompress(int.to_bytes(y, 32, "little")) is not None:
+        y += 1
+    enc = int.to_bytes(y, 32, "little")
+    _, ok = _jdecomp(enc_to_dev(enc))
+    assert not bool(ok[0])
+
+
+def test_decompress_zip215_edges():
+    # non-canonical y (>= p) accepted in zip215, rejected strict
+    enc = int.to_bytes(ref.P + 1, 32, "little")
+    if ref.decompress(enc) is not None:
+        _, ok = _jdecomp(enc_to_dev(enc))
+        assert bool(ok[0])
+        _, ok2 = _jdecomp_strict(enc_to_dev(enc))
+        assert not bool(ok2[0])
+    # small-order points accepted in both (canonical encodings)
+    for enc in ref.small_order_points():
+        pt, ok = _jdecomp(enc_to_dev(enc))
+        assert bool(ok[0]), enc.hex()
+        assert dev_point_to_affine(pt) == ref_affine(ref.decompress(enc))
+
+
+def test_point_add_matches_oracle():
+    a = ref.scalar_mult(12345, ref.BASE)
+    b = ref.scalar_mult(98765, ref.BASE)
+    pa, _ = _jdecomp(enc_to_dev(ref.compress(a)))
+    pb, _ = _jdecomp(enc_to_dev(ref.compress(b)))
+    got = jax.jit(C.point_add)(pa, pb)
+    assert dev_point_to_affine(got) == ref_affine(ref.point_add(a, b))
+
+
+def test_variable_base_mul():
+    for _ in range(3):
+        k = secrets.randbelow(ref.L)
+        s = secrets.randbelow(ref.L)
+        base = ref.scalar_mult(k, ref.BASE)
+        pt, _ = _jdecomp(enc_to_dev(ref.compress(base)))
+        got = _jvarmul(scalar_to_dev(s), pt)
+        want = ref_affine(ref.scalar_mult(s, base))
+        assert dev_point_to_affine(got) == want
+
+
+def test_variable_base_mul_edge_scalars():
+    base = ref.scalar_mult(777, ref.BASE)
+    pt, _ = _jdecomp(enc_to_dev(ref.compress(base)))
+    for s in [0, 1, 2, 15, 16, 255, 256, ref.L - 1, 8 * ref.L, 2**256 - 1]:
+        got = _jvarmul(scalar_to_dev(s % 2**256), pt)
+        want_pt = ref.scalar_mult(s % 2**256, base)
+        if ref.point_is_identity(want_pt):
+            assert bool(jax.jit(C.point_is_identity)(got)[0])
+        else:
+            assert dev_point_to_affine(got) == ref_affine(want_pt), s
+
+
+def test_fixed_base_mul():
+    for s in [0, 1, 2, 16, secrets.randbelow(ref.L), ref.L - 1]:
+        got = _jfixmul(scalar_to_dev(s))
+        want_pt = ref.scalar_mult(s, ref.BASE)
+        if ref.point_is_identity(want_pt):
+            assert bool(jax.jit(C.point_is_identity)(got)[0])
+        else:
+            assert dev_point_to_affine(got) == ref_affine(want_pt), s
+
+
+def test_compress_roundtrip():
+    k = secrets.randbelow(ref.L)
+    enc = ref.compress(ref.scalar_mult(k, ref.BASE))
+    pt, _ = _jdecomp(enc_to_dev(enc))
+    out = np.asarray(_jcompress(pt))[0]
+    assert bytes(out.astype(np.uint8)) == enc
+
+
+def test_batched_ops():
+    ks = [3, 5, 7, 11]
+    encs = np.stack(
+        [np.frombuffer(ref.compress(ref.scalar_mult(k, ref.BASE)), dtype=np.uint8).astype(np.int32) for k in ks]
+    )
+    pts, ok = _jdecomp(jnp.asarray(encs))
+    assert ok.shape == (4,) and bool(ok.all())
+    ss = np.stack([np.array([(s >> (8 * i)) & 0xFF for i in range(32)], dtype=np.int32) for s in [2, 3, 4, 5]])
+    got = _jvarmul(jnp.asarray(ss), pts)
+    for i, (k, s) in enumerate(zip(ks, [2, 3, 4, 5])):
+        arr = np.asarray(got)[i]
+        x = F.limbs_to_int(arr[0]) % ref.P
+        y = F.limbs_to_int(arr[1]) % ref.P
+        z = F.limbs_to_int(arr[2]) % ref.P
+        zinv = pow(z, ref.P - 2, ref.P)
+        want = ref_affine(ref.scalar_mult(k * s, ref.BASE))
+        assert (x * zinv % ref.P, y * zinv % ref.P) == want
