@@ -1,0 +1,98 @@
+"""North-star benchmark: ed25519 batch-verify sigs/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The measured path is the full device pipeline (ops/verify.py):
+decompression + [s]B - [k]A - R + cofactor clear for every signature,
+with host-side SHA-512 challenge prep excluded from neither side — both
+the TPU path and the CPU baseline verify the same (pubkey, msg, sig)
+triples end to end.
+
+The CPU baseline is a native single-signature verifier loop: the
+`cryptography` package's Ed25519 (OpenSSL) if available — the closest
+stand-in for the reference's Go curve25519-voi serial path
+(crypto/ed25519/ed25519.go Verify) — else the pure-Python oracle.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+CPU_SAMPLE = 256
+
+
+def make_jobs(n):
+    from tendermint_tpu.crypto import ed25519_ref as ref
+
+    pks, msgs, sigs = [], [], []
+    sk = ref.gen_privkey(b"\x42" * 32)
+    pk = sk[32:]
+    for i in range(n):
+        msg = b"bench-commit-vote-%d" % i
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(ref.sign(sk, msg))
+    return pks, msgs, sigs
+
+
+def bench_device(pks, msgs, sigs):
+    from tendermint_tpu.ops import verify as V
+
+    # Warm-up launch compiles the program; measure steady state.
+    V.verify_batch(pks, msgs, sigs)
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        bitmap = V.verify_batch(pks, msgs, sigs)
+    dt = (time.perf_counter() - t0) / iters
+    assert bool(bitmap.all()), "device rejected valid signatures"
+    return len(sigs) / dt
+
+
+def bench_cpu(pks, msgs, sigs):
+    n = min(CPU_SAMPLE, len(sigs))
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+        from cryptography.exceptions import InvalidSignature
+
+        keys = [Ed25519PublicKey.from_public_bytes(pk) for pk in pks[:n]]
+        t0 = time.perf_counter()
+        for key, m, s in zip(keys, msgs[:n], sigs[:n]):
+            try:
+                key.verify(s, m)
+            except InvalidSignature:
+                raise AssertionError("cpu baseline rejected valid signature")
+        dt = time.perf_counter() - t0
+    except ImportError:
+        from tendermint_tpu.crypto import ed25519_ref as ref
+
+        n = min(32, n)
+        t0 = time.perf_counter()
+        for pk, m, s in zip(pks[:n], msgs[:n], sigs[:n]):
+            assert ref.verify(pk, m, s, zip215=True)
+        dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    pks, msgs, sigs = make_jobs(BATCH)
+    device_rate = bench_device(pks, msgs, sigs)
+    cpu_rate = bench_cpu(pks, msgs, sigs)
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(device_rate, 1),
+                "unit": "sigs/sec/chip",
+                "vs_baseline": round(device_rate / cpu_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Proto PublicKey <-> domain PubKey codec (ref: crypto/encoding/codec.go)."""
+
+from __future__ import annotations
+
+from ..proto import messages as pb
+from . import PubKey
+from .ed25519 import Ed25519PubKey
+
+
+def pubkey_to_proto(pk: PubKey) -> pb.PublicKey:
+    if pk.type_name == "ed25519":
+        return pb.PublicKey(ed25519=pk.bytes())
+    raise ValueError(f"unsupported key type {pk.type_name}")
+
+
+def pubkey_from_proto(p: pb.PublicKey) -> PubKey:
+    name, data = p.sum
+    if name == "ed25519":
+        return Ed25519PubKey(data)
+    raise ValueError(f"unsupported proto pubkey arm {name!r}")

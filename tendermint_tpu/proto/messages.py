@@ -329,6 +329,90 @@ class CanonicalVoteExtension(Message):
     ]
 
 
+# -- consensus params (proto/tendermint/types/params.proto) ---------------
+
+
+class BlockParamsProto(Message):
+    fields = [
+        Field(1, "int64", "max_bytes"),
+        Field(2, "int64", "max_gas"),
+    ]
+
+
+class EvidenceParamsProto(Message):
+    fields = [
+        Field(1, "int64", "max_age_num_blocks"),
+        Field(2, "message", "max_age_duration", msg_cls=lambda: Duration),  # google.protobuf.Duration
+        Field(3, "int64", "max_bytes"),
+    ]
+
+
+class ValidatorParamsProto(Message):
+    fields = [Field(1, "string", "pub_key_types", repeated=True)]
+
+
+class VersionParamsProto(Message):
+    fields = [Field(1, "uint64", "app_version")]
+
+
+class Duration(Message):
+    """google.protobuf.Duration."""
+
+    fields = [
+        Field(1, "int64", "seconds"),
+        Field(2, "int32", "nanos"),
+    ]
+
+    def to_ns(self) -> int:
+        return (self.seconds or 0) * 1_000_000_000 + (self.nanos or 0)
+
+    @classmethod
+    def from_ns(cls, ns: int) -> "Duration":
+        return cls(seconds=ns // 1_000_000_000, nanos=ns % 1_000_000_000)
+
+
+class SynchronyParamsProto(Message):
+    """Field numbers per params.proto:78-85: message_delay=1, precision=2."""
+
+    fields = [
+        Field(1, "message", "message_delay", msg_cls=Duration),
+        Field(2, "message", "precision", msg_cls=Duration),
+    ]
+
+
+class TimeoutParamsProto(Message):
+    fields = [
+        Field(1, "message", "propose", msg_cls=Duration),
+        Field(2, "message", "propose_delta", msg_cls=Duration),
+        Field(3, "message", "vote", msg_cls=Duration),
+        Field(4, "message", "vote_delta", msg_cls=Duration),
+        Field(5, "message", "commit", msg_cls=Duration),
+        Field(6, "bool", "bypass_commit_timeout"),
+    ]
+
+
+class ABCIParamsProto(Message):
+    fields = [
+        Field(1, "int64", "vote_extensions_enable_height"),
+        Field(2, "bool", "recheck_tx"),
+    ]
+
+
+class ConsensusParamsUpdate(Message):
+    """tendermint.types.ConsensusParams as sent over ABCI (nullable sections,
+    ref: proto/tendermint/types/params.proto)."""
+
+    fields = [
+        Field(1, "message", "block", msg_cls=BlockParamsProto),
+        Field(2, "message", "evidence", msg_cls=EvidenceParamsProto),
+        Field(3, "message", "validator", msg_cls=ValidatorParamsProto),
+        Field(4, "message", "version", msg_cls=VersionParamsProto),
+        Field(5, "message", "synchrony", msg_cls=SynchronyParamsProto),
+        Field(6, "message", "timeout", msg_cls=TimeoutParamsProto),
+        Field(7, "message", "abci", msg_cls=ABCIParamsProto),
+    ]
+
+
 # -- evidence (proto/tendermint/types/evidence.proto) ---------------------
 
 

@@ -1,0 +1,196 @@
+"""Commit verification — the north-star path (ref: types/validation.go).
+
+All four consumers (block application, blocksync, light client, evidence)
+funnel here. Semantics preserved exactly from the reference:
+  - batch path for >=2 signatures with a batch-capable key type (:12-16)
+  - tally-before-verify with the voting-power check preceding the
+    signature check (:237)
+  - early-break once power exceeds the threshold when not counting all
+    signatures (:225-233)
+  - first-invalid-index reporting on batch failure (:245-255)
+  - by-address lookup + double-vote detection for the trusting path
+    (:190-210)
+
+The batch verifier itself is the TPU plane (crypto/ed25519.py ->
+ops/verify.py): one device launch evaluates every signature's cofactored
+ZIP-215 equation data-parallel, so unlike the reference no serial
+re-verification pass is needed to locate a bad signature.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from ..crypto import batch as crypto_batch
+from .block import BlockID, Commit, CommitSig
+from .validator_set import NotEnoughVotingPowerError, ValidatorSet
+
+# ref: types/validation.go:12
+BATCH_VERIFY_THRESHOLD = 2
+
+
+@dataclass(frozen=True)
+class Fraction:
+    """ref: libs/math/fraction.go."""
+
+    numerator: int
+    denominator: int
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    """ref: shouldBatchVerify (types/validation.go:14)."""
+    if len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
+        return False
+    proposer = vals.get_proposer()
+    return proposer is not None and crypto_batch.supports_batch_verifier(proposer.pub_key)
+
+
+def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit) -> None:
+    """Verify +2/3 signed AND check every signature (ref: VerifyCommit,
+    types/validation.go:27 — all signatures are checked because apps'
+    incentivization logic depends on LastCommitInfo)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.block_id_flag == 1  # absent
+    count = lambda c: c.block_id_flag == 2  # commit
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(chain_id, vals, commit, voting_power_needed, ignore, count, True, True)
+    else:
+        _verify_commit_single(chain_id, vals, commit, voting_power_needed, ignore, count, True, True)
+
+
+def verify_commit_light(chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit) -> None:
+    """Verify +2/3 signed, early-exit once reached (ref: VerifyCommitLight,
+    types/validation.go:61)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.block_id_flag != 2
+    count = lambda c: True
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(chain_id, vals, commit, voting_power_needed, ignore, count, False, True)
+    else:
+        _verify_commit_single(chain_id, vals, commit, voting_power_needed, ignore, count, False, True)
+
+
+def verify_commit_light_trusting(chain_id: str, vals: ValidatorSet, commit: Commit, trust_level: Fraction) -> None:
+    """Verify trustLevel of an arbitrary validator set signed, looking
+    validators up by address (ref: VerifyCommitLightTrusting,
+    types/validation.go:96)."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if trust_level.denominator == 0:
+        raise ValueError("trustLevel has zero Denominator")
+    if commit is None:
+        raise ValueError("nil commit")
+    product = vals.total_voting_power() * trust_level.numerator
+    if product >= 2**63:
+        raise OverflowError("int64 overflow while calculating voting power needed")
+    voting_power_needed = product // trust_level.denominator
+    ignore = lambda c: c.block_id_flag != 2
+    count = lambda c: True
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(chain_id, vals, commit, voting_power_needed, ignore, count, False, False)
+    else:
+        _verify_commit_single(chain_id, vals, commit, voting_power_needed, ignore, count, False, False)
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """ref: verifyCommitBatch (types/validation.go:154)."""
+    proposer = vals.get_proposer()
+    bv = crypto_batch.create_batch_verifier(proposer.pub_key)
+    tallied = 0
+    seen_vals: dict[int, int] = {}
+    batch_sig_idxs: list[int] = []
+
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(f"double vote from {val} ({seen_vals[val_idx]} and {idx})")
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        batch_sig_idxs.append(idx)
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
+
+    ok, valid_sigs = bv.verify()
+    if ok:
+        return
+    for i, sig_ok in enumerate(valid_sigs):
+        if not sig_ok:
+            idx = batch_sig_idxs[i]
+            sig = commit.signatures[idx].signature
+            raise ValueError(f"wrong signature (#{idx}): {sig.hex().upper()}")
+    raise RuntimeError("BUG: batch verification failed with no invalid signatures")
+
+
+def _verify_commit_single(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """ref: verifyCommitSingle (types/validation.go:267)."""
+    tallied = 0
+    seen_vals: dict[int, int] = {}
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(f"double vote from {val} ({seen_vals[val_idx]} and {idx})")
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        if not val.pub_key.verify_signature(vote_sign_bytes, commit_sig.signature):
+            raise ValueError(f"wrong signature (#{idx}): {commit_sig.signature.hex().upper()}")
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
+
+
+def _verify_basic_vals_and_commit(vals: ValidatorSet, commit: Commit, height: int, block_id: BlockID) -> None:
+    """ref: verifyBasicValsAndCommit (types/validation.go:328)."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if commit is None:
+        raise ValueError("nil commit")
+    if vals.size() != len(commit.signatures):
+        raise ValueError(f"invalid commit -- wrong set size: {vals.size()} vs {len(commit.signatures)}")
+    if height != commit.height:
+        raise ValueError(f"invalid commit -- wrong height: {height} vs {commit.height}")
+    if block_id != commit.block_id:
+        raise ValueError(f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}")

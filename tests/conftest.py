@@ -19,6 +19,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+# The container's sitecustomize pre-imports jax and registers the axon TPU
+# backend before conftest runs, so the env vars above are too late for the
+# already-initialized process. Force the platform through jax.config and
+# drop any initialized backends so jax.devices() re-resolves to the
+# 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb  # noqa: E402
+
+    _xb._clear_backends()
+except Exception:
+    pass
+
 # Persistent compilation cache: the crypto kernels are compile-heavy.
 jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

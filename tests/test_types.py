@@ -1,0 +1,289 @@
+"""Core-types parity tests.
+
+Golden vectors transcribed from the reference's tests
+(types/block_test.go:352 TestHeaderHash, types/validator_set_test.go:193
+TestProposerSelection1/2) — behavioral parity, not code translation.
+"""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.crypto import address_hash
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Fraction,
+    Header,
+    NotEnoughVotingPowerError,
+    PartSetHeader,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from tendermint_tpu.types import PRECOMMIT
+from tendermint_tpu.utils.tmtime import Time
+
+
+def sha(s: bytes) -> bytes:
+    return hashlib.sha256(s).digest()
+
+
+def test_header_hash_golden():
+    # ref: types/block_test.go:358-373
+    h = Header(
+        version_block=1,
+        version_app=2,
+        chain_id="chainId",
+        height=3,
+        time=Time.parse_rfc3339("2019-10-13T16:14:44Z"),
+        last_block_id=BlockID(hash=b"\x00" * 32, part_set_header=PartSetHeader(total=6, hash=b"\x00" * 32)),
+        last_commit_hash=sha(b"last_commit_hash"),
+        data_hash=sha(b"data_hash"),
+        validators_hash=sha(b"validators_hash"),
+        next_validators_hash=sha(b"next_validators_hash"),
+        consensus_hash=sha(b"consensus_hash"),
+        app_hash=sha(b"app_hash"),
+        last_results_hash=sha(b"last_results_hash"),
+        evidence_hash=sha(b"evidence_hash"),
+        proposer_address=address_hash(b"proposer_address"),
+    )
+    assert h.hash().hex().upper() == "F740121F553B5418C3EFBD343C2DBFE9E007BB67B0D020A0741374BAB65242A4"
+
+
+def test_header_hash_nil_validators_hash():
+    h = Header(chain_id="c", height=1)
+    assert h.hash() is None
+
+
+def _val(addr: bytes, power: int) -> Validator:
+    return Validator(address=addr, pub_key=None, voting_power=power)
+
+
+def test_proposer_selection_1():
+    # ref: types/validator_set_test.go:193-213
+    vset = ValidatorSet.new([_val(b"foo", 1000), _val(b"bar", 300), _val(b"baz", 330)])
+    proposers = []
+    for _ in range(99):
+        proposers.append(vset.get_proposer().address.decode())
+        vset.increment_proposer_priority(1)
+    expected = (
+        "foo baz foo bar foo foo baz foo bar foo foo baz foo foo bar foo baz foo foo bar"
+        " foo foo baz foo bar foo foo baz foo bar foo foo baz foo foo bar foo baz foo foo bar"
+        " foo baz foo foo bar foo baz foo foo bar foo baz foo foo foo baz bar foo foo foo baz"
+        " foo bar foo foo baz foo bar foo foo baz foo bar foo foo baz foo bar foo foo baz foo"
+        " foo bar foo baz foo foo bar foo baz foo foo bar foo baz foo foo"
+    )
+    assert " ".join(proposers) == expected
+
+
+def test_proposer_selection_2():
+    # ref: types/validator_set_test.go:215-252
+    addr0, addr1, addr2 = (bytes(19) + bytes([i]) for i in range(3))
+
+    # Equal powers: proposers rotate in address order.
+    vals = ValidatorSet.new([_val(addr0, 100), _val(addr1, 100), _val(addr2, 100)])
+    order = [addr0, addr1, addr2]
+    for i in range(15):
+        assert vals.get_proposer().address == order[i % 3]
+        vals.increment_proposer_priority(1)
+
+    # One stronger validator proposes first but not twice in a row.
+    vals = ValidatorSet.new([_val(addr0, 100), _val(addr1, 100), _val(addr2, 400)])
+    assert vals.get_proposer().address == addr2
+    vals.increment_proposer_priority(1)
+    assert vals.get_proposer().address == addr0
+
+    # Strong enough to go twice in a row.
+    vals = ValidatorSet.new([_val(addr0, 100), _val(addr1, 100), _val(addr2, 401)])
+    assert vals.get_proposer().address == addr2
+    vals.increment_proposer_priority(1)
+    assert vals.get_proposer().address == addr2
+
+
+def test_validator_set_update_and_hash():
+    pk1 = Ed25519PrivKey.generate(b"\x01" * 32).pub_key()
+    pk2 = Ed25519PrivKey.generate(b"\x02" * 32).pub_key()
+    pk3 = Ed25519PrivKey.generate(b"\x03" * 32).pub_key()
+    vset = ValidatorSet.new([Validator.new(pk1, 10), Validator.new(pk2, 20)])
+    assert vset.total_voting_power() == 30
+    h1 = vset.hash()
+    assert len(h1) == 32
+
+    # Add a validator.
+    vset.update_with_change_set([Validator.new(pk3, 5)])
+    assert vset.size() == 3
+    assert vset.total_voting_power() == 35
+    assert vset.hash() != h1
+
+    # Sorted by descending power then address.
+    powers = [v.voting_power for v in vset.validators]
+    assert powers == sorted(powers, reverse=True)
+
+    # Remove one.
+    vset.update_with_change_set([Validator.new(pk1, 0)])
+    assert vset.size() == 2
+    assert not vset.has_address(pk1.address())
+
+    # Removing everyone fails.
+    with pytest.raises(ValueError):
+        vset.update_with_change_set([Validator.new(pk2, 0), Validator.new(pk3, 0)])
+
+
+def _make_validators(n, power=100):
+    privs = [Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    vals = [Validator.new(p.pub_key(), power) for p in privs]
+    vset = ValidatorSet.new(vals)
+    # Order privs to match the sorted set.
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs_sorted = [by_addr[v.address] for v in vset.validators]
+    return vset, privs_sorted
+
+
+def _make_commit(chain_id, vset, privs, height=10, round_=1, block_hash=b"\xaa" * 32):
+    block_id = BlockID(hash=block_hash, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    vote_set = VoteSet(chain_id, height, round_, PRECOMMIT, vset)
+    ts = Time.parse_rfc3339("2024-01-02T03:04:05Z")
+    for i, (val, priv) in enumerate(zip(vset.validators, privs)):
+        vote = Vote(
+            type=PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=ts,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        vote.signature = priv.sign(vote.sign_bytes(chain_id))
+        assert vote_set.add_vote(vote)
+    assert vote_set.has_two_thirds_majority()
+    return block_id, vote_set.make_commit()
+
+
+@pytest.fixture(autouse=True)
+def _oracle_crypto(monkeypatch):
+    # Types tests exercise verification semantics, not the device kernel
+    # (tests/test_batch_verify.py covers that); the oracle keeps them fast.
+    monkeypatch.setenv("TM_TPU_CRYPTO", "off")
+
+
+def test_verify_commit_roundtrip():
+    vset, privs = _make_validators(4)
+    block_id, commit = _make_commit("test-chain", vset, privs)
+    verify_commit("test-chain", vset, block_id, 10, commit)
+    verify_commit_light("test-chain", vset, block_id, 10, commit)
+    verify_commit_light_trusting("test-chain", vset, commit, Fraction(1, 3))
+
+
+def test_verify_commit_wrong_sig():
+    vset, privs = _make_validators(4)
+    block_id, commit = _make_commit("test-chain", vset, privs)
+    commit.signatures[2].signature = b"\x01" * 64
+    with pytest.raises(ValueError, match=r"wrong signature \(#2\)"):
+        verify_commit("test-chain", vset, block_id, 10, commit)
+
+
+def test_verify_commit_insufficient_power():
+    vset, privs = _make_validators(4)
+    block_id, commit = _make_commit("test-chain", vset, privs)
+    # Mark two of four absent: 50% < 2/3.
+    commit.signatures[0] = CommitSig.new_absent()
+    commit.signatures[1] = CommitSig.new_absent()
+    with pytest.raises(NotEnoughVotingPowerError):
+        verify_commit("test-chain", vset, block_id, 10, commit)
+
+
+def test_verify_commit_basic_mismatches():
+    vset, privs = _make_validators(4)
+    block_id, commit = _make_commit("test-chain", vset, privs)
+    with pytest.raises(ValueError, match="wrong height"):
+        verify_commit("test-chain", vset, block_id, 11, commit)
+    with pytest.raises(ValueError, match="wrong block ID"):
+        verify_commit("test-chain", vset, BlockID(hash=b"\xcc" * 32, part_set_header=block_id.part_set_header), 10, commit)
+
+
+def test_vote_set_conflicting_vote():
+    from tendermint_tpu.types import ConflictingVoteError
+
+    vset, privs = _make_validators(3)
+    chain_id = "test-chain"
+    vote_set = VoteSet(chain_id, 5, 0, PRECOMMIT, vset)
+    bid_a = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    bid_b = BlockID(hash=b"\xcc" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xdd" * 32))
+    ts = Time.parse_rfc3339("2024-01-02T03:04:05Z")
+
+    def mkvote(idx, bid):
+        v = Vote(
+            type=PRECOMMIT,
+            height=5,
+            round=0,
+            block_id=bid,
+            timestamp=ts,
+            validator_address=vset.validators[idx].address,
+            validator_index=idx,
+        )
+        v.signature = privs[idx].sign(v.sign_bytes(chain_id))
+        return v
+
+    assert vote_set.add_vote(mkvote(0, bid_a))
+    # Same vote again: not added, no error.
+    assert vote_set.add_vote(mkvote(0, bid_a)) is False
+    # Conflicting vote: raises with both votes attached.
+    with pytest.raises(ConflictingVoteError) as ei:
+        vote_set.add_vote(mkvote(0, bid_b))
+    assert ei.value.vote_a.block_id == bid_a
+    assert ei.value.vote_b.block_id == bid_b
+
+
+def test_block_hash_and_partset_roundtrip():
+    vset, privs = _make_validators(4)
+    block_id, commit = _make_commit("test-chain", vset, privs, height=9)
+    block = Block(
+        header=Header(
+            version_block=11,
+            chain_id="test-chain",
+            height=10,
+            time=Time.parse_rfc3339("2024-01-02T03:04:06Z"),
+            last_block_id=block_id,
+            validators_hash=vset.hash(),
+            next_validators_hash=vset.hash(),
+            consensus_hash=b"\x11" * 32,
+            app_hash=b"",
+            proposer_address=vset.validators[0].address,
+        ),
+        txs=[b"tx-one", b"tx-two"],
+        last_commit=commit,
+    )
+    h = block.hash()
+    assert h is not None and len(h) == 32
+    block.validate_basic()
+
+    # Part-set split / reassemble / proof-check round trip.
+    ps = block.make_part_set(64)
+    assert ps.is_complete()
+    from tendermint_tpu.types.part_set import PartSet
+
+    ps2 = PartSet(ps.header)
+    for i in range(ps.total()):
+        ps2.add_part(ps.get_part(i))
+    assert ps2.is_complete()
+    block2 = Block.decode(ps2.get_data())
+    assert block2.hash() == h
+    assert block2.txs == [b"tx-one", b"tx-two"]
+    assert block2.last_commit.hash() == commit.hash()
+
+
+def test_commit_vote_sign_bytes_matches_vote():
+    vset, privs = _make_validators(2)
+    chain_id = "sb-chain"
+    block_id, commit = _make_commit(chain_id, vset, privs, height=3, round_=2)
+    for i in range(2):
+        vote = commit.get_vote(i)
+        assert commit.vote_sign_bytes(chain_id, i) == Vote.from_proto(vote).sign_bytes(chain_id)
