@@ -1,0 +1,4 @@
+"""ABCI++ boundary (ref: abci/)."""
+
+from .client import Client, LocalClient  # noqa: F401
+from .types import Application, BaseApplication  # noqa: F401

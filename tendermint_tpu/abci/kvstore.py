@@ -1,0 +1,230 @@
+"""KVStore example application — the universal test fixture
+(ref: abci/example/kvstore/kvstore.go, persistent_kvstore.go).
+
+Semantics preserved: txs are "key=value" (or raw bytes meaning k=v=tx),
+"val:base64pubkey!power" validator-set updates, app state = {size,
+height, app_hash} JSON blob under stateKey, app hash = 8-byte varint of
+size, equivocation slashing of -1 power in FinalizeBlock.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+
+from ..store.kv import KVStore, MemDB
+from . import types as abci
+
+STATE_KEY = b"stateKey"
+KV_PAIR_PREFIX_KEY = b"kvPairKey:"
+VALIDATOR_PREFIX = "val:"
+PROTOCOL_VERSION = 0x1
+
+CODE_TYPE_ENCODING_ERROR = 1
+CODE_TYPE_BAD_NONCE = 2
+CODE_TYPE_UNAUTHORIZED = 3
+CODE_TYPE_EXECUTED = 5
+
+
+def _put_varint(n: int) -> bytes:
+    """Go binary.PutVarint zigzag encoding into an 8-byte buffer
+    (ref: kvstore.go:201-203 AppHash layout)."""
+    ux = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    out = bytearray()
+    while ux >= 0x80:
+        out.append((ux & 0x7F) | 0x80)
+        ux >>= 7
+    out.append(ux)
+    out.extend(b"\x00" * (8 - len(out)))
+    return bytes(out[:8])
+
+
+def prefix_key(key: bytes) -> bytes:
+    return KV_PAIR_PREFIX_KEY + key
+
+
+class KVStoreApplication(abci.Application):
+    """ref: kvstore.Application (abci/example/kvstore/kvstore.go:74)."""
+
+    def __init__(self, db: KVStore | None = None, retain_blocks: int = 0):
+        self._mu = threading.Lock()
+        self.db = db if db is not None else MemDB()
+        self.retain_blocks = retain_blocks
+        self.size = 0
+        self.height = 0
+        self.app_hash = b""
+        self.val_updates: list[abci.ValidatorUpdate] = []
+        self.val_addr_to_pubkey: dict[bytes, tuple[str, bytes]] = {}
+        self._load_state()
+
+    # ------------------------------------------------------------ state io
+
+    def _load_state(self) -> None:
+        raw = self.db.get(STATE_KEY)
+        if not raw:
+            return
+        doc = json.loads(raw)
+        self.size = doc.get("size", 0)
+        self.height = doc.get("height", 0)
+        self.app_hash = base64.b64decode(doc.get("app_hash") or "")
+        for k, v in self.db.iterator(b"val:", b"val;"):
+            self.val_addr_to_pubkey[self._pub_to_addr(k[4:])] = ("ed25519", k[4:])
+            _ = v
+
+    def _save_state(self) -> None:
+        doc = {
+            "size": self.size,
+            "height": self.height,
+            "app_hash": base64.b64encode(self.app_hash).decode(),
+        }
+        self.db.set(STATE_KEY, json.dumps(doc).encode())
+
+    @staticmethod
+    def _pub_to_addr(pub: bytes) -> bytes:
+        from ..crypto.ed25519 import Ed25519PubKey
+
+        return Ed25519PubKey(pub).address()
+
+    # ------------------------------------------------------------ abci
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        with self._mu:
+            return abci.ResponseInfo(
+                data='{"size":%d}' % self.size,
+                version="0.17.0",
+                app_version=PROTOCOL_VERSION,
+                last_block_height=self.height,
+                last_block_app_hash=self.app_hash,
+            )
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        with self._mu:
+            for v in req.validators:
+                r = self._update_validator(v)
+                if r.code != abci.CODE_TYPE_OK:
+                    raise RuntimeError(f"problem updating validators: {r.log}")
+            return abci.ResponseInitChain()
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def finalize_block(self, req: abci.RequestFinalizeBlock) -> abci.ResponseFinalizeBlock:
+        with self._mu:
+            self.val_updates = []
+            for ev in req.misbehavior:
+                if ev.type == abci.MISBEHAVIOR_DUPLICATE_VOTE:
+                    entry = self.val_addr_to_pubkey.get(ev.validator.address)
+                    if entry is None:
+                        raise RuntimeError(f"wanted to punish val {ev.validator.address.hex()} but can't find it")
+                    self._update_validator(
+                        abci.ValidatorUpdate(pub_key_type=entry[0], pub_key_bytes=entry[1], power=ev.validator.power - 1)
+                    )
+            tx_results = [self._handle_tx(tx) for tx in req.txs]
+            self.app_hash = _put_varint(self.size)
+            self.height += 1
+            return abci.ResponseFinalizeBlock(
+                tx_results=tx_results,
+                validator_updates=list(self.val_updates),
+                app_hash=self.app_hash,
+            )
+
+    def commit(self) -> abci.ResponseCommit:
+        with self._mu:
+            self._save_state()
+            resp = abci.ResponseCommit()
+            if self.retain_blocks > 0 and self.height >= self.retain_blocks:
+                resp.retain_height = self.height - self.retain_blocks + 1
+            return resp
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        with self._mu:
+            if req.path == "/val":
+                value = self.db.get(b"val:" + req.data)
+                return abci.ResponseQuery(key=req.data, value=value or b"")
+            value = self.db.get(prefix_key(req.data))
+            resp = abci.ResponseQuery(
+                key=req.data, value=value or b"", height=self.height,
+                log="exists" if value is not None else "does not exist",
+            )
+            return resp
+
+    # ------------------------------------------------------------ tx exec
+
+    def _handle_tx(self, tx: bytes) -> abci.ExecTxResult:
+        """ref: kvstore.go:121 handleTx."""
+        if tx.startswith(VALIDATOR_PREFIX.encode()):
+            return self._exec_validator_tx(tx)
+        parts = tx.split(b"=")
+        if len(parts) == 2:
+            key, value = parts[0], parts[1]
+        else:
+            key, value = tx, tx
+        self.db.set(prefix_key(key), value)
+        self.size += 1
+        events = [
+            abci.Event(
+                type="app",
+                attributes=[
+                    abci.EventAttribute("creator", "Cosmoshi Netowoko", True),
+                    abci.EventAttribute("key", key.decode("utf-8", "replace"), True),
+                    abci.EventAttribute("index_key", "index is working", True),
+                    abci.EventAttribute("noindex_key", "index is working", False),
+                ],
+            )
+        ]
+        return abci.ExecTxResult(code=abci.CODE_TYPE_OK, events=events)
+
+    def _exec_validator_tx(self, tx: bytes) -> abci.ExecTxResult:
+        """ref: kvstore.go:343 execValidatorTx — "val:base64pubkey!power"."""
+        body = tx[len(VALIDATOR_PREFIX):]
+        parts = body.split(b"!")
+        if len(parts) != 2:
+            return abci.ExecTxResult(
+                code=CODE_TYPE_ENCODING_ERROR,
+                log=f"Expected 'pubkey!power'. Got {body!r}",
+            )
+        pub_s, power_s = parts
+        try:
+            pub = base64.b64decode(pub_s, validate=True)
+        except Exception:
+            return abci.ExecTxResult(code=CODE_TYPE_ENCODING_ERROR, log=f"Pubkey ({pub_s!r}) is invalid base64")
+        try:
+            power = int(power_s)
+        except ValueError:
+            return abci.ExecTxResult(code=CODE_TYPE_ENCODING_ERROR, log=f"Power ({power_s!r}) is not an int")
+        return self._update_validator(abci.ValidatorUpdate(pub_key_type="ed25519", pub_key_bytes=pub, power=power))
+
+    def _update_validator(self, v: abci.ValidatorUpdate) -> abci.ExecTxResult:
+        """ref: kvstore.go:380 updateValidator — tracked in the merkle tree
+        under val:pubkeybytes and in val_updates for the block response."""
+        key = b"val:" + v.pub_key_bytes
+        addr = self._pub_to_addr(v.pub_key_bytes)
+        if v.power == 0:
+            if not self.db.has(key):
+                pub_str = base64.b64encode(v.pub_key_bytes).decode()
+                return abci.ExecTxResult(
+                    code=CODE_TYPE_UNAUTHORIZED,
+                    log=f"Cannot remove non-existent validator {pub_str}",
+                )
+            self.db.delete(key)
+            self.val_addr_to_pubkey.pop(addr, None)
+        else:
+            self.db.set(key, str(v.power).encode())
+            self.val_addr_to_pubkey[addr] = (v.pub_key_type, v.pub_key_bytes)
+        self.val_updates = [u for u in self.val_updates if u.pub_key_bytes != v.pub_key_bytes]
+        self.val_updates.append(v)
+        return abci.ExecTxResult(code=abci.CODE_TYPE_OK)
+
+    def validators(self) -> list[abci.ValidatorUpdate]:
+        """Current validator set from the tree (ref: kvstore.go:306)."""
+        out = []
+        with self._mu:
+            for k, v in self.db.iterator(b"val:", b"val;"):
+                out.append(abci.ValidatorUpdate(pub_key_type="ed25519", pub_key_bytes=k[4:], power=int(v)))
+        return out
+
+
+def make_validator_tx(pub_key_bytes: bytes, power: int) -> bytes:
+    """ref: kvstore.go:334 MakeValSetChangeTx."""
+    return b"val:" + base64.b64encode(pub_key_bytes) + b"!" + str(power).encode()

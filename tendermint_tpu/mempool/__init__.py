@@ -1,0 +1,3 @@
+"""Mempool (ref: internal/mempool/)."""
+
+from .mempool import LRUTxCache, TxInCacheError, TxMempool, WrappedTx, tx_key  # noqa: F401

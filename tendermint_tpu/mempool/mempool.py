@@ -1,0 +1,294 @@
+"""TxMempool — concurrent priority mempool
+(ref: internal/mempool/mempool.go:36-700).
+
+Semantics preserved: CheckTx gates admission and assigns priority/gas
+from the app's response; LRU cache dedups seen txs (cache.go:35);
+ReapMaxBytesMaxGas returns txs in priority order (mempool.go:325);
+Update removes committed txs and re-checks the remainder; TxsAvailable
+fires once per height when the pool becomes non-empty.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..abci import types as abci
+from ..abci.client import Client
+
+
+def tx_key(tx: bytes) -> bytes:
+    """ref: types.Tx.Key — SHA-256 of the raw tx."""
+    return hashlib.sha256(tx).digest()
+
+
+@dataclass
+class WrappedTx:
+    """ref: internal/mempool/tx.go WrappedTx."""
+
+    tx: bytes
+    key: bytes
+    height: int  # height when added
+    priority: int = 0
+    gas_wanted: int = 0
+    sender: str = ""
+    timestamp: float = 0.0
+    peers: set = field(default_factory=set)  # peer IDs that sent us this tx
+
+
+class LRUTxCache:
+    """Fixed-size LRU of tx keys (ref: internal/mempool/cache.go:35)."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def push(self, key: bytes) -> bool:
+        """Returns False if already present (and refreshes recency)."""
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self._size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, key: bytes) -> None:
+        with self._lock:
+            self._map.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._map
+
+    def reset(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+class TxMempool:
+    """ref: mempool.TxMempool (internal/mempool/mempool.go:36)."""
+
+    def __init__(
+        self,
+        app_client: Client,
+        size: int = 5000,
+        max_tx_bytes: int = 1024 * 1024,
+        max_txs_bytes: int = 1024 * 1024 * 1024,
+        cache_size: int = 10000,
+        keep_invalid_txs_in_cache: bool = False,
+        post_check=None,
+    ):
+        self._app = app_client
+        self._size = size
+        self._max_tx_bytes = max_tx_bytes
+        self._max_txs_bytes = max_txs_bytes
+        self._cache = LRUTxCache(cache_size)
+        self._keep_invalid = keep_invalid_txs_in_cache
+        self._post_check = post_check
+
+        self._mtx = threading.RLock()
+        self._txs: dict[bytes, WrappedTx] = {}  # key -> wtx, insertion-ordered
+        self._height = 0
+        self._total_bytes = 0
+        self._seq = 0  # FIFO tiebreak within equal priority
+        self._order: dict[bytes, int] = {}
+
+        self._txs_available_cond = threading.Condition(self._mtx)
+        self._notified_txs_available = False
+        self._txs_available_enabled = False
+
+    # -------------------------------------------------------- properties
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._txs)
+
+    def total_bytes(self) -> int:
+        with self._mtx:
+            return self._total_bytes
+
+    def is_full(self, tx_size: int) -> Exception | None:
+        with self._mtx:
+            if len(self._txs) >= self._size or tx_size + self._total_bytes > self._max_txs_bytes:
+                return RuntimeError(
+                    f"mempool is full: number of txs {len(self._txs)} (max: {self._size}), "
+                    f"total txs bytes {self._total_bytes} (max: {self._max_txs_bytes})"
+                )
+        return None
+
+    def lock(self):
+        self._mtx.acquire()
+
+    def unlock(self):
+        self._mtx.release()
+
+    def flush(self) -> None:
+        with self._mtx:
+            self._txs.clear()
+            self._order.clear()
+            self._total_bytes = 0
+            self._cache.reset()
+
+    def enable_txs_available(self) -> None:
+        """ref: EnableTxsAvailable — consensus subscribes to the signal."""
+        with self._mtx:
+            self._txs_available_enabled = True
+
+    def wait_txs_available(self, timeout: float | None = None) -> bool:
+        with self._txs_available_cond:
+            if self._txs and self._notified_txs_available:
+                return True
+            return self._txs_available_cond.wait(timeout)
+
+    def _notify_txs_available(self) -> None:
+        if self._txs and self._txs_available_enabled and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available_cond.notify_all()
+
+    # ----------------------------------------------------------- checktx
+
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        """Admission path (ref: CheckTx mempool.go:175). Raises on
+        oversize/full/duplicate; returns the app's response otherwise."""
+        if len(tx) > self._max_tx_bytes:
+            raise ValueError(f"tx size {len(tx)} exceeds max {self._max_tx_bytes}")
+        err = self.is_full(len(tx))
+        if err is not None:
+            raise err
+        key = tx_key(tx)
+        if not self._cache.push(key):
+            # record the alternate sender for gossip routing (mempool.go:233)
+            with self._mtx:
+                wtx = self._txs.get(key)
+                if wtx is not None and sender:
+                    wtx.peers.add(sender)
+            raise TxInCacheError()
+        res = self._app.check_tx(abci.RequestCheckTx(tx=tx, type=0))
+        if res.is_ok:
+            with self._mtx:
+                wtx = WrappedTx(
+                    tx=tx,
+                    key=key,
+                    height=self._height,
+                    priority=res.priority,
+                    gas_wanted=res.gas_wanted,
+                    sender=sender or res.sender,
+                )
+                if sender:
+                    wtx.peers.add(sender)
+                self._insert(wtx)
+                self._notify_txs_available()
+        else:
+            if not self._keep_invalid:
+                self._cache.remove(key)
+        return res
+
+    def _insert(self, wtx: WrappedTx) -> None:
+        if wtx.key in self._txs:
+            return
+        self._txs[wtx.key] = wtx
+        self._seq += 1
+        self._order[wtx.key] = self._seq
+        self._total_bytes += len(wtx.tx)
+
+    def _remove(self, key: bytes) -> None:
+        wtx = self._txs.pop(key, None)
+        if wtx is not None:
+            self._order.pop(key, None)
+            self._total_bytes -= len(wtx.tx)
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        with self._mtx:
+            if key not in self._txs:
+                raise KeyError("transaction not found in mempool")
+            self._remove(key)
+            self._cache.remove(key)
+
+    def get_tx(self, key: bytes) -> bytes | None:
+        with self._mtx:
+            wtx = self._txs.get(key)
+            return wtx.tx if wtx else None
+
+    def all_txs(self) -> list[WrappedTx]:
+        """Insertion-ordered snapshot (for gossip walkers)."""
+        with self._mtx:
+            return list(self._txs.values())
+
+    # -------------------------------------------------------------- reap
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        """Priority-ordered reap under byte/gas budgets
+        (ref: ReapMaxBytesMaxGas mempool.go:325)."""
+        with self._mtx:
+            ordered = sorted(self._txs.values(), key=lambda w: (-w.priority, self._order[w.key]))
+            out: list[bytes] = []
+            total_bytes = 0
+            total_gas = 0
+            for wtx in ordered:
+                if max_bytes > -1 and total_bytes + len(wtx.tx) > max_bytes:
+                    break
+                gas = total_gas + wtx.gas_wanted
+                if max_gas > -1 and gas > max_gas:
+                    break
+                total_gas = gas
+                total_bytes += len(wtx.tx)
+                out.append(wtx.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        with self._mtx:
+            ordered = sorted(self._txs.values(), key=lambda w: (-w.priority, self._order[w.key]))
+            if n < 0:
+                n = len(ordered)
+            return [w.tx for w in ordered[:n]]
+
+    # ------------------------------------------------------------ update
+
+    def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        tx_results: list[abci.ExecTxResult],
+        recheck: bool = True,
+    ) -> None:
+        """Post-commit bookkeeping (ref: Update mempool.go:594): drop
+        committed txs (cache valid ones), then re-CheckTx survivors.
+        Caller must hold the mempool lock (BlockExecutor.Commit does)."""
+        self._height = height
+        self._notified_txs_available = False
+        for tx, res in zip(txs, tx_results):
+            key = tx_key(tx)
+            if res.is_ok:
+                self._cache.push(key)  # committed: keep in cache to reject replays
+            elif not self._keep_invalid:
+                self._cache.remove(key)
+            if key in self._txs:
+                self._remove(key)
+        if recheck and self._txs:
+            self._recheck_txs()
+        self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        """ref: updateReCheckTxs mempool.go:675 — re-run CheckTx(Recheck)
+        on every remaining tx, evicting newly-invalid ones."""
+        for wtx in list(self._txs.values()):
+            res = self._app.check_tx(abci.RequestCheckTx(tx=wtx.tx, type=1))
+            if not res.is_ok:
+                self._remove(wtx.key)
+                if not self._keep_invalid:
+                    self._cache.remove(wtx.key)
+            else:
+                wtx.priority = res.priority
+                wtx.gas_wanted = res.gas_wanted
+
+
+class TxInCacheError(Exception):
+    """ref: types.ErrTxInCache."""
+
+    def __str__(self):
+        return "tx already exists in cache"

@@ -1,0 +1,6 @@
+"""State execution layer (ref: internal/state/)."""
+
+from .execution import BlockExecutor, tx_results_hash  # noqa: F401
+from .state import State, make_genesis_state  # noqa: F401
+from .store import StateStore  # noqa: F401
+from .validation import InvalidBlockError, validate_block  # noqa: F401

@@ -1,0 +1,3 @@
+"""Persistence layer (ref: internal/store/, tm-db)."""
+
+from .kv import Batch, FileDB, KVStore, MemDB  # noqa: F401

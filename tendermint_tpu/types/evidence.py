@@ -219,3 +219,36 @@ def evidence_from_proto(p: pb.Evidence) -> Evidence:
     if p.light_client_attack_evidence is not None:
         return LightClientAttackEvidence.from_proto(p.light_client_attack_evidence)
     raise ValueError("evidence is not recognized")
+
+
+def evidence_to_abci(evidence: list) -> list:
+    """Convert evidence to ABCI Misbehavior records
+    (ref: EvidenceList.ToABCI / Evidence.ABCI(), types/evidence.go:70,300)."""
+    from ..abci import types as abci
+
+    out = []
+    for ev in evidence:
+        if isinstance(ev, DuplicateVoteEvidence):
+            out.append(
+                abci.Misbehavior(
+                    type=abci.MISBEHAVIOR_DUPLICATE_VOTE,
+                    validator=abci.Validator(address=ev.vote_a.validator_address, power=ev.validator_power),
+                    height=ev.vote_a.height,
+                    time_ns=ev.timestamp.unix_ns(),
+                    total_voting_power=ev.total_voting_power,
+                )
+            )
+        elif isinstance(ev, LightClientAttackEvidence):
+            for val in ev.byzantine_validators:
+                out.append(
+                    abci.Misbehavior(
+                        type=abci.MISBEHAVIOR_LIGHT_CLIENT_ATTACK,
+                        validator=abci.Validator(address=val.address, power=val.voting_power),
+                        height=ev.common_height,
+                        time_ns=ev.timestamp.unix_ns(),
+                        total_voting_power=ev.total_voting_power,
+                    )
+                )
+        else:
+            raise TypeError(f"evidence is not recognized: {type(ev)}")
+    return out

@@ -1,0 +1,78 @@
+"""Shared fixtures: deterministic validators, signed commits, genesis docs
+(ref: the randValidator/makeCommit helpers in types/test_util.go and
+internal/consensus/common_test.go)."""
+
+from __future__ import annotations
+
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.types.block import (
+    BLOCK_ID_FLAG_COMMIT,
+    BlockID,
+    Commit,
+    CommitSig,
+    PartSetHeader,
+)
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.types.vote import PRECOMMIT, Vote
+from tendermint_tpu.utils.tmtime import Time
+
+
+def make_keys(n: int) -> list[Ed25519PrivKey]:
+    return [Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+
+
+def make_validator_set(keys: list[Ed25519PrivKey], power: int = 10) -> ValidatorSet:
+    vals = [
+        Validator(address=k.pub_key().address(), pub_key=k.pub_key(), voting_power=power)
+        for k in keys
+    ]
+    return ValidatorSet.new(vals)
+
+
+def make_block_id(h: bytes = b"\x01" * 32, total: int = 1, ps_hash: bytes = b"\x02" * 32) -> BlockID:
+    return BlockID(hash=h, part_set_header=PartSetHeader(total=total, hash=ps_hash))
+
+
+def sign_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    keys: list[Ed25519PrivKey],
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    time: Time | None = None,
+) -> Commit:
+    """Every validator precommits block_id (ref: types/test_util.go
+    makeCommit)."""
+    t = time or Time.now()
+    by_addr = {k.pub_key().address(): k for k in keys}
+    sigs = []
+    for idx, val in enumerate(vals.validators):
+        key = by_addr.get(val.address)
+        if key is None:
+            sigs.append(CommitSig.new_absent())
+            continue
+        vote = Vote(
+            type=PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=t,
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        sig = key.sign(vote.sign_bytes(chain_id))
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, val.address, t, sig))
+    return Commit(height=height, round=round_, block_id=block_id, signatures=sigs)
+
+
+def make_genesis_doc(keys: list[Ed25519PrivKey], chain_id: str = "test-chain", power: int = 10) -> GenesisDoc:
+    return GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=Time.from_unix_ns(1_700_000_000 * 10**9),
+        validators=[
+            GenesisValidator(address=k.pub_key().address(), pub_key=k.pub_key(), power=power, name=f"v{i}")
+            for i, k in enumerate(keys)
+        ],
+    )

@@ -1,0 +1,153 @@
+"""ABCI application + kvstore fixture tests (ref: abci/example/kvstore/kvstore_test.go)."""
+
+import base64
+import os
+
+from tendermint_tpu.abci import LocalClient
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.kvstore import KVStoreApplication, make_validator_tx
+from tendermint_tpu.store.kv import FileDB, MemDB
+
+
+def finalize(app, txs, height=1):
+    return app.finalize_block(abci.RequestFinalizeBlock(txs=txs, height=height))
+
+
+def test_kv_roundtrip():
+    app = KVStoreApplication()
+    resp = finalize(app, [b"abc"])
+    assert len(resp.tx_results) == 1 and resp.tx_results[0].is_ok
+    app.commit()
+
+    q = app.query(abci.RequestQuery(path="/store", data=b"abc"))
+    assert q.value == b"abc"
+    assert q.log == "exists"
+
+    resp = finalize(app, [b"def=xyz"], height=2)
+    assert resp.tx_results[0].is_ok
+    app.commit()
+    q = app.query(abci.RequestQuery(path="/store", data=b"def"))
+    assert q.value == b"xyz"
+
+
+def test_app_hash_changes_with_size():
+    app = KVStoreApplication()
+    r1 = finalize(app, [b"a=1"])
+    r2 = finalize(app, [b"b=2"], height=2)
+    assert r1.app_hash != r2.app_hash
+    # empty block: size unchanged -> same app hash
+    r3 = finalize(app, [], height=3)
+    assert r3.app_hash == r2.app_hash
+
+
+def test_info_tracks_height():
+    app = KVStoreApplication()
+    finalize(app, [b"k=v"])
+    app.commit()
+    info = app.info(abci.RequestInfo())
+    assert info.last_block_height == 1
+    assert info.last_block_app_hash != b""
+
+
+def test_validator_updates():
+    app = KVStoreApplication()
+    pub = bytes(range(32))
+    resp = finalize(app, [make_validator_tx(pub, 10)])
+    assert resp.tx_results[0].is_ok, resp.tx_results[0].log
+    assert len(resp.validator_updates) == 1
+    assert resp.validator_updates[0].power == 10
+    vals = app.validators()
+    assert len(vals) == 1 and vals[0].pub_key_bytes == pub
+
+    # removal
+    resp = finalize(app, [make_validator_tx(pub, 0)], height=2)
+    assert resp.tx_results[0].is_ok
+    assert app.validators() == []
+
+    # removing a non-existent validator fails
+    resp = finalize(app, [make_validator_tx(b"\x99" * 32, 0)], height=3)
+    assert not resp.tx_results[0].is_ok
+
+
+def test_validator_tx_malformed():
+    app = KVStoreApplication()
+    resp = finalize(app, [b"val:notbase64!!10"])
+    assert not resp.tx_results[0].is_ok
+    resp = finalize(app, [b"val:" + base64.b64encode(b"\x01" * 32) + b"!ten"])
+    assert not resp.tx_results[0].is_ok
+
+
+def test_persistence(tmp_path):
+    path = os.path.join(tmp_path, "app.db")
+    db = FileDB(path)
+    app = KVStoreApplication(db=db)
+    finalize(app, [b"k=v", b"k2=v2"])
+    app.commit()
+    db.close()
+
+    db2 = FileDB(path)
+    app2 = KVStoreApplication(db=db2)
+    info = app2.info(abci.RequestInfo())
+    assert info.last_block_height == 1
+    q = app2.query(abci.RequestQuery(path="/store", data=b"k2"))
+    assert q.value == b"v2"
+
+
+def test_local_client_serializes():
+    app = KVStoreApplication()
+    cli = LocalClient(app)
+    assert cli.check_tx(abci.RequestCheckTx(tx=b"x")).is_ok
+    resp = cli.finalize_block(abci.RequestFinalizeBlock(txs=[b"x=1"], height=1))
+    assert resp.tx_results[0].is_ok
+    cli.commit()
+    assert cli.info(abci.RequestInfo()).last_block_height == 1
+
+
+def test_base_application_defaults():
+    app = abci.BaseApplication()
+    assert app.check_tx(abci.RequestCheckTx(tx=b"t")).is_ok
+    pp = app.prepare_proposal(abci.RequestPrepareProposal(max_tx_bytes=5, txs=[b"aaa", b"bbb", b"cc"]))
+    assert pp.txs == [b"aaa"]  # second tx exceeds budget
+    assert app.process_proposal(abci.RequestProcessProposal()).is_accepted
+    fb = app.finalize_block(abci.RequestFinalizeBlock(txs=[b"a", b"b"]))
+    assert len(fb.tx_results) == 2
+
+
+def test_memdb_ordered_iteration():
+    db = MemDB()
+    for k in [b"b", b"a", b"c", b"ab"]:
+        db.set(k, k.upper())
+    assert [k for k, _ in db.iterator()] == [b"a", b"ab", b"b", b"c"]
+    assert [k for k, _ in db.iterator(b"ab", b"c")] == [b"ab", b"b"]
+    assert [k for k, _ in db.reverse_iterator()] == [b"c", b"b", b"ab", b"a"]
+    db.delete(b"b")
+    assert [k for k, _ in db.iterator()] == [b"a", b"ab", b"c"]
+
+
+def test_filedb_crash_tail_truncation(tmp_path):
+    path = os.path.join(tmp_path, "t.db")
+    db = FileDB(path)
+    db.set(b"good", b"1")
+    db.close()
+    # simulate torn write
+    with open(path, "ab") as f:
+        f.write(b"\xde\xad\xbe")
+    db2 = FileDB(path)
+    assert db2.get(b"good") == b"1"
+    db2.set(b"more", b"2")
+    db2.close()
+    db3 = FileDB(path)
+    assert db3.get(b"more") == b"2"
+
+
+def test_filedb_compact(tmp_path):
+    path = os.path.join(tmp_path, "c.db")
+    db = FileDB(path)
+    for i in range(50):
+        db.set(b"k%d" % (i % 5), b"v%d" % i)
+    size_before = os.path.getsize(path)
+    db.compact()
+    assert os.path.getsize(path) < size_before
+    db.close()
+    db2 = FileDB(path)
+    assert db2.get(b"k4") == b"v49"

