@@ -116,6 +116,7 @@ class KVStoreApplication(abci.Application):
                 if ev.type == abci.MISBEHAVIOR_DUPLICATE_VOTE:
                     entry = self.val_addr_to_pubkey.get(ev.validator.address)
                     if entry is None:
+                        # The reference app panics here too (kvstore.go:186)
                         raise RuntimeError(f"wanted to punish val {ev.validator.address.hex()} but can't find it")
                     self._update_validator(
                         abci.ValidatorUpdate(pub_key_type=entry[0], pub_key_bytes=entry[1], power=ev.validator.power - 1)

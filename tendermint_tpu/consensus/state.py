@@ -1,0 +1,873 @@
+"""Consensus state machine — Tendermint BFT
+(ref: internal/consensus/state.go).
+
+Architecture preserved from the reference: ONE consumer thread
+(`receive_routine`) serializes peer messages, internal messages, and
+timeouts, writing each to the WAL before acting (fsync for the node's
+own messages). RoundState is owned exclusively by that thread — the
+single-goroutine discipline the reference calls out as a correctness
+feature (no locks in the hot path).
+
+Outbound messages (proposal, block parts, votes, step events) go
+through the `broadcast` hook; the reactor (or an in-process test
+harness) fans them out to peers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Callable
+
+from ..state.execution import BlockExecutor
+from ..state.state import State
+from ..types.block import BLOCK_PART_SIZE_BYTES, BlockID, Commit, PartSetHeader
+from ..types.part_set import PartSet
+from ..types.proposal import Proposal
+from ..types.vote import PRECOMMIT, PREVOTE, Vote
+from ..types.vote_set import ConflictingVoteError, VoteSet
+from ..utils.tmtime import Time
+from .messages import (
+    BlockPartMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from .round_state import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    HeightVoteSet,
+    RoundState,
+)
+from .ticker import TimeoutTicker
+from .wal import WAL, EndHeightMessage, EventRoundStep, MsgInfo, TimeoutInfo
+
+
+class ConsensusError(Exception):
+    pass
+
+
+class _NopWAL:
+    def write(self, msg):
+        pass
+
+    def write_sync(self, msg):
+        pass
+
+    def flush_and_sync(self):
+        pass
+
+    def close(self):
+        pass
+
+    def search_for_end_height(self, height):
+        return []
+
+
+class ConsensusState:
+    """ref: consensus.State (internal/consensus/state.go:123)."""
+
+    def __init__(
+        self,
+        state: State,
+        block_executor: BlockExecutor,
+        block_store,
+        priv_validator=None,
+        wal: WAL | None = None,
+        evidence_pool=None,
+        broadcast: Callable | None = None,
+        on_decided: Callable | None = None,
+        clock: Callable[[], Time] = Time.now,
+    ):
+        self.block_exec = block_executor
+        self.block_store = block_store
+        self.priv_validator = priv_validator
+        self.priv_pub_key = priv_validator.get_pub_key() if priv_validator else None
+        self.wal = wal if wal is not None else _NopWAL()
+        self.evpool = evidence_pool
+        self.broadcast = broadcast or (lambda msg: None)
+        self.on_decided = on_decided or (lambda height, block, block_id: None)
+        self.now = clock
+
+        self.rs = RoundState()
+        self.state = State()  # set by update_to_state
+        self.replay_mode = False
+
+        self._queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._internal_queue: queue.Queue = queue.Queue(maxsize=1000)
+        self.ticker = TimeoutTicker(self._tock)
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._n_steps = 0
+
+        self.update_to_state(state)
+        self._reconstruct_last_commit_if_needed(state)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self, replay: bool = True) -> None:
+        """Replay the WAL from the last height boundary, then launch the
+        consumer thread (ref: OnStart state.go:393 → catchupReplay)."""
+        if replay:
+            self._catchup_replay()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._receive_routine, daemon=True, name="consensus")
+        self._thread.start()
+        self._schedule_round_0()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.ticker.stop()
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.wal.flush_and_sync()
+
+    # ------------------------------------------------------------- inputs
+
+    def add_peer_message(self, msg, peer_id: str) -> None:
+        """Entry point for reactor-delivered messages (peerMsgQueue)."""
+        self._queue.put(MsgInfo(msg, peer_id))
+
+    def _send_internal(self, msg) -> None:
+        """ref: sendInternalMessage state.go — internal queue has
+        priority and is fsync'd in the WAL."""
+        self._internal_queue.put(MsgInfo(msg, ""))
+        self._queue.put(("internal",))  # wake the consumer
+
+    def _tock(self, ti: TimeoutInfo) -> None:
+        self._queue.put(ti)
+
+    def handle_txs_available(self) -> None:
+        """Mempool signal (ref: handleTxsAvailable state.go:1143).
+        With create-empty-blocks default-on, proposals don't wait for
+        txs, so this is a no-op wake."""
+
+    # -------------------------------------------------------- the routine
+
+    def _receive_routine(self) -> None:
+        """THE hot loop (ref: receiveRoutine state.go:888)."""
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+            try:
+                self._dispatch(item)
+            except Exception:
+                # ref: state.go:899 "CONSENSUS FAILURE!!!" — halt, don't
+                # limp along with corrupted round state.
+                traceback.print_exc()
+                self._stop.set()
+                raise
+
+    def _dispatch(self, item) -> None:
+        # Internal messages drain first (they carry our own votes).
+        if isinstance(item, tuple) and item and item[0] == "internal":
+            try:
+                mi = self._internal_queue.get_nowait()
+            except queue.Empty:
+                return
+            self.wal.write_sync(mi)  # fsync own messages (state.go:964)
+            self._handle_msg(mi)
+        elif isinstance(item, MsgInfo):
+            self.wal.write(item)
+            self._handle_msg(item)
+        elif isinstance(item, TimeoutInfo):
+            self.wal.write(item)
+            self._handle_timeout(item)
+
+    def process_all(self, timeout: float = 0.0) -> None:
+        """Synchronously drain pending inputs — used by replay and by
+        deterministic tests that drive the machine without the thread."""
+        while True:
+            try:
+                item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                return
+            if item is None:
+                return
+            self._dispatch(item)
+
+    def _handle_msg(self, mi: MsgInfo) -> None:
+        """ref: handleMsg (state.go:994)."""
+        msg, peer_id = mi.msg, mi.peer_id
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal, self.now())
+        elif isinstance(msg, BlockPartMessage):
+            added = self._add_proposal_block_part(msg)
+            if added and self.rs.proposal_block_parts.is_complete():
+                self._handle_complete_proposal(msg.height)
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """ref: handleTimeout (state.go:1089)."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (ti.round == rs.round and ti.step < rs.step):
+            return
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ConsensusError(f"invalid timeout step: {ti.step}")
+
+    # ------------------------------------------------------ state updates
+
+    def update_to_state(self, state: State) -> None:
+        """Reset RoundState for the next height (ref: updateToState
+        state.go:752)."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and rs.height != state.last_block_height:
+            raise ConsensusError(
+                f"updateToState() expected state height of {rs.height} but found {state.last_block_height}"
+            )
+        if not self.state.is_empty and self.state.last_block_height + 1 != rs.height and self.state.last_block_height > 0:
+            raise ConsensusError(
+                f"inconsistent cs.state.LastBlockHeight+1 {self.state.last_block_height + 1} vs cs.Height {rs.height}"
+            )
+        if not self.state.is_empty and state.last_block_height <= self.state.last_block_height:
+            self._new_step()
+            return
+
+        # LastCommit: the precommits that justified the block we just did
+        if state.last_block_height == 0:
+            last_commit = None
+        elif rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if not precommits.has_two_thirds_majority():
+                raise ConsensusError("wanted to form a commit, but precommits didn't have 2/3+")
+            last_commit = precommits
+        else:
+            last_commit = rs.last_commit  # reconstructed from seen commit
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        rs.height = height
+        rs.round = 0
+        rs.step = STEP_NEW_HEIGHT
+        commit_t = rs.commit_time if not rs.commit_time.is_zero() else self.now()
+        rs.start_time = commit_t.add(state.consensus_params.timeout.commit)
+        rs.validators = state.validators
+        rs.proposal = None
+        rs.proposal_receive_time = Time()
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, state.validators)
+        rs.commit_round = -1
+        rs.last_commit = last_commit
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.state = state
+        self._new_step()
+
+    def _reconstruct_last_commit_if_needed(self, state: State) -> None:
+        """Rebuild LastCommit VoteSet from the stored seen commit
+        (ref: reconstructLastCommit state.go:723)."""
+        if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        seen = self.block_store.load_seen_commit(state.last_block_height) if self.block_store else None
+        if seen is None:
+            raise ConsensusError(f"failed to reconstruct last commit; seen commit for height {state.last_block_height} not found")
+        last_vals = self.block_exec.store.load_validators(state.last_block_height)
+        vote_set = VoteSet(state.chain_id, seen.height, seen.round, PRECOMMIT, last_vals)
+        for idx, cs_sig in enumerate(seen.signatures):
+            if cs_sig.absent():
+                continue
+            vote = Vote(
+                type=PRECOMMIT,
+                height=seen.height,
+                round=seen.round,
+                block_id=cs_sig.block_id(seen.block_id),
+                timestamp=cs_sig.timestamp,
+                validator_address=cs_sig.validator_address,
+                validator_index=idx,
+                signature=cs_sig.signature,
+            )
+            vote_set.add_vote(vote)
+        if not vote_set.has_two_thirds_majority():
+            raise ConsensusError("failed to reconstruct last commit; does not have +2/3 maj")
+        self.rs.last_commit = vote_set
+
+    def _new_step(self) -> None:
+        """Log the step transition + notify the reactor
+        (ref: newStep state.go:861)."""
+        rs = self.rs
+        self.wal.write(EventRoundStep(rs.height, rs.round, rs.step))
+        self._n_steps += 1
+        self.broadcast(
+            NewRoundStepMessage(
+                height=rs.height,
+                round=rs.round,
+                step=rs.step,
+                seconds_since_start_time=max(0, int((self.now().unix_ns() - rs.start_time.unix_ns()) / 1e9)),
+                last_commit_round=rs.last_commit.round if isinstance(rs.last_commit, VoteSet) else 0,
+            )
+        )
+
+    def _schedule_round_0(self) -> None:
+        """ref: scheduleRound0 (state.go:712)."""
+        sleep = max(0.0, (self.rs.start_time.unix_ns() - self.now().unix_ns()) / 1e9)
+        self.ticker.schedule_timeout(TimeoutInfo(sleep, self.rs.height, 0, STEP_NEW_HEIGHT))
+
+    def _schedule_timeout(self, duration_s: float, height: int, round_: int, step: int) -> None:
+        self.ticker.schedule_timeout(TimeoutInfo(duration_s, height, round_, step))
+
+    # -------------------------------------------------------- step: round
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """ref: enterNewRound (state.go:1178)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (rs.round == round_ and rs.step != STEP_NEW_HEIGHT):
+            return
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_receive_time = Time()
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round for skipping
+        rs.triggered_timeout_precommit = False
+        self._enter_propose(height, round_)
+
+    def _is_proposer(self, address: bytes) -> bool:
+        proposer = self.rs.validators.get_proposer()
+        return proposer is not None and proposer.address == address
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """ref: enterPropose (state.go:1273)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (rs.round == round_ and STEP_PROPOSE <= rs.step):
+            return
+
+        # Proposer-based timestamps: wait until our clock passes the
+        # previous block time (ref: proposerWaitTime state.go:2799).
+        if self.priv_pub_key is not None and self._is_proposer(self.priv_pub_key.address()):
+            wait_ns = self.state.last_block_time.unix_ns() - self.now().unix_ns()
+            if wait_ns > 0:
+                self._schedule_timeout(wait_ns / 1e9 + 1e-3, height, round_, STEP_NEW_ROUND)
+                return
+
+        try:
+            self._schedule_timeout(
+                self.state.consensus_params.timeout.propose_timeout(round_), height, round_, STEP_PROPOSE
+            )
+            if self.priv_validator is None or self.priv_pub_key is None:
+                return
+            addr = self.priv_pub_key.address()
+            if not rs.validators.has_address(addr):
+                return
+            if self._is_proposer(addr):
+                self._decide_proposal(height, round_)
+        finally:
+            rs.round = round_
+            rs.step = STEP_PROPOSE
+            self._new_step()
+            if self._is_proposal_complete():
+                self._enter_prevote(height, rs.round)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """ref: defaultDecideProposal (state.go:1353)."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block = self._create_proposal_block(height)
+            if block is None:
+                return
+            block_parts = PartSet.from_data(block.to_proto().encode(), BLOCK_PART_SIZE_BYTES)
+
+        self.wal.flush_and_sync()
+        prop_block_id = BlockID(hash=block.hash(), part_set_header=block_parts.header)
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=prop_block_id,
+            timestamp=block.header.time,
+        )
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            if not self.replay_mode:
+                traceback.print_exc()
+            return
+        self._send_internal(ProposalMessage(proposal))
+        self.broadcast(ProposalMessage(proposal))
+        for i in range(block_parts.total()):
+            part = block_parts.get_part(i)
+            self._send_internal(BlockPartMessage(rs.height, rs.round, part))
+            self.broadcast(BlockPartMessage(rs.height, rs.round, part))
+
+    def _create_proposal_block(self, height: int):
+        """ref: createProposalBlock (state.go:1433)."""
+        rs = self.rs
+        if height == self.state.initial_height:
+            commit = Commit(height=0)
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            commit = rs.last_commit.make_commit()
+        else:
+            return None  # cannot propose without commit for previous block
+        proposer_addr = self.priv_pub_key.address()
+        return self.block_exec.create_proposal_block(
+            height, self.state, commit, proposer_addr, block_time=self.now()
+        )
+
+    def _is_proposal_complete(self) -> bool:
+        """ref: isProposalComplete (state.go:1411)."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    # ------------------------------------------------------ step: prevote
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """ref: enterPrevote (state.go:1478)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (rs.round == round_ and STEP_PREVOTE <= rs.step):
+            return
+        self._do_prevote(height, round_)
+        rs.round = round_
+        rs.step = STEP_PREVOTE
+        self._new_step()
+
+    def _proposal_is_timely(self) -> bool:
+        sp = self.state.consensus_params.synchrony
+        return self.rs.proposal.is_timely(self.rs.proposal_receive_time, sp.precision, sp.message_delay, self.rs.round)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """ref: defaultDoPrevote (state.go:1507)."""
+        rs = self.rs
+        if rs.proposal_block is None or rs.proposal is None:
+            self._sign_add_vote(PREVOTE, b"", PartSetHeader())
+            return
+        if rs.proposal.timestamp != rs.proposal_block.header.time:
+            self._sign_add_vote(PREVOTE, b"", PartSetHeader())
+            return
+        # PBTS: fresh (non-POL) proposals must be timely when we're unlocked
+        if not self.replay_mode and rs.proposal.pol_round == -1 and rs.locked_round == -1 and not self._proposal_is_timely():
+            self._sign_add_vote(PREVOTE, b"", PartSetHeader())
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception:
+            self._sign_add_vote(PREVOTE, b"", PartSetHeader())
+            return
+        if not self.block_exec.process_proposal(rs.proposal_block, self.state):
+            self._sign_add_vote(PREVOTE, b"", PartSetHeader())
+            return
+
+        # Algorithm line 22: fresh proposal, unlocked or matching our lock
+        if rs.proposal.pol_round == -1:
+            if rs.locked_round == -1 or rs.proposal_block.hashes_to(rs.locked_block.hash()):
+                self._sign_add_vote(PREVOTE, rs.proposal_block.hash(), rs.proposal_block_parts.header)
+                return
+        # Algorithm line 28: POL from an earlier round unlocks us
+        pol_round = rs.proposal.pol_round
+        if 0 <= pol_round < rs.round:
+            prevotes = rs.votes.prevotes(pol_round)
+            if prevotes is not None:
+                block_id, ok = prevotes.two_thirds_majority()
+                if ok and rs.proposal_block.hashes_to(block_id.hash):
+                    if rs.locked_round <= pol_round or rs.proposal_block.hashes_to(rs.locked_block.hash()):
+                        self._sign_add_vote(PREVOTE, rs.proposal_block.hash(), rs.proposal_block_parts.header)
+                        return
+        self._sign_add_vote(PREVOTE, b"", PartSetHeader())
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """ref: enterPrevoteWait (state.go:1646)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (rs.round == round_ and STEP_PREVOTE_WAIT <= rs.step):
+            return
+        if not rs.votes.prevotes(round_).has_two_thirds_any():
+            raise ConsensusError(f"entering prevote wait step ({height}/{round_}), but prevotes does not have any +2/3 votes")
+        rs.round = round_
+        rs.step = STEP_PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(self.state.consensus_params.timeout.vote_timeout(round_), height, round_, STEP_PREVOTE_WAIT)
+
+    # ---------------------------------------------------- step: precommit
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """ref: enterPrecommit (state.go:1682)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (rs.round == round_ and STEP_PRECOMMIT <= rs.step):
+            return
+        try:
+            block_id, ok = rs.votes.prevotes(round_).two_thirds_majority()
+            if not ok:
+                self._sign_add_vote(PRECOMMIT, b"", PartSetHeader())
+                return
+            pol_round, _ = rs.votes.pol_info()
+            if pol_round < round_:
+                raise ConsensusError(f"this POLRound should be {round_} but got {pol_round}")
+            if block_id.is_nil():
+                self._sign_add_vote(PRECOMMIT, b"", PartSetHeader())
+                return
+            if rs.proposal is None or rs.proposal_block is None:
+                self._sign_add_vote(PRECOMMIT, b"", PartSetHeader())
+                return
+            if rs.proposal.timestamp != rs.proposal_block.header.time:
+                self._sign_add_vote(PRECOMMIT, b"", PartSetHeader())
+                return
+            if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+                rs.locked_round = round_
+                self._sign_add_vote(PRECOMMIT, block_id.hash, block_id.part_set_header)
+                return
+            if rs.proposal_block.hashes_to(block_id.hash):
+                self.block_exec.validate_block(self.state, rs.proposal_block)  # panics in ref on failure
+                rs.locked_round = round_
+                rs.locked_block = rs.proposal_block
+                rs.locked_block_parts = rs.proposal_block_parts
+                self._sign_add_vote(PRECOMMIT, block_id.hash, block_id.part_set_header)
+                return
+            # polka for a block we don't have: fetch it, precommit nil
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(block_id.part_set_header):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            self._sign_add_vote(PRECOMMIT, b"", PartSetHeader())
+        finally:
+            rs.round = round_
+            rs.step = STEP_PRECOMMIT
+            self._new_step()
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """ref: enterPrecommitWait (state.go:1807)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (rs.round == round_ and rs.triggered_timeout_precommit):
+            return
+        if not rs.votes.precommits(round_).has_two_thirds_any():
+            raise ConsensusError(f"entering precommit wait step ({height}/{round_}), but precommits does not have any +2/3 votes")
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(self.state.consensus_params.timeout.vote_timeout(round_), height, round_, STEP_PRECOMMIT_WAIT)
+
+    # ------------------------------------------------------- step: commit
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """ref: enterCommit (state.go:1837)."""
+        rs = self.rs
+        if rs.height != height or STEP_COMMIT <= rs.step:
+            return
+        try:
+            block_id, ok = rs.votes.precommits(commit_round).two_thirds_majority()
+            if not ok:
+                raise ConsensusError("enterCommit expects +2/3 precommits")
+            if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+                rs.proposal_block = rs.locked_block
+                rs.proposal_block_parts = rs.locked_block_parts
+            if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+                if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(block_id.part_set_header):
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(block_id.part_set_header)
+        finally:
+            rs.step = STEP_COMMIT
+            rs.commit_round = commit_round
+            rs.commit_time = self.now()
+            self._new_step()
+            self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """ref: tryFinalizeCommit (state.go:1905)."""
+        rs = self.rs
+        if rs.height != height:
+            raise ConsensusError(f"tryFinalizeCommit() cs.Height: {rs.height} vs height: {height}")
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if not ok or block_id.is_nil():
+            return
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """ref: finalizeCommit (state.go:1931) — save, WAL EndHeight,
+        ApplyBlock, advance to next height."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok:
+            raise ConsensusError("cannot finalize commit; commit does not have 2/3 majority")
+        if not block_parts.has_header(block_id.part_set_header):
+            raise ConsensusError("expected ProposalBlockParts header to be commit header")
+        if not block.hashes_to(block_id.hash):
+            raise ConsensusError("cannot finalize commit; proposal block does not hash to commit hash")
+        self.block_exec.validate_block(self.state, block)
+
+        if self.block_store.height() < block.header.height:
+            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)
+
+        # EndHeight implies the block store saved the block; crash before
+        # this replays from the WAL, crash after replays via ApplyBlock in
+        # the handshake (state.go:1993).
+        self.wal.write_sync(EndHeightMessage(height))
+
+        state_copy = self.state.copy()
+        state_copy = self.block_exec.apply_block(state_copy, block_id, block)
+
+        self.on_decided(height, block, block_id)
+        self.update_to_state(state_copy)
+        self._schedule_round_0()
+
+    # -------------------------------------------------------------- msgs
+
+    def _set_proposal(self, proposal: Proposal, recv_time: Time) -> None:
+        """ref: defaultSetProposal (state.go:2138)."""
+        rs = self.rs
+        if rs.proposal is not None or proposal is None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (proposal.pol_round >= 0 and proposal.pol_round >= proposal.round):
+            raise ConsensusError("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(proposal.sign_bytes(self.state.chain_id), proposal.signature):
+            raise ConsensusError("invalid proposal signature")
+        rs.proposal = proposal
+        rs.proposal_receive_time = recv_time
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage) -> bool:
+        """ref: addProposalBlockPart (state.go:2183)."""
+        from ..proto import messages as pb
+        from ..types.block import Block
+
+        rs = self.rs
+        if rs.height != msg.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added:
+            return False
+        if rs.proposal_block_parts.byte_size > self.state.consensus_params.block.max_bytes:
+            raise ConsensusError(
+                f"total size of proposal block parts exceeds maximum block bytes "
+                f"({rs.proposal_block_parts.byte_size} > {self.state.consensus_params.block.max_bytes})"
+            )
+        if rs.proposal_block_parts.is_complete():
+            data = rs.proposal_block_parts.get_data()
+            rs.proposal_block = Block.from_proto(pb.Block.decode(data))
+        return added
+
+    def _handle_complete_proposal(self, height: int) -> None:
+        """ref: handleCompleteProposal (state.go:2255)."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        block_id, has_two_thirds = prevotes.two_thirds_majority()
+        if has_two_thirds and not block_id.is_nil() and rs.valid_round < rs.round:
+            if rs.proposal_block.hashes_to(block_id.hash):
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(height, rs.round)
+            if has_two_thirds:
+                self._enter_precommit(height, rs.round)
+        elif rs.step == STEP_COMMIT:
+            self._try_finalize_commit(height)
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """ref: tryAddVote (state.go:2289)."""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            if self.priv_pub_key is not None and vote.validator_address == self.priv_pub_key.address():
+                # conflicting vote from ourselves — unsafe reset?
+                return False
+            if self.evpool is not None:
+                self.evpool.report_conflicting_votes(e.conflicting, e.new)
+            return False
+        except Exception:
+            return False
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """ref: addVote (state.go:2333)."""
+        rs = self.rs
+
+        # Late precommit for the previous height during timeoutCommit
+        if vote.height + 1 == rs.height and vote.type == PRECOMMIT:
+            if rs.step != STEP_NEW_HEIGHT:
+                return False
+            if rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            self.broadcast(HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index))
+            if self.state.consensus_params.timeout.bypass_commit_timeout and rs.last_commit.has_all():
+                self._enter_new_round(rs.height, 0)
+            return True
+
+        if vote.height != rs.height:
+            return False
+
+        # Vote extensions
+        if self.state.consensus_params.abci.vote_extensions_enabled(rs.height):
+            my_addr = self.priv_pub_key.address() if self.priv_pub_key else b""
+            if vote.type == PRECOMMIT and not vote.block_id.is_nil() and vote.validator_address != my_addr:
+                _, val = self.state.validators.get_by_index(vote.validator_index)
+                vote.verify_with_extension(self.state.chain_id, val.pub_key)
+                if not self.block_exec.verify_vote_extension(vote):
+                    return False
+        else:
+            vote.extension = b""
+            vote.extension_signature = b""
+
+        height = rs.height
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self.broadcast(HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index))
+
+        if vote.type == PREVOTE:
+            prevotes = rs.votes.prevotes(vote.round)
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok and not block_id.is_nil():
+                if rs.valid_round < vote.round and vote.round == rs.round:
+                    if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
+                        rs.valid_round = vote.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+                    else:
+                        rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(block_id.part_set_header):
+                        rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)  # round skip
+            elif rs.round == vote.round and STEP_PREVOTE <= rs.step:
+                block_id, ok = prevotes.two_thirds_majority()
+                if ok and (self._is_proposal_complete() or block_id.is_nil()):
+                    self._enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    self._enter_prevote_wait(height, vote.round)
+            elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round:
+                if self._is_proposal_complete():
+                    self._enter_prevote(height, rs.round)
+        elif vote.type == PRECOMMIT:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit(height, vote.round)
+                if not block_id.is_nil():
+                    self._enter_commit(height, vote.round)
+                    if self.state.consensus_params.timeout.bypass_commit_timeout and precommits.has_all():
+                        self._enter_new_round(rs.height, 0)
+                else:
+                    self._enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit_wait(height, vote.round)
+        else:
+            raise ConsensusError(f"unexpected vote type {vote.type}")
+        return True
+
+    # -------------------------------------------------------------- votes
+
+    def _sign_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Vote | None:
+        """ref: signVote (state.go:2540)."""
+        self.wal.flush_and_sync()
+        if self.priv_pub_key is None:
+            return None
+        addr = self.priv_pub_key.address()
+        val_idx, _ = self.rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=msg_type,
+            height=self.rs.height,
+            round=self.rs.round,
+            block_id=BlockID(hash=hash_, part_set_header=header),
+            timestamp=self.now(),
+            validator_address=addr,
+            validator_index=val_idx,
+        )
+        if msg_type == PRECOMMIT and not vote.block_id.is_nil():
+            if self.state.consensus_params.abci.vote_extensions_enabled(self.rs.height):
+                vote.extension = self.block_exec.extend_vote(vote)
+        self.priv_validator.sign_vote(self.state.chain_id, vote)
+        return vote
+
+    def _sign_add_vote(self, msg_type: int, hash_: bytes, header: PartSetHeader) -> Vote | None:
+        """ref: signAddVote (state.go:2599)."""
+        if self.priv_validator is None or self.priv_pub_key is None:
+            return None
+        if not self.rs.validators.has_address(self.priv_pub_key.address()):
+            return None
+        try:
+            vote = self._sign_vote(msg_type, hash_, header)
+        except Exception:
+            # During WAL replay the privval rightly refuses to re-sign
+            # already-signed HRS slots; only surface errors live.
+            if not self.replay_mode:
+                traceback.print_exc()
+            return None
+        if vote is None:
+            return None
+        if not self.state.consensus_params.abci.vote_extensions_enabled(vote.height):
+            vote.extension = b""
+            vote.extension_signature = b""
+        self._send_internal(VoteMessage(vote))
+        self.broadcast(VoteMessage(vote))
+        return vote
+
+    # -------------------------------------------------------------- replay
+
+    def _catchup_replay(self) -> None:
+        """Replay WAL messages since the last EndHeight
+        (ref: catchupReplay replay.go:97)."""
+        msgs = self.wal.search_for_end_height(self.rs.height - 1)
+        if msgs is None:
+            return
+        self.replay_mode = True
+        try:
+            for m in msgs:
+                if isinstance(m, EndHeightMessage):
+                    continue
+                if isinstance(m, EventRoundStep):
+                    # fast-forward round/step markers are informational
+                    continue
+                if isinstance(m, TimeoutInfo):
+                    self._handle_timeout(m)
+                elif isinstance(m, MsgInfo):
+                    self._handle_msg(m)
+        finally:
+            self.replay_mode = False

@@ -1,0 +1,35 @@
+"""Timeout ticker (ref: internal/consensus/ticker.go:18-135).
+
+One pending timeout at a time; scheduling a new one cancels the old —
+the reference's timeoutRoutine drains the timer on every ScheduleTimeout
+so only the latest (height, round, step) can fire.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .wal import TimeoutInfo
+
+
+class TimeoutTicker:
+    def __init__(self, fire: Callable[[TimeoutInfo], None]):
+        self._fire = fire
+        self._lock = threading.Lock()
+        self._timer: threading.Timer | None = None
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            t = threading.Timer(ti.duration_s, self._fire, args=(ti,))
+            t.daemon = True
+            self._timer = t
+            t.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

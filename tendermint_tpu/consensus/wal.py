@@ -1,0 +1,216 @@
+"""Consensus write-ahead log (ref: internal/consensus/wal.go:61-436).
+
+Every message is logged BEFORE it is processed; the node's own messages
+(internal queue) are fsync'd (WriteSync) so a crashed validator can
+never act twice on the same input. Record framing: u32 crc32(payload) ‖
+u32 length ‖ payload, payload = JSON of a TimedWALMessage. A torn or
+corrupt tail stops replay (the reference's repairWalFile behavior).
+
+EndHeightMessage marks a height as fully committed; replay starts from
+the record after the last EndHeight(h >= target-1)
+(ref: SearchForEndHeight wal.go:261).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+
+from ..proto import messages as pb
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+
+MAX_WAL_MSG_SIZE = 1024 * 1024  # wal.go:32
+
+
+@dataclass
+class EndHeightMessage:
+    """ref: EndHeightMessage (wal.go:44)."""
+
+    height: int
+
+
+@dataclass
+class TimeoutInfo:
+    """ref: timeoutInfo (state.go:78)."""
+
+    duration_s: float
+    height: int
+    round: int
+    step: int
+
+
+@dataclass
+class MsgInfo:
+    """A consensus message + the peer that sent it ('' = internal)
+    (ref: msgInfo state.go:70)."""
+
+    msg: object
+    peer_id: str = ""
+
+
+@dataclass
+class EventRoundStep:
+    """Step transition marker, logged for replay catch-up
+    (ref: EventDataRoundState written at state.go:952)."""
+
+    height: int
+    round: int
+    step: int
+
+
+def _encode_msg(m) -> dict:
+    from .messages import (
+        BlockPartMessage,
+        ProposalMessage,
+        VoteMessage,
+    )
+
+    if isinstance(m, EndHeightMessage):
+        return {"type": "end_height", "height": m.height}
+    if isinstance(m, EventRoundStep):
+        return {"type": "round_step", "height": m.height, "round": m.round, "step": m.step}
+    if isinstance(m, TimeoutInfo):
+        return {
+            "type": "timeout",
+            "duration_s": m.duration_s,
+            "height": m.height,
+            "round": m.round,
+            "step": m.step,
+        }
+    if isinstance(m, MsgInfo):
+        inner = m.msg
+        if isinstance(inner, ProposalMessage):
+            body = {"kind": "proposal", "data": base64.b64encode(inner.proposal.to_proto().encode()).decode()}
+        elif isinstance(inner, BlockPartMessage):
+            body = {
+                "kind": "block_part",
+                "height": inner.height,
+                "round": inner.round,
+                "data": base64.b64encode(inner.part.to_proto().encode()).decode(),
+            }
+        elif isinstance(inner, VoteMessage):
+            body = {"kind": "vote", "data": base64.b64encode(inner.vote.to_proto().encode()).decode()}
+        else:
+            raise TypeError(f"unsupported WAL msgInfo payload: {type(inner)}")
+        return {"type": "msg_info", "peer_id": m.peer_id, "msg": body}
+    raise TypeError(f"unsupported WAL message: {type(m)}")
+
+
+def _decode_msg(doc: dict):
+    from ..types.part_set import Part
+    from .messages import BlockPartMessage, ProposalMessage, VoteMessage
+
+    t = doc["type"]
+    if t == "end_height":
+        return EndHeightMessage(doc["height"])
+    if t == "round_step":
+        return EventRoundStep(doc["height"], doc["round"], doc["step"])
+    if t == "timeout":
+        return TimeoutInfo(doc["duration_s"], doc["height"], doc["round"], doc["step"])
+    if t == "msg_info":
+        body = doc["msg"]
+        kind = body["kind"]
+        if kind == "proposal":
+            inner = ProposalMessage(Proposal.from_proto(pb.Proposal.decode(base64.b64decode(body["data"]))))
+        elif kind == "block_part":
+            inner = BlockPartMessage(
+                body["height"], body["round"], Part.from_proto(pb.Part.decode(base64.b64decode(body["data"])))
+            )
+        elif kind == "vote":
+            inner = VoteMessage(Vote.from_proto(pb.Vote.decode(base64.b64decode(body["data"]))))
+        else:
+            raise ValueError(f"unknown msg kind {kind}")
+        return MsgInfo(inner, doc.get("peer_id", ""))
+    raise ValueError(f"unknown WAL message type {t}")
+
+
+class WAL:
+    """ref: BaseWAL (wal.go:61). Single-file append log (the reference
+    rotates via autofile.Group; size-based rotation can layer on)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def write(self, msg) -> None:
+        """Buffered append (ref: Write wal.go:118 — fsync deferred)."""
+        self._append(msg, fsync=False)
+
+    def write_sync(self, msg) -> None:
+        """Append + fsync — used for the node's OWN messages
+        (ref: WriteSync wal.go:132; state.go:964)."""
+        self._append(msg, fsync=True)
+
+    def _append(self, msg, fsync: bool) -> None:
+        payload = json.dumps(_encode_msg(msg), separators=(",", ":")).encode()
+        if len(payload) > MAX_WAL_MSG_SIZE:
+            raise ValueError(f"msg is too big: {len(payload)} bytes, max: {MAX_WAL_MSG_SIZE} bytes")
+        rec = struct.pack("<II", zlib.crc32(payload), len(payload)) + payload
+        with self._lock:
+            self._f.write(rec)
+            self._f.flush()
+            if fsync:
+                os.fsync(self._f.fileno())
+
+    def flush_and_sync(self) -> None:
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._f.close()
+
+    # ------------------------------------------------------------ replay
+
+    def _read_all(self) -> list:
+        """Decode every intact record; stop at first corruption (the
+        reference truncates there via repairWalFile)."""
+        out = []
+        if not os.path.exists(self._path):
+            return out
+        with self._lock:
+            self._f.flush()
+        with open(self._path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + 8 <= len(data):
+            crc, length = struct.unpack_from("<II", data, pos)
+            end = pos + 8 + length
+            if end > len(data) or length > MAX_WAL_MSG_SIZE:
+                break
+            payload = data[pos + 8 : end]
+            if zlib.crc32(payload) != crc:
+                break
+            try:
+                out.append(_decode_msg(json.loads(payload)))
+            except Exception:
+                break
+            pos = end
+        return out
+
+    def search_for_end_height(self, height: int) -> list | None:
+        """Messages after EndHeight(height), or None if not found
+        (ref: SearchForEndHeight wal.go:261; height 0 always 'found' so
+        fresh chains replay from the start)."""
+        msgs = self._read_all()
+        if height == 0:
+            return msgs
+        idx = None
+        for i, m in enumerate(msgs):
+            if isinstance(m, EndHeightMessage) and m.height == height:
+                idx = i
+        if idx is None:
+            return None
+        return msgs[idx + 1 :]

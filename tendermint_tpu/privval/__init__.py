@@ -1,0 +1,3 @@
+"""Private validator (ref: privval/)."""
+
+from .file_pv import DoubleSignError, FilePV, LastSignState  # noqa: F401

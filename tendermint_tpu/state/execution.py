@@ -7,7 +7,6 @@ CreateProposalBlock → PrepareProposal, ProcessProposal, ValidateBlock
 
 from __future__ import annotations
 
-import hashlib
 import time as _time
 
 from ..abci import types as abci
@@ -142,6 +141,10 @@ class BlockExecutor:
         evidence, ev_size = self.evpool.pending_evidence(state.consensus_params.evidence.max_bytes)
         max_data_bytes = max_data_bytes_for(max_bytes, ev_size, state.validators.size())
         txs = self.mempool.reap_max_bytes_max_gas(max_data_bytes, max_gas)
+        if block_time is None:
+            from ..utils.tmtime import Time
+
+            block_time = Time.now()  # resolve once: PrepareProposal and the final block must agree
         block = state.make_block(height, txs, last_commit, evidence, proposer_address, block_time)
         rpp = self.app.prepare_proposal(
             abci.RequestPrepareProposal(
@@ -323,7 +326,3 @@ def max_data_bytes_for(max_bytes: int, evidence_bytes: int, num_validators: int)
             f"negative MaxDataBytes. Block.MaxBytes={max_bytes} is too small to accommodate header&lastCommit&evidence"
         )
     return data_bytes
-
-
-def block_hash_key(block: Block) -> bytes:
-    return hashlib.sha256(block.to_proto().encode()).digest()

@@ -31,6 +31,25 @@ def _hkey(prefix: bytes, height: int) -> bytes:
     return prefix + height.to_bytes(8, "big")
 
 
+def _events_to_json(events) -> list:
+    return [
+        {"type": e.type, "attributes": [{"key": a.key, "value": a.value, "index": a.index} for a in e.attributes]}
+        for e in events
+    ]
+
+
+def _events_from_json(docs: list):
+    from ..abci import types as abci
+
+    return [
+        abci.Event(
+            type=d["type"],
+            attributes=[abci.EventAttribute(a["key"], a["value"], a["index"]) for a in d["attributes"]],
+        )
+        for d in docs
+    ]
+
+
 def state_to_json(state: State) -> dict:
     return {
         "chain_id": state.chain_id,
@@ -109,11 +128,7 @@ class StateStore:
             next_height = state.initial_height
             # initial state: bootstrap both current and next sets
             self.save_validator_sets(state.initial_height, state.last_height_validators_changed, state.validators)
-            self.save_validator_sets(
-                state.initial_height + 1, max(state.last_height_validators_changed, state.initial_height + 1)
-                if state.next_validators is not state.validators else state.last_height_validators_changed,
-                state.next_validators,
-            )
+            self.save_validator_sets(state.initial_height + 1, state.initial_height + 1, state.next_validators)
         else:
             self.save_validator_sets(next_height + 1, state.last_height_validators_changed, state.next_validators)
         self._save_params(next_height, state.last_height_consensus_params_changed, state.consensus_params)
@@ -186,10 +201,7 @@ class StateStore:
 
     def save_finalize_block_responses(self, height: int, resp) -> None:
         """Persist the ABCI FinalizeBlock response for replay/indexing
-        (ref: store.go SaveFinalizeBlockResponses:461). Stored as JSON of
-        the deterministic fields plus events."""
-        from ..abci import types as abci
-
+        (ref: store.go SaveFinalizeBlockResponses:461)."""
         doc = {
             "app_hash": _b64(resp.app_hash),
             "tx_results": [
@@ -199,6 +211,7 @@ class StateStore:
                     "log": r.log,
                     "gas_wanted": r.gas_wanted,
                     "gas_used": r.gas_used,
+                    "events": _events_to_json(r.events),
                 }
                 for r in resp.tx_results
             ],
@@ -206,8 +219,11 @@ class StateStore:
                 {"pub_key_type": u.pub_key_type, "pub_key": _b64(u.pub_key_bytes), "power": u.power}
                 for u in resp.validator_updates
             ],
+            "consensus_param_updates": (
+                _b64(resp.consensus_param_updates.encode()) if resp.consensus_param_updates is not None else None
+            ),
+            "events": _events_to_json(resp.events),
         }
-        _ = abci
         self._db.set(_hkey(KEY_ABCI_RESPONSES, height), json.dumps(doc).encode())
 
     def load_finalize_block_responses(self, height: int):
@@ -217,6 +233,7 @@ class StateStore:
         if raw is None:
             return None
         doc = json.loads(raw)
+        cpu = doc.get("consensus_param_updates")
         return abci.ResponseFinalizeBlock(
             app_hash=_unb64(doc["app_hash"]),
             tx_results=[
@@ -226,6 +243,7 @@ class StateStore:
                     log=r["log"],
                     gas_wanted=r["gas_wanted"],
                     gas_used=r["gas_used"],
+                    events=_events_from_json(r.get("events", [])),
                 )
                 for r in doc["tx_results"]
             ],
@@ -233,6 +251,8 @@ class StateStore:
                 abci.ValidatorUpdate(pub_key_type=u["pub_key_type"], pub_key_bytes=_unb64(u["pub_key"]), power=u["power"])
                 for u in doc["validator_updates"]
             ],
+            consensus_param_updates=pb.ConsensusParamsUpdate.decode(_unb64(cpu)) if cpu else None,
+            events=_events_from_json(doc.get("events", [])),
         )
 
     # --------------------------------------------------------- pruning

@@ -138,7 +138,27 @@ class MemDB(KVStore):
 
 
 _REC = struct.Struct("<BII")  # op, klen, vlen
-_OP_SET, _OP_DEL = 1, 2
+_OP_SET, _OP_DEL, _OP_BATCH = 1, 2, 3
+
+
+def _pack_batch(ops: list[tuple[bool, bytes, bytes]]) -> bytes:
+    out = bytearray()
+    for is_set, k, v in ops:
+        out += _REC.pack(_OP_SET if is_set else _OP_DEL, len(k), len(v))
+        out += k
+        out += v
+    return bytes(out)
+
+
+def _unpack_batch(data: bytes):
+    pos = 0
+    while pos + _REC.size <= len(data):
+        op, klen, vlen = _REC.unpack_from(data, pos)
+        pos += _REC.size
+        key = data[pos : pos + klen]
+        value = data[pos + klen : pos + klen + vlen]
+        pos += klen + vlen
+        yield op == _OP_SET, key, value
 
 
 class FileDB(MemDB):
@@ -183,6 +203,13 @@ class FileDB(MemDB):
                 super().set(key, value)
             elif op == _OP_DEL:
                 super().delete(key)
+            elif op == _OP_BATCH:
+                # value holds the packed sub-ops; applied all-or-nothing
+                for is_set, k, v in _unpack_batch(value):
+                    if is_set:
+                        super().set(k, v)
+                    else:
+                        super().delete(k)
             pos = good = end
         if good < len(data):
             with open(self._path, "r+b") as f:
@@ -208,12 +235,16 @@ class FileDB(MemDB):
             self._append(_OP_DEL, key, b"")
 
     def apply_batch(self, ops) -> None:
+        """Crash-atomic batch: all sub-ops ride in ONE CRC-framed record,
+        so a torn tail drops the whole batch, never a prefix of it —
+        preserving the Batch contract BlockStore.save_block relies on."""
         with self._lock:
             for is_set, k, v in ops:
                 if is_set:
-                    self.set(k, v)
+                    MemDB.set(self, k, v)
                 else:
-                    self.delete(k)
+                    MemDB.delete(self, k)
+            self._append(_OP_BATCH, b"", _pack_batch(ops))
 
     def compact(self) -> None:
         """Rewrite the log as one sorted pass of live records."""

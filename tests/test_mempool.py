@@ -1,0 +1,134 @@
+"""TxMempool tests (ref: internal/mempool/mempool_test.go, cache_test.go)."""
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.mempool import LRUTxCache, TxInCacheError, TxMempool, tx_key
+
+
+class PriorityApp(abci.BaseApplication):
+    """CheckTx returns priority = int prefix of the tx ('<prio>:payload'),
+    rejects txs starting with 'bad', and on recheck rejects 'stale'."""
+
+    def check_tx(self, req):
+        tx = req.tx
+        if tx.startswith(b"bad"):
+            return abci.ResponseCheckTx(code=1, log="rejected")
+        if req.type == 1 and tx.startswith(b"stale"):
+            return abci.ResponseCheckTx(code=2, log="stale on recheck")
+        prio = 0
+        if b":" in tx:
+            head = tx.split(b":", 1)[0]
+            try:
+                prio = int(head)
+            except ValueError:
+                prio = 0
+        return abci.ResponseCheckTx(code=0, priority=prio, gas_wanted=1)
+
+
+class _DirectClient:
+    def __init__(self, app):
+        self._app = app
+
+    def check_tx(self, req):
+        return self._app.check_tx(req)
+
+
+def make_pool(**kw):
+    return TxMempool(_DirectClient(PriorityApp()), **kw)
+
+
+def test_check_tx_admits_and_dedups():
+    mp = make_pool()
+    assert mp.check_tx(b"5:aaa").is_ok
+    assert mp.size() == 1
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"5:aaa")
+    assert mp.size() == 1
+
+
+def test_rejected_tx_not_added_and_not_cached():
+    mp = make_pool()
+    res = mp.check_tx(b"bad-tx")
+    assert not res.is_ok
+    assert mp.size() == 0
+    # not kept in cache -> can be submitted again
+    res2 = mp.check_tx(b"bad-tx")
+    assert not res2.is_ok
+
+
+def test_reap_priority_order_with_fifo_tiebreak():
+    mp = make_pool()
+    mp.check_tx(b"1:low")
+    mp.check_tx(b"9:high")
+    mp.check_tx(b"5:mid-a")
+    mp.check_tx(b"5:mid-b")
+    txs = mp.reap_max_bytes_max_gas(-1, -1)
+    assert txs == [b"9:high", b"5:mid-a", b"5:mid-b", b"1:low"]
+
+
+def test_reap_respects_byte_and_gas_budgets():
+    mp = make_pool()
+    mp.check_tx(b"9:aaaaaaaa")  # 10 bytes
+    mp.check_tx(b"5:bbbbbbbb")
+    mp.check_tx(b"1:cccccccc")
+    assert len(mp.reap_max_bytes_max_gas(21, -1)) == 2  # 2×10 fits, 3rd doesn't
+    assert len(mp.reap_max_bytes_max_gas(-1, 2)) == 2  # gas_wanted=1 each
+    assert mp.reap_max_txs(1) == [b"9:aaaaaaaa"]
+
+
+def test_update_removes_committed_and_rechecks():
+    mp = make_pool()
+    mp.check_tx(b"7:keep")
+    mp.check_tx(b"stale:gone-on-recheck")
+    mp.check_tx(b"3:committed")
+    assert mp.size() == 3
+    mp.lock()
+    try:
+        mp.update(
+            1,
+            [b"3:committed"],
+            [abci.ExecTxResult(code=0)],
+            recheck=True,
+        )
+    finally:
+        mp.unlock()
+    # committed tx removed; stale tx evicted by recheck; keep survives
+    assert mp.size() == 1
+    assert mp.reap_max_txs(-1) == [b"7:keep"]
+    # committed tx key remains cached: replays rejected
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"3:committed")
+
+
+def test_full_mempool_errors():
+    mp = make_pool(size=2)
+    mp.check_tx(b"1:a")
+    mp.check_tx(b"1:b")
+    with pytest.raises(RuntimeError):
+        mp.check_tx(b"1:c")
+
+
+def test_txs_available_signal():
+    mp = make_pool()
+    mp.enable_txs_available()
+    assert not mp.wait_txs_available(timeout=0.01)
+    mp.check_tx(b"5:x")
+    assert mp.wait_txs_available(timeout=1.0)
+
+
+def test_remove_tx_by_key():
+    mp = make_pool()
+    mp.check_tx(b"5:x")
+    mp.remove_tx_by_key(tx_key(b"5:x"))
+    assert mp.size() == 0
+    # removed from cache too -> re-submittable
+    assert mp.check_tx(b"5:x").is_ok
+
+
+def test_lru_cache_eviction():
+    c = LRUTxCache(2)
+    assert c.push(b"a") and c.push(b"b")
+    assert not c.push(b"a")  # refreshes 'a'
+    assert c.push(b"c")  # evicts 'b' (least recent)
+    assert c.has(b"a") and c.has(b"c") and not c.has(b"b")

@@ -1,0 +1,118 @@
+"""FilePV double-sign-guard tests (ref: privval/file_test.go)."""
+
+import os
+
+import pytest
+
+from helpers import make_block_id
+from tendermint_tpu.privval import DoubleSignError, FilePV
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import PRECOMMIT, PREVOTE, Vote
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN = "pv-chain"
+
+
+def make_vote(height=1, round_=0, vtype=PREVOTE, bid=None, t_ns=1_700_000_000 * 10**9):
+    return Vote(
+        type=vtype,
+        height=height,
+        round=round_,
+        block_id=bid if bid is not None else make_block_id(),
+        timestamp=Time.from_unix_ns(t_ns),
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+    )
+
+
+def test_sign_vote_and_verify():
+    pv = FilePV.generate(seed=b"\x01" * 32)
+    vote = make_vote()
+    pv.sign_vote(CHAIN, vote)
+    assert vote.signature
+    assert pv.get_pub_key().verify_signature(vote.sign_bytes(CHAIN), vote.signature)
+
+
+def test_same_hrs_same_bytes_reuses_signature():
+    pv = FilePV.generate(seed=b"\x02" * 32)
+    v1 = make_vote()
+    pv.sign_vote(CHAIN, v1)
+    v2 = make_vote()
+    pv.sign_vote(CHAIN, v2)
+    assert v2.signature == v1.signature
+
+
+def test_same_hrs_different_timestamp_reuses_sig_and_timestamp():
+    pv = FilePV.generate(seed=b"\x03" * 32)
+    v1 = make_vote(t_ns=1_700_000_000 * 10**9)
+    pv.sign_vote(CHAIN, v1)
+    v2 = make_vote(t_ns=1_700_000_099 * 10**9)
+    pv.sign_vote(CHAIN, v2)
+    assert v2.signature == v1.signature
+    assert v2.timestamp == v1.timestamp
+
+
+def test_same_hrs_conflicting_block_refused():
+    pv = FilePV.generate(seed=b"\x04" * 32)
+    v1 = make_vote(bid=make_block_id(b"\x0a" * 32))
+    pv.sign_vote(CHAIN, v1)
+    v2 = make_vote(bid=make_block_id(b"\x0b" * 32))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, v2)
+
+
+def test_hrs_regression_refused():
+    pv = FilePV.generate(seed=b"\x05" * 32)
+    pv.sign_vote(CHAIN, make_vote(height=5, round_=2))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, make_vote(height=4, round_=0))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, make_vote(height=5, round_=1))
+    # step regression: precommit then prevote at same h/r
+    pv2 = FilePV.generate(seed=b"\x06" * 32)
+    pv2.sign_vote(CHAIN, make_vote(vtype=PRECOMMIT))
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN, make_vote(vtype=PREVOTE))
+
+
+def test_precommit_carries_extension_signature():
+    pv = FilePV.generate(seed=b"\x07" * 32)
+    v = make_vote(vtype=PRECOMMIT)
+    v.extension = b"app-extension"
+    pv.sign_vote(CHAIN, v)
+    assert v.extension_signature
+    assert pv.get_pub_key().verify_signature(v.extension_sign_bytes(CHAIN), v.extension_signature)
+    # prevotes must not carry extensions
+    v2 = make_vote(height=2, vtype=PREVOTE)
+    v2.extension = b"bad"
+    with pytest.raises(ValueError):
+        pv.sign_vote(CHAIN, v2)
+
+
+def test_sign_proposal_and_double_sign_guard():
+    pv = FilePV.generate(seed=b"\x08" * 32)
+    p1 = Proposal(height=3, round=1, pol_round=-1, block_id=make_block_id(), timestamp=Time.from_unix_ns(10**18))
+    pv.sign_proposal(CHAIN, p1)
+    assert p1.signature
+    p2 = Proposal(height=3, round=1, pol_round=-1, block_id=make_block_id(b"\xcc" * 32), timestamp=Time.from_unix_ns(10**18))
+    with pytest.raises(DoubleSignError):
+        pv.sign_proposal(CHAIN, p2)
+
+
+def test_persistence_across_restart(tmp_path):
+    key_file = os.path.join(tmp_path, "priv_validator_key.json")
+    state_file = os.path.join(tmp_path, "priv_validator_state.json")
+    pv = FilePV.generate(key_file, state_file, seed=b"\x09" * 32)
+    v1 = make_vote(bid=make_block_id(b"\x0a" * 32))
+    pv.sign_vote(CHAIN, v1)
+
+    pv2 = FilePV.load_or_generate(key_file, state_file)
+    assert pv2.get_pub_key().bytes() == pv.get_pub_key().bytes()
+    # same HRS different block after restart -> refused
+    v2 = make_vote(bid=make_block_id(b"\x0b" * 32))
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN, v2)
+    # same HRS same vote -> same signature
+    v3 = make_vote(bid=make_block_id(b"\x0a" * 32))
+    pv2.sign_vote(CHAIN, v3)
+    assert v3.signature == v1.signature
