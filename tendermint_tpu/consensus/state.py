@@ -126,7 +126,10 @@ class ConsensusState:
     def stop(self) -> None:
         self._stop.set()
         self.ticker.stop()
-        self._queue.put(None)
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass  # consumer sees _stop on its next poll timeout
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.wal.flush_and_sync()
@@ -134,14 +137,31 @@ class ConsensusState:
     # ------------------------------------------------------------- inputs
 
     def add_peer_message(self, msg, peer_id: str) -> None:
-        """Entry point for reactor-delivered messages (peerMsgQueue)."""
+        """Entry point for reactor-delivered messages (peerMsgQueue).
+
+        Only the three data-plane kinds reach the state machine — the
+        reactor handles gossip-control messages (NewRoundStep, HasVote,
+        VoteSetMaj23…) itself, as in the reference — which also keeps the
+        WAL codec closed over exactly these types."""
+        if not isinstance(msg, (ProposalMessage, BlockPartMessage, VoteMessage)):
+            return
         self._queue.put(MsgInfo(msg, peer_id))
 
     def _send_internal(self, msg) -> None:
         """ref: sendInternalMessage state.go — internal queue has
-        priority and is fsync'd in the WAL."""
+        priority and is fsync'd in the WAL. Never blocks: the caller IS
+        the consumer thread, so a blocking put on a full queue would
+        self-deadlock (the reference uses select/default + goroutine
+        fallback for exactly this reason)."""
         self._internal_queue.put(MsgInfo(msg, ""))
-        self._queue.put(("internal",))  # wake the consumer
+        try:
+            self._queue.put_nowait(("internal",))  # wake the consumer
+        except queue.Full:
+            # Queue is saturated with peer messages; the consumer drains
+            # the internal queue opportunistically via the next wake.
+            threading.Thread(
+                target=lambda: self._queue.put(("internal",)), daemon=True
+            ).start()
 
     def _tock(self, ti: TimeoutInfo) -> None:
         self._queue.put(ti)
@@ -219,7 +239,7 @@ class ConsensusState:
         if ti.step == STEP_NEW_HEIGHT:
             self._enter_new_round(ti.height, 0)
         elif ti.step == STEP_NEW_ROUND:
-            self._enter_propose(ti.height, 0)
+            self._enter_propose(ti.height, ti.round)
         elif ti.step == STEP_PROPOSE:
             self._enter_prevote(ti.height, ti.round)
         elif ti.step == STEP_PREVOTE_WAIT:

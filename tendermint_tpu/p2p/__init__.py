@@ -1,0 +1,55 @@
+"""Host-side P2P stack (ref: internal/p2p/).
+
+The distributed communication backend of the framework. Consensus gossip
+is host work (sockets, not MXU math) — per SURVEY §5.8 the TPU analog of
+the reference's NCCL-free custom TCP stack is: keep host↔host gossip on
+CPU threads, and run the dense compute (signature verification) on the
+device mesh via jax collectives. This package is the CPU half.
+
+Layout mirrors the reference:
+  types.py             Envelope / ChannelDescriptor / PeerUpdate / NodeInfo
+  channel.py           typed duplex pipe per protocol  (internal/p2p/channel.go)
+  transport.py         Transport/Connection interfaces (internal/p2p/transport.go)
+  transport_memory.py  in-process network for tests    (internal/p2p/transport_memory.go)
+  transport_tcp.py     TCP + MConnection-style framing (internal/p2p/transport_mconn.go)
+  secret_connection.py STS authenticated encryption    (internal/p2p/conn/secret_connection.go)
+  peermanager.py       peer lifecycle + scoring        (internal/p2p/peermanager.go)
+  router.py            envelope routing                (internal/p2p/router.go)
+"""
+
+from .types import (
+    ChannelDescriptor,
+    Envelope,
+    NodeInfo,
+    PeerUpdate,
+    PEER_STATUS_UP,
+    PEER_STATUS_DOWN,
+    node_id_from_pubkey,
+    validate_node_id,
+)
+from .channel import Channel
+from .transport import Connection, Endpoint, Transport
+from .transport_memory import MemoryNetwork, MemoryTransport
+from .peermanager import PeerManager, PeerManagerOptions
+from .router import Router, RouterOptions
+
+__all__ = [
+    "Channel",
+    "ChannelDescriptor",
+    "Connection",
+    "Endpoint",
+    "Envelope",
+    "MemoryNetwork",
+    "MemoryTransport",
+    "NodeInfo",
+    "PeerManager",
+    "PeerManagerOptions",
+    "PeerUpdate",
+    "PEER_STATUS_UP",
+    "PEER_STATUS_DOWN",
+    "Router",
+    "RouterOptions",
+    "Transport",
+    "node_id_from_pubkey",
+    "validate_node_id",
+]

@@ -1,0 +1,344 @@
+"""Router — connects transports, the peer manager, and reactor channels
+(ref: internal/p2p/router.go:142-976).
+
+Thread layout mirrors the reference's goroutine layout:
+  - one accept loop per transport           (router.go:444 acceptPeers)
+  - one dial loop                           (router.go:528 dialPeers)
+  - one evict loop                          (router.go:877 evictPeers)
+  - per-channel route loop                  (router.go:301 routeChannel)
+  - per-peer send + receive threads         (router.go:791,843)
+
+Envelopes flow: reactor → Channel.out_queue → routeChannel → per-peer
+queue → sendPeer → Connection; and Connection → receivePeer →
+Channel.in_queue → reactor.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from dataclasses import dataclass, field
+
+from .channel import Channel
+from .transport import Connection, ConnectionClosed, Endpoint, Transport
+from .types import ChannelDescriptor, Envelope, NodeInfo, PeerError, node_id_from_pubkey
+from .peermanager import PeerManager
+
+
+@dataclass
+class RouterOptions:
+    """ref: router.go RouterOptions."""
+
+    dial_timeout: float = 5.0
+    handshake_timeout: float = 5.0
+    queue_size: int = 128
+    num_dial_threads: int = 4
+    filter_peer_by_id: object = None  # callable(node_id) -> None | raise
+
+
+class _PeerQueue:
+    """Per-peer outbound queue; closed on disconnect."""
+
+    __slots__ = ("q", "closed")
+    _SENTINEL = object()
+
+    def __init__(self, size: int):
+        self.q: queue.Queue = queue.Queue(maxsize=size)
+        self.closed = threading.Event()
+
+    def put(self, envelope: Envelope, timeout: float = 1.0) -> bool:
+        if self.closed.is_set():
+            return False
+        try:
+            self.q.put(envelope, timeout=timeout)
+            return True
+        except queue.Full:
+            return False  # drop on sustained backpressure (ref drops too)
+
+    def get(self, timeout: float = 0.2):
+        try:
+            item = self.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return None if item is self._SENTINEL else item
+
+    def close(self) -> None:
+        self.closed.set()
+        try:
+            self.q.put_nowait(self._SENTINEL)
+        except queue.Full:
+            pass
+
+
+class Router:
+    """ref: internal/p2p/router.go Router."""
+
+    def __init__(
+        self,
+        node_info: NodeInfo,
+        priv_key,
+        peer_manager: PeerManager,
+        transports: list[Transport],
+        endpoint_for: dict[str, Transport] | None = None,
+        options: RouterOptions | None = None,
+        logger=None,
+    ):
+        self.node_info = node_info
+        self.priv_key = priv_key
+        self.peer_manager = peer_manager
+        self.transports = list(transports)
+        self.options = options or RouterOptions()
+        self.logger = logger
+
+        self._channels: dict[int, Channel] = {}
+        self._channel_lock = threading.RLock()
+        self._peer_queues: dict[str, _PeerQueue] = {}
+        self._peer_conns: dict[str, Connection] = {}
+        self._peer_channels: dict[str, set[int]] = {}
+        self._peer_lock = threading.RLock()
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- channels
+
+    def open_channel(self, desc: ChannelDescriptor) -> Channel:
+        """ref: router.go:251 OpenChannel."""
+        with self._channel_lock:
+            if desc.id in self._channels:
+                raise ValueError(f"channel {desc.id:#x} already exists")
+            ch = Channel(desc)
+            self._channels[desc.id] = ch
+            self.node_info.channels += bytes([desc.id])
+            if not self._stop.is_set() and self._threads:
+                self._spawn(self._route_channel, ch)
+            return ch
+
+    def channel_ids(self) -> set[int]:
+        with self._channel_lock:
+            return set(self._channels)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._stop.clear()
+        with self._channel_lock:
+            for ch in self._channels.values():
+                self._spawn(self._route_channel, ch)
+        for t in self.transports:
+            self._spawn(self._accept_loop, t)
+        for _ in range(self.options.num_dial_threads):
+            self._spawn(self._dial_loop)
+        self._spawn(self._evict_loop)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._channel_lock:
+            for ch in self._channels.values():
+                ch.close()
+        with self._peer_lock:
+            conns = list(self._peer_conns.values())
+            queues = list(self._peer_queues.values())
+        for pq in queues:
+            pq.close()
+        for conn in conns:
+            conn.close()
+        for t in self.transports:
+            t.close()
+        for th in self._threads:
+            th.join(timeout=2)
+        self._threads.clear()
+
+    def _spawn(self, fn, *args) -> None:
+        th = threading.Thread(target=fn, args=args, daemon=True, name=fn.__name__)
+        self._threads.append(th)
+        th.start()
+
+    # -------------------------------------------------------- channel route
+
+    def _route_channel(self, ch: Channel) -> None:
+        """Fan envelopes from a reactor channel out to peer queues
+        (ref: router.go:301 routeChannel)."""
+        while not self._stop.is_set():
+            # peer errors → peer manager
+            try:
+                while True:
+                    perr: PeerError = ch.error_queue.get_nowait()
+                    self.peer_manager.errored(perr.node_id, perr.err)
+            except queue.Empty:
+                pass
+            try:
+                envelope = ch.out_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if envelope is None:
+                return
+            envelope.channel_id = ch.id
+            if envelope.broadcast:
+                with self._peer_lock:
+                    targets = [
+                        (nid, pq)
+                        for nid, pq in self._peer_queues.items()
+                        if ch.id in self._peer_channels.get(nid, ())
+                    ]
+            else:
+                if not envelope.to:
+                    continue
+                with self._peer_lock:
+                    pq = self._peer_queues.get(envelope.to)
+                    ok = pq is not None and ch.id in self._peer_channels.get(envelope.to, ())
+                targets = [(envelope.to, pq)] if ok else []
+            for nid, pq in targets:
+                env = Envelope(
+                    message=envelope.message,
+                    to=nid,
+                    channel_id=ch.id,
+                )
+                pq.put(env)
+
+    # ------------------------------------------------------------- accept
+
+    def _accept_loop(self, transport: Transport) -> None:
+        """ref: router.go:444 acceptPeers."""
+        while not self._stop.is_set():
+            try:
+                conn = transport.accept(timeout=0.2)
+            except TimeoutError:
+                continue
+            except (ConnectionClosed, OSError):
+                return
+            self._spawn(self._open_connection, conn, False, None)
+
+    def _open_connection(self, conn: Connection, outgoing: bool, endpoint: Endpoint | None) -> None:
+        """Handshake + register + run send/recv (ref: router.go:481
+        openConnection / :675 handshakePeer + :745 routePeer)."""
+        peer_id = None
+        try:
+            peer_info, peer_key = conn.handshake(
+                self.node_info, self.priv_key, timeout=self.options.handshake_timeout
+            )
+            peer_info.validate()
+            peer_id = peer_info.node_id
+            if node_id_from_pubkey(peer_key) != peer_id:
+                raise ValueError("peer's public key did not match its node ID")
+            if peer_id == self.node_info.node_id:
+                raise ValueError("rejecting handshake with self")
+            if outgoing and endpoint is not None and endpoint.node_id and endpoint.node_id != peer_id:
+                raise ValueError(f"expected to dial {endpoint.node_id}, got {peer_id}")
+            self.node_info.compatible_with(peer_info)
+            if self.options.filter_peer_by_id is not None:
+                self.options.filter_peer_by_id(peer_id)
+
+            if outgoing:
+                self.peer_manager.dialed(endpoint)
+            else:
+                self.peer_manager.accepted(peer_id)
+        except Exception:
+            if outgoing and endpoint is not None:
+                self.peer_manager.dial_failed(endpoint)
+            conn.close()
+            return
+
+        peer_channels = set(peer_info.channels)
+        pq = _PeerQueue(self.options.queue_size)
+        with self._peer_lock:
+            old = self._peer_conns.pop(peer_id, None)
+            self._peer_queues[peer_id] = pq
+            self._peer_conns[peer_id] = conn
+            self._peer_channels[peer_id] = peer_channels & self.channel_ids()
+        if old is not None:
+            old.close()
+
+        self.peer_manager.ready(peer_id, peer_channels)
+
+        send_done = threading.Event()
+        sender = threading.Thread(
+            target=self._send_peer, args=(peer_id, conn, pq, send_done), daemon=True, name=f"send:{peer_id[:8]}"
+        )
+        sender.start()
+        try:
+            self._receive_peer(peer_id, conn)
+        finally:
+            pq.close()
+            conn.close()
+            send_done.set()
+            sender.join(timeout=2)
+            with self._peer_lock:
+                if self._peer_conns.get(peer_id) is conn:
+                    del self._peer_conns[peer_id]
+                    self._peer_queues.pop(peer_id, None)
+                    self._peer_channels.pop(peer_id, None)
+            self.peer_manager.disconnected(peer_id)
+
+    # --------------------------------------------------------------- dial
+
+    def _dial_loop(self) -> None:
+        """ref: router.go:528 dialPeers."""
+        while not self._stop.is_set():
+            endpoint = self.peer_manager.dial_next(timeout=0.2)
+            if endpoint is None:
+                continue
+            transport = self._transport_for(endpoint.protocol)
+            if transport is None:
+                self.peer_manager.dial_failed(endpoint)
+                continue
+            try:
+                conn = transport.dial(endpoint, timeout=self.options.dial_timeout)
+            except Exception:
+                self.peer_manager.dial_failed(endpoint)
+                continue
+            self._open_connection(conn, True, endpoint)
+
+    def _transport_for(self, protocol: str) -> Transport | None:
+        for t in self.transports:
+            if t.protocol == protocol:
+                return t
+        return None
+
+    # --------------------------------------------------------------- evict
+
+    def _evict_loop(self) -> None:
+        """ref: router.go:877 evictPeers."""
+        while not self._stop.is_set():
+            nid = self.peer_manager.evict_next(timeout=0.2)
+            if nid is None:
+                continue
+            with self._peer_lock:
+                conn = self._peer_conns.get(nid)
+            if conn is not None:
+                conn.close()
+
+    # ------------------------------------------------------------ send/recv
+
+    def _send_peer(self, peer_id: str, conn: Connection, pq: _PeerQueue, done: threading.Event) -> None:
+        """ref: router.go:791 sendPeer."""
+        while not done.is_set() and not self._stop.is_set():
+            envelope = pq.get(timeout=0.2)
+            if envelope is None:
+                if pq.closed.is_set():
+                    return
+                continue
+            try:
+                conn.send_message(envelope.channel_id, envelope.message)
+            except (ConnectionClosed, OSError):
+                return
+            except Exception:
+                traceback.print_exc()
+                return
+
+    def _receive_peer(self, peer_id: str, conn: Connection) -> None:
+        """ref: router.go:843 receivePeer."""
+        while not self._stop.is_set():
+            try:
+                channel_id, message = conn.receive_message(timeout=0.2)
+            except TimeoutError:
+                continue
+            except (ConnectionClosed, OSError):
+                return
+            except Exception:
+                return
+            with self._channel_lock:
+                ch = self._channels.get(channel_id)
+            if ch is None:
+                continue
+            ch.deliver(Envelope(message=message, from_=peer_id, channel_id=channel_id))

@@ -1,0 +1,161 @@
+"""SecretConnection — Station-to-Station authenticated encryption over a
+byte stream (ref: internal/p2p/conn/secret_connection.go:92-455).
+
+Protocol, matching the reference's construction:
+  1. exchange 32-byte ephemeral X25519 pubkeys (unauthenticated)
+  2. DH → HKDF-SHA256 (info "TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN")
+     derives 96 bytes: two ChaCha20-Poly1305 keys + 32-byte challenge;
+     key assignment by sorted ephemeral pubkeys (deriveSecrets :337)
+  3. all further traffic in sealed frames: 4-byte LE length + 1024-byte
+     data chunk, nonce = 96-bit LE counter (:55-58 dataMaxSize/frame)
+  4. each side sends (node pubkey, sig over challenge) through the
+     encrypted stream; verify → peer identity authenticated (:193-222)
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes
+
+from ..crypto.ed25519 import Ed25519PubKey
+
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = 1024
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE
+AEAD_TAG_SIZE = 16
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + AEAD_TAG_SIZE
+
+_HKDF_INFO = b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+
+
+class _NonceCounter:
+    """96-bit little-endian counter nonce (ref: secret_connection.go:469)."""
+
+    __slots__ = ("counter",)
+
+    def __init__(self):
+        self.counter = 0
+
+    def next(self) -> bytes:
+        n = struct.pack("<4xQ", self.counter)
+        self.counter += 1
+        if self.counter >= 1 << 64:
+            raise OverflowError("nonce counter overflow")
+        return n
+
+
+def derive_secrets(dh_secret: bytes, loc_is_least: bool) -> tuple[bytes, bytes, bytes]:
+    """HKDF → (recv_key, send_key, challenge) (ref: deriveSecrets :337)."""
+    okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=None, info=_HKDF_INFO).derive(dh_secret)
+    if loc_is_least:
+        recv_key, send_key = okm[0:32], okm[32:64]
+    else:
+        send_key, recv_key = okm[0:32], okm[32:64]
+    return recv_key, send_key, okm[64:96]
+
+
+class SecretConnection:
+    """Wraps a duplex byte stream (an object with sendall/recv/close —
+    i.e. a socket) in authenticated encryption."""
+
+    def __init__(self, sock, priv_key):
+        self._sock = sock
+        self.local_pub_key = priv_key.pub_key()
+        self.remote_pub_key: Ed25519PubKey | None = None
+
+        # 1. ephemeral key exchange
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+        self._write_all(eph_pub)
+        remote_eph_pub = self._read_exact(32)
+
+        # 2. derive keys; "least" side by raw pubkey comparison (:128)
+        dh = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph_pub))
+        loc_is_least = eph_pub < remote_eph_pub
+        recv_key, send_key, challenge = derive_secrets(dh, loc_is_least)
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = _NonceCounter()
+        self._recv_nonce = _NonceCounter()
+        self._recv_buf = b""
+
+        # 4. authenticate: sign the shared challenge with the node key
+        sig = priv_key.sign(challenge)
+        auth = json.dumps(
+            {"pub_key": self.local_pub_key.bytes().hex(), "sig": sig.hex()}
+        ).encode()
+        self.write(struct.pack("<I", len(auth)) + auth)
+        hdr = self.read_exact(4)
+        (alen,) = struct.unpack("<I", hdr)
+        if alen > 4096:
+            raise ValueError("oversized auth message")
+        peer_auth = json.loads(self.read_exact(alen).decode())
+        peer_pub = Ed25519PubKey(bytes.fromhex(peer_auth["pub_key"]))
+        if not peer_pub.verify_signature(challenge, bytes.fromhex(peer_auth["sig"])):
+            raise ValueError("challenge verification failed")
+        self.remote_pub_key = peer_pub
+
+    # ----------------------------------------------------------- raw stream
+
+    def _write_all(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed")
+            buf += chunk
+        return buf
+
+    # ------------------------------------------------------- sealed stream
+
+    def write(self, data: bytes) -> int:
+        """Frame + seal + send (ref: secret_connection.go:243 Write)."""
+        n = 0
+        view = memoryview(data)
+        while view:
+            chunk = bytes(view[:DATA_MAX_SIZE])
+            view = view[len(chunk):]
+            frame = struct.pack("<I", len(chunk)) + chunk
+            frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+            sealed = self._send_aead.encrypt(self._send_nonce.next(), frame, None)
+            self._write_all(sealed)
+            n += len(chunk)
+        return n
+
+    def _read_frame(self) -> bytes:
+        sealed = self._read_exact(SEALED_FRAME_SIZE)
+        frame = self._recv_aead.decrypt(self._recv_nonce.next(), sealed, None)
+        (chunk_len,) = struct.unpack("<I", frame[:DATA_LEN_SIZE])
+        if chunk_len > DATA_MAX_SIZE:
+            raise ValueError("chunk length exceeds frame size")
+        return frame[DATA_LEN_SIZE : DATA_LEN_SIZE + chunk_len]
+
+    def read(self, n: int) -> bytes:
+        """Read up to n plaintext bytes (ref: :274 Read)."""
+        if not self._recv_buf:
+            self._recv_buf = self._read_frame()
+        out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
+        return out
+
+    def read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.read(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

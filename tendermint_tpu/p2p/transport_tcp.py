@@ -1,0 +1,193 @@
+"""TCP transport: SecretConnection + channel-multiplexed framing
+(ref: internal/p2p/transport_mconn.go + internal/p2p/conn/connection.go).
+
+Wire format after the SecretConnection handshake: each message is one
+frame `varint(total_len) || channel_id byte || payload`. Channel codecs
+(ChannelDescriptor.encode/decode) translate payload bytes ↔ message
+objects; unknown channels are dropped by the router.
+
+The reference splits messages into 1024-byte MConnection packets with
+per-channel priority queues and flowrate throttling
+(conn/connection.go:45-46: 500 KB/s each way). Here the SecretConnection
+already chunks at 1024 bytes; prioritization happens in the router's
+per-peer queue, and OS socket buffering provides backpressure.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any
+
+from .secret_connection import SecretConnection
+from .transport import Connection, ConnectionClosed, Endpoint, Transport
+from .types import ChannelDescriptor, NodeInfo, node_id_from_pubkey
+
+MAX_MSG_SIZE = 1 << 22  # 4 MiB, ref: conn/connection.go maxPacketMsgPayloadSize scaled
+
+
+def _encode_uvarint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class TcpConnection(Connection):
+    def __init__(self, sock: socket.socket, channel_descs: dict[int, ChannelDescriptor]):
+        self._sock = sock
+        self._descs = channel_descs
+        self._secret: SecretConnection | None = None
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self._closed = threading.Event()
+        try:
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+    def handshake(self, node_info: NodeInfo, priv_key, timeout: float | None = None) -> tuple[NodeInfo, Any]:
+        """SecretConnection handshake authenticates keys; then NodeInfo
+        exchange (ref: transport_mconn.go:116 Handshake)."""
+        self._sock.settimeout(timeout)
+        self._secret = SecretConnection(self._sock, priv_key)
+        import json
+
+        payload = json.dumps(node_info.to_wire()).encode()
+        self._secret.write(struct.pack("<I", len(payload)) + payload)
+        (plen,) = struct.unpack("<I", self._secret.read_exact(4))
+        if plen > 1 << 20:
+            raise ValueError("oversized NodeInfo")
+        peer_info = NodeInfo.from_wire(json.loads(self._secret.read_exact(plen).decode()))
+        peer_key = self._secret.remote_pub_key
+        if node_id_from_pubkey(peer_key) != peer_info.node_id:
+            raise ValueError("peer's public key does not match its node ID")
+        self._sock.settimeout(None)
+        return peer_info, peer_key
+
+    def send_message(self, channel_id: int, message) -> None:
+        if self._closed.is_set():
+            raise ConnectionClosed("connection closed")
+        desc = self._descs.get(channel_id)
+        if desc is None or desc.encode is None:
+            raise ValueError(f"no codec for channel {channel_id:#x}")
+        payload = desc.encode(message)
+        if len(payload) + 1 > MAX_MSG_SIZE:
+            raise ValueError("message exceeds maximum size")
+        frame = _encode_uvarint(len(payload) + 1) + bytes([channel_id]) + payload
+        with self._send_lock:
+            try:
+                self._secret.write(frame)
+            except (OSError, ConnectionError) as e:
+                self._closed.set()
+                raise ConnectionClosed(str(e))
+
+    def _read_uvarint(self) -> int:
+        result, shift = 0, 0
+        while True:
+            b = self._secret.read_exact(1)[0]
+            result |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return result
+            shift += 7
+            if shift > 63:
+                raise ValueError("uvarint overflow")
+
+    def receive_message(self, timeout: float | None = None) -> tuple[int, Any]:
+        if self._closed.is_set():
+            raise ConnectionClosed("connection closed")
+        with self._recv_lock:
+            try:
+                self._sock.settimeout(timeout)
+                total = self._read_uvarint()
+                if total < 1 or total > MAX_MSG_SIZE:
+                    raise ValueError(f"invalid frame length {total}")
+                self._sock.settimeout(None)  # got a header; finish the frame
+                body = self._secret.read_exact(total)
+            except socket.timeout:
+                raise TimeoutError("receive timed out")
+            except (OSError, ConnectionError, ValueError) as e:
+                self._closed.set()
+                raise ConnectionClosed(str(e))
+        channel_id = body[0]
+        desc = self._descs.get(channel_id)
+        if desc is None or desc.decode is None:
+            return channel_id, body[1:]  # router drops unknown channels
+        return channel_id, desc.decode(body[1:])
+
+    def local_endpoint(self) -> Endpoint:
+        try:
+            host, port = self._sock.getsockname()[:2]
+        except OSError:
+            host, port = "", 0
+        return Endpoint(protocol="mconn", host=host, port=port)
+
+    def remote_endpoint(self) -> Endpoint:
+        try:
+            host, port = self._sock.getpeername()[:2]
+        except OSError:
+            host, port = "", 0
+        return Endpoint(protocol="mconn", host=host, port=port)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpTransport(Transport):
+    """ref: transport_mconn.go MConnTransport."""
+
+    protocol = "mconn"
+
+    def __init__(self, channel_descs: list[ChannelDescriptor], bind_host: str = "127.0.0.1", bind_port: int = 0):
+        self._descs = {d.id: d for d in channel_descs}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind_host, bind_port))
+        self._listener.listen(64)
+        self._closed = threading.Event()
+
+    def add_channel_descriptors(self, descs: list[ChannelDescriptor]) -> None:
+        for d in descs:
+            self._descs[d.id] = d
+
+    def endpoint(self) -> Endpoint:
+        host, port = self._listener.getsockname()[:2]
+        return Endpoint(protocol="mconn", host=host, port=port)
+
+    def accept(self, timeout: float | None = None) -> Connection:
+        if self._closed.is_set():
+            raise ConnectionClosed("transport closed")
+        self._listener.settimeout(timeout)
+        try:
+            sock, _ = self._listener.accept()
+        except socket.timeout:
+            raise TimeoutError("accept timed out")
+        except OSError as e:
+            raise ConnectionClosed(str(e))
+        return TcpConnection(sock, self._descs)
+
+    def dial(self, endpoint: Endpoint, timeout: float | None = None) -> Connection:
+        sock = socket.create_connection((endpoint.host, endpoint.port), timeout=timeout)
+        sock.settimeout(None)
+        return TcpConnection(sock, self._descs)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

@@ -1,0 +1,244 @@
+"""P2P stack tests (ref: internal/p2p/router_test.go,
+peermanager_test.go, conn/secret_connection_test.go)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    Envelope,
+    MemoryNetwork,
+    NodeInfo,
+    PeerManager,
+    PeerManagerOptions,
+    PEER_STATUS_DOWN,
+    PEER_STATUS_UP,
+    Router,
+    node_id_from_pubkey,
+)
+from tendermint_tpu.p2p.secret_connection import SecretConnection
+from tendermint_tpu.p2p.transport import Endpoint
+from tendermint_tpu.p2p.transport_tcp import TcpTransport
+
+
+def _make_node(network: MemoryNetwork, seed: int, chain_id: str = "p2p-test"):
+    key = Ed25519PrivKey.generate(bytes([seed]) * 32)
+    nid = node_id_from_pubkey(key.pub_key())
+    transport = network.create_transport(nid)
+    info = NodeInfo(node_id=nid, network=chain_id, listen_addr=f"memory:{nid}")
+    pm = PeerManager(nid, PeerManagerOptions(max_connected=8))
+    router = Router(info, key, pm, [transport])
+    return key, nid, pm, router
+
+
+CH_TEST = ChannelDescriptor(id=0x77, name="test", priority=5)
+
+
+def wait_until(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_endpoint_parse_roundtrip():
+    ep = Endpoint.parse("mconn://" + "ab" * 20 + "@127.0.0.1:26656")
+    assert ep.protocol == "mconn" and ep.port == 26656 and ep.node_id == "ab" * 20
+    assert Endpoint.parse(str(ep)) == ep
+    mem = Endpoint.parse("memory:" + "cd" * 20)
+    assert mem.protocol == "memory" and mem.node_id == "cd" * 20
+
+
+def test_router_two_nodes_memory_roundtrip():
+    net = MemoryNetwork()
+    _, nid_a, pm_a, router_a = _make_node(net, 1)
+    _, nid_b, pm_b, router_b = _make_node(net, 2)
+    ch_a = router_a.open_channel(CH_TEST)
+    ch_b = router_b.open_channel(ChannelDescriptor(id=0x77, name="test", priority=5))
+    router_a.start()
+    router_b.start()
+    try:
+        pm_a.add(Endpoint(protocol="memory", host=nid_b, node_id=nid_b))
+        assert wait_until(lambda: nid_b in pm_a.peers())
+        assert wait_until(lambda: nid_a in pm_b.peers())
+
+        ch_a.send_to(nid_b, {"hello": "world"})
+        env = ch_b.receive_one(timeout=5)
+        assert env is not None and env.message == {"hello": "world"} and env.from_ == nid_a
+
+        ch_b.broadcast({"reply": 42})
+        env2 = ch_a.receive_one(timeout=5)
+        assert env2 is not None and env2.message == {"reply": 42} and env2.from_ == nid_b
+    finally:
+        router_a.stop()
+        router_b.stop()
+
+
+def test_router_peer_error_evicts():
+    net = MemoryNetwork()
+    _, nid_a, pm_a, router_a = _make_node(net, 3)
+    _, nid_b, pm_b, router_b = _make_node(net, 4)
+    ch_a = router_a.open_channel(CH_TEST)
+    router_b.open_channel(ChannelDescriptor(id=0x77, name="test"))
+    updates = []
+    pm_a.subscribe(lambda u: updates.append(u))
+    router_a.start()
+    router_b.start()
+    try:
+        pm_a.add(Endpoint(protocol="memory", host=nid_b, node_id=nid_b))
+        assert wait_until(lambda: nid_b in pm_a.peers())
+        from tendermint_tpu.p2p.types import PeerError
+
+        ch_a.send_error(PeerError(node_id=nid_b, err="bad peer"))
+        # Evicted → disconnected (the dialer may immediately reconnect,
+        # matching the reference: eviction doesn't blacklist the address).
+        assert wait_until(lambda: PEER_STATUS_DOWN in [u.status for u in updates])
+        assert PEER_STATUS_UP in [u.status for u in updates]
+    finally:
+        router_a.stop()
+        router_b.stop()
+
+
+def test_peer_manager_dial_retry_backoff():
+    pm = PeerManager("aa" * 20, PeerManagerOptions(max_connected=4, min_retry_time=60.0))
+    ep = Endpoint(protocol="memory", host="bb" * 20, node_id="bb" * 20)
+    assert pm.add(ep)
+    got = pm.try_dial_next()
+    assert got == ep
+    pm.dial_failed(ep)
+    # within backoff window → no redial
+    assert pm.try_dial_next() is None
+
+
+def test_peer_manager_upgrade_eviction():
+    """A persistent (max-score) candidate evicts a low-scored peer at capacity
+    (ref: peermanager.go upgrade slots)."""
+    persistent = "cc" * 20
+    pm = PeerManager("aa" * 20, PeerManagerOptions(max_connected=1, persistent_peers=[persistent]))
+    pm.add(Endpoint(protocol="memory", host="bb" * 20, node_id="bb" * 20))
+    ep1 = pm.try_dial_next()
+    pm.dialed(ep1)
+    pm.ready("bb" * 20, set())
+    pm.add(Endpoint(protocol="memory", host=persistent, node_id=persistent))
+    ep2 = pm.try_dial_next()
+    assert ep2 is not None and ep2.node_id == persistent
+    pm.dialed(ep2)  # at capacity → marks victim for eviction
+    assert pm.try_evict_next() == "bb" * 20
+
+
+def test_peer_manager_max_connected_rejects_accept():
+    pm = PeerManager("aa" * 20, PeerManagerOptions(max_connected=1, max_connected_upgrade=0))
+    pm.accepted("bb" * 20)
+    with pytest.raises(ValueError):
+        pm.accepted("cc" * 20)
+
+
+def test_peer_store_persistence():
+    from tendermint_tpu.store.kv import MemDB
+
+    db = MemDB()
+    pm = PeerManager("aa" * 20, db=db)
+    ep = Endpoint(protocol="memory", host="bb" * 20, node_id="bb" * 20)
+    pm.add(ep)
+    pm2 = PeerManager("aa" * 20, db=db)
+    assert pm2.store.get("bb" * 20) is not None
+    assert str(list(pm2.store.get("bb" * 20).address_info.values())[0].endpoint) == str(ep)
+
+
+def test_secret_connection_roundtrip():
+    """Full STS handshake + bidirectional sealed traffic over a socketpair
+    (ref: conn/secret_connection_test.go TestSecretConnectionHandshake)."""
+    key_a = Ed25519PrivKey.generate(b"\x11" * 32)
+    key_b = Ed25519PrivKey.generate(b"\x12" * 32)
+    sock_a, sock_b = socket.socketpair()
+    result = {}
+
+    def server():
+        sc = SecretConnection(sock_b, key_b)
+        result["server"] = sc
+        assert sc.read_exact(11) == b"hello world"
+        sc.write(b"general kenobi")
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    sc_a = SecretConnection(sock_a, key_a)
+    sc_a.write(b"hello world")
+    assert sc_a.read_exact(14) == b"general kenobi"
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert sc_a.remote_pub_key.bytes() == key_b.pub_key().bytes()
+    assert result["server"].remote_pub_key.bytes() == key_a.pub_key().bytes()
+
+
+def test_secret_connection_large_payload():
+    key_a = Ed25519PrivKey.generate(b"\x13" * 32)
+    key_b = Ed25519PrivKey.generate(b"\x14" * 32)
+    sock_a, sock_b = socket.socketpair()
+    payload = bytes(range(256)) * 40  # > 1024-byte frame size
+
+    def server():
+        sc = SecretConnection(sock_b, key_b)
+        sc.write(sc.read_exact(len(payload)))
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    sc_a = SecretConnection(sock_a, key_a)
+    sc_a.write(payload)
+    assert sc_a.read_exact(len(payload)) == payload
+    th.join(timeout=5)
+
+
+def test_tcp_transport_router_roundtrip():
+    """Two routers over real TCP + SecretConnection with a JSON codec."""
+    import json
+
+    desc = ChannelDescriptor(
+        id=0x77,
+        name="test",
+        encode=lambda m: json.dumps(m).encode(),
+        decode=lambda b: json.loads(b.decode()),
+    )
+    key_a = Ed25519PrivKey.generate(b"\x21" * 32)
+    key_b = Ed25519PrivKey.generate(b"\x22" * 32)
+    nid_a = node_id_from_pubkey(key_a.pub_key())
+    nid_b = node_id_from_pubkey(key_b.pub_key())
+    t_a = TcpTransport([desc])
+    t_b = TcpTransport([desc])
+    pm_a = PeerManager(nid_a)
+    pm_b = PeerManager(nid_b)
+    router_a = Router(NodeInfo(node_id=nid_a, network="tcp-test"), key_a, pm_a, [t_a])
+    router_b = Router(NodeInfo(node_id=nid_b, network="tcp-test"), key_b, pm_b, [t_b])
+    ch_a = router_a.open_channel(desc)
+    ch_b = router_b.open_channel(ChannelDescriptor(id=0x77, name="test", encode=desc.encode, decode=desc.decode))
+    router_a.start()
+    router_b.start()
+    try:
+        ep_b = t_b.endpoint()
+        pm_a.add(Endpoint(protocol="mconn", host=ep_b.host, port=ep_b.port, node_id=nid_b))
+        assert wait_until(lambda: nid_b in pm_a.peers(), timeout=10)
+        ch_a.send_to(nid_b, {"n": 7})
+        env = ch_b.receive_one(timeout=10)
+        assert env is not None and env.message == {"n": 7} and env.from_ == nid_a
+        ch_b.send_to(nid_a, {"n": 8})
+        env2 = ch_a.receive_one(timeout=10)
+        assert env2 is not None and env2.message == {"n": 8}
+    finally:
+        router_a.stop()
+        router_b.stop()
+
+
+def test_node_info_compatibility():
+    a = NodeInfo(node_id="aa" * 20, network="net-1", channels=bytes([0x20]))
+    b = NodeInfo(node_id="bb" * 20, network="net-1", channels=bytes([0x20, 0x21]))
+    a.compatible_with(b)
+    c = NodeInfo(node_id="cc" * 20, network="net-2", channels=bytes([0x20]))
+    with pytest.raises(ValueError):
+        a.compatible_with(c)
