@@ -11,6 +11,7 @@ from .messages import (  # noqa: F401
     VoteSetBitsMessage,
     VoteSetMaj23Message,
 )
+from .handshake import AppHashMismatchError, Handshaker, HandshakeError  # noqa: F401
 from .round_state import HeightVoteSet, RoundState  # noqa: F401
 from .state import ConsensusError, ConsensusState  # noqa: F401
 from .ticker import TimeoutTicker  # noqa: F401

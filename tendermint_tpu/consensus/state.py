@@ -727,7 +727,11 @@ class ConsensusState:
             self._try_finalize_commit(height)
 
     def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
-        """ref: tryAddVote (state.go:2289)."""
+        """ref: tryAddVote (state.go:2289). Only *vote-level* errors
+        (bad sig, wrong index, conflicts) are non-fatal; anything raised
+        downstream of a 2/3 majority (enterCommit → ApplyBlock) is a
+        consensus failure and must propagate to halt the node, as the
+        reference's panics do."""
         try:
             return self._add_vote(vote, peer_id)
         except ConflictingVoteError as e:
@@ -737,7 +741,8 @@ class ConsensusState:
             if self.evpool is not None:
                 self.evpool.report_conflicting_votes(e.conflicting, e.new)
             return False
-        except Exception:
+        except ValueError:
+            # VoteSet.add_vote rejection (invalid index/address/signature)
             return False
 
     def _add_vote(self, vote: Vote, peer_id: str) -> bool:
@@ -766,6 +771,8 @@ class ConsensusState:
             my_addr = self.priv_pub_key.address() if self.priv_pub_key else b""
             if vote.type == PRECOMMIT and not vote.block_id.is_nil() and vote.validator_address != my_addr:
                 _, val = self.state.validators.get_by_index(vote.validator_index)
+                if val is None:
+                    return False  # unknown validator index — reject, don't crash
                 vote.verify_with_extension(self.state.chain_id, val.pub_key)
                 if not self.block_exec.verify_vote_extension(vote):
                     return False

@@ -1,0 +1,13 @@
+"""Evidence subsystem: pool, verification, gossip reactor
+(ref: internal/evidence/)."""
+
+from .pool import EvidencePool, EvidenceError
+from .verify import verify_evidence, verify_duplicate_vote, verify_light_client_attack
+
+__all__ = [
+    "EvidencePool",
+    "EvidenceError",
+    "verify_evidence",
+    "verify_duplicate_vote",
+    "verify_light_client_attack",
+]

@@ -1,0 +1,171 @@
+"""Evidence pool (ref: internal/evidence/pool.go).
+
+Holds pending (uncommitted, unexpired) evidence in a KV store + an
+in-memory list for gossip and proposal inclusion. Consensus reports
+conflicting votes via `report_conflicting_votes` (pool.go:187); they are
+converted into DuplicateVoteEvidence at the next `update` once the
+block time is known (pool.go:132 processConsensusBuffer in spirit).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..types.evidence import (
+    DuplicateVoteEvidence,
+    LightClientAttackEvidence,
+    evidence_from_proto,
+    evidence_to_proto,
+)
+from .verify import EvidenceVerifyError, verify_evidence
+
+_PENDING_PREFIX = b"ev/pending/"
+_COMMITTED_PREFIX = b"ev/committed/"
+
+
+class EvidenceError(Exception):
+    """ref: types.ErrInvalidEvidence."""
+
+
+def _key(prefix: bytes, ev) -> bytes:
+    return prefix + ev.height.to_bytes(8, "big") + ev.hash()
+
+
+class EvidencePool:
+    """ref: evidence.Pool (pool.go:42)."""
+
+    def __init__(self, db, state_store, block_store, logger=None):
+        self.db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.logger = logger
+        self._lock = threading.RLock()
+        self._pending: dict[bytes, object] = {}  # hash → evidence
+        self._consensus_buffer: list[tuple] = []  # (vote_a, vote_b)
+        self._state = state_store.load()
+        self._load_pending()
+
+    # ------------------------------------------------------------- queries
+
+    def pending_evidence(self, max_bytes: int) -> tuple[list, int]:
+        """Evidence for block inclusion, within the byte budget; returns
+        (evidence, total_bytes) (ref: pool.go:90 PendingEvidence)."""
+        with self._lock:
+            out, size = [], 0
+            for ev in sorted(self._pending.values(), key=lambda e: (e.height, e.hash())):
+                sz = len(ev.bytes()) + 8  # proto overhead margin
+                if size + sz > max_bytes:
+                    break
+                out.append(ev)
+                size += sz
+            return out, size
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # ------------------------------------------------------------ mutation
+
+    def add_evidence(self, ev) -> None:
+        """Validate + persist new (gossiped or locally formed) evidence
+        (ref: pool.go:118 AddEvidence)."""
+        with self._lock:
+            h = ev.hash()
+            if h in self._pending or self._is_committed(ev):
+                return  # idempotent
+            verify_evidence(ev, self._state, self.state_store, self.block_store)
+            self._add_pending(ev)
+
+    def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        """Called by consensus on a double-sign (ref: pool.go:187
+        ReportConflictingVotes). Buffered until the next Update when the
+        block time and validator set are final."""
+        with self._lock:
+            self._consensus_buffer.append((vote_a, vote_b))
+
+    def check_evidence(self, ev_list: list) -> None:
+        """Validate a proposed block's evidence list (ref: pool.go:200
+        CheckEvidence). Raises EvidenceError on any invalid item."""
+        hashes = set()
+        with self._lock:
+            for ev in ev_list:
+                h = ev.hash()
+                if h in hashes:
+                    raise EvidenceError("duplicate evidence in list")
+                hashes.add(h)
+                if self._is_committed(ev):
+                    raise EvidenceError("evidence was already committed")
+                if h not in self._pending:
+                    try:
+                        verify_evidence(ev, self._state, self.state_store, self.block_store)
+                    except EvidenceVerifyError as e:
+                        raise EvidenceError(str(e))
+                    self._add_pending(ev)
+
+    def update(self, state, ev_list: list) -> None:
+        """Post-commit bookkeeping (ref: pool.go:102 Update): mark the
+        block's evidence committed, convert buffered conflicting votes,
+        prune expired."""
+        with self._lock:
+            if state.last_block_height <= self._state.last_block_height:
+                raise ValueError(
+                    f"failed EvidencePool.Update: new state height {state.last_block_height} "
+                    f"not greater than previous {self._state.last_block_height}"
+                )
+            self._state = state
+            for ev in ev_list:
+                self._mark_committed(ev)
+            self._process_consensus_buffer(state)
+            self._prune_expired()
+
+    # ------------------------------------------------------------ internals
+
+    def _load_pending(self) -> None:
+        for key, value in self.db.iterator(_PENDING_PREFIX, _PENDING_PREFIX + b"\xff"):
+            from ..proto import messages as pb
+
+            ev = evidence_from_proto(pb.Evidence.decode(value))
+            self._pending[ev.hash()] = ev
+
+    def _add_pending(self, ev) -> None:
+        self._pending[ev.hash()] = ev
+        self.db.set(_key(_PENDING_PREFIX, ev), evidence_to_proto(ev).encode())
+
+    def _mark_committed(self, ev) -> None:
+        h = ev.hash()
+        self._pending.pop(h, None)
+        self.db.delete(_key(_PENDING_PREFIX, ev))
+        # committed marker carries only the height (pool.go:272)
+        self.db.set(_COMMITTED_PREFIX + h, ev.height.to_bytes(8, "big"))
+
+    def _is_committed(self, ev) -> bool:
+        return self.db.has(_COMMITTED_PREFIX + ev.hash())
+
+    def _process_consensus_buffer(self, state) -> None:
+        """ref: pool.go:132 processConsensusBuffer."""
+        for vote_a, vote_b in self._consensus_buffer:
+            try:
+                val_set = self.state_store.load_validators(vote_a.height)
+                if val_set is None:
+                    continue
+                block_meta = self.block_store.load_block_meta(vote_a.height)
+                ev_time = block_meta.header.time if block_meta else state.last_block_time
+                ev = DuplicateVoteEvidence.new(vote_a, vote_b, ev_time, val_set)
+                if ev.hash() not in self._pending and not self._is_committed(ev):
+                    self._add_pending(ev)
+            except Exception:
+                continue
+        self._consensus_buffer.clear()
+
+    def _prune_expired(self) -> None:
+        """Both windows must lapse (ref: pool.go:264 removeExpiredPendingEvidence
+        → isExpired pool.go:480: height AND time)."""
+        params = self._state.consensus_params.evidence
+        height = self._state.last_block_height
+        now_ns = self._state.last_block_time.unix_ns()
+        for h, ev in list(self._pending.items()):
+            expired_height = ev.height <= height - params.max_age_num_blocks
+            expired_time = ev.time.unix_ns() <= now_ns - params.max_age_duration
+            if expired_height and expired_time:
+                self._pending.pop(h, None)
+                self.db.delete(_key(_PENDING_PREFIX, ev))

@@ -1,0 +1,103 @@
+"""Evidence gossip reactor (ref: internal/evidence/reactor.go).
+
+Broadcasts pending evidence to every peer via a per-peer thread walking
+the pool (the reference walks a clist, reactor.go:159 broadcastEvidenceLoop);
+inbound evidence is added to the pool, invalid senders are reported.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..p2p.types import (
+    CHANNEL_EVIDENCE,
+    ChannelDescriptor,
+    PEER_STATUS_UP,
+    PeerError,
+)
+from ..proto import messages as pb
+from ..types.evidence import evidence_from_proto, evidence_to_proto
+from .pool import EvidencePool
+
+
+def evidence_channel_descriptor() -> ChannelDescriptor:
+    """Channel 0x38, priority 6 (ref: evidence/reactor.go:21,36-39)."""
+    return ChannelDescriptor(
+        id=CHANNEL_EVIDENCE,
+        name="evidence",
+        priority=6,
+        recv_message_capacity=1048576,
+        encode=lambda ev: evidence_to_proto(ev).encode(),
+        decode=lambda b: evidence_from_proto(pb.Evidence.decode(b)),
+    )
+
+
+class EvidenceReactor:
+    BROADCAST_INTERVAL = 0.5  # re-scan cadence for new pending evidence
+
+    def __init__(self, pool: EvidencePool, channel, peer_manager):
+        self.pool = pool
+        self.channel = channel
+        self.peer_manager = peer_manager
+        self._peers: dict[str, set[bytes]] = {}  # peer → hashes already sent
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        self.peer_manager.subscribe(self._on_peer_update)
+        for nid in self.peer_manager.peers():
+            self._add_peer(nid)
+        t = threading.Thread(target=self._recv_loop, daemon=True, name="evidence-recv")
+        t.start()
+        self._threads.append(t)
+        t2 = threading.Thread(target=self._broadcast_loop, daemon=True, name="evidence-bcast")
+        t2.start()
+        self._threads.append(t2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.peer_manager.unsubscribe(self._on_peer_update)
+
+    def _on_peer_update(self, update) -> None:
+        if update.status == PEER_STATUS_UP:
+            self._add_peer(update.node_id)
+        else:
+            with self._lock:
+                self._peers.pop(update.node_id, None)
+
+    def _add_peer(self, nid: str) -> None:
+        with self._lock:
+            self._peers.setdefault(nid, set())
+
+    def _broadcast_loop(self) -> None:
+        """Send every pending evidence to every peer exactly once
+        (ref: reactor.go:159 broadcastEvidenceLoop)."""
+        while not self._stop.is_set():
+            pending, _ = self.pool.pending_evidence(1 << 20)
+            with self._lock:
+                peers = list(self._peers.items())
+            for nid, sent in peers:
+                for ev in pending:
+                    h = ev.hash()
+                    if h in sent:
+                        continue
+                    if self.channel.send_to(nid, ev, timeout=1.0):
+                        sent.add(h)
+            self._stop.wait(self.BROADCAST_INTERVAL)
+
+    def _recv_loop(self) -> None:
+        """ref: reactor.go:109 handleEvidenceMessage."""
+        while not self._stop.is_set():
+            env = self.channel.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            try:
+                self.pool.add_evidence(env.message)
+                with self._lock:
+                    sent = self._peers.get(env.from_)
+                    if sent is not None:
+                        sent.add(env.message.hash())
+            except Exception as e:
+                self.channel.send_error(PeerError(node_id=env.from_, err=e))

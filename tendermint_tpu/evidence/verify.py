@@ -1,0 +1,157 @@
+"""Evidence verification (ref: internal/evidence/verify.go).
+
+Two evidence kinds:
+  - DuplicateVoteEvidence: two conflicting votes by one validator for
+    the same height/round/type (verify.go:211 VerifyDuplicateVote)
+  - LightClientAttackEvidence: a conflicting light block signed by a
+    subset of a historical validator set (verify.go:115
+    VerifyLightClientAttack) — commit checks route through the same
+    batched TPU verification plane as block application
+    (verify.go:165 VerifyCommitLightTrusting, :177 VerifyCommitLight)
+"""
+
+from __future__ import annotations
+
+from ..types.evidence import DuplicateVoteEvidence, LightClientAttackEvidence
+from ..types.validation import (
+    Fraction,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+class EvidenceVerifyError(Exception):
+    pass
+
+
+def verify_evidence(ev, state, state_store, block_store) -> None:
+    """Full contextual verification (ref: verify.go:34 verify).
+
+    Checks age (both height AND time window must be exceeded for
+    expiry, verify.go:59), then dispatches by type.
+    """
+    height = state.last_block_height
+    ev_params = state.consensus_params.evidence
+
+    age_height = height - ev.height
+    header = _header_at(block_store, ev.height)
+    if header is None:
+        raise EvidenceVerifyError(f"don't have header at height #{ev.height}")
+    ev_time = header.time
+    age_duration_ns = state.last_block_time.unix_ns() - ev_time.unix_ns()
+
+    if age_duration_ns > ev_params.max_age_duration and age_height > ev_params.max_age_num_blocks:
+        raise EvidenceVerifyError(
+            f"evidence from height {ev.height} is too old; min height is "
+            f"{height - ev_params.max_age_num_blocks}"
+        )
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        val_set = state_store.load_validators(ev.height)
+        if val_set is None:
+            raise EvidenceVerifyError(f"no validator set at height {ev.height}")
+        verify_duplicate_vote(ev, state.chain_id, val_set)
+        # the evidence's recorded time must match the block time at its
+        # height (verify.go:91 — prevents time-based expiry gaming)
+        if ev.timestamp != ev_time:
+            raise EvidenceVerifyError(
+                f"evidence has a different time to the block it is associated with "
+                f"({ev.timestamp} != {ev_time})"
+            )
+    elif isinstance(ev, LightClientAttackEvidence):
+        common_height = ev.common_height
+        common_vals = state_store.load_validators(common_height)
+        if common_vals is None:
+            raise EvidenceVerifyError(f"no validator set at common height {common_height}")
+        trusted_header = _header_at(block_store, ev.conflicting_block.height)
+        if trusted_header is None:
+            # conflicting header is at a future height: use the latest header
+            trusted_header = _header_at(block_store, block_store.height())
+            if trusted_header is None:
+                raise EvidenceVerifyError("no trusted header available")
+        common_header = _header_at(block_store, common_height)
+        if common_header is None:
+            raise EvidenceVerifyError(f"no header at common height {common_height} (pruned?)")
+        verify_light_client_attack(
+            ev, common_header, trusted_header, common_vals, state.chain_id
+        )
+        if ev.timestamp != common_header.time:
+            raise EvidenceVerifyError(
+                f"evidence has a different time to the block it is associated with "
+                f"({ev.timestamp} != {common_header.time})"
+            )
+    else:
+        raise EvidenceVerifyError(f"unrecognized evidence type: {type(ev)}")
+
+
+def _header_at(block_store, height: int):
+    meta = block_store.load_block_meta(height)
+    return meta.header if meta is not None else None
+
+
+def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set) -> None:
+    """ref: verify.go:211 VerifyDuplicateVote."""
+    a, b = ev.vote_a, ev.vote_b
+    if a.height != b.height or a.round != b.round or a.type != b.type:
+        raise EvidenceVerifyError(f"h/r/s does not match: {a.height}/{a.round}/{a.type} vs {b.height}/{b.round}/{b.type}")
+    if a.validator_address != b.validator_address:
+        raise EvidenceVerifyError("validator addresses do not match")
+    if a.block_id.key() == b.block_id.key():
+        raise EvidenceVerifyError("block IDs are the same — not a duplicate vote")
+    idx, val = val_set.get_by_address(a.validator_address)
+    if val is None:
+        raise EvidenceVerifyError(f"address {a.validator_address.hex()} was not a validator at height {a.height}")
+    pub_key = val.pub_key
+
+    # vote power and total power must match the evidence record (:246)
+    if ev.validator_power != val.voting_power:
+        raise EvidenceVerifyError(
+            f"validator power from evidence {ev.validator_power} != {val.voting_power}"
+        )
+    if ev.total_voting_power != val_set.total_voting_power():
+        raise EvidenceVerifyError(
+            f"total voting power from evidence {ev.total_voting_power} != {val_set.total_voting_power()}"
+        )
+
+    if not pub_key.verify_signature(a.sign_bytes(chain_id), a.signature):
+        raise EvidenceVerifyError("verifying VoteA: invalid signature")
+    if not pub_key.verify_signature(b.sign_bytes(chain_id), b.signature):
+        raise EvidenceVerifyError("verifying VoteB: invalid signature")
+
+
+def verify_light_client_attack(
+    ev: LightClientAttackEvidence,
+    common_header,
+    trusted_header,
+    common_vals,
+    chain_id: str,
+) -> None:
+    """ref: verify.go:115 VerifyLightClientAttack."""
+    sh = ev.conflicting_block.signed_header
+    # Lunatic attack: conflicting header descends from an earlier common
+    # header → a third of the COMMON val set must have signed (:160-166)
+    if common_header is not None and common_header.height != sh.header.height:
+        verify_commit_light_trusting(
+            chain_id,
+            common_vals,
+            sh.commit,
+            Fraction(1, 3),
+        )
+    else:
+        # Equivocation/amnesia: same height → conflicting validator set
+        # hash must match the trusted one (:142-150)
+        if sh.header.validators_hash != trusted_header.validators_hash:
+            raise EvidenceVerifyError(
+                f"validator hash of conflicting block ({sh.header.validators_hash.hex()}) "
+                f"does not match trusted ({trusted_header.validators_hash.hex()})"
+            )
+        verify_commit_light(
+            chain_id,
+            ev.conflicting_block.validator_set,
+            sh.commit.block_id,
+            sh.header.height,
+            sh.commit,
+        )
+
+    # evidence must actually conflict: same height, different hash, or
+    # an invalid header chain (:169-181)
+    if trusted_header.height == sh.header.height and trusted_header.hash() == sh.header.hash():
+        raise EvidenceVerifyError("headers are equal — no attack")

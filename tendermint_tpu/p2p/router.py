@@ -97,7 +97,8 @@ class Router:
         self._peer_conns: dict[str, Connection] = {}
         self._peer_channels: dict[str, set[int]] = {}
         self._peer_lock = threading.RLock()
-        self._threads: list[threading.Thread] = []
+        self._threads: list[threading.Thread] = []  # long-lived loop threads only
+        self._threads_lock = threading.Lock()
         self._stop = threading.Event()
 
     # ------------------------------------------------------------- channels
@@ -145,14 +146,24 @@ class Router:
             conn.close()
         for t in self.transports:
             t.close()
-        for th in self._threads:
+        with self._threads_lock:
+            loops = list(self._threads)
+            self._threads.clear()
+        for th in loops:
             th.join(timeout=2)
-        self._threads.clear()
 
     def _spawn(self, fn, *args) -> None:
+        """Spawn + track a long-lived loop thread (joined at stop)."""
         th = threading.Thread(target=fn, args=args, daemon=True, name=fn.__name__)
-        self._threads.append(th)
+        with self._threads_lock:
+            self._threads.append(th)
         th.start()
+
+    @staticmethod
+    def _spawn_conn(fn, *args, name: str = "conn") -> None:
+        """Per-connection thread: untracked (exits when its connection
+        closes; stop() closes every connection, unblocking them all)."""
+        threading.Thread(target=fn, args=args, daemon=True, name=name).start()
 
     # -------------------------------------------------------- channel route
 
@@ -207,7 +218,7 @@ class Router:
                 continue
             except (ConnectionClosed, OSError):
                 return
-            self._spawn(self._open_connection, conn, False, None)
+            self._spawn_conn(self._open_connection, conn, False, None, name="accept-conn")
 
     def _open_connection(self, conn: Connection, outgoing: bool, endpoint: Endpoint | None) -> None:
         """Handshake + register + run send/recv (ref: router.go:481
@@ -287,7 +298,10 @@ class Router:
             except Exception:
                 self.peer_manager.dial_failed(endpoint)
                 continue
-            self._open_connection(conn, True, endpoint)
+            # run the connection on its own thread so this dial worker is
+            # free to keep dialing (outbound peers otherwise cap at the
+            # number of dial threads)
+            self._spawn_conn(self._open_connection, conn, True, endpoint, name="dial-conn")
 
     def _transport_for(self, protocol: str) -> Transport | None:
         for t in self.transports:

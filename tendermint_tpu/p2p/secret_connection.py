@@ -65,6 +65,7 @@ class SecretConnection:
 
     def __init__(self, sock, priv_key):
         self._sock = sock
+        self._raw_buf = bytearray()
         self.local_pub_key = priv_key.pub_key()
         self.remote_pub_key: Ed25519PubKey | None = None
 
@@ -106,13 +107,16 @@ class SecretConnection:
         self._sock.sendall(data)
 
     def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
+        """Resumable exact read: on a socket timeout the partial bytes
+        stay buffered so the next call resumes mid-frame instead of
+        desynchronizing the AEAD stream."""
+        while len(self._raw_buf) < n:
+            chunk = self._sock.recv(n - len(self._raw_buf))
             if not chunk:
                 raise ConnectionError("connection closed")
-            buf += chunk
-        return buf
+            self._raw_buf += chunk
+        out, self._raw_buf = self._raw_buf[:n], self._raw_buf[n:]
+        return bytes(out)
 
     # ------------------------------------------------------- sealed stream
 

@@ -41,12 +41,15 @@ def fast_params() -> ConsensusParams:
 
 def make_node(keys, idx, gen_doc, wal_path=None):
     """One in-process consensus node over the kvstore app."""
+    from tendermint_tpu.consensus import Handshaker
+
     state = make_genesis_state(gen_doc)
     app = KVStoreApplication()
     client = LocalClient(app)
     state_store = StateStore(MemDB())
     block_store = BlockStore(MemDB())
     state_store.save(state)
+    state = Handshaker(state_store, state, block_store, gen_doc).handshake(client)
     executor = BlockExecutor(state_store, client, block_store=block_store)
     pv = FilePV(priv_key=keys[idx])
     wal = WAL(wal_path) if wal_path else None
