@@ -56,20 +56,25 @@ class Handshaker:
         store_height = self.block_store.height()
         store_base = self.block_store.base()
 
-        # 1. fresh chain → InitChain (replay.go:292-334)
+        # 1. fresh chain → InitChain (replay.go:292-334). Validators and
+        # params come from the GENESIS doc, not the current state — a
+        # fresh app on an old chain must re-derive updates by replay.
         if app_height == 0:
-            validators = [
-                abci.ValidatorUpdate(
-                    pub_key_type=v.pub_key.type_name,
-                    pub_key_bytes=v.pub_key.bytes(),
-                    power=v.voting_power,
-                )
-                for v in state.validators.validators
-            ]
+            if self.gen_doc.validators:
+                validators = [
+                    abci.ValidatorUpdate(
+                        pub_key_type=gv.pub_key.type_name,
+                        pub_key_bytes=gv.pub_key.bytes(),
+                        power=gv.power,
+                    )
+                    for gv in self.gen_doc.validators
+                ]
+            else:
+                validators = []
             req = abci.RequestInitChain(
                 time_ns=self.gen_doc.genesis_time.unix_ns(),
                 chain_id=self.gen_doc.chain_id,
-                consensus_params=state.consensus_params,
+                consensus_params=self.gen_doc.consensus_params or state.consensus_params,
                 validators=validators,
                 app_state_bytes=getattr(self.gen_doc, "app_state", b"") or b"",
                 initial_height=self.gen_doc.initial_height,
@@ -94,6 +99,11 @@ class Handshaker:
 
         # 2. app and store in sync? (replay.go:344-376)
         if store_height == 0:
+            if app_height > 0:
+                raise AppHashMismatchError(
+                    f"app is at height {app_height} but the block store is empty; "
+                    "wrong data dir or wiped chain — refusing to restart from genesis"
+                )
             return state
 
         if store_height == app_height:

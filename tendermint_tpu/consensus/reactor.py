@@ -1,0 +1,599 @@
+"""Consensus reactor — gossips the state machine over the P2P layer
+(ref: internal/consensus/reactor.go).
+
+Four channels (reactor.go:36-71):
+  0x20 State     p8  — NewRoundStep / NewValidBlock / HasVote / VoteSetMaj23
+  0x21 Data      p12 — Proposal / ProposalPOL / BlockPart
+  0x22 Vote      p10 — Vote
+  0x23 VoteSetBits p5 — VoteSetBits
+
+Outbound control messages come from the ConsensusState `broadcast` hook;
+data-plane delivery is pull-gossip: one gossipData + one gossipVotes
+thread per peer reads the (GIL-shared) RoundState and this peer's
+PeerState and sends exactly what the peer is missing (reactor.go:501,736).
+All inbound handling is idempotent, so the additional push of our own
+proposal/parts/votes costs duplicates at worst.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..p2p.types import (
+    CHANNEL_CONSENSUS_DATA,
+    CHANNEL_CONSENSUS_STATE,
+    CHANNEL_CONSENSUS_VOTE,
+    CHANNEL_CONSENSUS_VOTE_SET_BITS,
+    ChannelDescriptor,
+    PEER_STATUS_UP,
+    PeerError,
+)
+from ..proto import messages as pb
+from ..types.block import BlockID, PartSetHeader
+from ..types.part_set import Part
+from ..types.proposal import Proposal
+from ..types.vote import PRECOMMIT, PREVOTE, Vote
+from ..utils.bits import BitArray
+from .messages import (
+    BlockPartMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    VoteMessage,
+    VoteSetBitsMessage,
+    VoteSetMaj23Message,
+)
+from .peer_state import PeerState
+from .round_state import STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PROPOSE
+
+# ------------------------------------------------------------------ codecs
+#
+# Wire format: 1 tag byte + payload. Data-plane payloads are proto
+# (byte-identical with the canonical types); control payloads are JSON
+# (framework-internal, hex for bytes).
+
+
+def _psh_to_wire(h: PartSetHeader | None) -> dict:
+    h = h or PartSetHeader()
+    return {"total": h.total, "hash": h.hash.hex()}
+
+
+def _psh_from_wire(d: dict) -> PartSetHeader:
+    return PartSetHeader(total=d["total"], hash=bytes.fromhex(d["hash"]))
+
+
+def _bid_to_wire(b: BlockID) -> dict:
+    return {"hash": b.hash.hex(), "psh": _psh_to_wire(b.part_set_header)}
+
+
+def _bid_from_wire(d: dict) -> BlockID:
+    return BlockID(hash=bytes.fromhex(d["hash"]), part_set_header=_psh_from_wire(d["psh"]))
+
+
+def _ba_to_wire(ba: BitArray | None) -> dict | None:
+    if ba is None:
+        return None
+    return {"bits": ba.bits, "elems": ba.to_bytes().hex()}
+
+
+def _ba_from_wire(d: dict | None) -> BitArray | None:
+    if d is None:
+        return None
+    return BitArray.from_bytes(d["bits"], bytes.fromhex(d["elems"]))
+
+
+def encode_consensus_msg(msg) -> bytes:
+    """ref: internal/consensus/msgs.go MsgToProto."""
+    if isinstance(msg, NewRoundStepMessage):
+        return b"\x01" + json.dumps(
+            {
+                "h": msg.height,
+                "r": msg.round,
+                "s": msg.step,
+                "t": msg.seconds_since_start_time,
+                "lcr": msg.last_commit_round,
+            }
+        ).encode()
+    if isinstance(msg, NewValidBlockMessage):
+        return b"\x02" + json.dumps(
+            {
+                "h": msg.height,
+                "r": msg.round,
+                "psh": _psh_to_wire(msg.block_part_set_header),
+                "parts": _ba_to_wire(msg.block_parts),
+                "commit": msg.is_commit,
+            }
+        ).encode()
+    if isinstance(msg, ProposalMessage):
+        return b"\x03" + msg.proposal.to_proto().encode()
+    if isinstance(msg, ProposalPOLMessage):
+        return b"\x04" + json.dumps(
+            {"h": msg.height, "pr": msg.proposal_pol_round, "pol": _ba_to_wire(msg.proposal_pol)}
+        ).encode()
+    if isinstance(msg, BlockPartMessage):
+        inner = msg.part.to_proto().encode()
+        return b"\x05" + msg.height.to_bytes(8, "big") + msg.round.to_bytes(4, "big") + inner
+    if isinstance(msg, VoteMessage):
+        return b"\x06" + msg.vote.to_proto().encode()
+    if isinstance(msg, HasVoteMessage):
+        return b"\x07" + json.dumps({"h": msg.height, "r": msg.round, "t": msg.type, "i": msg.index}).encode()
+    if isinstance(msg, VoteSetMaj23Message):
+        return b"\x08" + json.dumps(
+            {"h": msg.height, "r": msg.round, "t": msg.type, "bid": _bid_to_wire(msg.block_id)}
+        ).encode()
+    if isinstance(msg, VoteSetBitsMessage):
+        return b"\x09" + json.dumps(
+            {
+                "h": msg.height,
+                "r": msg.round,
+                "t": msg.type,
+                "bid": _bid_to_wire(msg.block_id),
+                "votes": _ba_to_wire(msg.votes),
+            }
+        ).encode()
+    raise TypeError(f"unknown consensus message {type(msg)}")
+
+
+def decode_consensus_msg(data: bytes):
+    """ref: internal/consensus/msgs.go MsgFromProto."""
+    tag, body = data[0], data[1:]
+    if tag == 0x01:
+        d = json.loads(body)
+        return NewRoundStepMessage(d["h"], d["r"], d["s"], d["t"], d["lcr"])
+    if tag == 0x02:
+        d = json.loads(body)
+        return NewValidBlockMessage(
+            d["h"], d["r"], _psh_from_wire(d["psh"]), _ba_from_wire(d["parts"]), d["commit"]
+        )
+    if tag == 0x03:
+        return ProposalMessage(Proposal.from_proto(pb.Proposal.decode(body)))
+    if tag == 0x04:
+        d = json.loads(body)
+        return ProposalPOLMessage(d["h"], d["pr"], _ba_from_wire(d["pol"]))
+    if tag == 0x05:
+        height = int.from_bytes(body[:8], "big")
+        round_ = int.from_bytes(body[8:12], "big")
+        return BlockPartMessage(height, round_, Part.from_proto(pb.Part.decode(body[12:])))
+    if tag == 0x06:
+        return VoteMessage(Vote.from_proto(pb.Vote.decode(body)))
+    if tag == 0x07:
+        d = json.loads(body)
+        return HasVoteMessage(d["h"], d["r"], d["t"], d["i"])
+    if tag == 0x08:
+        d = json.loads(body)
+        return VoteSetMaj23Message(d["h"], d["r"], d["t"], _bid_from_wire(d["bid"]))
+    if tag == 0x09:
+        d = json.loads(body)
+        return VoteSetBitsMessage(d["h"], d["r"], d["t"], _bid_from_wire(d["bid"]), _ba_from_wire(d["votes"]))
+    raise ValueError(f"unknown consensus message tag {tag}")
+
+
+def consensus_channel_descriptors() -> list[ChannelDescriptor]:
+    """ref: reactor.go:36-71 (GetChannelDescriptors)."""
+    mk = lambda cid, name, prio: ChannelDescriptor(
+        id=cid,
+        name=name,
+        priority=prio,
+        send_queue_capacity=64,
+        encode=encode_consensus_msg,
+        decode=decode_consensus_msg,
+    )
+    return [
+        mk(CHANNEL_CONSENSUS_STATE, "cs-state", 8),
+        mk(CHANNEL_CONSENSUS_DATA, "cs-data", 12),
+        mk(CHANNEL_CONSENSUS_VOTE, "cs-vote", 10),
+        mk(CHANNEL_CONSENSUS_VOTE_SET_BITS, "cs-votebits", 5),
+    ]
+
+
+class ConsensusReactor:
+    """ref: internal/consensus/reactor.go Reactor."""
+
+    GOSSIP_SLEEP = 0.05  # ref: gossipSleepDuration (100ms in reference)
+    QUERY_MAJ23_SLEEP = 2.0
+
+    def __init__(self, cs, state_ch, data_ch, vote_ch, bits_ch, peer_manager, block_store):
+        self.cs = cs
+        self.state_ch = state_ch
+        self.data_ch = data_ch
+        self.vote_ch = vote_ch
+        self.bits_ch = bits_ch
+        self.peer_manager = peer_manager
+        self.block_store = block_store
+        self.peers: dict[str, PeerState] = {}
+        self._peer_threads: dict[str, list[threading.Thread]] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        cs.broadcast = self._on_state_broadcast
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.peer_manager.subscribe(self._on_peer_update)
+        for nid in self.peer_manager.peers():
+            self._add_peer(nid)
+        for fn, ch in (
+            (self._recv_state, self.state_ch),
+            (self._recv_data, self.data_ch),
+            (self._recv_vote, self.vote_ch),
+            (self._recv_bits, self.bits_ch),
+        ):
+            t = threading.Thread(target=fn, args=(ch,), daemon=True, name=fn.__name__)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.peer_manager.unsubscribe(self._on_peer_update)
+        with self._lock:
+            for ps in self.peers.values():
+                ps.running = False
+
+    # --------------------------------------------------------------- peers
+
+    def _on_peer_update(self, update) -> None:
+        if update.status == PEER_STATUS_UP:
+            self._add_peer(update.node_id)
+        else:
+            with self._lock:
+                ps = self.peers.pop(update.node_id, None)
+                if ps is not None:
+                    ps.running = False
+                self._peer_threads.pop(update.node_id, None)
+
+    def _add_peer(self, nid: str) -> None:
+        """Spawn gossip threads for a new peer (ref: reactor.go:1324
+        processPeerUpdate → spawning gossipDataRoutine etc.)."""
+        with self._lock:
+            if nid in self.peers:
+                return
+            ps = PeerState(nid)
+            self.peers[nid] = ps
+            threads = [
+                threading.Thread(target=self._gossip_data_routine, args=(ps,), daemon=True, name=f"gossip-data:{nid[:8]}"),
+                threading.Thread(target=self._gossip_votes_routine, args=(ps,), daemon=True, name=f"gossip-votes:{nid[:8]}"),
+                threading.Thread(target=self._query_maj23_routine, args=(ps,), daemon=True, name=f"maj23:{nid[:8]}"),
+            ]
+            self._peer_threads[nid] = threads
+        # announce our current state so the peer can gossip to us
+        rs = self.cs.rs
+        self.state_ch.send_to(
+            nid,
+            NewRoundStepMessage(
+                height=rs.height,
+                round=rs.round,
+                step=rs.step,
+                seconds_since_start_time=0,
+                last_commit_round=rs.last_commit.round if rs.last_commit is not None else 0,
+            ),
+        )
+        for t in threads:
+            t.start()
+
+    # ------------------------------------------------- state-machine events
+
+    def _on_state_broadcast(self, msg) -> None:
+        """Hook from ConsensusState: control messages on the State
+        channel, our own data-plane messages pushed to all peers
+        (ref: broadcastNewRoundStepMessage reactor.go:350)."""
+        if isinstance(msg, (NewRoundStepMessage, HasVoteMessage, NewValidBlockMessage)):
+            self.state_ch.broadcast(msg, timeout=0.5)
+        elif isinstance(msg, (ProposalMessage, BlockPartMessage)):
+            self.data_ch.broadcast(msg, timeout=0.5)
+        elif isinstance(msg, VoteMessage):
+            self.vote_ch.broadcast(msg, timeout=0.5)
+
+    # ------------------------------------------------------- receive loops
+
+    def _recv_state(self, ch) -> None:
+        """ref: reactor.go:1013 handleStateMessage."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            ps = self._peer_state(nid)
+            if ps is None:
+                continue
+            try:
+                if isinstance(msg, NewRoundStepMessage):
+                    ps.apply_new_round_step(msg)
+                    ps.ensure_vote_bit_arrays(msg.height, self.cs.state.validators.size())
+                    ps.ensure_vote_bit_arrays(msg.height - 1, self.cs.state.last_validators.size())
+                elif isinstance(msg, NewValidBlockMessage):
+                    ps.apply_new_valid_block(msg)
+                elif isinstance(msg, HasVoteMessage):
+                    ps.apply_has_vote(msg)
+                elif isinstance(msg, VoteSetMaj23Message):
+                    self._handle_vote_set_maj23(ps, msg)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    def _handle_vote_set_maj23(self, ps: PeerState, msg) -> None:
+        """Record the peer's claimed majority, reply with our vote bits
+        (ref: reactor.go:1041-1086)."""
+        rs = self.cs.rs
+        if rs.height != msg.height or rs.votes is None:
+            return
+        votes = rs.votes.prevotes(msg.round) if msg.type == PREVOTE else rs.votes.precommits(msg.round)
+        if votes is None:
+            return
+        votes.set_peer_maj23(ps.peer_id, msg.block_id)
+        our_bits = votes.bit_array_by_block_id(msg.block_id)
+        if our_bits is None:
+            our_bits = BitArray(votes.size())
+        self.bits_ch.send_to(
+            ps.peer_id,
+            VoteSetBitsMessage(msg.height, msg.round, msg.type, msg.block_id, our_bits),
+        )
+
+    def _recv_data(self, ch) -> None:
+        """ref: reactor.go:1094 handleDataMessage."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            ps = self._peer_state(nid)
+            if ps is None:
+                continue
+            try:
+                if isinstance(msg, ProposalMessage):
+                    ps.set_has_proposal(msg.proposal)
+                    self.cs.add_peer_message(msg, nid)
+                elif isinstance(msg, BlockPartMessage):
+                    ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
+                    self.cs.add_peer_message(msg, nid)
+                elif isinstance(msg, ProposalPOLMessage):
+                    ps.apply_proposal_pol(msg)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    def _recv_vote(self, ch) -> None:
+        """ref: reactor.go:1138 handleVoteMessage."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            ps = self._peer_state(nid)
+            if ps is None:
+                continue
+            try:
+                if isinstance(msg, VoteMessage):
+                    height = self.cs.rs.height
+                    val_size = self.cs.state.validators.size()
+                    last_size = self.cs.state.last_validators.size()
+                    ps.ensure_vote_bit_arrays(height, val_size)
+                    ps.ensure_vote_bit_arrays(height - 1, last_size)
+                    ps.set_has_vote(msg.vote)
+                    self.cs.add_peer_message(msg, nid)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    def _recv_bits(self, ch) -> None:
+        """ref: reactor.go:1172 handleVoteSetBitsMessage."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            ps = self._peer_state(nid)
+            if ps is None:
+                continue
+            try:
+                if isinstance(msg, VoteSetBitsMessage):
+                    rs = self.cs.rs
+                    our_votes = None
+                    if rs.height == msg.height and rs.votes is not None:
+                        votes = rs.votes.prevotes(msg.round) if msg.type == PREVOTE else rs.votes.precommits(msg.round)
+                        if votes is not None:
+                            our_votes = votes.bit_array_by_block_id(msg.block_id)
+                    ps.apply_vote_set_bits(msg, our_votes)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    def _peer_state(self, nid: str) -> PeerState | None:
+        with self._lock:
+            return self.peers.get(nid)
+
+    # ---------------------------------------------------------- gossip data
+
+    def _gossip_data_routine(self, ps: PeerState) -> None:
+        """ref: reactor.go:501 gossipDataRoutine."""
+        while ps.running and not self._stop.is_set():
+            rs = self.cs.rs
+            prs = ps.prs
+            try:
+                # 1. peer is missing a part of the current proposal block
+                if (
+                    rs.proposal_block_parts is not None
+                    and rs.height == prs.height
+                    and prs.proposal_block_parts is not None
+                    and rs.proposal_block_parts.has_header(prs.proposal_block_parts_header)
+                ):
+                    missing = rs.proposal_block_parts.bit_array().sub(prs.proposal_block_parts)
+                    idx, ok = missing.pick_random()
+                    if ok:
+                        part = rs.proposal_block_parts.get_part(idx)
+                        if part is not None:
+                            if self.data_ch.send_to(ps.peer_id, BlockPartMessage(rs.height, rs.round, part), timeout=1.0):
+                                ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+                            continue
+
+                # 2. peer is on an older height: feed committed block parts
+                #    (reactor.go:437 gossipDataForCatchup)
+                if 0 < prs.height < rs.height and prs.height >= self.block_store.base():
+                    if self._gossip_catchup(ps, prs):
+                        # rate-limit: catchup parts are re-sent until the
+                        # peer advances (no delivery ack — marking them
+                        # "had" would wedge a peer that wasn't ready yet)
+                        time.sleep(self.GOSSIP_SLEEP * 4)
+                        continue
+
+                # 3. peer needs the proposal itself
+                if rs.proposal is not None and rs.height == prs.height and rs.round == prs.round and not prs.proposal:
+                    self.data_ch.send_to(ps.peer_id, ProposalMessage(rs.proposal), timeout=1.0)
+                    ps.set_has_proposal(rs.proposal)
+                    # also send POL prevote bits (reactor.go:679)
+                    if 0 <= rs.proposal.pol_round and rs.votes is not None:
+                        pol = rs.votes.prevotes(rs.proposal.pol_round)
+                        if pol is not None:
+                            self.data_ch.send_to(
+                                ps.peer_id,
+                                ProposalPOLMessage(rs.height, rs.proposal.pol_round, pol.bit_array()),
+                                timeout=1.0,
+                            )
+                    continue
+            except Exception:
+                pass
+            time.sleep(self.GOSSIP_SLEEP)
+
+    def _gossip_catchup(self, ps: PeerState, prs) -> bool:
+        """Send one missing part of a committed block (reactor.go:437)."""
+        if prs.proposal_block_parts is None:
+            # init from the stored block meta so part bits line up
+            meta = self.block_store.load_block_meta(prs.height)
+            if meta is None:
+                return False
+            ps.init_proposal_block_parts(meta.block_id.part_set_header)
+            return True
+        if prs.proposal_block_parts_header is None:
+            return False
+        missing = BitArray(prs.proposal_block_parts_header.total).not_().sub(prs.proposal_block_parts)
+        idx, ok = missing.pick_random()
+        if not ok:
+            return False
+        part = self.block_store.load_block_part(prs.height, idx)
+        if part is None:
+            return False
+        self.data_ch.send_to(ps.peer_id, BlockPartMessage(prs.height, prs.round, part), timeout=1.0)
+        # deliberately NOT set_has_proposal_block_part: there is no ack,
+        # and a part sent before the peer enters commit is dropped on
+        # their side — keep resending until their NewRoundStep advances
+        return True
+
+    # --------------------------------------------------------- gossip votes
+
+    def _gossip_votes_routine(self, ps: PeerState) -> None:
+        """ref: reactor.go:736 gossipVotesRoutine."""
+        while ps.running and not self._stop.is_set():
+            rs = self.cs.rs
+            prs = ps.prs
+            try:
+                if rs.height == prs.height:
+                    if self._gossip_votes_for_height(rs, ps, prs):
+                        continue
+                # peer is on the previous height: send last-commit precommits
+                if prs.height != 0 and rs.height == prs.height + 1 and rs.last_commit is not None:
+                    if self._pick_send_vote(ps, rs.last_commit):
+                        continue
+                # peer is further behind: send precommits from the stored
+                # commit at their height (reactor.go:789)
+                if prs.height != 0 and rs.height >= prs.height + 2 and self.block_store.base() <= prs.height:
+                    commit = self.block_store.load_block_commit(prs.height)
+                    if commit is not None and self._pick_send_commit_sig(ps, prs, commit):
+                        continue
+            except Exception:
+                pass
+            time.sleep(self.GOSSIP_SLEEP)
+
+    def _gossip_votes_for_height(self, rs, ps: PeerState, prs) -> bool:
+        """ref: reactor.go:685 gossipVotesForHeight."""
+        if rs.votes is None:
+            return False
+        # catchup: peer in earlier round wants that round's precommits? —
+        # reference order: LastCommit → round prevotes/precommits → POL
+        if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
+            if self._pick_send_vote(ps, rs.last_commit):
+                return True
+        if prs.step <= STEP_PROPOSE and prs.round != -1 and prs.round <= rs.round and prs.proposal_pol_round >= 0:
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and self._pick_send_vote(ps, pol):
+                return True
+        if prs.step <= STEP_PRECOMMIT and prs.round != -1 and prs.round <= rs.round:
+            prevotes = rs.votes.prevotes(prs.round)
+            if prevotes is not None and self._pick_send_vote(ps, prevotes):
+                return True
+            precommits = rs.votes.precommits(prs.round)
+            if precommits is not None and self._pick_send_vote(ps, precommits):
+                return True
+        if prs.round != -1 and prs.round <= rs.round:
+            precommits = rs.votes.precommits(prs.round)
+            if precommits is not None and self._pick_send_vote(ps, precommits):
+                return True
+        if prs.proposal_pol_round != -1:
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and self._pick_send_vote(ps, pol):
+                return True
+        return False
+
+    def _pick_send_vote(self, ps: PeerState, votes) -> bool:
+        """ref: reactor.go:717 pickSendVote."""
+        vote = ps.pick_vote_to_send(votes)
+        if vote is None:
+            return False
+        if self.vote_ch.send_to(ps.peer_id, VoteMessage(vote), timeout=1.0):
+            ps.set_has_vote(vote)
+            return True
+        return False
+
+    def _pick_send_commit_sig(self, ps: PeerState, prs, commit) -> bool:
+        """Reconstruct one precommit from a stored Commit for a lagging
+        peer (ref: reactor.go:789 via types.CommitToVoteSet)."""
+        vals = self.cs.block_exec.store.load_validators(prs.height)
+        if vals is None:
+            return False
+        ps.ensure_catchup_commit_round(prs.height, commit.round, vals.size())
+        ps.ensure_vote_bit_arrays(prs.height, vals.size())
+        from ..types.vote_set import VoteSet
+
+        vote_set = VoteSet(self.cs.state.chain_id, commit.height, commit.round, PRECOMMIT, vals)
+        for idx, cs_sig in enumerate(commit.signatures):
+            if cs_sig.absent():
+                continue
+            vote = Vote(
+                type=PRECOMMIT,
+                height=commit.height,
+                round=commit.round,
+                block_id=cs_sig.block_id(commit.block_id),
+                timestamp=cs_sig.timestamp,
+                validator_address=cs_sig.validator_address,
+                validator_index=idx,
+                signature=cs_sig.signature,
+            )
+            vote_set.add_vote(vote)
+        return self._pick_send_vote(ps, vote_set)
+
+    # ---------------------------------------------------------- maj23 query
+
+    def _query_maj23_routine(self, ps: PeerState) -> None:
+        """Periodically tell peers about our observed majorities
+        (ref: reactor.go:808 queryMaj23Routine)."""
+        while ps.running and not self._stop.is_set():
+            time.sleep(self.QUERY_MAJ23_SLEEP)
+            rs = self.cs.rs
+            prs = ps.prs
+            try:
+                if rs.height != prs.height or rs.votes is None:
+                    continue
+                for vote_type, votes in (
+                    (PREVOTE, rs.votes.prevotes(prs.round)),
+                    (PRECOMMIT, rs.votes.precommits(prs.round)),
+                ):
+                    if votes is None:
+                        continue
+                    maj23, ok = votes.two_thirds_majority()
+                    if ok:
+                        self.state_ch.send_to(
+                            ps.peer_id,
+                            VoteSetMaj23Message(rs.height, prs.round, vote_type, maj23),
+                            timeout=1.0,
+                        )
+            except Exception:
+                pass

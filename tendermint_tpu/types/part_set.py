@@ -109,5 +109,12 @@ class PartSet:
             raise ValueError("part set is not complete")
         return b"".join(p.bytes_ for p in self.parts)
 
-    def bit_array(self) -> list[bool]:
-        return [p is not None for p in self.parts]
+    def bit_array(self) -> "BitArray":
+        """Which part indices are present (ref: PartSet.BitArray)."""
+        from ..utils.bits import BitArray
+
+        ba = BitArray(self.header.total)
+        for i, p in enumerate(self.parts):
+            if p is not None:
+                ba.set_index(i, True)
+        return ba

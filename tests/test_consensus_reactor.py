@@ -1,0 +1,136 @@
+"""Multi-validator consensus over the real P2P stack (memory network)
+(ref: internal/consensus/reactor_test.go TestReactorBasic)."""
+
+from __future__ import annotations
+
+import time
+
+from helpers import make_genesis_doc, make_keys
+from test_consensus import fast_params, make_node, wait_for_height
+from tendermint_tpu.consensus.reactor import (
+    ConsensusReactor,
+    consensus_channel_descriptors,
+    decode_consensus_msg,
+    encode_consensus_msg,
+)
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.p2p import (
+    MemoryNetwork,
+    NodeInfo,
+    PeerManager,
+    PeerManagerOptions,
+    Router,
+    node_id_from_pubkey,
+)
+from tendermint_tpu.p2p.transport import Endpoint
+
+CHAIN = "csr-test-chain"
+
+
+class P2PNode:
+    """A validator wired through router + consensus reactor."""
+
+    def __init__(self, network: MemoryNetwork, keys, idx, gen_doc):
+        self.cs = make_node(keys, idx, gen_doc)
+        # p2p identity = validator key (the reference uses a separate
+        # node key; same key is fine for tests)
+        self.key = keys[idx]
+        self.node_id = node_id_from_pubkey(self.key.pub_key())
+        self.transport = network.create_transport(self.node_id)
+        self.pm = PeerManager(self.node_id, PeerManagerOptions(max_connected=8))
+        self.router = Router(
+            NodeInfo(node_id=self.node_id, network=CHAIN),
+            self.key,
+            self.pm,
+            [self.transport],
+        )
+        descs = consensus_channel_descriptors()
+        chans = [self.router.open_channel(d) for d in descs]
+        self.reactor = ConsensusReactor(
+            self.cs, chans[0], chans[1], chans[2], chans[3], self.pm, self.cs.block_store
+        )
+
+    def start(self):
+        self.router.start()
+        self.reactor.start()
+        self.cs.start()
+
+    def stop(self):
+        self.cs.stop()
+        self.reactor.stop()
+        self.router.stop()
+
+
+def test_codec_roundtrip():
+    from tendermint_tpu.consensus.messages import (
+        HasVoteMessage,
+        NewRoundStepMessage,
+        VoteSetMaj23Message,
+    )
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+
+    for msg in (
+        NewRoundStepMessage(5, 1, 3, 10, 0),
+        HasVoteMessage(5, 0, 1, 2),
+        VoteSetMaj23Message(5, 0, 1, BlockID(hash=b"\x01" * 32, part_set_header=PartSetHeader(total=2, hash=b"\x02" * 32))),
+    ):
+        rt = decode_consensus_msg(encode_consensus_msg(msg))
+        assert rt == msg
+
+
+def test_four_validators_over_p2p():
+    """4 validators, full-mesh memory network, reach height 3 together."""
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    net = MemoryNetwork()
+    nodes = [P2PNode(net, keys, i, gen_doc) for i in range(4)]
+    for n in nodes:
+        n.start()
+    try:
+        # everyone dials node 0 (peer gossip not needed for 4 nodes;
+        # router fan-out via hub is not enough though — full mesh)
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if i < j:
+                    n.pm.add(Endpoint(protocol="memory", host=m.node_id, node_id=m.node_id))
+        assert wait_for_height([n.cs for n in nodes], 3, timeout=90), (
+            f"heights: {[n.cs.block_store.height() for n in nodes]}"
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_late_joiner_catches_up_via_gossip():
+    """A validator that joins after the network has advanced must catch
+    up through catchup gossip (ref: reactor.go:437)."""
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    net = MemoryNetwork()
+    nodes = [P2PNode(net, keys, i, gen_doc) for i in range(3)]
+    for n in nodes:
+        n.start()
+    late = None
+    try:
+        for i, n in enumerate(nodes):
+            for j, m in enumerate(nodes):
+                if i < j:
+                    n.pm.add(Endpoint(protocol="memory", host=m.node_id, node_id=m.node_id))
+        # 3 of 4 validators = 75% > 2/3 — chain advances without the 4th
+        assert wait_for_height([n.cs for n in nodes], 2, timeout=90)
+        late = P2PNode(net, keys, 3, gen_doc)
+        late.start()
+        for n in nodes:
+            late.pm.add(Endpoint(protocol="memory", host=n.node_id, node_id=n.node_id))
+        target = max(n.cs.block_store.height() for n in nodes) + 1
+        assert wait_for_height([late.cs], target, timeout=90), (
+            f"late joiner at {late.cs.block_store.height()}, net at "
+            f"{max(n.cs.block_store.height() for n in nodes)}"
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+        if late is not None:
+            late.stop()
