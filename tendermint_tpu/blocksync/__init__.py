@@ -1,0 +1,6 @@
+"""Blocksync: fast block-by-block catch-up (ref: internal/blocksync/)."""
+
+from .pool import BlockPool
+from .reactor import BlockSyncReactor, blocksync_channel_descriptor
+
+__all__ = ["BlockPool", "BlockSyncReactor", "blocksync_channel_descriptor"]

@@ -1,0 +1,264 @@
+"""Blocksync reactor (ref: internal/blocksync/reactor.go).
+
+Serves BlockRequests from the local store and runs the verify loop:
+PeekTwoBlocks → VerifyCommitLight(first, using second.LastCommit) —
+routed through the batched TPU verification plane (reactor.go:582) —
+→ ApplyBlock → PopRequest. Channel 0x40, priority 5.
+
+Blocksync is the reference's per-height serial path; batching many
+heights' commits into one TPU launch happens naturally here because
+`verify_commit_light` dispatches whole commits to the device verifier.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..p2p.types import CHANNEL_BLOCKSYNC, ChannelDescriptor, PEER_STATUS_UP, PeerError
+from ..proto import messages as pb
+from ..types.block import Block, BlockID
+from ..types.validation import verify_commit_light
+from .pool import BlockPool
+
+
+# ------------------------------------------------------------------ messages
+
+
+class BlockRequest:
+    def __init__(self, height: int):
+        self.height = height
+
+
+class NoBlockResponse:
+    def __init__(self, height: int):
+        self.height = height
+
+
+class BlockResponse:
+    def __init__(self, block: Block):
+        self.block = block
+
+
+class StatusRequest:
+    pass
+
+
+class StatusResponse:
+    def __init__(self, base: int, height: int):
+        self.base = base
+        self.height = height
+
+
+def encode_blocksync_msg(msg) -> bytes:
+    """ref: blocksync wire messages (proto/tendermint/blocksync)."""
+    if isinstance(msg, BlockRequest):
+        return b"\x01" + json.dumps({"h": msg.height}).encode()
+    if isinstance(msg, NoBlockResponse):
+        return b"\x02" + json.dumps({"h": msg.height}).encode()
+    if isinstance(msg, BlockResponse):
+        return b"\x03" + msg.block.to_proto().encode()
+    if isinstance(msg, StatusRequest):
+        return b"\x04"
+    if isinstance(msg, StatusResponse):
+        return b"\x05" + json.dumps({"b": msg.base, "h": msg.height}).encode()
+    raise TypeError(f"unknown blocksync message {type(msg)}")
+
+
+def decode_blocksync_msg(data: bytes):
+    tag, body = data[0], data[1:]
+    if tag == 0x01:
+        return BlockRequest(json.loads(body)["h"])
+    if tag == 0x02:
+        return NoBlockResponse(json.loads(body)["h"])
+    if tag == 0x03:
+        return BlockResponse(Block.from_proto(pb.Block.decode(body)))
+    if tag == 0x04:
+        return StatusRequest()
+    if tag == 0x05:
+        d = json.loads(body)
+        return StatusResponse(d["b"], d["h"])
+    raise ValueError(f"unknown blocksync tag {tag}")
+
+
+def blocksync_channel_descriptor() -> ChannelDescriptor:
+    """ref: reactor.go:27,43-48 — channel 0x40, priority 5."""
+    return ChannelDescriptor(
+        id=CHANNEL_BLOCKSYNC,
+        name="blocksync",
+        priority=5,
+        send_queue_capacity=1000,
+        recv_message_capacity=10 * 1024 * 1024,
+        recv_buffer_capacity=1024,
+        encode=encode_blocksync_msg,
+        decode=decode_blocksync_msg,
+    )
+
+
+class BlockSyncReactor:
+    """ref: reactor.go Reactor."""
+
+    STATUS_UPDATE_INTERVAL = 2.0  # reactor.go statusUpdateIntervalSeconds = 10
+    SWITCH_CHECK_INTERVAL = 0.5  # reactor.go switchToConsensusIntervalSeconds = 1
+
+    def __init__(
+        self,
+        state,
+        block_executor,
+        block_store,
+        channel,
+        peer_manager,
+        on_caught_up=None,
+        block_sync: bool = True,
+    ):
+        """on_caught_up(state, blocks_synced) fires when the pool reaches
+        the network head — the node switches to consensus
+        (ref: reactor.go:370 SwitchToBlockSync / poolRoutine)."""
+        self.state = state
+        self.block_exec = block_executor
+        self.block_store = block_store
+        self.channel = channel
+        self.peer_manager = peer_manager
+        self.on_caught_up = on_caught_up or (lambda state, n: None)
+        self.block_sync = block_sync
+        self.pool = BlockPool(
+            max(self.state.last_block_height + 1, self.state.initial_height),
+            self._send_block_request,
+            self._send_peer_error,
+        )
+        self.blocks_synced = 0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._switched = False
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.peer_manager.subscribe(self._on_peer_update)
+        if self.block_sync:
+            self.pool.start()
+        for fn in (self._recv_loop, self._status_broadcast_loop):
+            t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+            t.start()
+            self._threads.append(t)
+        if self.block_sync:
+            t = threading.Thread(target=self._pool_routine, daemon=True, name="bs-pool")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.pool.stop()
+        self.peer_manager.unsubscribe(self._on_peer_update)
+
+    # ------------------------------------------------------------- wiring
+
+    def _send_block_request(self, height: int, peer_id: str) -> None:
+        if not self.channel.send_to(peer_id, BlockRequest(height), timeout=1.0):
+            raise RuntimeError("send queue full")
+
+    def _send_peer_error(self, err, peer_id: str) -> None:
+        self.channel.send_error(PeerError(node_id=peer_id, err=err))
+
+    def _on_peer_update(self, update) -> None:
+        if update.status == PEER_STATUS_UP:
+            self.channel.send_to(update.node_id, StatusRequest(), timeout=1.0)
+        else:
+            self.pool.remove_peer(update.node_id)
+
+    # -------------------------------------------------------------- loops
+
+    def _recv_loop(self) -> None:
+        """ref: reactor.go:236 handleMessage."""
+        while not self._stop.is_set():
+            env = self.channel.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            try:
+                if isinstance(msg, BlockRequest):
+                    self._respond_to_peer(msg, nid)
+                elif isinstance(msg, BlockResponse):
+                    self.pool.add_block(nid, msg.block)
+                elif isinstance(msg, StatusRequest):
+                    self.channel.send_to(
+                        nid, StatusResponse(self.block_store.base(), self.block_store.height()), timeout=1.0
+                    )
+                elif isinstance(msg, StatusResponse):
+                    self.pool.set_peer_range(nid, msg.base, msg.height)
+                elif isinstance(msg, NoBlockResponse):
+                    self.pool.retry_height(msg.height, nid)
+            except Exception as e:
+                self.channel.send_error(PeerError(node_id=nid, err=e))
+
+    def _respond_to_peer(self, msg: BlockRequest, peer_id: str) -> None:
+        """ref: reactor.go:186 respondToPeer."""
+        block = self.block_store.load_block(msg.height)
+        if block is not None:
+            self.channel.send_to(peer_id, BlockResponse(block), timeout=1.0)
+        else:
+            self.channel.send_to(peer_id, NoBlockResponse(msg.height), timeout=1.0)
+
+    def _status_broadcast_loop(self) -> None:
+        while not self._stop.is_set():
+            self.channel.broadcast(
+                StatusResponse(self.block_store.base(), self.block_store.height()), timeout=1.0
+            )
+            self._stop.wait(self.STATUS_UPDATE_INTERVAL)
+
+    def _pool_routine(self) -> None:
+        """The verify loop (ref: reactor.go:477 poolRoutine)."""
+        last_switch_check = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last_switch_check > self.SWITCH_CHECK_INTERVAL:
+                last_switch_check = now
+                if not self._switched and self.pool.is_caught_up():
+                    self._switched = True
+                    self.pool.stop()
+                    self.on_caught_up(self.state, self.blocks_synced)
+                    return
+            if not self._try_sync_one():
+                time.sleep(0.01)
+
+    def _try_sync_one(self) -> bool:
+        """ref: reactor.go:536-616 (the trySync block)."""
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        first_parts = None
+        try:
+            from ..types.part_set import PartSet
+            from ..types.block import BLOCK_PART_SIZE_BYTES
+
+            first_parts = PartSet.from_data(first.to_proto().encode(), BLOCK_PART_SIZE_BYTES)
+            first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
+            # ★ the north-star call (reactor.go:582): batched verify of
+            # second.LastCommit against OUR current validator set
+            verify_commit_light(
+                self.state.chain_id,
+                self.state.validators,
+                first_id,
+                first.header.height,
+                second.last_commit,
+            )
+        except Exception as e:
+            # Either sender could be lying (a forged second.LastCommit
+            # fails an honest first block): ban BOTH and refetch both
+            # heights (ref: reactor.go:592-604 errors both senders).
+            h = first.header.height
+            second_peer = self.pool.block_sender(h + 1)
+            first_peer = self.pool.redo_request(h)
+            if second_peer is not None and second_peer != first_peer:
+                self.pool.redo_request(h + 1)
+                self.channel.send_error(PeerError(node_id=second_peer, err=e))
+            if first_peer is not None:
+                self.channel.send_error(PeerError(node_id=first_peer, err=e))
+            return False
+
+        self.pool.pop_request()
+        self.block_store.save_block(first, first_parts, second.last_commit)
+        self.state = self.block_exec.apply_block(self.state, first_id, first)
+        self.blocks_synced += 1
+        return True
