@@ -229,10 +229,7 @@ class BlockSyncReactor:
             return False
         first_parts = None
         try:
-            from ..types.part_set import PartSet
-            from ..types.block import BLOCK_PART_SIZE_BYTES
-
-            first_parts = PartSet.from_data(first.to_proto().encode(), BLOCK_PART_SIZE_BYTES)
+            first_parts = first.make_part_set()
             first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
             # ★ the north-star call (reactor.go:582): batched verify of
             # second.LastCommit against OUR current validator set

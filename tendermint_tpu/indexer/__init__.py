@@ -1,0 +1,6 @@
+"""Tx/block indexing (ref: internal/state/indexer/)."""
+
+from .kv import KVIndexer
+from .service import IndexerService
+
+__all__ = ["KVIndexer", "IndexerService"]

@@ -1,0 +1,6 @@
+"""In-process pub/sub with query filtering (ref: internal/pubsub/)."""
+
+from .query import Query, QueryError, parse_query
+from .pubsub import Server, Subscription
+
+__all__ = ["Query", "QueryError", "Server", "Subscription", "parse_query"]

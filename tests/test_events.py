@@ -1,0 +1,196 @@
+"""Pubsub / eventbus / indexer tests (ref: internal/pubsub/pubsub_test.go,
+query/query_test.go, indexer tests)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.eventbus import EventBus
+from tendermint_tpu.eventbus.event_bus import tx_hash
+from tendermint_tpu.indexer import IndexerService, KVIndexer
+from tendermint_tpu.pubsub import Server, parse_query
+from tendermint_tpu.pubsub.query import QueryError
+from tendermint_tpu.store.kv import MemDB
+
+
+# ------------------------------------------------------------------- query
+
+
+def test_query_parse_and_match():
+    q = parse_query("tm.event = 'NewBlock'")
+    assert q.matches({"tm.event": ["NewBlock"]})
+    assert not q.matches({"tm.event": ["Tx"]})
+    assert not q.matches({})
+
+
+def test_query_numeric_comparisons():
+    q = parse_query("tx.height > 5 AND tx.height <= 10")
+    assert q.matches({"tx.height": ["7"]})
+    assert not q.matches({"tx.height": ["5"]})
+    assert q.matches({"tx.height": ["10"]})
+    assert not q.matches({"tx.height": ["11"]})
+
+
+def test_query_and_contains_exists():
+    q = parse_query("tm.event = 'Tx' AND transfer.sender CONTAINS 'addr' AND account.number EXISTS")
+    events = {
+        "tm.event": ["Tx"],
+        "transfer.sender": ["cosmos-addr-1"],
+        "account.number": ["1"],
+    }
+    assert q.matches(events)
+    del events["account.number"]
+    assert not q.matches(events)
+
+
+def test_query_reference_example():
+    """The doc example from internal/pubsub/query/query.go:1-13."""
+    q = parse_query("tm.events.type='NewBlock'".replace("=", " = "))
+    assert q.matches({"tm.events.type": ["NewBlock"]})
+
+
+def test_query_syntax_errors():
+    for bad in ("tm.event =", "= 'x'", "tm.event = 'x' AND", "tm.event LIKE 'x'"):
+        with pytest.raises(QueryError):
+            parse_query(bad)
+
+
+# ------------------------------------------------------------------ pubsub
+
+
+def test_pubsub_basic_delivery():
+    s = Server()
+    sub = s.subscribe("client-1", parse_query("tm.event = 'Tx'"))
+    s.publish({"n": 1}, {"tm.event": ["Tx"]})
+    s.publish({"n": 2}, {"tm.event": ["NewBlock"]})
+    msg = sub.next(timeout=1)
+    assert msg is not None and msg.data == {"n": 1}
+    assert sub.next(timeout=0.05) is None  # NewBlock filtered out
+
+
+def test_pubsub_slow_subscriber_terminated():
+    s = Server()
+    sub = s.subscribe("slow", parse_query("tm.event = 'Tx'"), buffer_size=2)
+    for i in range(5):
+        s.publish({"n": i}, {"tm.event": ["Tx"]})
+    assert sub.terminated.is_set()
+    assert sub.termination_reason == "slow subscriber"
+    assert s.num_subscriptions() == 0
+
+
+def test_pubsub_unsubscribe():
+    s = Server()
+    q = parse_query("tm.event = 'Tx'")
+    s.subscribe("c", q)
+    assert s.num_subscriptions() == 1
+    s.unsubscribe("c", q)
+    assert s.num_subscriptions() == 0
+
+
+# ---------------------------------------------------------------- eventbus
+
+
+def _tx_result(events=None):
+    return abci.ExecTxResult(code=0, events=events or [])
+
+
+def _ev(type_, **attrs):
+    return abci.Event(
+        type=type_, attributes=[abci.EventAttribute(key=k, value=v) for k, v in attrs.items()]
+    )
+
+
+def test_eventbus_tx_event_reserved_keys():
+    bus = EventBus()
+    sub = bus.subscribe("c", "tm.event = 'Tx' AND tx.height = 3")
+    tx = b"tx-payload"
+    bus.publish_event_tx(3, 0, tx, _tx_result([_ev("transfer", sender="alice")]))
+    msg = sub.next(timeout=1)
+    assert msg is not None
+    assert msg.events["tx.hash"] == [tx_hash(tx).hex().upper()]
+    assert msg.events["transfer.sender"] == ["alice"]
+    # non-matching height filtered
+    bus.publish_event_tx(4, 0, tx, _tx_result())
+    assert sub.next(timeout=0.05) is None
+
+
+def test_eventbus_custom_abci_event_filter():
+    bus = EventBus()
+    sub = bus.subscribe("c", "transfer.amount > 100")
+    bus.publish_event_tx(1, 0, b"t1", _tx_result([_ev("transfer", amount="250")]))
+    bus.publish_event_tx(1, 1, b"t2", _tx_result([_ev("transfer", amount="50")]))
+    msg = sub.next(timeout=1)
+    assert msg is not None and msg.data.tx == b"t1"
+    assert sub.next(timeout=0.05) is None
+
+
+# ----------------------------------------------------------------- indexer
+
+
+class _Blk:
+    def __init__(self, height, txs):
+        class H:  # noqa
+            pass
+
+        self.header = H()
+        self.header.height = height
+        self.txs = txs
+
+
+class _FRes:
+    def __init__(self, tx_results, events=None):
+        self.tx_results = tx_results
+        self.events = events or []
+
+
+def test_indexer_tx_by_hash_and_search():
+    idx = KVIndexer(MemDB())
+    txs = [b"tx-a", b"tx-b"]
+    results = [
+        _tx_result([_ev("transfer", sender="alice", amount="10")]),
+        _tx_result([_ev("transfer", sender="bob", amount="99")]),
+    ]
+    idx.index_tx_events(5, txs, results)
+    doc = idx.get_tx_by_hash(tx_hash(b"tx-a"))
+    assert doc is not None and doc["height"] == 5 and doc["index"] == 0
+
+    found = idx.search_tx_events(parse_query("transfer.sender = 'bob'"))
+    assert len(found) == 1 and found[0]["tx"] == b"tx-b".hex()
+
+    found = idx.search_tx_events(parse_query("tx.height = 5"))
+    assert len(found) == 2
+
+    found = idx.search_tx_events(parse_query("transfer.amount > 50 AND tx.height = 5"))
+    assert len(found) == 1 and found[0]["tx"] == b"tx-b".hex()
+
+
+def test_indexer_block_events():
+    idx = KVIndexer(MemDB())
+    idx.index_block_events(7, _FRes([], [_ev("rewards", validator="v1")]))
+    idx.index_block_events(8, _FRes([], [_ev("rewards", validator="v2")]))
+    assert idx.search_block_events(parse_query("rewards.validator = 'v2'")) == [8]
+    assert idx.search_block_events(parse_query("block.height > 6")) == [7, 8]
+
+
+def test_indexer_service_end_to_end():
+    bus = EventBus()
+    idx = KVIndexer(MemDB())
+    svc = IndexerService(idx, bus)
+    svc.start()
+    try:
+        tx = b"indexed-tx"
+        blk = _Blk(9, [tx])
+        f_res = _FRes([_tx_result([_ev("transfer", sender="carol")])])
+        bus.publish_event_new_block(blk, None, f_res)
+        import time
+
+        deadline = time.monotonic() + 5
+        doc = None
+        while time.monotonic() < deadline and doc is None:
+            doc = idx.get_tx_by_hash(tx_hash(tx))
+            time.sleep(0.02)
+    finally:
+        svc.stop()
+    assert doc is not None and doc["height"] == 9
+    assert idx.search_tx_events(parse_query("transfer.sender = 'carol'"))

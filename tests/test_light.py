@@ -1,0 +1,240 @@
+"""Light client tests (ref: light/verifier_test.go, client_test.go,
+detector_test.go)."""
+
+from __future__ import annotations
+
+import pytest
+
+from helpers import make_genesis_doc, make_keys
+from test_consensus import fast_params, make_node, wait_for_height
+from tendermint_tpu.light import (
+    DBLightStore,
+    LightClient,
+    LocalProvider,
+    MemLightStore,
+    TrustOptions,
+    verify_adjacent,
+    verify_non_adjacent,
+)
+from tendermint_tpu.light.client import SEQUENTIAL, ErrLightClientAttack
+from tendermint_tpu.light.verifier import (
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired,
+    validate_trust_level,
+)
+from tendermint_tpu.store.kv import MemDB
+from tendermint_tpu.types.light_block import LightBlock, SignedHeader
+from tendermint_tpu.types.validation import Fraction
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN = "light-test-chain"
+HOUR_NS = 3600 * 10**9
+
+_chain_cache = {}
+
+
+def build_chain(n_heights=6):
+    """A committed chain + LocalProvider (module-cached: building takes
+    seconds and the chain is immutable once built)."""
+    if n_heights in _chain_cache:
+        return _chain_cache[n_heights]
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    node = make_node(keys, 0, gen_doc)
+    node.start()
+    try:
+        assert wait_for_height([node], n_heights, timeout=90)
+    finally:
+        node.stop()
+    provider = LocalProvider(CHAIN, node.block_store, node.block_exec.store)
+    _chain_cache[n_heights] = (node, provider)
+    return node, provider
+
+
+def now_after(provider) -> Time:
+    latest = provider.light_block(0)
+    return Time.from_unix_ns(latest.signed_header.header.time.unix_ns() + 10**9)
+
+
+def test_validate_trust_level():
+    validate_trust_level(Fraction(1, 3))
+    validate_trust_level(Fraction(2, 3))
+    validate_trust_level(Fraction(1, 1))
+    for bad in (Fraction(1, 4), Fraction(4, 3), Fraction(0, 1)):
+        with pytest.raises(ValueError):
+            validate_trust_level(bad)
+
+
+def test_verify_adjacent_ok():
+    node, provider = build_chain()
+    lb1 = provider.light_block(1)
+    lb2 = provider.light_block(2)
+    verify_adjacent(
+        CHAIN, lb1.signed_header, lb2.signed_header, lb2.validator_set,
+        HOUR_NS, now_after(provider), 10 * 10**9,
+    )
+
+
+def test_verify_adjacent_rejects_expired_trust():
+    node, provider = build_chain()
+    lb1 = provider.light_block(1)
+    lb2 = provider.light_block(2)
+    with pytest.raises(ErrOldHeaderExpired):
+        verify_adjacent(
+            CHAIN, lb1.signed_header, lb2.signed_header, lb2.validator_set,
+            1, now_after(provider), 10 * 10**9,  # 1ns trusting period
+        )
+
+
+def test_verify_non_adjacent_ok():
+    node, provider = build_chain()
+    lb1 = provider.light_block(1)
+    lb4 = provider.light_block(4)
+    verify_non_adjacent(
+        CHAIN, lb1.signed_header, lb1.validator_set, lb4.signed_header, lb4.validator_set,
+        HOUR_NS, now_after(provider), 10 * 10**9,
+    )
+
+
+def test_verify_rejects_tampered_header():
+    node, provider = build_chain()
+    lb1 = provider.light_block(1)
+    lb2 = provider.light_block(2)
+    import copy
+
+    evil = copy.deepcopy(lb2)
+    evil.signed_header.header.app_hash = b"\xec" * 32
+    with pytest.raises(Exception):
+        verify_adjacent(
+            CHAIN, lb1.signed_header, evil.signed_header, evil.validator_set,
+            HOUR_NS, now_after(provider), 10 * 10**9,
+        )
+
+
+def _trust_options(provider, height=1):
+    lb = provider.light_block(height)
+    return TrustOptions(period_ns=24 * HOUR_NS, height=height, hash=lb.signed_header.hash())
+
+
+def test_client_skipping_verification():
+    node, provider = build_chain()
+    target = node.block_store.height()
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider, clock=lambda: now_after(provider)
+    )
+    lb = client.verify_light_block_at_height(target)
+    assert lb.height == target
+    assert client.latest_trusted().height == target
+
+
+def test_client_sequential_verification():
+    node, provider = build_chain()
+    target = node.block_store.height()
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider,
+        verification_mode=SEQUENTIAL, clock=lambda: now_after(provider),
+    )
+    lb = client.verify_light_block_at_height(target)
+    assert lb.height == target
+    # sequential stores every intermediate header
+    for h in range(1, target + 1):
+        assert client.trusted_light_block(h) is not None
+
+
+def test_client_backwards_verification():
+    node, provider = build_chain()
+    target = node.block_store.height()
+    client = LightClient(
+        CHAIN,
+        TrustOptions(period_ns=24 * HOUR_NS, height=target, hash=provider.light_block(target).signed_header.hash()),
+        provider,
+        clock=lambda: now_after(provider),
+    )
+    lb = client.verify_light_block_at_height(1)
+    assert lb.height == 1
+    assert lb.signed_header.hash() == provider.light_block(1).signed_header.hash()
+
+
+def test_client_detects_forged_witness():
+    """A witness serving a diverging header at the verified height
+    triggers attack evidence (ref: detector_test.go)."""
+    import copy
+
+    node, provider = build_chain()
+    target = node.block_store.height()
+
+    class EvilProvider(LocalProvider):
+        def light_block(self, height):
+            lb = super().light_block(height)
+            evil = copy.deepcopy(lb)
+            evil.signed_header.header.app_hash = b"\x66" * 32
+            return evil
+
+    evil = EvilProvider(CHAIN, node.block_store, node.block_exec.store, name="evil-witness")
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider, witnesses=[evil],
+        clock=lambda: now_after(provider),
+    )
+    with pytest.raises(ErrLightClientAttack):
+        client.verify_light_block_at_height(target)
+    assert client.latest_attack_evidence is not None
+    assert provider.evidence, "evidence must be reported to providers"
+
+
+def test_client_persists_to_db_store():
+    node, provider = build_chain()
+    target = node.block_store.height()
+    db = MemDB()
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider,
+        trusted_store=DBLightStore(db), clock=lambda: now_after(provider),
+    )
+    client.verify_light_block_at_height(target)
+    # second client restores trust from the same DB without refetching root
+    client2 = LightClient(
+        CHAIN, _trust_options(provider), provider,
+        trusted_store=DBLightStore(db), clock=lambda: now_after(provider),
+    )
+    assert client2.latest_trusted().height == target
+
+
+def test_client_bisection_on_trust_failure(monkeypatch):
+    """When a direct jump fails the trust-fraction check, the client
+    bisects to the midpoint and retries (ref: client.go:647
+    verifySkipping). Simulated by rejecting jumps of more than 2
+    heights, as a rotated validator set would."""
+    node, provider = build_chain()
+    target = node.block_store.height()
+    from tendermint_tpu.light import client as client_mod
+    from tendermint_tpu.light import verifier as vf
+
+    real = vf.verify_non_adjacent
+    jumps = []
+
+    def limited(chain_id, th, tv, uh, uv, *a, **k):
+        jumps.append((th.header.height, uh.header.height))
+        if uh.header.height - th.header.height > 2:
+            raise vf.ErrNewValSetCantBeTrusted("simulated validator rotation")
+        return real(chain_id, th, tv, uh, uv, *a, **k)
+
+    monkeypatch.setattr(client_mod.vf, "verify_non_adjacent", limited)
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider, clock=lambda: now_after(provider)
+    )
+    lb = client.verify_light_block_at_height(target)
+    assert lb.height == target
+    assert any(b - a > 2 for a, b in jumps), "a long jump must have been attempted"
+    # bisection must have fetched midpoints: some non-adjacent jump of
+    # <=2 heights eventually succeeded
+    assert any(b - a <= 2 for a, b in jumps), f"no bisected jump seen: {jumps}"
+
+
+def test_client_update_follows_head():
+    node, provider = build_chain()
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider, clock=lambda: now_after(provider)
+    )
+    lb = client.update()
+    assert lb.height == node.block_store.height()
