@@ -35,10 +35,20 @@ class IndexerService:
     def _run(self) -> None:
         while not self._stop.is_set():
             if self._sub.terminated.is_set():
-                # dropped as a slow subscriber: resubscribe so indexing
-                # resumes (blocks published meanwhile are missed — the
-                # reference re-indexes on catch-up; log loudly)
-                print("indexer: subscription terminated (slow); resubscribing", flush=True)
+                # dropped as a slow subscriber: drain what's already
+                # buffered, then resubscribe (blocks published between
+                # termination and resubscribe are missed; log loudly)
+                drained = 0
+                while True:
+                    msg = self._sub.next(timeout=0)
+                    if msg is None:
+                        break
+                    self._index_one(msg)
+                    drained += 1
+                print(
+                    f"indexer: subscription terminated (slow); drained {drained}, resubscribing",
+                    flush=True,
+                )
                 self.event_bus.unsubscribe_all(self.SUBSCRIBER)
                 self._sub = self.event_bus.subscribe(
                     self.SUBSCRIBER, parse_query(f"tm.event = '{EVENT_NEW_BLOCK}'"), buffer_size=512
@@ -48,13 +58,16 @@ class IndexerService:
                 if self._sub.terminated.is_set():
                     self._stop.wait(0.2)  # no hot spin while terminated+empty
                 continue
-            data = msg.data  # EventDataNewBlock
-            block = data.block
-            f_res = data.result_finalize_block
-            try:
-                self.indexer.index_block_events(block.header.height, f_res)
-                self.indexer.index_tx_events(block.header.height, list(block.txs), list(f_res.tx_results))
-            except Exception:
-                import traceback
+            self._index_one(msg)
 
-                traceback.print_exc()
+    def _index_one(self, msg) -> None:
+        data = msg.data  # EventDataNewBlock
+        block = data.block
+        f_res = data.result_finalize_block
+        try:
+            self.indexer.index_block_events(block.header.height, f_res)
+            self.indexer.index_tx_events(block.header.height, list(block.txs), list(f_res.tx_results))
+        except Exception:
+            import traceback
+
+            traceback.print_exc()

@@ -148,15 +148,19 @@ class LightClient:
         return lb
 
     def _verify_light_block(self, new_lb: LightBlock, now: Time) -> None:
-        """ref: client.go:497 verifyLightBlock."""
+        """ref: client.go:497 verifyLightBlock. Nothing is persisted
+        until witness divergence detection passes — a detected attack
+        must not leave forged intermediate headers trusted."""
         closest = self._closest_trusted_below(new_lb.height)
         if closest is None:
             raise LightClientError("no trusted state below requested height")
         if self.mode == SEQUENTIAL:
-            self._verify_sequential(closest, new_lb, now)
+            verified = self._verify_sequential(closest, new_lb, now)
         else:
-            self._verify_skipping_against_primary(closest, new_lb, now)
+            verified = self._verify_skipping_against_primary(closest, new_lb, now)
         self._detect_divergence(new_lb, now)
+        for lb in verified:
+            self.store.save_light_block(lb)
         self.store.save_light_block(new_lb)
         self.store.prune(self.pruning_size)
 
@@ -164,10 +168,11 @@ class LightClient:
         lb = self.store.light_block_before(height + 1)
         return lb
 
-    def _verify_sequential(self, trusted: LightBlock, new_lb: LightBlock, now: Time) -> None:
-        """Verify every height in (trusted, new] (ref: client.go:554
-        verifySequential)."""
+    def _verify_sequential(self, trusted: LightBlock, new_lb: LightBlock, now: Time) -> list[LightBlock]:
+        """Verify every height in (trusted, new]; returns the verified
+        intermediates for deferred persistence (ref: client.go:554)."""
         current = trusted
+        verified: list[LightBlock] = []
         for h in range(trusted.height + 1, new_lb.height + 1):
             lb = new_lb if h == new_lb.height else self._fetch(self.primary, h)
             vf.verify_adjacent(
@@ -180,13 +185,15 @@ class LightClient:
                 self.max_clock_drift_ns,
             )
             if h != new_lb.height:
-                self.store.save_light_block(lb)
+                verified.append(lb)
             current = lb
+        return verified
 
-    def _verify_skipping_against_primary(self, trusted: LightBlock, new_lb: LightBlock, now: Time) -> None:
+    def _verify_skipping_against_primary(self, trusted: LightBlock, new_lb: LightBlock, now: Time) -> list[LightBlock]:
         """Bisection (ref: client.go:647 verifySkipping): try to jump
         straight from trusted → target; on trust failure, fetch the
-        midpoint, verify it, and continue from there."""
+        midpoint, verify it, and continue from there. Returns the
+        verified intermediates for deferred persistence."""
         verified = [trusted]
         target = new_lb
         pending: list[LightBlock] = [new_lb]
@@ -220,8 +227,6 @@ class LightClient:
                 verified.append(candidate)
                 pending.pop()
                 depth = 0  # progress made — only CONSECUTIVE failures count
-                if candidate.height != target.height:
-                    self.store.save_light_block(candidate)
             except vf.ErrNewValSetCantBeTrusted:
                 # bisect: pull the midpoint between current and candidate
                 depth += 1
@@ -234,6 +239,7 @@ class LightClient:
                     )
                 mid_lb = self._fetch(self.primary, mid)
                 pending.append(mid_lb)
+        return [lb for lb in verified[1:] if lb.height != target.height]
 
     def _verify_backwards(self, height: int, from_lb: LightBlock, now: Time) -> LightBlock:
         """Hash-chain walk to an earlier height (ref: client.go:884

@@ -19,7 +19,7 @@ from ..types.validation import (
     verify_commit_light,
     verify_commit_light_trusting,
 )
-from ..types.validator_set import ValidatorSet
+from ..types.validator_set import NotEnoughVotingPowerError, ValidatorSet
 from ..utils.tmtime import Time
 
 DEFAULT_TRUST_LEVEL = Fraction(1, 3)  # light/trust_options.go
@@ -98,10 +98,12 @@ def verify_non_adjacent(
         raise ErrOldHeaderExpired(f"old header expired at {trusted_header.header.time}")
     _verify_new_header_and_vals(untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift_ns, chain_id)
 
-    # enough trusted validators signed the NEW commit? (:70)
+    # enough trusted validators signed the NEW commit? (:70) — only a
+    # POWER shortfall means "bisect"; invalid signatures etc. are final
+    # (the reference keys on ErrNotEnoughVotingPowerSigned, :74)
     try:
         verify_commit_light_trusting(chain_id, trusted_vals, untrusted_header.commit, trust_level)
-    except Exception as e:
+    except NotEnoughVotingPowerError as e:
         raise ErrNewValSetCantBeTrusted(str(e))
 
     # the new validator set signed its own header with 2/3 (:85)

@@ -1,0 +1,107 @@
+"""Mempool gossip reactor (ref: internal/mempool/reactor.go).
+
+One broadcast thread per peer walks the mempool's tx list, sending each
+tx the peer hasn't seen; the originating peer is skipped
+(reactor.go:279 broadcastTxRoutine). Channel 0x30, priority 5.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..p2p.types import CHANNEL_MEMPOOL, ChannelDescriptor, PEER_STATUS_UP, PeerError
+from .mempool import TxInCacheError, TxMempool, tx_key
+
+
+def mempool_channel_descriptor() -> ChannelDescriptor:
+    """ref: internal/mempool/types.go:14, reactor.go:83-86."""
+    return ChannelDescriptor(
+        id=CHANNEL_MEMPOOL,
+        name="mempool",
+        priority=5,
+        send_queue_capacity=512,
+        recv_message_capacity=1048576,
+        encode=lambda tx: tx,  # a tx IS bytes on the wire (Txs message, 1 tx per frame)
+        decode=lambda b: bytes(b),
+    )
+
+
+class MempoolReactor:
+    BROADCAST_SLEEP = 0.02
+
+    def __init__(self, mempool: TxMempool, channel, peer_manager):
+        self.mempool = mempool
+        self.channel = channel
+        self.peer_manager = peer_manager
+        self._peers: dict[str, set[bytes]] = {}  # peer → tx keys sent/known
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        self.peer_manager.subscribe(self._on_peer_update)
+        for nid in self.peer_manager.peers():
+            self._add_peer(nid)
+        for fn in (self._recv_loop, self._broadcast_loop):
+            t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.peer_manager.unsubscribe(self._on_peer_update)
+
+    def _on_peer_update(self, update) -> None:
+        if update.status == PEER_STATUS_UP:
+            self._add_peer(update.node_id)
+        else:
+            with self._lock:
+                self._peers.pop(update.node_id, None)
+
+    def _add_peer(self, nid: str) -> None:
+        with self._lock:
+            self._peers.setdefault(nid, set())
+
+    def _broadcast_loop(self) -> None:
+        """ref: reactor.go:279 broadcastTxRoutine (clist walk per peer;
+        here one scan thread over all peers)."""
+        sweeps = 0
+        while not self._stop.is_set():
+            txs = self.mempool.all_txs()
+            with self._lock:
+                peers = list(self._peers.items())
+            for nid, sent in peers:
+                for wtx in txs:
+                    key = tx_key(wtx.tx)
+                    if key in sent or nid in wtx.peers:
+                        continue  # don't echo a tx back to its source
+                    if self.channel.send_to(nid, wtx.tx, timeout=0.5):
+                        sent.add(key)
+            sweeps += 1
+            if sweeps % 256 == 0:
+                # prune: keys no longer in the mempool can be forgotten —
+                # bounds memory and lets a re-submitted tx re-propagate
+                live = {tx_key(w.tx) for w in txs}
+                with self._lock:
+                    for _, sent in self._peers.items():
+                        sent &= live
+            self._stop.wait(self.BROADCAST_SLEEP)
+
+    def _recv_loop(self) -> None:
+        """ref: reactor.go:119 handleMempoolMessage → CheckTx."""
+        while not self._stop.is_set():
+            env = self.channel.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            tx, nid = env.message, env.from_
+            with self._lock:
+                sent = self._peers.get(nid)
+                if sent is not None:
+                    sent.add(tx_key(tx))
+            try:
+                self.mempool.check_tx(tx, sender=nid)
+            except TxInCacheError:
+                pass  # duplicate — normal gossip redundancy
+            except Exception as e:
+                self.channel.send_error(PeerError(node_id=nid, err=e))

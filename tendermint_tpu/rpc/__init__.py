@@ -1,0 +1,6 @@
+"""JSON-RPC service (ref: rpc/ + internal/rpc/)."""
+
+from .server import JSONRPCServer, RPCError
+from .core import RPCEnvironment, build_routes
+
+__all__ = ["JSONRPCServer", "RPCEnvironment", "RPCError", "build_routes"]

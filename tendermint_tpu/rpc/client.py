@@ -1,0 +1,208 @@
+"""RPC clients (ref: rpc/client/http + eventstream).
+
+HTTPClient speaks JSON-RPC over HTTP POST; WSClient implements a
+minimal RFC-6455 client for /websocket subscriptions.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+import urllib.request
+
+
+class RPCClientError(Exception):
+    def __init__(self, code, message, data=None):
+        super().__init__(f"RPC error {code}: {message}" + (f" ({data})" if data else ""))
+        self.code = code
+        self.data = data
+
+
+class HTTPClient:
+    """ref: rpc/client/http/http.go."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._ids = itertools.count(1)
+
+    def call(self, method: str, **params):
+        req = {
+            "jsonrpc": "2.0",
+            "id": next(self._ids),
+            "method": method,
+            "params": params,
+        }
+        data = json.dumps(req).encode()
+        http_req = urllib.request.Request(
+            self.base_url, data=data, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(http_req, timeout=self.timeout) as resp:
+            body = json.loads(resp.read())
+        if "error" in body:
+            e = body["error"]
+            raise RPCClientError(e.get("code"), e.get("message"), e.get("data"))
+        return body["result"]
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda **params: self.call(name, **params)
+
+
+class WSClient:
+    """Minimal websocket JSON-RPC client (ref: rpc/client/http ws +
+    eventstream)."""
+
+    def __init__(self, host: str, port: int, path: str = "/websocket", timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        handshake = (
+            f"GET {path} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n"
+        )
+        self.sock.sendall(handshake.encode())
+        # read response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("websocket handshake failed")
+            buf += chunk
+        status = buf.split(b"\r\n", 1)[0]
+        if b"101" not in status:
+            raise ConnectionError(f"websocket upgrade rejected: {status!r}")
+        self._ids = itertools.count(1)
+        self._responses: dict[int, dict] = {}
+        self._events: queue.Queue = queue.Queue(maxsize=1024)
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True, name="ws-client")
+        self._reader.start()
+
+    # --------------------------------------------------------------- frames
+
+    def _send_text(self, text: str) -> None:
+        payload = text.encode()
+        mask = os.urandom(4)
+        header = bytearray([0x81])
+        n = len(payload)
+        if n < 126:
+            header.append(0x80 | n)
+        elif n < 1 << 16:
+            header.append(0x80 | 126)
+            header += struct.pack(">H", n)
+        else:
+            header.append(0x80 | 127)
+            header += struct.pack(">Q", n)
+        header += mask
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(bytes(header) + masked)
+
+    def _read_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        try:
+            self._read_loop_inner()
+        finally:
+            self._closed.set()  # fail fast for blocked call()/next_event()
+
+    def _read_loop_inner(self) -> None:
+        while not self._closed.is_set():
+            hdr = self._read_exact(2)
+            if hdr is None:
+                return
+            opcode = hdr[0] & 0x0F
+            length = hdr[1] & 0x7F
+            if length == 126:
+                ext = self._read_exact(2)
+                if ext is None:
+                    return
+                length = struct.unpack(">H", ext)[0]
+            elif length == 127:
+                ext = self._read_exact(8)
+                if ext is None:
+                    return
+                length = struct.unpack(">Q", ext)[0]
+            payload = self._read_exact(length) if length else b""
+            if payload is None or opcode == 0x8:
+                return
+            if opcode == 0x9:  # ping → pong
+                try:
+                    self.sock.sendall(bytes([0x8A, 0x80]) + os.urandom(4))
+                except OSError:
+                    self._closed.set()
+                    return
+                continue
+            if opcode not in (0x1, 0x2):
+                continue
+            try:
+                msg = json.loads(payload)
+            except Exception:
+                continue
+            result = msg.get("result") or {}
+            if isinstance(result, dict) and "data" in result and "query" in result:
+                try:
+                    self._events.put_nowait(result)
+                except queue.Full:
+                    pass
+            else:
+                with self._lock:
+                    self._responses[msg.get("id")] = msg
+
+    # ----------------------------------------------------------------- API
+
+    def call(self, method: str, timeout: float = 10.0, **params):
+        id_ = next(self._ids)
+        self._send_text(json.dumps({"jsonrpc": "2.0", "id": id_, "method": method, "params": params}))
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                msg = self._responses.pop(id_, None)
+            if msg is not None:
+                if "error" in msg:
+                    e = msg["error"]
+                    raise RPCClientError(e.get("code"), e.get("message"), e.get("data"))
+                return msg.get("result")
+            if self._closed.is_set():
+                raise ConnectionError("websocket closed")
+            time.sleep(0.01)
+        raise TimeoutError(f"no response for {method}")
+
+    def subscribe(self, query: str) -> None:
+        self.call("subscribe", query=query)
+
+    def next_event(self, timeout: float = 10.0) -> dict | None:
+        try:
+            return self._events.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass

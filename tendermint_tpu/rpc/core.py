@@ -1,0 +1,643 @@
+"""RPC core: environment + the ~30 route handlers
+(ref: internal/rpc/core/env.go, routes.go:28-80).
+
+JSON conventions follow the reference's RPC: hashes hex-upper, txs
+base64, heights as strings.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time as _time
+
+from ..abci import types as abci
+from ..eventbus.event_bus import (
+    EventDataNewBlock,
+    EventDataNewBlockHeader,
+    EventDataTx,
+    tx_hash,
+)
+from ..pubsub.query import parse_query
+from .server import RPCError
+
+ERR_TX_NOT_FOUND = -32603
+
+
+# ------------------------------------------------------------- JSON encoding
+
+
+def _b64(b: bytes | None) -> str:
+    return base64.b64encode(b or b"").decode()
+
+
+def _hex(b: bytes | None) -> str:
+    return (b or b"").hex().upper()
+
+
+def block_id_to_json(bid) -> dict:
+    if bid is None:
+        return {"hash": "", "parts": {"total": 0, "hash": ""}}
+    return {
+        "hash": _hex(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total if bid.part_set_header else 0,
+            "hash": _hex(bid.part_set_header.hash if bid.part_set_header else b""),
+        },
+    }
+
+
+def header_to_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version_block), "app": str(h.version_app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": str(h.time),
+        "last_block_id": block_id_to_json(h.last_block_id),
+        "last_commit_hash": _hex(h.last_commit_hash),
+        "data_hash": _hex(h.data_hash),
+        "validators_hash": _hex(h.validators_hash),
+        "next_validators_hash": _hex(h.next_validators_hash),
+        "consensus_hash": _hex(h.consensus_hash),
+        "app_hash": _hex(h.app_hash),
+        "last_results_hash": _hex(h.last_results_hash),
+        "evidence_hash": _hex(h.evidence_hash),
+        "proposer_address": _hex(h.proposer_address),
+    }
+
+
+def commit_to_json(c) -> dict:
+    if c is None:
+        return None
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": block_id_to_json(c.block_id),
+        "signatures": [
+            {
+                "block_id_flag": s.block_id_flag,
+                "validator_address": _hex(s.validator_address),
+                "timestamp": str(s.timestamp),
+                "signature": _b64(s.signature),
+            }
+            for s in c.signatures
+        ],
+    }
+
+
+def block_to_json(b) -> dict:
+    return {
+        "header": header_to_json(b.header),
+        "data": {"txs": [_b64(tx) for tx in b.txs]},
+        "evidence": {"evidence": [ev.to_proto().encode().hex() for ev in b.evidence]},
+        "last_commit": commit_to_json(b.last_commit),
+    }
+
+
+def validator_to_json(v) -> dict:
+    return {
+        "address": _hex(v.address),
+        "pub_key": {"type": v.pub_key.type_name, "value": _b64(v.pub_key.bytes())},
+        "voting_power": str(v.voting_power),
+        "proposer_priority": str(v.proposer_priority),
+    }
+
+
+def tx_result_to_json(r) -> dict:
+    return {
+        "code": getattr(r, "code", 0),
+        "data": _b64(getattr(r, "data", b"")),
+        "log": getattr(r, "log", ""),
+        "info": getattr(r, "info", ""),
+        "gas_wanted": str(getattr(r, "gas_wanted", 0)),
+        "gas_used": str(getattr(r, "gas_used", 0)),
+        "events": [
+            {
+                "type": e.type,
+                "attributes": [{"key": a.key, "value": a.value, "index": a.index} for a in e.attributes],
+            }
+            for e in (getattr(r, "events", None) or [])
+        ],
+        "codespace": getattr(r, "codespace", ""),
+    }
+
+
+def event_to_json(data) -> dict:
+    """Event payloads for ws subscriptions (ref: coretypes result events)."""
+    if isinstance(data, EventDataNewBlock):
+        return {
+            "type": "tendermint/event/NewBlock",
+            "value": {
+                "block": block_to_json(data.block) if data.block else None,
+                "block_id": block_id_to_json(data.block_id),
+            },
+        }
+    if isinstance(data, EventDataNewBlockHeader):
+        return {
+            "type": "tendermint/event/NewBlockHeader",
+            "value": {"header": header_to_json(data.header), "num_txs": str(data.num_txs)},
+        }
+    if isinstance(data, EventDataTx):
+        return {
+            "type": "tendermint/event/Tx",
+            "value": {
+                "TxResult": {
+                    "height": str(data.height),
+                    "index": data.index,
+                    "tx": _b64(data.tx),
+                    "result": tx_result_to_json(data.result) if data.result else None,
+                }
+            },
+        }
+    return {"type": type(data).__name__, "value": str(data)}
+
+
+# --------------------------------------------------------------- environment
+
+
+class RPCEnvironment:
+    """Holds every subsystem the routes touch (ref: env.go Environment)."""
+
+    def __init__(
+        self,
+        chain_id: str = "",
+        state_store=None,
+        block_store=None,
+        consensus_state=None,
+        mempool=None,
+        evidence_pool=None,
+        event_bus=None,
+        tx_indexer=None,
+        app_client=None,
+        gen_doc=None,
+        peer_manager=None,
+        node_info=None,
+        pub_key=None,
+    ):
+        self.chain_id = chain_id
+        self.state_store = state_store
+        self.block_store = block_store
+        self.consensus_state = consensus_state
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
+        self.tx_indexer = tx_indexer
+        self.app_client = app_client
+        self.gen_doc = gen_doc
+        self.peer_manager = peer_manager
+        self.node_info = node_info
+        self.pub_key = pub_key
+        self.start_time = _time.time()
+
+
+def _as_int(v, name: str) -> int:
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise RPCError(-32602, f"invalid {name}: {v!r}")
+
+
+def _as_bytes_hex(v, name: str) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    s = str(v)
+    if s.startswith("0x") or s.startswith("0X"):
+        s = s[2:]
+    try:
+        return bytes.fromhex(s)
+    except ValueError:
+        try:
+            return base64.b64decode(s, validate=True)
+        except Exception:
+            raise RPCError(-32602, f"invalid {name}: {v!r}")
+
+
+def build_routes(env: RPCEnvironment) -> dict:
+    """ref: internal/rpc/core/routes.go:28-80."""
+
+    # ---------------------------------------------------------------- info
+
+    def health():
+        return {}
+
+    def status():
+        """ref: internal/rpc/core/status.go."""
+        latest_height = env.block_store.height() if env.block_store else 0
+        latest_meta = env.block_store.load_block_meta(latest_height) if latest_height else None
+        base = env.block_store.base() if env.block_store else 0
+        base_meta = env.block_store.load_block_meta(base) if base else None
+        val_info = {}
+        if env.pub_key is not None and env.state_store is not None:
+            state = env.state_store.load()
+            addr = env.pub_key.address()
+            idx, val = state.validators.get_by_address(addr) if state else (None, None)
+            val_info = {
+                "address": _hex(addr),
+                "pub_key": {"type": env.pub_key.type_name, "value": _b64(env.pub_key.bytes())},
+                "voting_power": str(val.voting_power) if val else "0",
+            }
+        return {
+            "node_info": env.node_info.to_wire() if env.node_info else {},
+            "sync_info": {
+                "latest_block_hash": _hex(latest_meta.block_id.hash if latest_meta else b""),
+                "latest_app_hash": _hex(latest_meta.header.app_hash if latest_meta else b""),
+                "latest_block_height": str(latest_height),
+                "latest_block_time": str(latest_meta.header.time) if latest_meta else "",
+                "earliest_block_height": str(base),
+                "earliest_block_time": str(base_meta.header.time) if base_meta else "",
+                "catching_up": False,
+            },
+            "validator_info": val_info,
+        }
+
+    def net_info():
+        peers = env.peer_manager.peers() if env.peer_manager else []
+        return {
+            "listening": True,
+            "n_peers": str(len(peers)),
+            "peers": [{"node_id": p} for p in peers],
+        }
+
+    def genesis():
+        import json as _json
+
+        if env.gen_doc is None:
+            raise RPCError(-32603, "genesis doc unavailable")
+        return {"genesis": _json.loads(env.gen_doc.to_json())}
+
+    def genesis_chunked(chunk=0):
+        if env.gen_doc is None:
+            raise RPCError(-32603, "genesis doc unavailable")
+        data = env.gen_doc.to_json().encode()
+        size = 16 * 1024
+        chunks = [data[i : i + size] for i in range(0, len(data), size)] or [b""]
+        idx = _as_int(chunk, "chunk") or 0
+        if idx < 0 or idx >= len(chunks):
+            raise RPCError(-32603, f"there are {len(chunks)} chunks; {idx} is invalid")
+        return {"chunk": str(idx), "total": str(len(chunks)), "data": _b64(chunks[idx])}
+
+    # --------------------------------------------------------------- blocks
+
+    def _height_or_latest(height) -> int:
+        h = _as_int(height, "height")
+        if h is None or h == 0:
+            return env.block_store.height()
+        if h < 0:
+            raise RPCError(-32603, f"height must be greater than 0, but got {h}")
+        if h > env.block_store.height():
+            raise RPCError(
+                -32603,
+                f"height {h} must be less than or equal to the head height {env.block_store.height()}",
+            )
+        return h
+
+    def block(height=None):
+        h = _height_or_latest(height)
+        blk = env.block_store.load_block(h)
+        meta = env.block_store.load_block_meta(h)
+        if blk is None:
+            return {"block_id": block_id_to_json(None), "block": None}
+        return {"block_id": block_id_to_json(meta.block_id), "block": block_to_json(blk)}
+
+    def block_by_hash(hash=None):
+        h = _as_bytes_hex(hash, "hash")
+        blk = env.block_store.load_block_by_hash(h)
+        if blk is None:
+            return {"block_id": block_id_to_json(None), "block": None}
+        meta = env.block_store.load_block_meta(blk.header.height)
+        return {"block_id": block_id_to_json(meta.block_id), "block": block_to_json(blk)}
+
+    def block_results(height=None):
+        h = _height_or_latest(height)
+        f_res = env.state_store.load_finalize_block_responses(h)
+        if f_res is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        return {
+            "height": str(h),
+            "txs_results": [tx_result_to_json(r) for r in f_res.tx_results],
+            "finalize_block_events": [
+                {"type": e.type, "attributes": [{"key": a.key, "value": a.value} for a in e.attributes]}
+                for e in (getattr(f_res, "events", None) or [])
+            ],
+            "validator_updates": [
+                {"pub_key_type": u.pub_key_type, "power": str(u.power)} for u in f_res.validator_updates
+            ],
+            "app_hash": _hex(getattr(f_res, "app_hash", b"")),
+        }
+
+    def blockchain(minHeight=None, maxHeight=None):
+        """ref: internal/rpc/core/blocks.go BlockchainInfo."""
+        base = env.block_store.base()
+        head = env.block_store.height()
+        max_h = min(_as_int(maxHeight, "maxHeight") or head, head)
+        min_h = max(_as_int(minHeight, "minHeight") or base, base)
+        min_h = max(min_h, max_h - 20 + 1)
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            meta = env.block_store.load_block_meta(h)
+            if meta is not None:
+                metas.append(
+                    {
+                        "block_id": block_id_to_json(meta.block_id),
+                        "block_size": str(meta.block_size),
+                        "header": header_to_json(meta.header),
+                        "num_txs": str(meta.num_txs),
+                    }
+                )
+        return {"last_height": str(head), "block_metas": metas}
+
+    def commit(height=None):
+        h = _height_or_latest(height)
+        meta = env.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no header at height {h}")
+        c = env.block_store.load_block_commit(h)
+        canonical = True
+        if c is None:
+            c = env.block_store.load_seen_commit(h)
+            canonical = False
+        return {
+            "signed_header": {"header": header_to_json(meta.header), "commit": commit_to_json(c)},
+            "canonical": canonical,
+        }
+
+    def validators(height=None, page=1, per_page=30):
+        h = _height_or_latest(height)
+        vals = env.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validator set at height {h}")
+        page_i = max(1, _as_int(page, "page") or 1)
+        per = min(100, max(1, _as_int(per_page, "per_page") or 30))
+        start = (page_i - 1) * per
+        sel = vals.validators[start : start + per]
+        return {
+            "block_height": str(h),
+            "validators": [validator_to_json(v) for v in sel],
+            "count": str(len(sel)),
+            "total": str(vals.size()),
+        }
+
+    def consensus_params(height=None):
+        h = _height_or_latest(height)
+        params = env.state_store.load_consensus_params(h)
+        if params is None:
+            state = env.state_store.load()
+            params = state.consensus_params
+        return {
+            "block_height": str(h),
+            "consensus_params": {
+                "block": {"max_bytes": str(params.block.max_bytes), "max_gas": str(params.block.max_gas)},
+                "evidence": {
+                    "max_age_num_blocks": str(params.evidence.max_age_num_blocks),
+                    "max_age_duration": str(params.evidence.max_age_duration),
+                    "max_bytes": str(params.evidence.max_bytes),
+                },
+                "validator": {"pub_key_types": list(params.validator.pub_key_types)},
+            },
+        }
+
+    def consensus_state():
+        cs = env.consensus_state
+        if cs is None:
+            raise RPCError(-32603, "consensus state unavailable")
+        rs = cs.rs
+        return {
+            "round_state": {
+                "height/round/step": f"{rs.height}/{rs.round}/{rs.step}",
+                "start_time": str(rs.start_time),
+                "proposal_block_hash": _hex(rs.proposal_block.hash() if rs.proposal_block else b""),
+                "locked_block_hash": _hex(rs.locked_block.hash() if rs.locked_block else b""),
+                "valid_block_hash": _hex(rs.valid_block.hash() if rs.valid_block else b""),
+            }
+        }
+
+    def dump_consensus_state():
+        base = consensus_state()
+        base["peers"] = [{"node_id": p} for p in (env.peer_manager.peers() if env.peer_manager else [])]
+        return base
+
+    # ------------------------------------------------------------- txs
+
+    def broadcast_tx_async(tx=None):
+        raw = _as_bytes_hex(tx, "tx")
+        threading.Thread(target=lambda: _check_tx_quiet(raw), daemon=True).start()
+        return {"code": 0, "data": "", "log": "", "hash": _hex(tx_hash(raw))}
+
+    def _check_tx_quiet(raw):
+        try:
+            env.mempool.check_tx(raw, sender="")
+        except Exception:
+            pass
+
+    def broadcast_tx_sync(tx=None):
+        raw = _as_bytes_hex(tx, "tx")
+        try:
+            res = env.mempool.check_tx(raw, sender="")
+        except Exception as e:
+            return {"code": 1, "data": "", "log": str(e), "hash": _hex(tx_hash(raw))}
+        return {
+            "code": res.code,
+            "data": _b64(res.data),
+            "log": res.log,
+            "codespace": res.codespace,
+            "hash": _hex(tx_hash(raw)),
+        }
+
+    def broadcast_tx_commit(tx=None, timeout=30.0):
+        """CheckTx, then wait for the tx to be committed
+        (ref: internal/rpc/core/mempool.go BroadcastTxCommit)."""
+        raw = _as_bytes_hex(tx, "tx")
+        if env.event_bus is None:
+            raise RPCError(-32603, "event bus unavailable; use broadcast_tx_sync")
+        import os as _os
+
+        h = tx_hash(raw)
+        # unique per request: concurrent re-submissions of the SAME tx
+        # must not collide on the subscriber name
+        subscriber = f"tx-commit-{h.hex()[:16]}-{_os.urandom(4).hex()}"
+        sub = env.event_bus.subscribe(subscriber, f"tm.event = 'Tx' AND tx.hash = '{h.hex().upper()}'")
+        try:
+            try:
+                check = env.mempool.check_tx(raw, sender="")
+            except Exception as e:
+                return {"check_tx": {"code": 1, "log": str(e)}, "hash": _hex(h)}
+            if check.code != abci.CODE_TYPE_OK:
+                return {"check_tx": tx_result_to_json(check), "hash": _hex(h)}
+            deadline = _time.monotonic() + float(timeout)
+            while _time.monotonic() < deadline:
+                msg = sub.next(timeout=0.25)
+                if msg is None:
+                    continue
+                data = msg.data
+                return {
+                    "check_tx": tx_result_to_json(check),
+                    "tx_result": tx_result_to_json(data.result),
+                    "hash": _hex(h),
+                    "height": str(data.height),
+                }
+            raise RPCError(-32603, "timed out waiting for tx to be included in a block")
+        finally:
+            env.event_bus.unsubscribe_all(subscriber)
+
+    def check_tx(tx=None):
+        raw = _as_bytes_hex(tx, "tx")
+        res = env.app_client.check_tx(abci.RequestCheckTx(tx=raw, type=0))
+        return tx_result_to_json(res)
+
+    def unconfirmed_txs(page=1, per_page=30):
+        txs = [w.tx for w in env.mempool.all_txs()]
+        page_i = max(1, _as_int(page, "page") or 1)
+        per = min(100, max(1, _as_int(per_page, "per_page") or 30))
+        sel = txs[(page_i - 1) * per : (page_i - 1) * per + per]
+        return {
+            "count": str(len(sel)),
+            "total": str(len(txs)),
+            "total_bytes": str(env.mempool.total_bytes()),
+            "txs": [_b64(t) for t in sel],
+        }
+
+    def num_unconfirmed_txs():
+        return {
+            "count": str(env.mempool.size()),
+            "total": str(env.mempool.size()),
+            "total_bytes": str(env.mempool.total_bytes()),
+        }
+
+    def tx(hash=None, prove=False):
+        if env.tx_indexer is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        h = _as_bytes_hex(hash, "hash")
+        doc = env.tx_indexer.get_tx_by_hash(h)
+        if doc is None:
+            raise RPCError(ERR_TX_NOT_FOUND, f"tx {h.hex().upper()} not found")
+        return {
+            "hash": _hex(h),
+            "height": str(doc["height"]),
+            "index": doc["index"],
+            "tx_result": {
+                "code": doc["code"],
+                "log": doc["log"],
+                "gas_wanted": str(doc["gas_wanted"]),
+                "gas_used": str(doc["gas_used"]),
+                "events": doc["events"],
+            },
+            "tx": _b64(bytes.fromhex(doc["tx"])),
+        }
+
+    def tx_search(query=None, prove=False, page=1, per_page=30, order_by="asc"):
+        if env.tx_indexer is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        q = parse_query(query or "")
+        docs = env.tx_indexer.search_tx_events(q, limit=10_000)
+        if order_by == "desc":
+            docs = list(reversed(docs))
+        page_i = max(1, _as_int(page, "page") or 1)
+        per = min(100, max(1, _as_int(per_page, "per_page") or 30))
+        sel = docs[(page_i - 1) * per : (page_i - 1) * per + per]
+        return {
+            "txs": [
+                {
+                    "hash": _hex(tx_hash(bytes.fromhex(d["tx"]))),
+                    "height": str(d["height"]),
+                    "index": d["index"],
+                    "tx_result": {"code": d["code"], "log": d["log"], "events": d["events"]},
+                    "tx": _b64(bytes.fromhex(d["tx"])),
+                }
+                for d in sel
+            ],
+            "total_count": str(len(docs)),
+        }
+
+    def block_search(query=None, page=1, per_page=30, order_by="asc"):
+        if env.tx_indexer is None:
+            raise RPCError(-32603, "block indexing is disabled")
+        q = parse_query(query or "")
+        heights = env.tx_indexer.search_block_events(q, limit=10_000)
+        if order_by == "desc":
+            heights = list(reversed(heights))
+        page_i = max(1, _as_int(page, "page") or 1)
+        per = min(100, max(1, _as_int(per_page, "per_page") or 30))
+        sel = heights[(page_i - 1) * per : (page_i - 1) * per + per]
+        blocks = []
+        for h in sel:
+            meta = env.block_store.load_block_meta(h)
+            blk = env.block_store.load_block(h)
+            if meta and blk:
+                blocks.append({"block_id": block_id_to_json(meta.block_id), "block": block_to_json(blk)})
+        return {"blocks": blocks, "total_count": str(len(heights))}
+
+    # ------------------------------------------------------------ evidence
+
+    def broadcast_evidence(evidence=None):
+        from ..proto import messages as pb
+        from ..types.evidence import evidence_from_proto
+
+        if env.evidence_pool is None:
+            raise RPCError(-32603, "evidence pool unavailable")
+        raw = _as_bytes_hex(evidence, "evidence")
+        ev = evidence_from_proto(pb.Evidence.decode(raw))
+        env.evidence_pool.add_evidence(ev)
+        return {"hash": _hex(ev.hash())}
+
+    # ----------------------------------------------------------------- abci
+
+    def abci_query(path="", data="", height=0, prove=False):
+        raw = _as_bytes_hex(data, "data") if data else b""
+        res = env.app_client.query(
+            abci.RequestQuery(data=raw, path=path, height=_as_int(height, "height") or 0, prove=bool(prove))
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "info": res.info,
+                "index": str(res.index),
+                "key": _b64(res.key),
+                "value": _b64(res.value),
+                "height": str(res.height),
+                "codespace": res.codespace,
+            }
+        }
+
+    def abci_info():
+        res = env.app_client.info(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": str(res.app_version),
+                "last_block_height": str(res.last_block_height),
+                "last_block_app_hash": _b64(res.last_block_app_hash),
+            }
+        }
+
+    return {
+        "health": health,
+        "status": status,
+        "net_info": net_info,
+        "genesis": genesis,
+        "genesis_chunked": genesis_chunked,
+        "blockchain": blockchain,
+        "block": block,
+        "block_by_hash": block_by_hash,
+        "block_results": block_results,
+        "commit": commit,
+        "validators": validators,
+        "consensus_params": consensus_params,
+        "consensus_state": consensus_state,
+        "dump_consensus_state": dump_consensus_state,
+        "broadcast_tx_async": broadcast_tx_async,
+        "broadcast_tx_sync": broadcast_tx_sync,
+        "broadcast_tx_commit": broadcast_tx_commit,
+        "check_tx": check_tx,
+        "unconfirmed_txs": unconfirmed_txs,
+        "num_unconfirmed_txs": num_unconfirmed_txs,
+        "tx": tx,
+        "tx_search": tx_search,
+        "block_search": block_search,
+        "broadcast_evidence": broadcast_evidence,
+        "abci_query": abci_query,
+        "abci_info": abci_info,
+    }

@@ -1,0 +1,54 @@
+"""Mempool gossip reactor test (ref: internal/mempool/reactor_test.go)."""
+
+from __future__ import annotations
+
+import time
+
+from test_p2p import wait_until
+from tendermint_tpu.abci import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.mempool.mempool import TxMempool, tx_key
+from tendermint_tpu.mempool.reactor import MempoolReactor, mempool_channel_descriptor
+from tendermint_tpu.p2p import (
+    MemoryNetwork,
+    NodeInfo,
+    PeerManager,
+    Router,
+    node_id_from_pubkey,
+)
+from tendermint_tpu.p2p.transport import Endpoint
+
+
+def _mk(net, seed):
+    key = Ed25519PrivKey.generate(bytes([seed]) * 32)
+    nid = node_id_from_pubkey(key.pub_key())
+    t = net.create_transport(nid)
+    pm = PeerManager(nid)
+    r = Router(NodeInfo(node_id=nid, network="mp-net"), key, pm, [t])
+    ch = r.open_channel(mempool_channel_descriptor())
+    mp = TxMempool(LocalClient(KVStoreApplication()))
+    reactor = MempoolReactor(mp, ch, pm)
+    r.start()
+    reactor.start()
+    return nid, pm, r, reactor, mp
+
+
+def test_tx_gossips_across_three_nodes():
+    net = MemoryNetwork()
+    nodes = [_mk(net, s) for s in (0x71, 0x72, 0x73)]
+    try:
+        # chain topology a—b—c: tx at a must reach c through b
+        for (a, b) in [(0, 1), (1, 2)]:
+            nodes[a][1].add(Endpoint(protocol="memory", host=nodes[b][0], node_id=nodes[b][0]))
+        assert wait_until(lambda: all(len(n[1].peers()) >= 1 for n in nodes))
+        tx = b"gossip-key=42"
+        nodes[0][4].check_tx(tx)
+        assert wait_until(lambda: nodes[2][4].size() == 1, timeout=10), (
+            f"sizes: {[n[4].size() for n in nodes]}"
+        )
+        assert nodes[2][4].get_tx(tx_key(tx)) == tx
+    finally:
+        for _, _, r, reactor, _ in nodes:
+            reactor.stop()
+            r.stop()

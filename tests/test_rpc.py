@@ -1,0 +1,192 @@
+"""RPC tests against a live single-validator node
+(ref: rpc/client/rpc_test.go)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from helpers import make_genesis_doc, make_keys
+from test_consensus import fast_params, make_node, wait_for_height
+from tendermint_tpu.eventbus import EventBus
+from tendermint_tpu.indexer import IndexerService, KVIndexer
+from tendermint_tpu.rpc import JSONRPCServer, RPCEnvironment, build_routes
+from tendermint_tpu.rpc.client import HTTPClient, RPCClientError, WSClient
+from tendermint_tpu.store.kv import MemDB
+
+CHAIN = "rpc-test-chain"
+
+
+@pytest.fixture(scope="module")
+def live_node():
+    """A running node with RPC, eventbus, and indexer wired."""
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    node = make_node(keys, 0, gen_doc)
+
+    bus = EventBus()
+    idx = KVIndexer(MemDB())
+    svc = IndexerService(idx, bus)
+    svc.start()
+    node.block_exec.event_publisher = bus.block_event_publisher()
+
+    from tendermint_tpu.mempool.mempool import TxMempool
+
+    mempool = TxMempool(node.block_exec.app)
+    node.block_exec.mempool = mempool
+
+    env = RPCEnvironment(
+        chain_id=CHAIN,
+        state_store=node.block_exec.store,
+        block_store=node.block_store,
+        consensus_state=node,
+        mempool=mempool,
+        event_bus=bus,
+        tx_indexer=idx,
+        app_client=node.block_exec.app,
+        gen_doc=gen_doc,
+        pub_key=keys[0].pub_key(),
+    )
+    server = JSONRPCServer(build_routes(env), event_bus=bus)
+    server.start()
+    node.start()
+    assert wait_for_height([node], 2, timeout=60)
+    host, port = server.address
+    yield node, HTTPClient(f"http://{host}:{port}"), (host, port)
+    node.stop()
+    server.stop()
+    svc.stop()
+
+
+def test_health_and_status(live_node):
+    node, client, _ = live_node
+    assert client.health() == {}
+    st = client.status()
+    assert int(st["sync_info"]["latest_block_height"]) >= 2
+    assert st["validator_info"]["voting_power"] == "10"
+
+
+def test_block_and_commit(live_node):
+    node, client, _ = live_node
+    blk = client.block(height=1)
+    assert blk["block"]["header"]["height"] == "1"
+    assert blk["block"]["header"]["chain_id"] == CHAIN
+    by_hash = client.block_by_hash(hash=blk["block_id"]["hash"])
+    assert by_hash["block"]["header"]["height"] == "1"
+    cm = client.commit(height=1)
+    assert cm["signed_header"]["commit"]["height"] == "1"
+    results = client.block_results(height=1)
+    assert results["height"] == "1"
+
+
+def test_blockchain_info_and_validators(live_node):
+    node, client, _ = live_node
+    bc = client.blockchain()
+    assert int(bc["last_height"]) >= 2
+    assert bc["block_metas"][0]["header"]["height"] == bc["last_height"]
+    vals = client.validators(height=1)
+    assert vals["total"] == "1" and len(vals["validators"]) == 1
+
+
+def test_genesis_endpoints(live_node):
+    node, client, _ = live_node
+    g = client.genesis()
+    assert g["genesis"]["chain_id"] == CHAIN
+    chunked = client.genesis_chunked(chunk=0)
+    assert chunked["chunk"] == "0"
+
+
+def test_abci_info_and_query(live_node):
+    node, client, _ = live_node
+    info = client.abci_info()
+    assert int(info["response"]["last_block_height"]) >= 1
+
+
+def test_broadcast_tx_commit_and_tx_search(live_node):
+    node, client, _ = live_node
+    tx = b"rpckey=rpcvalue"
+    res = client.broadcast_tx_commit(tx=tx.hex())
+    assert res["tx_result"]["code"] == 0
+    height = int(res["height"])
+    assert height >= 1
+
+    # indexed by hash
+    time.sleep(0.3)
+    got = client.tx(hash=res["hash"])
+    assert got["height"] == str(height)
+
+    found = client.tx_search(query=f"tx.height = {height}")
+    assert int(found["total_count"]) >= 1
+
+
+def test_broadcast_tx_sync_and_mempool_endpoints(live_node):
+    node, client, _ = live_node
+    res = client.broadcast_tx_sync(tx=b"synckey=1".hex())
+    assert res["code"] == 0
+    n = client.num_unconfirmed_txs()
+    assert int(n["total_bytes"]) >= 0
+
+
+def test_error_paths(live_node):
+    node, client, _ = live_node
+    with pytest.raises(RPCClientError):
+        client.block(height=10**9)  # beyond head
+    with pytest.raises(RPCClientError):
+        client.call("no_such_method")
+    with pytest.raises(RPCClientError):
+        client.tx(hash="ff" * 32)  # unknown tx
+
+
+def test_uri_get_requests(live_node):
+    import json
+    import urllib.request
+
+    node, client, (host, port) = live_node
+    with urllib.request.urlopen(f"http://{host}:{port}/status", timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert "result" in body and int(body["result"]["sync_info"]["latest_block_height"]) >= 1
+    with urllib.request.urlopen(f"http://{host}:{port}/block?height=1", timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert body["result"]["block"]["header"]["height"] == "1"
+
+
+def test_websocket_subscription(live_node):
+    node, client, (host, port) = live_node
+    ws = WSClient(host, port)
+    try:
+        ws.subscribe("tm.event = 'NewBlock'")
+        ev = ws.next_event(timeout=30)
+        assert ev is not None
+        assert ev["data"]["type"] == "tendermint/event/NewBlock"
+        h = int(ev["data"]["value"]["block"]["header"]["height"])
+        assert h >= 1
+        # status over the same ws connection
+        st = ws.call("status")
+        assert int(st["sync_info"]["latest_block_height"]) >= h
+    finally:
+        ws.close()
+
+
+def test_light_client_over_http_provider(live_node):
+    """Full loop: light client verifying the live node through its own
+    RPC (ref: light/provider/http)."""
+    from tendermint_tpu.light import LightClient, TrustOptions
+    from tendermint_tpu.light.http_provider import HTTPProvider
+    from tendermint_tpu.utils.tmtime import Time
+
+    node, client, (host, port) = live_node
+    provider = HTTPProvider(CHAIN, f"http://{host}:{port}")
+    lb1 = provider.light_block(1)
+    assert lb1.height == 1
+    lb1.validate_basic(CHAIN)
+
+    lc = LightClient(
+        CHAIN,
+        TrustOptions(period_ns=24 * 3600 * 10**9, height=1, hash=lb1.signed_header.hash()),
+        provider,
+    )
+    head = lc.update()
+    assert head.height >= 2
+    assert lc.latest_trusted().height == head.height
