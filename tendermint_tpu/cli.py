@@ -1,0 +1,330 @@
+"""Command-line interface (ref: cmd/tendermint/main.go:28-48 +
+cmd/tendermint/commands/).
+
+Commands: init, start, testnet, light, inspect, rollback, reset,
+gen-validator, gen-node-key, show-node-id, show-validator, version.
+Run as `python -m tendermint_tpu <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+VERSION = "0.35.0-tpu"
+
+
+def _default_home() -> str:
+    return os.environ.get("TMHOME", os.path.expanduser("~/.tendermint-tpu"))
+
+
+# ---------------------------------------------------------------- commands
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+def cmd_init(args) -> int:
+    """ref: commands/init.go — init validator|full|seed."""
+    from .node import init_files_home
+
+    cfg = init_files_home(args.home, chain_id=args.chain_id or "", mode=args.mode)
+    print(f"initialized {args.mode} node in {args.home}")
+    print(f"  config:  {os.path.join(args.home, 'config', 'config.toml')}")
+    print(f"  genesis: {cfg.genesis_file}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """ref: commands/run_node.go:97 NewRunNodeCmd."""
+    from .config import load_config
+    from .node import Node
+
+    cfg = load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    node = Node(cfg)
+    node.start()
+    rpc = node.rpc_address
+    print(f"node {node.node_id} started")
+    print(f"  p2p: {node.p2p_endpoint}")
+    if rpc:
+        print(f"  rpc: http://{rpc[0]}:{rpc[1]}")
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Generate a multi-node testnet layout
+    (ref: commands/testnet.go)."""
+    from .config import default_config
+    from .node import NodeKey, init_files_home
+    from .privval import FilePV
+    from .types.genesis import GenesisDoc, GenesisValidator
+    from .utils.tmtime import Time
+
+    n = args.validators
+    base = args.output
+    pvs = []
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        cfg = default_config(home)
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+        NodeKey.load_or_gen(cfg.node_key_file)
+        pvs.append(pv)
+
+    gen_doc = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time=Time.now(),
+        validators=[
+            GenesisValidator(address=pv.get_pub_key().address(), pub_key=pv.get_pub_key(), power=10, name=f"node{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+
+    node_ids = []
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        cfg = default_config(home)
+        nk = NodeKey.load_or_gen(cfg.node_key_file)
+        node_ids.append(nk.node_id)
+
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        cfg = default_config(home)
+        gen_doc.save_as(cfg.genesis_file)
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        peers = [
+            f"{node_ids[j]}@127.0.0.1:{args.starting_port + 2 * j}" for j in range(n) if j != i
+        ]
+        cfg.p2p.persistent_peers = ",".join(peers)
+        cfg.save()
+    print(f"generated {n}-validator testnet in {base} (chain id {gen_doc.chain_id})")
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from .config import load_config
+    from .node import NodeKey
+
+    cfg = load_config(args.home)
+    print(NodeKey.load_or_gen(cfg.node_key_file).node_id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from .config import load_config
+    from .privval import FilePV
+
+    cfg = load_config(args.home)
+    pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": pub.type_name, "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from .crypto.ed25519 import Ed25519PrivKey
+
+    key = Ed25519PrivKey.generate()
+    print(
+        json.dumps(
+            {
+                "address": key.pub_key().address().hex().upper(),
+                "pub_key": {"type": "ed25519", "value": key.pub_key().bytes().hex()},
+                "priv_key": {"type": "ed25519", "value": key.bytes().hex()},
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from .crypto.ed25519 import Ed25519PrivKey
+    from .p2p import node_id_from_pubkey
+
+    key = Ed25519PrivKey.generate()
+    print(json.dumps({"id": node_id_from_pubkey(key.pub_key()), "priv_key": key.bytes().hex()}))
+    return 0
+
+
+def cmd_reset(args) -> int:
+    """ref: commands/reset.go — unsafe-reset-all keeps keys/genesis,
+    wipes data."""
+    data_dir = os.path.join(args.home, "data")
+    if os.path.isdir(data_dir):
+        keep = {}
+        pv_state = os.path.join(data_dir, "priv_validator_state.json")
+        shutil.rmtree(data_dir)
+        os.makedirs(data_dir, exist_ok=True)
+        with open(pv_state, "w") as f:
+            json.dump({"height": 0, "round": 0, "step": 0}, f)
+        print(f"reset {data_dir} (privval sign-state zeroed — DANGEROUS on a live chain)")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """ref: commands/rollback.go."""
+    from .config import load_config
+    from .node.node import _make_db
+    from .state import StateStore
+    from .state.rollback import rollback_state
+    from .store.blockstore import BlockStore
+
+    cfg = load_config(args.home)
+    state_store = StateStore(_make_db(cfg, "state"))
+    block_store = BlockStore(_make_db(cfg, "blockstore"))
+    height, app_hash = rollback_state(state_store, block_store)
+    print(f"rolled back state to height {height} and hash {app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """Read-only RPC over a crashed node's data
+    (ref: internal/inspect/inspect.go:45)."""
+    from .config import load_config
+    from .indexer import KVIndexer
+    from .node.node import _make_db
+    from .rpc import JSONRPCServer, RPCEnvironment, build_routes
+    from .state import StateStore
+    from .store.blockstore import BlockStore
+    from .types.genesis import GenesisDoc
+
+    cfg = load_config(args.home)
+    state_store = StateStore(_make_db(cfg, "state"))
+    block_store = BlockStore(_make_db(cfg, "blockstore"))
+    gen_doc = GenesisDoc.from_file(cfg.genesis_file)
+    env = RPCEnvironment(
+        chain_id=gen_doc.chain_id,
+        state_store=state_store,
+        block_store=block_store,
+        tx_indexer=KVIndexer(_make_db(cfg, "tx_index")),
+        gen_doc=gen_doc,
+    )
+    from urllib.parse import urlparse
+
+    addr = urlparse(cfg.rpc.laddr if "//" in cfg.rpc.laddr else "tcp://" + cfg.rpc.laddr)
+    server = JSONRPCServer(build_routes(env), host=addr.hostname or "127.0.0.1", port=addr.port or 0)
+    server.start()
+    host, port = server.address
+    print(f"inspect server on http://{host}:{port} (read-only; ctrl-c to exit)")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.stop()
+    return 0
+
+
+def cmd_light(args) -> int:
+    """Light client proxy daemon (ref: commands/light.go +
+    light/proxy/proxy.go)."""
+    from .light import LightClient, TrustOptions
+    from .light.http_provider import HTTPProvider
+
+    primary = HTTPProvider(args.chain_id, args.primary)
+    witnesses = [HTTPProvider(args.chain_id, w) for w in (args.witnesses or "").split(",") if w]
+    if args.trusted_height and args.trusted_hash:
+        opts = TrustOptions(
+            period_ns=int(args.trusting_period * 1e9),
+            height=args.trusted_height,
+            hash=bytes.fromhex(args.trusted_hash),
+        )
+    else:
+        lb = primary.light_block(0)
+        opts = TrustOptions(
+            period_ns=int(args.trusting_period * 1e9),
+            height=lb.height,
+            hash=lb.signed_header.hash(),
+        )
+        print(f"trusting current head: height {lb.height} hash {opts.hash.hex().upper()}")
+    client = LightClient(args.chain_id, opts, primary, witnesses=witnesses)
+    print(f"light client tracking {args.primary} (chain {args.chain_id})")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        try:
+            head = client.update()
+            print(f"verified head {head.height} {head.signed_header.hash().hex().upper()[:16]}")
+        except Exception as e:
+            print(f"update error: {e}")
+        time.sleep(args.interval)
+    return 0
+
+
+# ------------------------------------------------------------------- parser
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tendermint-tpu", description="TPU-native BFT consensus engine")
+    p.add_argument("--home", default=_default_home(), help="node home directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version", help="show version").set_defaults(fn=cmd_version)
+
+    sp = sub.add_parser("init", help="initialize a node home directory")
+    sp.add_argument("mode", nargs="?", default="validator", choices=["validator", "full", "seed"])
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--proxy-app", default="")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("testnet", help="generate a localnet layout")
+    sp.add_argument("--validators", type=int, default=4)
+    sp.add_argument("--output", default="./testnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sub.add_parser("show-node-id", help="print the p2p node id").set_defaults(fn=cmd_show_node_id)
+    sub.add_parser("show-validator", help="print the validator pubkey").set_defaults(fn=cmd_show_validator)
+    sub.add_parser("gen-validator", help="generate a validator keypair").set_defaults(fn=cmd_gen_validator)
+    sub.add_parser("gen-node-key", help="generate a node key").set_defaults(fn=cmd_gen_node_key)
+    sub.add_parser("unsafe-reset-all", help="wipe the data directory").set_defaults(fn=cmd_reset)
+    sub.add_parser("rollback", help="rewind state one height").set_defaults(fn=cmd_rollback)
+    sub.add_parser("inspect", help="read-only RPC over node data").set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("light", help="run a verifying light client against a primary")
+    sp.add_argument("chain_id")
+    sp.add_argument("primary", help="primary RPC address (http://host:port)")
+    sp.add_argument("--witnesses", default="", help="comma-separated witness RPC addresses")
+    sp.add_argument("--trusted-height", type=int, default=0)
+    sp.add_argument("--trusted-hash", default="")
+    sp.add_argument("--trusting-period", type=float, default=168 * 3600)
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.set_defaults(fn=cmd_light)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""Node configuration (ref: config/)."""
+
+from .config import (
+    BaseConfig,
+    BlockSyncConfig,
+    Config,
+    ConsensusConfig,
+    MempoolConfig,
+    P2PConfig,
+    RPCConfig,
+    StateSyncConfig,
+    TxIndexConfig,
+    default_config,
+    load_config,
+)
+
+__all__ = [
+    "BaseConfig",
+    "BlockSyncConfig",
+    "Config",
+    "ConsensusConfig",
+    "MempoolConfig",
+    "P2PConfig",
+    "RPCConfig",
+    "StateSyncConfig",
+    "TxIndexConfig",
+    "default_config",
+    "load_config",
+]

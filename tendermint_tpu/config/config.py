@@ -1,0 +1,262 @@
+"""Config tree + TOML persistence (ref: config/config.go:62-1230,
+config/toml.go).
+
+Consensus-critical parameters (timeouts, synchrony) are ON-CHAIN
+ConsensusParams, not node config — a node-local config cannot fork the
+chain (config.go's deprecated-timeout migration moved them out). What
+remains here is operational: listeners, db paths, mempool sizing,
+peers, sync modes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+try:
+    import tomllib  # py3.11+
+except ImportError:  # pragma: no cover
+    tomllib = None
+
+DEFAULT_DATA_DIR = "data"
+DEFAULT_CONFIG_DIR = "config"
+DEFAULT_CONFIG_FILE = "config.toml"
+DEFAULT_GENESIS_FILE = "genesis.json"
+DEFAULT_PRIVVAL_KEY = "priv_validator_key.json"
+DEFAULT_PRIVVAL_STATE = "priv_validator_state.json"
+DEFAULT_NODE_KEY = "node_key.json"
+
+
+@dataclass
+class BaseConfig:
+    """ref: config.BaseConfig (config/config.go:146)."""
+
+    home: str = ""
+    moniker: str = "anonymous"
+    mode: str = "validator"  # validator | full | seed
+    proxy_app: str = "builtin:kvstore"  # builtin:<name> | tcp://... (socket ABCI)
+    db_backend: str = "filedb"
+    db_dir: str = "data"
+    log_level: str = "info"
+    genesis_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_GENESIS_FILE)
+    priv_validator_key_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_PRIVVAL_KEY)
+    priv_validator_state_file: str = os.path.join(DEFAULT_DATA_DIR, DEFAULT_PRIVVAL_STATE)
+    node_key_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_NODE_KEY)
+
+
+@dataclass
+class RPCConfig:
+    """ref: config.RPCConfig (config/config.go:388)."""
+
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    timeout_broadcast_tx_commit: float = 10.0
+    enable: bool = True
+
+
+@dataclass
+class P2PConfig:
+    """ref: config.P2PConfig (config/config.go:570)."""
+
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    persistent_peers: str = ""  # comma-separated id@host:port
+    bootstrap_peers: str = ""
+    max_connections: int = 64
+    max_incoming_connection_attempts: int = 100
+    pex: bool = True
+    private_peer_ids: str = ""
+
+
+@dataclass
+class MempoolConfig:
+    """ref: config.MempoolConfig (config/config.go:697)."""
+
+    size: int = 5000
+    max_txs_bytes: int = 1 << 30
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1 << 20
+    recheck: bool = True
+    broadcast: bool = True
+
+
+@dataclass
+class BlockSyncConfig:
+    """ref: config.BlockSyncConfig (config/config.go:832)."""
+
+    enable: bool = True
+
+
+@dataclass
+class StateSyncConfig:
+    """ref: config.StateSyncConfig (config/config.go:775)."""
+
+    enable: bool = False
+    rpc_servers: str = ""
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: float = 168 * 3600.0  # seconds
+    discovery_time: float = 15.0
+    chunk_fetchers: int = 4
+
+
+@dataclass
+class ConsensusConfig:
+    """Operational consensus knobs (ref: config.ConsensusConfig
+    config/config.go:847 — timeouts live on-chain now)."""
+
+    wal_file: str = os.path.join(DEFAULT_DATA_DIR, "cs.wal", "wal")
+    double_sign_check_height: int = 0
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+
+
+@dataclass
+class TxIndexConfig:
+    """ref: config.TxIndexConfig (config/config.go:1100)."""
+
+    indexer: str = "kv"  # kv | "null"
+
+
+@dataclass
+class InstrumentationConfig:
+    """ref: config.InstrumentationConfig (config/config.go:1130)."""
+
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    """ref: config.Config (config/config.go:62)."""
+
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    # -------------------------------------------------------------- paths
+
+    def _root(self, path: str) -> str:
+        return path if os.path.isabs(path) else os.path.join(self.base.home, path)
+
+    @property
+    def genesis_file(self) -> str:
+        return self._root(self.base.genesis_file)
+
+    @property
+    def priv_validator_key_file(self) -> str:
+        return self._root(self.base.priv_validator_key_file)
+
+    @property
+    def priv_validator_state_file(self) -> str:
+        return self._root(self.base.priv_validator_state_file)
+
+    @property
+    def node_key_file(self) -> str:
+        return self._root(self.base.node_key_file)
+
+    @property
+    def db_dir(self) -> str:
+        return self._root(self.base.db_dir)
+
+    @property
+    def wal_file(self) -> str:
+        return self._root(self.consensus.wal_file)
+
+    def validate_basic(self) -> None:
+        if self.base.mode not in ("validator", "full", "seed"):
+            raise ValueError(f"unknown mode {self.base.mode!r}")
+        if self.mempool.size <= 0:
+            raise ValueError("mempool.size must be positive")
+
+    # --------------------------------------------------------------- TOML
+
+    def save(self, path: str | None = None) -> str:
+        path = path or os.path.join(self.base.home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+        return path
+
+    def to_toml(self) -> str:
+        """ref: config/toml.go template."""
+
+        def v(val) -> str:
+            if isinstance(val, bool):
+                return "true" if val else "false"
+            if isinstance(val, (int, float)):
+                return str(val)
+            return '"%s"' % str(val).replace("\\", "\\\\").replace('"', '\\"')
+
+        lines = ["# tendermint-tpu node configuration", ""]
+        sections = [
+            ("", self.base),
+            ("rpc", self.rpc),
+            ("p2p", self.p2p),
+            ("mempool", self.mempool),
+            ("statesync", self.statesync),
+            ("blocksync", self.blocksync),
+            ("consensus", self.consensus),
+            ("tx-index", self.tx_index),
+            ("instrumentation", self.instrumentation),
+        ]
+        for name, section in sections:
+            if name:
+                lines.append(f"[{name}]")
+            for key, val in vars(section).items():
+                if name == "" and key == "home":
+                    continue  # home is implied by file location
+                lines.append(f"{key.replace('_', '-')} = {v(val)}")
+            lines.append("")
+        return "\n".join(lines)
+
+    @classmethod
+    def from_toml(cls, text: str, home: str = "") -> "Config":
+        if tomllib is None:
+            raise RuntimeError("tomllib unavailable")
+        doc = tomllib.loads(text)
+        cfg = cls()
+        cfg.base.home = home
+
+        def apply(section_obj, d: dict):
+            for k, val in d.items():
+                attr = k.replace("-", "_")
+                if hasattr(section_obj, attr) and not isinstance(val, dict):
+                    setattr(section_obj, attr, val)
+
+        apply(cfg.base, {k: v for k, v in doc.items() if not isinstance(v, dict)})
+        apply(cfg.rpc, doc.get("rpc", {}))
+        apply(cfg.p2p, doc.get("p2p", {}))
+        apply(cfg.mempool, doc.get("mempool", {}))
+        apply(cfg.statesync, doc.get("statesync", {}))
+        apply(cfg.blocksync, doc.get("blocksync", {}))
+        apply(cfg.consensus, doc.get("consensus", {}))
+        apply(cfg.tx_index, doc.get("tx-index", {}))
+        apply(cfg.instrumentation, doc.get("instrumentation", {}))
+        return cfg
+
+
+def default_config(home: str) -> Config:
+    cfg = Config()
+    cfg.base.home = home
+    return cfg
+
+
+def load_config(home: str) -> Config:
+    """Load <home>/config/config.toml, defaulting when absent."""
+    path = os.path.join(home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE)
+    if not os.path.exists(path):
+        return default_config(home)
+    with open(path) as f:
+        cfg = Config.from_toml(f.read(), home=home)
+    return cfg

@@ -143,10 +143,13 @@ class HTTPProvider(Provider):
         )
 
     def report_evidence(self, ev) -> None:
+        import sys
+
         from ..types.evidence import evidence_to_proto
 
         try:
             # oneof wrapper: the RPC handler decodes pb.Evidence
             self.client.broadcast_evidence(evidence=evidence_to_proto(ev).encode().hex())
-        except Exception:
-            pass
+        except (RPCClientError, OSError) as e:
+            # network/server failure only — programming errors must surface
+            print(f"light: failed to report evidence to {self.base_url}: {e}", file=sys.stderr)

@@ -73,16 +73,15 @@ class MempoolReactor:
                 peers = list(self._peers.items())
             for nid, sent in peers:
                 for wtx in txs:
-                    key = tx_key(wtx.tx)
-                    if key in sent or nid in wtx.peers:
+                    if wtx.key in sent or nid in wtx.peers:
                         continue  # don't echo a tx back to its source
                     if self.channel.send_to(nid, wtx.tx, timeout=0.5):
-                        sent.add(key)
+                        sent.add(wtx.key)
             sweeps += 1
             if sweeps % 256 == 0:
                 # prune: keys no longer in the mempool can be forgotten —
                 # bounds memory and lets a re-submitted tx re-propagate
-                live = {tx_key(w.tx) for w in txs}
+                live = {w.key for w in txs}
                 with self._lock:
                     for _, sent in self._peers.items():
                         sent &= live

@@ -1,0 +1,6 @@
+"""Node assembly (ref: node/)."""
+
+from .node import Node, NodeKey
+from .setup import init_files_home
+
+__all__ = ["Node", "NodeKey", "init_files_home"]

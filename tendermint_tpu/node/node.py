@@ -1,0 +1,318 @@
+"""Node — dependency wiring of every subsystem
+(ref: node/node.go:121-400 makeNode, :403-520 OnStart).
+
+Start order preserved from the reference: app client → eventbus →
+indexer → ABCI handshake/replay → router → reactors → RPC. Sync
+orchestration: blocksync first unless this node is the only validator,
+switching to consensus when caught up (node/node.go:360-377,
+node/setup.go:134 onlyValidatorIsUs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from urllib.parse import urlparse
+
+from ..abci import LocalClient
+from ..abci.kvstore import KVStoreApplication
+from ..blocksync import BlockSyncReactor, blocksync_channel_descriptor
+from ..config import Config
+from ..consensus import WAL, ConsensusState, Handshaker
+from ..consensus.reactor import ConsensusReactor, consensus_channel_descriptors
+from ..crypto.ed25519 import Ed25519PrivKey
+from ..eventbus import EventBus
+from ..evidence import EvidencePool
+from ..evidence.reactor import EvidenceReactor, evidence_channel_descriptor
+from ..indexer import IndexerService, KVIndexer
+from ..light.provider import LocalProvider
+from ..mempool.mempool import TxMempool
+from ..mempool.reactor import MempoolReactor, mempool_channel_descriptor
+from ..p2p import NodeInfo, PeerManager, PeerManagerOptions, Router, RouterOptions, node_id_from_pubkey
+from ..p2p.transport import Endpoint
+from ..p2p.transport_tcp import TcpTransport
+from ..privval import FilePV
+from ..rpc import JSONRPCServer, RPCEnvironment, build_routes
+from ..state import BlockExecutor, StateStore, make_genesis_state
+from ..store.blockstore import BlockStore
+from ..store.kv import FileDB, MemDB
+from ..types.genesis import GenesisDoc
+
+
+class NodeKey:
+    """P2P identity key (ref: types/node_key.go)."""
+
+    def __init__(self, priv_key: Ed25519PrivKey):
+        self.priv_key = priv_key
+        self.node_id = node_id_from_pubkey(priv_key.pub_key())
+
+    @classmethod
+    def load_or_gen(cls, path: str) -> "NodeKey":
+        if os.path.exists(path):
+            with open(path) as f:
+                doc = json.load(f)
+            return cls(Ed25519PrivKey(bytes.fromhex(doc["priv_key"])))
+        key = Ed25519PrivKey.generate()
+        nk = cls(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"id": nk.node_id, "priv_key": key.bytes().hex()}, f)
+        return nk
+
+
+def _make_db(config: Config, name: str):
+    if config.base.db_backend == "memdb":
+        return MemDB()
+    os.makedirs(config.db_dir, exist_ok=True)
+    return FileDB(os.path.join(config.db_dir, f"{name}.db"))
+
+
+def _make_app(proxy_app: str):
+    """ref: internal/proxy/client.go:26 ClientFactory."""
+    if proxy_app in ("builtin:kvstore", "kvstore", "builtin"):
+        return LocalClient(KVStoreApplication())
+    if proxy_app in ("noop", "builtin:noop"):
+        from ..abci.types import BaseApplication
+
+        return LocalClient(BaseApplication())
+    raise ValueError(f"unsupported proxy_app {proxy_app!r} (socket/grpc transports TBD)")
+
+
+class Node:
+    """ref: node.nodeImpl (node/node.go:57)."""
+
+    def __init__(
+        self,
+        config: Config,
+        gen_doc: GenesisDoc | None = None,
+        app_client=None,
+        priv_validator=None,
+        node_key: NodeKey | None = None,
+    ):
+        self.config = config
+        config.validate_basic()
+
+        # ---- genesis + state (node/node.go:691 loadStateFromDBOrGenesisDocProvider)
+        self.gen_doc = gen_doc if gen_doc is not None else GenesisDoc.from_file(config.genesis_file)
+        self.state_store = StateStore(_make_db(config, "state"))
+        self.block_store = BlockStore(_make_db(config, "blockstore"))
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(self.gen_doc)
+            self.state_store.save(state)
+
+        # ---- app + handshake prerequisites (node/node.go:159)
+        self.app_client = app_client if app_client is not None else _make_app(config.base.proxy_app)
+        self.event_bus = EventBus()
+        self.indexer = KVIndexer(_make_db(config, "tx_index")) if config.tx_index.indexer == "kv" else None
+        self.indexer_service = IndexerService(self.indexer, self.event_bus) if self.indexer else None
+
+        # ---- privval (node/setup.go:489)
+        if priv_validator is not None:
+            self.priv_validator = priv_validator
+        elif config.base.mode == "validator":
+            self.priv_validator = FilePV.load_or_generate(
+                config.priv_validator_key_file, config.priv_validator_state_file
+            )
+        else:
+            self.priv_validator = None
+
+        # ---- p2p identity + transport + router (node/setup.go:201,290)
+        self.node_key = node_key if node_key is not None else NodeKey.load_or_gen(config.node_key_file)
+        self.node_id = self.node_key.node_id
+        descs = (
+            consensus_channel_descriptors()
+            + [mempool_channel_descriptor(), evidence_channel_descriptor(), blocksync_channel_descriptor()]
+        )
+        laddr = urlparse(config.p2p.laddr if "//" in config.p2p.laddr else "tcp://" + config.p2p.laddr)
+        self.transport = TcpTransport(descs, bind_host=laddr.hostname or "0.0.0.0", bind_port=laddr.port or 0)
+        persistent = []
+        for entry in filter(None, (s.strip() for s in config.p2p.persistent_peers.split(","))):
+            persistent.append(Endpoint.parse("mconn://" + entry if "://" not in entry else entry))
+        self.peer_manager = PeerManager(
+            self.node_id,
+            PeerManagerOptions(
+                persistent_peers=[e.node_id for e in persistent],
+                max_connected=config.p2p.max_connections,
+                private_peers=set(filter(None, config.p2p.private_peer_ids.split(","))),
+            ),
+            db=_make_db(config, "peerstore"),
+        )
+        for ep in persistent:
+            self.peer_manager.add(ep)
+        ep = self.transport.endpoint()
+        self.node_info = NodeInfo(
+            node_id=self.node_id,
+            listen_addr=f"{ep.host}:{ep.port}",
+            network=self.gen_doc.chain_id,
+            moniker=config.base.moniker,
+            rpc_address=config.rpc.laddr,
+        )
+        self.router = Router(
+            self.node_info, self.node_key.priv_key, self.peer_manager, [self.transport],
+            options=RouterOptions(),
+        )
+        cs_chs = [self.router.open_channel(d) for d in consensus_channel_descriptors()]
+        mp_ch = self.router.open_channel(mempool_channel_descriptor())
+        ev_ch = self.router.open_channel(evidence_channel_descriptor())
+        bs_ch = self.router.open_channel(blocksync_channel_descriptor())
+
+        # ---- pools + executor (node/setup.go:142,177; node/node.go:276)
+        self.mempool = TxMempool(
+            self.app_client,
+            size=config.mempool.size,
+            max_txs_bytes=config.mempool.max_txs_bytes,
+            cache_size=config.mempool.cache_size,
+            max_tx_bytes=config.mempool.max_tx_bytes,
+            keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+        )
+        self.evidence_pool = EvidencePool(
+            _make_db(config, "evidence"), self.state_store, self.block_store
+        )
+        self.block_executor = BlockExecutor(
+            self.state_store,
+            self.app_client,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            block_store=self.block_store,
+            event_publisher=self.event_bus.block_event_publisher(),
+        )
+
+        # ---- consensus (node/node.go:300,316)
+        wal = WAL(config.wal_file)
+        self.consensus = ConsensusState(
+            state,
+            self.block_executor,
+            self.block_store,
+            priv_validator=self.priv_validator,
+            wal=wal,
+            evidence_pool=self.evidence_pool,
+        )
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus, cs_chs[0], cs_chs[1], cs_chs[2], cs_chs[3], self.peer_manager, self.block_store
+        )
+        self.mempool_reactor = MempoolReactor(self.mempool, mp_ch, self.peer_manager)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool, ev_ch, self.peer_manager)
+
+        # ---- blocksync (node/node.go:329)
+        self._initial_state = state
+        self.blocksync_reactor = BlockSyncReactor(
+            state,
+            self.block_executor,
+            self.block_store,
+            bs_ch,
+            self.peer_manager,
+            on_caught_up=self._on_blocksync_done,
+            block_sync=self._should_blocksync(state),
+        )
+
+        # ---- RPC (node/node.go:509)
+        self.rpc_server = None
+        if config.rpc.enable:
+            rpc_addr = urlparse(config.rpc.laddr if "//" in config.rpc.laddr else "tcp://" + config.rpc.laddr)
+            env = RPCEnvironment(
+                chain_id=self.gen_doc.chain_id,
+                state_store=self.state_store,
+                block_store=self.block_store,
+                consensus_state=self.consensus,
+                mempool=self.mempool,
+                evidence_pool=self.evidence_pool,
+                event_bus=self.event_bus,
+                tx_indexer=self.indexer,
+                app_client=self.app_client,
+                gen_doc=self.gen_doc,
+                peer_manager=self.peer_manager,
+                node_info=self.node_info,
+                pub_key=self.priv_validator.get_pub_key() if self.priv_validator else None,
+            )
+            self.rpc_server = JSONRPCServer(
+                build_routes(env),
+                host=rpc_addr.hostname or "127.0.0.1",
+                port=rpc_addr.port or 0,
+                event_bus=self.event_bus,
+            )
+
+        self.local_provider = LocalProvider(self.gen_doc.chain_id, self.block_store, self.state_store)
+        self._started = threading.Event()
+        self._consensus_running = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _should_blocksync(self, state) -> bool:
+        """Skip blocksync when we're the only validator
+        (ref: node/setup.go:134 onlyValidatorIsUs)."""
+        if not self.config.blocksync.enable:
+            return False
+        if self.priv_validator is None:
+            return True
+        if state.validators.size() != 1:
+            return True
+        addr = self.priv_validator.get_pub_key().address()
+        _, val = state.validators.get_by_address(addr)
+        return val is None
+
+    def start(self) -> None:
+        """ref: OnStart ordering (node/node.go:403-520)."""
+        if self.indexer_service is not None:
+            self.indexer_service.start()
+
+        # ABCI handshake: sync the app to the stores (node/node.go:430)
+        hs = Handshaker(
+            self.state_store, self._initial_state, self.block_store, self.gen_doc,
+            event_publisher=self.event_bus.block_event_publisher(),
+        )
+        state = hs.handshake(self.app_client)
+        self._initial_state = state
+        self.consensus.update_to_state(state)
+        self.blocksync_reactor.state = state
+
+        self.router.start()
+        self.evidence_reactor.start()
+        self.mempool_reactor.start()
+        self.consensus_reactor.start()
+        if self.blocksync_reactor.block_sync:
+            self.blocksync_reactor.start()
+        else:
+            self._start_consensus()
+        if self.rpc_server is not None:
+            self.rpc_server.start()
+        self._started.set()
+
+    def _on_blocksync_done(self, state, blocks_synced: int) -> None:
+        """ref: node/node.go:360-377 (statesync/blocksync → consensus)."""
+        self.consensus.update_to_state(state)
+        self._start_consensus()
+
+    def _start_consensus(self) -> None:
+        if not self._consensus_running.is_set():
+            self._consensus_running.set()
+            self.consensus.start()
+
+    def stop(self) -> None:
+        if self._consensus_running.is_set():
+            self.consensus.stop()
+        self.blocksync_reactor.stop()
+        self.consensus_reactor.stop()
+        self.mempool_reactor.stop()
+        self.evidence_reactor.stop()
+        self.router.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        if self.indexer_service is not None:
+            self.indexer_service.stop()
+        self.consensus.wal.close()
+
+    # -------------------------------------------------------------- helpers
+
+    @property
+    def rpc_address(self) -> tuple[str, int] | None:
+        return self.rpc_server.address if self.rpc_server else None
+
+    @property
+    def p2p_endpoint(self) -> Endpoint:
+        ep = self.transport.endpoint()
+        return Endpoint(protocol="mconn", host=ep.host, port=ep.port, node_id=self.node_id)
+
+    def dial(self, other: "Node") -> None:
+        self.peer_manager.add(other.p2p_endpoint)

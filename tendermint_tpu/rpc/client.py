@@ -82,6 +82,9 @@ class WSClient:
         status = buf.split(b"\r\n", 1)[0]
         if b"101" not in status:
             raise ConnectionError(f"websocket upgrade rejected: {status!r}")
+        # connect timeout must not apply to the event stream: an idle
+        # subscription would otherwise kill the reader after `timeout`
+        self.sock.settimeout(None)
         self._ids = itertools.count(1)
         self._responses: dict[int, dict] = {}
         self._events: queue.Queue = queue.Queue(maxsize=1024)

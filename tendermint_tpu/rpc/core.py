@@ -200,6 +200,8 @@ def _as_int(v, name: str) -> int:
 
 
 def _as_bytes_hex(v, name: str) -> bytes:
+    if v is None:
+        raise RPCError(-32602, f"missing required parameter {name}")
     if isinstance(v, bytes):
         return v
     s = str(v)
@@ -445,10 +447,16 @@ def build_routes(env: RPCEnvironment) -> dict:
             "hash": _hex(tx_hash(raw)),
         }
 
+    MAX_TX_COMMIT_TIMEOUT = 60.0
+
     def broadcast_tx_commit(tx=None, timeout=30.0):
         """CheckTx, then wait for the tx to be committed
         (ref: internal/rpc/core/mempool.go BroadcastTxCommit)."""
         raw = _as_bytes_hex(tx, "tx")
+        try:
+            timeout = min(float(timeout), MAX_TX_COMMIT_TIMEOUT)
+        except (TypeError, ValueError):
+            raise RPCError(-32602, f"invalid timeout: {timeout!r}")
         if env.event_bus is None:
             raise RPCError(-32603, "event bus unavailable; use broadcast_tx_sync")
         import os as _os

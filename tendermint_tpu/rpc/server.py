@@ -245,7 +245,9 @@ class JSONRPCServer:
             # strip the reference's quoted-string convention ("0x...", "\"str\"")
             return v.strip('"')
 
-    def _dispatch(self, req: dict) -> dict:
+    def _dispatch(self, req) -> dict:
+        if not isinstance(req, dict):
+            return _rpc_response(None, error=RPCError(ERR_INVALID_REQUEST, "Invalid Request"))
         id_ = req.get("id")
         method = req.get("method")
         fn = self.routes.get(method)
