@@ -47,15 +47,20 @@ def prefix_key(key: bytes) -> bytes:
 class KVStoreApplication(abci.Application):
     """ref: kvstore.Application (abci/example/kvstore/kvstore.go:74)."""
 
-    def __init__(self, db: KVStore | None = None, retain_blocks: int = 0):
+    SNAPSHOT_CHUNK_SIZE = 16 * 1024  # ref: test/e2e/app/snapshots.go snapshotChunkSize
+
+    def __init__(self, db: KVStore | None = None, retain_blocks: int = 0, snapshot_interval: int = 0):
         self._mu = threading.Lock()
         self.db = db if db is not None else MemDB()
         self.retain_blocks = retain_blocks
+        self.snapshot_interval = snapshot_interval
         self.size = 0
         self.height = 0
         self.app_hash = b""
         self.val_updates: list[abci.ValidatorUpdate] = []
         self.val_addr_to_pubkey: dict[bytes, tuple[str, bytes]] = {}
+        self._snapshots: dict[int, tuple[abci.Snapshot, list[bytes]]] = {}
+        self._restore: tuple[abci.Snapshot, list[bytes | None]] | None = None
         self._load_state()
 
     # ------------------------------------------------------------ state io
@@ -133,10 +138,95 @@ class KVStoreApplication(abci.Application):
     def commit(self) -> abci.ResponseCommit:
         with self._mu:
             self._save_state()
+            if self.snapshot_interval and self.height > 0 and self.height % self.snapshot_interval == 0:
+                self._take_snapshot()
             resp = abci.ResponseCommit()
             if self.retain_blocks > 0 and self.height >= self.retain_blocks:
                 resp.retain_height = self.height - self.retain_blocks + 1
             return resp
+
+    # ----------------------------------------------------------- snapshots
+    # ref: test/e2e/app/snapshots.go — the e2e app's chunked state export
+
+    def _serialize_state(self) -> bytes:
+        items = sorted((k.hex(), v.hex()) for k, v in self.db.iterator(None, None))
+        doc = {"height": self.height, "size": self.size, "app_hash": self.app_hash.hex(), "items": items}
+        return json.dumps(doc, sort_keys=True).encode()
+
+    def _take_snapshot(self) -> None:
+        import hashlib
+
+        data = self._serialize_state()
+        chunks = [
+            data[i : i + self.SNAPSHOT_CHUNK_SIZE] for i in range(0, len(data), self.SNAPSHOT_CHUNK_SIZE)
+        ] or [b""]
+        snap = abci.Snapshot(
+            height=self.height,
+            format=1,
+            chunks=len(chunks),
+            hash=hashlib.sha256(data).digest(),
+        )
+        self._snapshots[self.height] = (snap, chunks)
+        # keep a bounded window (snapshots.go keeps a bounded set); wide
+        # enough that an in-flight statesync can still fetch its chunks
+        for h in sorted(self._snapshots)[:-8]:
+            del self._snapshots[h]
+
+    def list_snapshots(self, req: abci.RequestListSnapshots) -> abci.ResponseListSnapshots:
+        with self._mu:
+            return abci.ResponseListSnapshots(
+                snapshots=[s for s, _ in sorted(self._snapshots.values(), key=lambda t: t[0].height)]
+            )
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        with self._mu:
+            if req.snapshot.format != 1:
+                return abci.ResponseOfferSnapshot(result=abci.SNAPSHOT_REJECT_FORMAT)
+            if req.snapshot.chunks <= 0:
+                return abci.ResponseOfferSnapshot(result=abci.SNAPSHOT_REJECT)
+            self._restore = (req.snapshot, [None] * req.snapshot.chunks)
+            return abci.ResponseOfferSnapshot(result=abci.SNAPSHOT_ACCEPT)
+
+    def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk) -> abci.ResponseLoadSnapshotChunk:
+        with self._mu:
+            entry = self._snapshots.get(req.height)
+            if entry is None or entry[0].format != req.format or req.chunk >= len(entry[1]):
+                return abci.ResponseLoadSnapshotChunk(chunk=b"")
+            return abci.ResponseLoadSnapshotChunk(chunk=entry[1][req.chunk])
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk) -> abci.ResponseApplySnapshotChunk:
+        import hashlib
+
+        with self._mu:
+            if self._restore is None:
+                return abci.ResponseApplySnapshotChunk(result=abci.CHUNK_ABORT)
+            snap, chunks = self._restore
+            if req.index >= len(chunks):
+                return abci.ResponseApplySnapshotChunk(result=abci.CHUNK_REJECT_SNAPSHOT)
+            chunks[req.index] = req.chunk
+            if any(c is None for c in chunks):
+                return abci.ResponseApplySnapshotChunk(result=abci.CHUNK_ACCEPT)
+            data = b"".join(chunks)
+            if hashlib.sha256(data).digest() != snap.hash:
+                self._restore = (snap, [None] * len(chunks))
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.CHUNK_RETRY_SNAPSHOT, refetch_chunks=list(range(len(chunks))),
+                    reject_senders=[req.sender] if req.sender else [],
+                )
+            doc = json.loads(data)
+            for k, v in self.db.iterator(None, None):
+                self.db.delete(k)
+            for k_hex, v_hex in doc["items"]:
+                self.db.set(bytes.fromhex(k_hex), bytes.fromhex(v_hex))
+            self.height = doc["height"]
+            self.size = doc["size"]
+            self.app_hash = bytes.fromhex(doc["app_hash"])
+            self.val_addr_to_pubkey = {}
+            for k, v in self.db.iterator(b"val:", b"val;"):
+                self.val_addr_to_pubkey[self._pub_to_addr(k[4:])] = ("ed25519", k[4:])
+            self._save_state()
+            self._restore = None
+            return abci.ResponseApplySnapshotChunk(result=abci.CHUNK_ACCEPT)
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
         with self._mu:

@@ -69,7 +69,12 @@ def _make_db(config: Config, name: str):
 
 
 def _make_app(proxy_app: str):
-    """ref: internal/proxy/client.go:26 ClientFactory."""
+    """ref: internal/proxy/client.go:26 ClientFactory. The builtin
+    kvstore accepts a snapshot-interval suffix:
+    builtin:kvstore:snapshot=N."""
+    if proxy_app.startswith("builtin:kvstore:snapshot="):
+        interval = int(proxy_app.rsplit("=", 1)[1])
+        return LocalClient(KVStoreApplication(snapshot_interval=interval))
     if proxy_app in ("builtin:kvstore", "kvstore", "builtin"):
         return LocalClient(KVStoreApplication())
     if proxy_app in ("noop", "builtin:noop"):
@@ -121,9 +126,12 @@ class Node:
         # ---- p2p identity + transport + router (node/setup.go:201,290)
         self.node_key = node_key if node_key is not None else NodeKey.load_or_gen(config.node_key_file)
         self.node_id = self.node_key.node_id
+        from ..statesync import statesync_channel_descriptors
+
         descs = (
             consensus_channel_descriptors()
             + [mempool_channel_descriptor(), evidence_channel_descriptor(), blocksync_channel_descriptor()]
+            + statesync_channel_descriptors()
         )
         laddr = urlparse(config.p2p.laddr if "//" in config.p2p.laddr else "tcp://" + config.p2p.laddr)
         self.transport = TcpTransport(descs, bind_host=laddr.hostname or "0.0.0.0", bind_port=laddr.port or 0)
@@ -157,6 +165,7 @@ class Node:
         mp_ch = self.router.open_channel(mempool_channel_descriptor())
         ev_ch = self.router.open_channel(evidence_channel_descriptor())
         bs_ch = self.router.open_channel(blocksync_channel_descriptor())
+        ss_chs = [self.router.open_channel(d) for d in statesync_channel_descriptors()]
 
         # ---- pools + executor (node/setup.go:142,177; node/node.go:276)
         self.mempool = TxMempool(
@@ -207,6 +216,20 @@ class Node:
             block_sync=self._should_blocksync(state),
         )
 
+        # ---- statesync (node/node.go:352-377): always serves snapshots/
+        # light blocks to peers; consumes when config.statesync.enable
+        from ..statesync import StateSyncReactor
+
+        self.local_provider = LocalProvider(self.gen_doc.chain_id, self.block_store, self.state_store)
+        self.statesync_reactor = StateSyncReactor(
+            self.app_client,
+            self.state_store,
+            self.block_store,
+            ss_chs[0], ss_chs[1], ss_chs[2], ss_chs[3],
+            self.peer_manager,
+            local_provider=self.local_provider,
+        )
+
         # ---- RPC (node/node.go:509)
         self.rpc_server = None
         if config.rpc.enable:
@@ -233,7 +256,6 @@ class Node:
                 event_bus=self.event_bus,
             )
 
-        self.local_provider = LocalProvider(self.gen_doc.chain_id, self.block_store, self.state_store)
         self._started = threading.Event()
         self._consensus_running = threading.Event()
 
@@ -271,13 +293,63 @@ class Node:
         self.evidence_reactor.start()
         self.mempool_reactor.start()
         self.consensus_reactor.start()
-        if self.blocksync_reactor.block_sync:
+        self.statesync_reactor.start()
+        if self.config.statesync.enable and state.last_block_height == 0:
+            threading.Thread(target=self._run_statesync, daemon=True, name="statesync").start()
+        elif self.blocksync_reactor.block_sync:
             self.blocksync_reactor.start()
         else:
             self._start_consensus()
         if self.rpc_server is not None:
             self.rpc_server.start()
         self._started.set()
+
+    def _run_statesync(self) -> None:
+        """Statesync → blocksync → consensus (node/node.go:360-377)."""
+        import traceback
+
+        from ..light import LightClient, TrustOptions
+        from ..light.http_provider import HTTPProvider
+        from ..statesync.stateprovider import LightClientStateProvider
+
+        cfg = self.config.statesync
+        try:
+            servers = [s.strip() for s in cfg.rpc_servers.split(",") if s.strip()]
+            if not servers or not cfg.trust_hash:
+                raise ValueError("statesync requires rpc_servers and trust_hash")
+            primary = HTTPProvider(self.gen_doc.chain_id, servers[0])
+            witnesses = [HTTPProvider(self.gen_doc.chain_id, s) for s in servers[1:]]
+            lc = LightClient(
+                self.gen_doc.chain_id,
+                TrustOptions(
+                    period_ns=int(cfg.trust_period * 1e9),
+                    height=cfg.trust_height,
+                    hash=bytes.fromhex(cfg.trust_hash),
+                ),
+                primary,
+                witnesses=witnesses,
+            )
+            sp = LightClientStateProvider(lc, self.gen_doc)
+            state, _commit = self.statesync_reactor.sync(sp, self.gen_doc, discovery_time=cfg.discovery_time)
+            self.statesync_reactor.backfill(state, lambda h: self._fetch_lb_quiet(primary, h))
+            self.consensus.update_to_state(state)
+            self.blocksync_reactor.state = state
+            self.blocksync_reactor.pool.height = state.last_block_height + 1
+            self.blocksync_reactor.start()
+        except Exception:
+            traceback.print_exc()
+            # fall back to blocksync-from-genesis
+            if self.blocksync_reactor.block_sync:
+                self.blocksync_reactor.start()
+            else:
+                self._start_consensus()
+
+    @staticmethod
+    def _fetch_lb_quiet(provider, height: int):
+        try:
+            return provider.light_block(height)
+        except Exception:
+            return None
 
     def _on_blocksync_done(self, state, blocks_synced: int) -> None:
         """ref: node/node.go:360-377 (statesync/blocksync → consensus)."""
@@ -293,6 +365,7 @@ class Node:
         if self._consensus_running.is_set():
             self.consensus.stop()
         self.blocksync_reactor.stop()
+        self.statesync_reactor.stop()
         self.consensus_reactor.stop()
         self.mempool_reactor.stop()
         self.evidence_reactor.stop()

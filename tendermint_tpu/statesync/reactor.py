@@ -1,0 +1,348 @@
+"""Statesync reactor — serves and consumes snapshots, chunks, light
+blocks, and consensus params over 4 channels
+(ref: internal/statesync/reactor.go:36-45,78-109).
+
+  0x60 Snapshot   p6 — SnapshotsRequest/Response
+  0x61 Chunk      p3 — ChunkRequest/Response
+  0x62 LightBlock p5 — LightBlockRequest/Response
+  0x63 Params     p2 — ParamsRequest/Response
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from ..abci import types as abci
+from ..p2p.types import (
+    CHANNEL_CHUNK,
+    CHANNEL_LIGHT_BLOCK,
+    CHANNEL_PARAMS,
+    CHANNEL_SNAPSHOT,
+    ChannelDescriptor,
+    PEER_STATUS_UP,
+    PeerError,
+)
+from ..proto import messages as pb
+from ..types.light_block import LightBlock
+
+
+# ------------------------------------------------------------------ messages
+
+
+class SnapshotsRequest:
+    pass
+
+
+class SnapshotsResponse:
+    def __init__(self, snapshot: abci.Snapshot):
+        self.snapshot = snapshot
+
+
+class ChunkRequest:
+    def __init__(self, height: int, format: int, index: int):
+        self.height, self.format, self.index = height, format, index
+
+
+class ChunkResponse:
+    def __init__(self, height: int, format: int, index: int, chunk: bytes, missing: bool = False):
+        self.height, self.format, self.index, self.chunk, self.missing = height, format, index, chunk, missing
+
+
+class LightBlockRequest:
+    def __init__(self, height: int):
+        self.height = height
+
+
+class LightBlockResponse:
+    def __init__(self, light_block: LightBlock | None):
+        self.light_block = light_block
+
+
+class ParamsRequest:
+    def __init__(self, height: int):
+        self.height = height
+
+
+class ParamsResponse:
+    def __init__(self, height: int, params_doc: dict):
+        self.height, self.params_doc = height, params_doc
+
+
+def _enc_snapshot_ch(msg) -> bytes:
+    if isinstance(msg, SnapshotsRequest):
+        return b"\x01"
+    s = msg.snapshot
+    return b"\x02" + json.dumps(
+        {"h": s.height, "f": s.format, "c": s.chunks, "hash": s.hash.hex(), "meta": s.metadata.hex()}
+    ).encode()
+
+
+def _dec_snapshot_ch(data: bytes):
+    if data[0] == 1:
+        return SnapshotsRequest()
+    d = json.loads(data[1:])
+    return SnapshotsResponse(
+        abci.Snapshot(height=d["h"], format=d["f"], chunks=d["c"], hash=bytes.fromhex(d["hash"]),
+                      metadata=bytes.fromhex(d["meta"]))
+    )
+
+
+def _enc_chunk_ch(msg) -> bytes:
+    if isinstance(msg, ChunkRequest):
+        return b"\x01" + json.dumps({"h": msg.height, "f": msg.format, "i": msg.index}).encode()
+    hdr = json.dumps({"h": msg.height, "f": msg.format, "i": msg.index, "m": msg.missing}).encode()
+    return b"\x02" + len(hdr).to_bytes(4, "big") + hdr + msg.chunk
+
+
+def _dec_chunk_ch(data: bytes):
+    if data[0] == 1:
+        d = json.loads(data[1:])
+        return ChunkRequest(d["h"], d["f"], d["i"])
+    n = int.from_bytes(data[1:5], "big")
+    d = json.loads(data[5 : 5 + n])
+    return ChunkResponse(d["h"], d["f"], d["i"], bytes(data[5 + n :]), d["m"])
+
+
+def _enc_lb_ch(msg) -> bytes:
+    if isinstance(msg, LightBlockRequest):
+        return b"\x01" + msg.height.to_bytes(8, "big")
+    if msg.light_block is None:
+        return b"\x02"
+    return b"\x02" + msg.light_block.to_proto().encode()
+
+
+def _dec_lb_ch(data: bytes):
+    if data[0] == 1:
+        return LightBlockRequest(int.from_bytes(data[1:9], "big"))
+    if len(data) == 1:
+        return LightBlockResponse(None)
+    return LightBlockResponse(LightBlock.from_proto(pb.LightBlock.decode(data[1:])))
+
+
+def _enc_params_ch(msg) -> bytes:
+    if isinstance(msg, ParamsRequest):
+        return b"\x01" + msg.height.to_bytes(8, "big")
+    return b"\x02" + msg.height.to_bytes(8, "big") + json.dumps(msg.params_doc).encode()
+
+
+def _dec_params_ch(data: bytes):
+    if data[0] == 1:
+        return ParamsRequest(int.from_bytes(data[1:9], "big"))
+    return ParamsResponse(int.from_bytes(data[1:9], "big"), json.loads(data[9:]))
+
+
+def statesync_channel_descriptors() -> list[ChannelDescriptor]:
+    """ref: reactor.go:36-45 channel table."""
+    return [
+        ChannelDescriptor(id=CHANNEL_SNAPSHOT, name="snapshot", priority=6,
+                          encode=_enc_snapshot_ch, decode=_dec_snapshot_ch),
+        ChannelDescriptor(id=CHANNEL_CHUNK, name="chunk", priority=3, recv_message_capacity=16 << 20,
+                          encode=_enc_chunk_ch, decode=_dec_chunk_ch),
+        ChannelDescriptor(id=CHANNEL_LIGHT_BLOCK, name="light-block", priority=5,
+                          encode=_enc_lb_ch, decode=_dec_lb_ch),
+        ChannelDescriptor(id=CHANNEL_PARAMS, name="params", priority=2,
+                          encode=_enc_params_ch, decode=_dec_params_ch),
+    ]
+
+
+class StateSyncReactor:
+    """ref: internal/statesync/reactor.go Reactor."""
+
+    def __init__(
+        self,
+        app_client,
+        state_store,
+        block_store,
+        snapshot_ch,
+        chunk_ch,
+        lb_ch,
+        params_ch,
+        peer_manager,
+        local_provider=None,
+    ):
+        self.app = app_client
+        self.state_store = state_store
+        self.block_store = block_store
+        self.snapshot_ch = snapshot_ch
+        self.chunk_ch = chunk_ch
+        self.lb_ch = lb_ch
+        self.params_ch = params_ch
+        self.peer_manager = peer_manager
+        self.local_provider = local_provider
+        self.syncer = None  # set by sync()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.peer_manager.subscribe(self._on_peer_update)
+        for fn, ch in (
+            (self._recv_snapshot, self.snapshot_ch),
+            (self._recv_chunk, self.chunk_ch),
+            (self._recv_light_block, self.lb_ch),
+            (self._recv_params, self.params_ch),
+        ):
+            t = threading.Thread(target=fn, args=(ch,), daemon=True, name=fn.__name__)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.peer_manager.unsubscribe(self._on_peer_update)
+
+    def _on_peer_update(self, update) -> None:
+        if update.status != PEER_STATUS_UP and self.syncer is not None:
+            self.syncer.remove_peer(update.node_id)
+
+    # ------------------------------------------------------------- serving
+
+    def _recv_snapshot(self, ch) -> None:
+        """ref: reactor.go:238 handleSnapshotMessage."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            try:
+                if isinstance(msg, SnapshotsRequest):
+                    res = self.app.list_snapshots(abci.RequestListSnapshots())
+                    for s in res.snapshots[-10:]:
+                        ch.send_to(nid, SnapshotsResponse(s), timeout=1.0)
+                elif isinstance(msg, SnapshotsResponse) and self.syncer is not None:
+                    self.syncer.add_snapshot(nid, msg.snapshot)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    def _recv_chunk(self, ch) -> None:
+        """ref: reactor.go:291 handleChunkMessage."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            try:
+                if isinstance(msg, ChunkRequest):
+                    res = self.app.load_snapshot_chunk(
+                        abci.RequestLoadSnapshotChunk(height=msg.height, format=msg.format, chunk=msg.index)
+                    )
+                    ch.send_to(
+                        nid,
+                        ChunkResponse(msg.height, msg.format, msg.index, res.chunk, missing=not res.chunk),
+                        timeout=1.0,
+                    )
+                elif isinstance(msg, ChunkResponse) and self.syncer is not None:
+                    if msg.missing:
+                        self.syncer.note_missing(msg.height, msg.format)
+                    else:
+                        self.syncer.add_chunk(msg.index, msg.chunk, nid)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    def _recv_light_block(self, ch) -> None:
+        """p2p light-block serving (ref: reactor.go:765)."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            try:
+                if isinstance(msg, LightBlockRequest):
+                    lb = None
+                    if self.local_provider is not None:
+                        try:
+                            lb = self.local_provider.light_block(msg.height)
+                        except Exception:
+                            lb = None
+                    ch.send_to(nid, LightBlockResponse(lb), timeout=1.0)
+                elif isinstance(msg, LightBlockResponse):
+                    handler = getattr(self, "_lb_waiter", None)
+                    if handler is not None:
+                        handler(nid, msg.light_block)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    def _recv_params(self, ch) -> None:
+        """ref: reactor.go params channel handling."""
+        while not self._stop.is_set():
+            env = ch.receive_one(timeout=0.2)
+            if env is None:
+                continue
+            msg, nid = env.message, env.from_
+            try:
+                if isinstance(msg, ParamsRequest):
+                    params = self.state_store.load_consensus_params(msg.height)
+                    if params is None:
+                        state = self.state_store.load()
+                        params = state.consensus_params if state else None
+                    if params is not None:
+                        from ..types.genesis import _params_to_json
+
+                        ch.send_to(nid, ParamsResponse(msg.height, _params_to_json(params)), timeout=1.0)
+                elif isinstance(msg, ParamsResponse):
+                    handler = getattr(self, "_params_waiter", None)
+                    if handler is not None:
+                        handler(nid, msg)
+            except Exception as e:
+                ch.send_error(PeerError(node_id=nid, err=e))
+
+    # ------------------------------------------------------------- syncing
+
+    def sync(self, state_provider, gen_doc, discovery_time: float = 15.0):
+        """Run the syncer to completion; returns (state, commit)
+        (ref: reactor.go:180 Sync)."""
+        from .syncer import Syncer
+
+        def request_snapshots():
+            self.snapshot_ch.broadcast(SnapshotsRequest(), timeout=1.0)
+
+        def request_chunk(snapshot, index, peers):
+            import random
+
+            peer = random.choice(peers)
+            self.chunk_ch.send_to(
+                peer, ChunkRequest(snapshot.height, snapshot.format, index), timeout=1.0
+            )
+
+        self.syncer = Syncer(self.app, state_provider, request_snapshots, request_chunk)
+        state, commit = self.syncer.sync_any(discovery_time=discovery_time, stop_event=self._stop)
+
+        # persist: bootstrap state + seen commit so consensus/blocksync
+        # can continue from the snapshot height (reactor.go:Sync end)
+        self.state_store.save(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        return state, commit
+
+    # ------------------------------------------------------------ backfill
+
+    def backfill(self, state, fetch_light_block, stop_height: int | None = None) -> int:
+        """Fetch + hash-chain-verify historical light blocks back to the
+        evidence window, persisting validator sets and commits
+        (ref: reactor.go:416 Backfill)."""
+        params = state.consensus_params.evidence
+        target = max(
+            state.last_block_height - params.max_age_num_blocks + 1,
+            state.initial_height,
+            stop_height or 1,
+        )
+        height = state.last_block_height
+        trusted_lb = fetch_light_block(height)
+        if trusted_lb is None:
+            return 0
+        stored = 0
+        cur = trusted_lb
+        self.state_store.save_validator_sets(cur.height, cur.height, cur.validator_set)
+        while cur.height > target and not self._stop.is_set():
+            prev = fetch_light_block(cur.height - 1)
+            if prev is None:
+                break
+            if prev.signed_header.hash() != cur.signed_header.header.last_block_id.hash:
+                raise ValueError(
+                    f"backfill: header at {prev.height} does not hash-chain to {cur.height}"
+                )
+            self.state_store.save_validator_sets(prev.height, prev.height, prev.validator_set)
+            self.block_store.save_seen_commit(prev.height, prev.signed_header.commit)
+            stored += 1
+            cur = prev
+        return stored

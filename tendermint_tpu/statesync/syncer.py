@@ -1,0 +1,259 @@
+"""Statesync syncer — discover snapshots, offer to the app, fetch and
+apply chunks, verify against the light client
+(ref: internal/statesync/syncer.go:54-550).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..abci import types as abci
+
+
+class StateSyncError(Exception):
+    pass
+
+
+class ErrNoSnapshots(StateSyncError):
+    pass
+
+
+class ErrRejectSnapshot(StateSyncError):
+    pass
+
+
+class _SnapshotPool:
+    """Dedup + peer tracking + prioritization (ref: snapshots.go)."""
+
+    def __init__(self):
+        self._snapshots: dict[tuple, abci.Snapshot] = {}
+        self._peers: dict[tuple, set[str]] = {}
+        self._rejected: set[tuple] = set()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(s: abci.Snapshot) -> tuple:
+        return (s.height, s.format, s.chunks, s.hash)
+
+    def add(self, peer_id: str, snapshot: abci.Snapshot) -> bool:
+        with self._lock:
+            key = self._key(snapshot)
+            if key in self._rejected:
+                return False
+            known = key in self._snapshots
+            self._snapshots[key] = snapshot
+            self._peers.setdefault(key, set()).add(peer_id)
+            return not known
+
+    def best(self) -> abci.Snapshot | None:
+        """Highest height, most peers first (ref: snapshots.go Best)."""
+        with self._lock:
+            if not self._snapshots:
+                return None
+            return max(
+                self._snapshots.values(),
+                key=lambda s: (s.height, len(self._peers.get(self._key(s), ()))),
+            )
+
+    def reject(self, snapshot: abci.Snapshot) -> None:
+        with self._lock:
+            key = self._key(snapshot)
+            self._rejected.add(key)
+            self._snapshots.pop(key, None)
+
+    def peers_of(self, snapshot: abci.Snapshot) -> list[str]:
+        with self._lock:
+            return sorted(self._peers.get(self._key(snapshot), ()))
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            for key in list(self._peers):
+                self._peers[key].discard(peer_id)
+                if not self._peers[key]:
+                    del self._peers[key]
+                    self._snapshots.pop(key, None)
+
+
+class _ChunkQueue:
+    """Pending/received chunk bookkeeping (ref: chunks.go)."""
+
+    def __init__(self, n_chunks: int):
+        self.n = n_chunks
+        self.chunks: list[bytes | None] = [None] * n_chunks
+        self.senders: dict[int, str] = {}
+        self._requested: dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def next_request(self, timeout: float = 10.0) -> int | None:
+        with self._lock:
+            now = time.monotonic()
+            for i in range(self.n):
+                if self.chunks[i] is None and now - self._requested.get(i, 0) > timeout:
+                    self._requested[i] = now
+                    return i
+            return None
+
+    def add(self, index: int, chunk: bytes, sender: str) -> bool:
+        with self._lock:
+            if index >= self.n or self.chunks[index] is not None:
+                return False
+            self.chunks[index] = chunk
+            self.senders[index] = sender
+            return True
+
+    def refetch(self, indexes: list[int]) -> None:
+        with self._lock:
+            for i in indexes:
+                if 0 <= i < self.n:
+                    self.chunks[i] = None
+                    self._requested.pop(i, None)
+
+    def complete(self) -> bool:
+        with self._lock:
+            return all(c is not None for c in self.chunks)
+
+    def next_unapplied(self, applied: int) -> tuple[int, bytes, str] | None:
+        with self._lock:
+            if applied < self.n and self.chunks[applied] is not None:
+                return applied, self.chunks[applied], self.senders.get(applied, "")
+            return None
+
+
+class Syncer:
+    """ref: syncer.go:54 syncer."""
+
+    DISCOVERY_WAIT = 2.0
+    CHUNK_TIMEOUT = 5.0
+    FETCH_STALL = 15.0
+
+    def __init__(self, app_client, state_provider, request_snapshots, request_chunk, logger=None):
+        """request_snapshots() broadcasts a GetSnapshots query;
+        request_chunk(snapshot, index, peers) asks a peer for a chunk.
+        state_provider: .app_hash(height), .state(height), .commit(height)."""
+        self.app = app_client
+        self.state_provider = state_provider
+        self.request_snapshots = request_snapshots
+        self.request_chunk = request_chunk
+        self.snapshots = _SnapshotPool()
+        self.chunks: _ChunkQueue | None = None
+        self._current: abci.Snapshot | None = None
+        self._missing = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ inbound
+
+    def add_snapshot(self, peer_id: str, snapshot: abci.Snapshot) -> bool:
+        return self.snapshots.add(peer_id, snapshot)
+
+    def add_chunk(self, index: int, chunk: bytes, sender: str) -> bool:
+        with self._lock:
+            if self.chunks is None:
+                return False
+            return self.chunks.add(index, chunk, sender)
+
+    def note_missing(self, height: int, format: int) -> None:
+        """Peer no longer has a chunk of the current snapshot (pruned) —
+        abandon this snapshot and rediscover."""
+        with self._lock:
+            if self._current is not None and self._current.height == height and self._current.format == format:
+                self._missing = True
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.snapshots.remove_peer(peer_id)
+
+    # -------------------------------------------------------------- sync
+
+    def sync_any(self, discovery_time: float = 15.0, stop_event: threading.Event | None = None):
+        """Try snapshots until one restores; returns (state, commit)
+        (ref: syncer.go:126 SyncAny)."""
+        stop_event = stop_event or threading.Event()
+        deadline = time.monotonic() + discovery_time
+        while not stop_event.is_set():
+            self.request_snapshots()
+            snapshot = self.snapshots.best()
+            if snapshot is None:
+                if time.monotonic() > deadline:
+                    raise ErrNoSnapshots("no viable snapshots discovered")
+                stop_event.wait(self.DISCOVERY_WAIT)
+                continue
+            try:
+                return self._sync_snapshot(snapshot, stop_event)
+            except (ErrRejectSnapshot, StateSyncError):
+                self.snapshots.reject(snapshot)
+                deadline = time.monotonic() + discovery_time
+        raise StateSyncError("statesync aborted")
+
+    def _sync_snapshot(self, snapshot: abci.Snapshot, stop_event: threading.Event):
+        """ref: syncer.go:262 Sync: verify app hash via light client,
+        OfferSnapshot, fetch+apply chunks, verify final state."""
+        # 1. trusted app hash for the snapshot height (+1 header carries it)
+        app_hash = self.state_provider.app_hash(snapshot.height)
+
+        # 2. offer to the app (syncer.go:320 offerSnapshot)
+        resp = self.app.offer_snapshot(abci.RequestOfferSnapshot(snapshot=snapshot, app_hash=app_hash))
+        if resp.result == abci.SNAPSHOT_REJECT:
+            raise ErrRejectSnapshot("snapshot rejected by app")
+        if resp.result in (abci.SNAPSHOT_REJECT_FORMAT, abci.SNAPSHOT_REJECT_SENDER):
+            raise ErrRejectSnapshot(f"snapshot rejected: {resp.result}")
+        if resp.result != abci.SNAPSHOT_ACCEPT:
+            raise StateSyncError(f"unexpected OfferSnapshot result {resp.result}")
+
+        with self._lock:
+            self.chunks = _ChunkQueue(snapshot.chunks)
+            self._current = snapshot
+            self._missing = False
+
+        # 3. fetch + apply strictly in order (syncer.go:380 fetchChunks /
+        #    applyChunks — the e2e app requires ordered apply). A stall
+        #    (no progress for FETCH_STALL) abandons the snapshot.
+        applied = 0
+        peers = self.snapshots.peers_of(snapshot)
+        last_progress = time.monotonic()
+        while applied < snapshot.chunks and not stop_event.is_set():
+            if self._missing:
+                raise ErrRejectSnapshot("peer no longer has the snapshot's chunks")
+            if time.monotonic() - last_progress > self.FETCH_STALL:
+                raise ErrRejectSnapshot("chunk fetching stalled")
+            entry = self.chunks.next_unapplied(applied)
+            if entry is None:
+                idx = self.chunks.next_request(self.CHUNK_TIMEOUT)
+                if idx is not None and peers:
+                    self.request_chunk(snapshot, idx, peers)
+                stop_event.wait(0.05)
+                continue
+            index, chunk, sender = entry
+            last_progress = time.monotonic()
+            resp = self.app.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=index, chunk=chunk, sender=sender)
+            )
+            if resp.result == abci.CHUNK_ACCEPT:
+                applied += 1
+                continue
+            if resp.result == abci.CHUNK_RETRY:
+                self.chunks.refetch([index])
+                continue
+            if resp.result == abci.CHUNK_RETRY_SNAPSHOT:
+                self.chunks.refetch(resp.refetch_chunks or list(range(snapshot.chunks)))
+                applied = 0
+                continue
+            raise ErrRejectSnapshot(f"chunk apply failed: {resp.result}")
+
+        if stop_event.is_set():
+            raise StateSyncError("statesync aborted")
+
+        # 4. verify the app restored to the trusted hash (syncer.go:470)
+        info = self.app.info(abci.RequestInfo())
+        if info.last_block_app_hash != app_hash:
+            raise ErrRejectSnapshot(
+                f"app hash mismatch after restore: {info.last_block_app_hash.hex()} != {app_hash.hex()}"
+            )
+        if info.last_block_height != snapshot.height:
+            raise ErrRejectSnapshot(
+                f"app height mismatch after restore: {info.last_block_height} != {snapshot.height}"
+            )
+
+        # 5. build the framework state + seen commit (syncer.go:500)
+        state = self.state_provider.state(snapshot.height)
+        commit = self.state_provider.commit(snapshot.height)
+        return state, commit
