@@ -111,16 +111,21 @@ class BlockSyncReactor:
         peer_manager,
         on_caught_up=None,
         block_sync: bool = True,
+        on_fatal=None,
     ):
         """on_caught_up(state, blocks_synced) fires when the pool reaches
         the network head — the node switches to consensus
-        (ref: reactor.go:370 SwitchToBlockSync / poolRoutine)."""
+        (ref: reactor.go:370 SwitchToBlockSync / poolRoutine).
+        on_fatal(exc) fires when a VERIFIED block fails to apply — an
+        invariant violation the node must halt on, as the reference's
+        poolRoutine panic does."""
         self.state = state
         self.block_exec = block_executor
         self.block_store = block_store
         self.channel = channel
         self.peer_manager = peer_manager
         self.on_caught_up = on_caught_up or (lambda state, n: None)
+        self.on_fatal = on_fatal or (lambda exc: None)
         self.block_sync = block_sync
         self.pool = BlockPool(
             max(self.state.last_block_height + 1, self.state.initial_height),
@@ -128,6 +133,7 @@ class BlockSyncReactor:
             self._send_peer_error,
         )
         self.blocks_synced = 0
+        self.sync_error = False
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._switched = False
@@ -219,7 +225,21 @@ class BlockSyncReactor:
                     self.pool.stop()
                     self.on_caught_up(self.state, self.blocks_synced)
                     return
-            if not self._try_sync_one():
+            try:
+                advanced = self._try_sync_one()
+            except Exception as exc:
+                # A verified block failing to apply is a store/app
+                # invariant violation — the reference panics here
+                # (reactor.go poolRoutine). Halt the node via on_fatal
+                # rather than dying silently and stalling half-alive.
+                import traceback
+
+                traceback.print_exc()
+                self.sync_error = True
+                self.pool.stop()
+                self.on_fatal(exc)
+                return
+            if not advanced:
                 time.sleep(0.01)
 
     def _try_sync_one(self) -> bool:

@@ -86,7 +86,12 @@ class ConsensusState:
         broadcast: Callable | None = None,
         on_decided: Callable | None = None,
         clock: Callable[[], Time] = Time.now,
+        metrics=None,
+        logger=None,
+        on_fatal: Callable | None = None,
     ):
+        from ..utils.log import new_logger
+
         self.block_exec = block_executor
         self.block_store = block_store
         self.priv_validator = priv_validator
@@ -96,6 +101,12 @@ class ConsensusState:
         self.broadcast = broadcast or (lambda msg: None)
         self.on_decided = on_decided or (lambda height, block, block_id: None)
         self.now = clock
+        self.metrics = metrics
+        self.logger = logger or new_logger("consensus")
+        # Invoked when the state machine dies — the node must halt rather
+        # than keep serving from a dead machine (ref: state.go:899-938
+        # "CONSENSUS FAILURE!!!" panics the whole process).
+        self.on_fatal = on_fatal or (lambda exc: None)
 
         self.rs = RoundState()
         self.state = State()  # set by update_to_state
@@ -184,12 +195,19 @@ class ConsensusState:
                 break
             try:
                 self._dispatch(item)
-            except Exception:
+            except Exception as exc:
                 # ref: state.go:899 "CONSENSUS FAILURE!!!" — halt, don't
-                # limp along with corrupted round state.
+                # limp along with corrupted round state. on_fatal stops
+                # the whole node (router, RPC, mempool included).
+                self.logger.error(
+                    "CONSENSUS FAILURE!!!", err=repr(exc), height=self.rs.height, round=self.rs.round
+                )
                 traceback.print_exc()
                 self._stop.set()
-                raise
+                try:
+                    self.on_fatal(exc)
+                finally:
+                    raise
 
     def _dispatch(self, item) -> None:
         # Internal messages drain first (they carry our own votes).
@@ -305,6 +323,9 @@ class ConsensusState:
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.state = state
+        if self.metrics is not None:
+            self.metrics.validators.set(state.validators.size())
+            self.metrics.validators_power.set(state.validators.total_voting_power())
         self._new_step()
 
     def _reconstruct_last_commit_if_needed(self, state: State) -> None:
@@ -341,6 +362,12 @@ class ConsensusState:
         rs = self.rs
         self.wal.write(EventRoundStep(rs.height, rs.round, rs.step))
         self._n_steps += 1
+        if self.metrics is not None:
+            from .round_state import STEP_NAMES
+
+            self.metrics.mark_step(STEP_NAMES.get(rs.step, str(rs.step)))
+            self.metrics.height.set(rs.height)
+            self.metrics.rounds.set(rs.round)
         self.broadcast(
             NewRoundStepMessage(
                 height=rs.height,
@@ -661,7 +688,24 @@ class ConsensusState:
         self.wal.write_sync(EndHeightMessage(height))
 
         state_copy = self.state.copy()
+        prev_block_time = self.state.last_block_time
         state_copy = self.block_exec.apply_block(state_copy, block_id, block)
+
+        if self.metrics is not None:
+            m = self.metrics
+            if height > self.state.initial_height:
+                m.block_interval.observe(
+                    max(0.0, (block.header.time.unix_ns() - prev_block_time.unix_ns()) / 1e9)
+                )
+            m.num_txs.set(len(block.txs))
+            m.total_txs.add(len(block.txs))
+            m.block_size.set(len(block.to_proto().encode()))
+            if block.last_commit is not None:
+                m.commit_sigs.set(sum(1 for s in block.last_commit.signatures if s.for_block()))
+            m.mark_round()
+        self.logger.info(
+            "finalized block", height=height, hash=block_id.hash, txs=len(block.txs), round=rs.commit_round
+        )
 
         self.on_decided(height, block, block_id)
         self.update_to_state(state_copy)

@@ -75,12 +75,43 @@ class Ed25519PrivKey(PrivKey):
 
 def _use_device() -> bool:
     """Batch verification backend: the JAX kernel unless explicitly
-    disabled (TM_TPU_CRYPTO=off forces the pure-Python oracle — the
-    equivalent of the reference running without its batch path)."""
+    disabled (TM_TPU_CRYPTO=off forces the host path — the equivalent of
+    the reference running without its batch path)."""
     return os.environ.get("TM_TPU_CRYPTO", "on") != "off"
 
 
+# Below this many signatures a device launch costs more than it saves
+# (dispatch + transfer latency vs ~125us/sig native host verify); the
+# batch verifier then runs serially on host. SURVEY "hard parts": a
+# 4-validator commit must not regress vs CPU. Tunable for benchmarking.
+DEVICE_BATCH_CUTOVER = int(os.environ.get("TM_TPU_BATCH_CUTOVER", "64"))
+
+try:  # native (OpenSSL) fast path for single verification
+    from cryptography.exceptions import InvalidSignature as _InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey as _OsslPubKey,
+    )
+except ImportError:  # pragma: no cover
+    _OsslPubKey = None
+
+
 def _single_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """ZIP-215 single verification with a native fast path.
+
+    OpenSSL verifies the cofactorless RFC-8032 equation over a stricter
+    encoding set; anything it ACCEPTS is also ZIP-215-valid (cofactorless
+    acceptance implies cofactored, and its admissible encodings are a
+    subset of ZIP-215's). Rejections fall back to the authoritative
+    pure-Python ZIP-215 oracle so consensus acceptance stays byte-exact
+    with the reference (crypto/ed25519/ed25519.go:24-31) — honest
+    signatures take the ~125us path, only adversarial edge encodings pay
+    the oracle price."""
+    if _OsslPubKey is not None:
+        try:
+            _OsslPubKey.from_public_bytes(pub).verify(sig, msg)
+            return True
+        except (_InvalidSignature, ValueError):
+            pass  # fall through: may still be ZIP-215-acceptable
     return ref.verify(pub, msg, sig, zip215=True)
 
 
@@ -112,7 +143,7 @@ class Ed25519BatchVerifier(BatchVerifier):
         n = len(self._sigs)
         if n == 0:
             return False, []
-        if _use_device():
+        if _use_device() and n >= DEVICE_BATCH_CUTOVER:
             from ..ops import verify as dev
 
             bitmap = dev.verify_batch(self._pks, self._msgs, self._sigs)

@@ -17,7 +17,7 @@ from ..types.evidence import (
     evidence_from_proto,
     evidence_to_proto,
 )
-from .verify import EvidenceVerifyError, verify_evidence
+from .verify import EvidenceABCIError, EvidenceVerifyError, verify_evidence
 
 _PENDING_PREFIX = b"ev/pending/"
 _COMMITTED_PREFIX = b"ev/committed/"
@@ -73,7 +73,15 @@ class EvidencePool:
             h = ev.hash()
             if h in self._pending or self._is_committed(ev):
                 return  # idempotent
-            verify_evidence(ev, self._state, self.state_store, self.block_store)
+            try:
+                verify_evidence(ev, self._state, self.state_store, self.block_store)
+            except EvidenceABCIError as e:
+                # Structurally valid but the ABCI component is wrong:
+                # regenerate it, store the rectified evidence, and still
+                # reject the original (ref: verify.go:76-81,:136-142).
+                e.regenerate()
+                self._add_pending(ev)
+                raise
             self._add_pending(ev)
 
     def report_conflicting_votes(self, vote_a, vote_b) -> None:

@@ -13,13 +13,27 @@ Two evidence kinds:
 from __future__ import annotations
 
 from ..types.evidence import DuplicateVoteEvidence, LightClientAttackEvidence
+from ..types.light_block import SignedHeader
 from ..types.validation import (
     Fraction,
     verify_commit_light,
     verify_commit_light_trusting,
 )
+
+
 class EvidenceVerifyError(Exception):
     pass
+
+
+class EvidenceABCIError(EvidenceVerifyError):
+    """The structural checks passed but the ABCI component (powers,
+    timestamp, byzantine validators) is wrong — the pool regenerates it
+    and stores the rectified evidence while still rejecting the original
+    (ref: verify.go:76-81, :136-142)."""
+
+    def __init__(self, msg: str, regenerate):
+        super().__init__(msg)
+        self.regenerate = regenerate  # () -> None, fixes ev in place
 
 
 def verify_evidence(ev, state, state_store, block_store) -> None:
@@ -49,35 +63,46 @@ def verify_evidence(ev, state, state_store, block_store) -> None:
         if val_set is None:
             raise EvidenceVerifyError(f"no validator set at height {ev.height}")
         verify_duplicate_vote(ev, state.chain_id, val_set)
-        # the evidence's recorded time must match the block time at its
-        # height (verify.go:91 — prevents time-based expiry gaming)
-        if ev.timestamp != ev_time:
-            raise EvidenceVerifyError(
-                f"evidence has a different time to the block it is associated with "
-                f"({ev.timestamp} != {ev_time})"
+        _, val = val_set.get_by_address(ev.vote_a.validator_address)
+        # the ABCI component: powers and the evidence's recorded time must
+        # match the block at its height (verify.go:76 ValidateABCI —
+        # prevents time-based expiry gaming)
+        if (
+            ev.timestamp != ev_time
+            or ev.validator_power != val.voting_power
+            or ev.total_voting_power != val_set.total_voting_power()
+        ):
+            raise EvidenceABCIError(
+                f"duplicate-vote evidence ABCI component mismatch "
+                f"(time {ev.timestamp} vs {ev_time}, power {ev.validator_power}, "
+                f"total {ev.total_voting_power})",
+                lambda: ev.generate_abci(val, val_set, ev_time),
             )
     elif isinstance(ev, LightClientAttackEvidence):
         common_height = ev.common_height
         common_vals = state_store.load_validators(common_height)
         if common_vals is None:
             raise EvidenceVerifyError(f"no validator set at common height {common_height}")
-        trusted_header = _header_at(block_store, ev.conflicting_block.height)
-        if trusted_header is None:
-            # conflicting header is at a future height: use the latest header
-            trusted_header = _header_at(block_store, block_store.height())
-            if trusted_header is None:
+        trusted_sh = _signed_header_at(block_store, ev.conflicting_block.height)
+        if trusted_sh is None:
+            # Conflicting header is at a future height (possible forward
+            # lunatic attack): use the latest header, and reject outright
+            # if our latest block predates the conflicting block's time
+            # (ref: verify.go:108-118).
+            trusted_sh = _signed_header_at(block_store, block_store.height())
+            if trusted_sh is None:
                 raise EvidenceVerifyError("no trusted header available")
+            if trusted_sh.header.time.unix_ns() < sh_time_ns(ev):
+                raise EvidenceVerifyError(
+                    "latest block time is before conflicting block time"
+                )
         common_header = _header_at(block_store, common_height)
         if common_header is None:
             raise EvidenceVerifyError(f"no header at common height {common_height} (pruned?)")
         verify_light_client_attack(
-            ev, common_header, trusted_header, common_vals, state.chain_id
+            ev, common_header, trusted_sh.header, common_vals, state.chain_id
         )
-        if ev.timestamp != common_header.time:
-            raise EvidenceVerifyError(
-                f"evidence has a different time to the block it is associated with "
-                f"({ev.timestamp} != {common_header.time})"
-            )
+        _validate_lca_abci(ev, common_vals, trusted_sh, common_header.time)
     else:
         raise EvidenceVerifyError(f"unrecognized evidence type: {type(ev)}")
 
@@ -85,6 +110,57 @@ def verify_evidence(ev, state, state_store, block_store) -> None:
 def _header_at(block_store, height: int):
     meta = block_store.load_block_meta(height)
     return meta.header if meta is not None else None
+
+
+def _signed_header_at(block_store, height: int) -> SignedHeader | None:
+    """Header + its commit (ref: getSignedHeader, verify.go:196)."""
+    header = _header_at(block_store, height)
+    if header is None:
+        return None
+    commit = block_store.load_block_commit(height)
+    if commit is None:
+        commit = block_store.load_seen_commit(height)
+    if commit is None:
+        return None
+    return SignedHeader(header=header, commit=commit)
+
+
+def sh_time_ns(ev: LightClientAttackEvidence) -> int:
+    return ev.conflicting_block.signed_header.header.time.unix_ns()
+
+
+def _validate_lca_abci(ev: LightClientAttackEvidence, common_vals, trusted_sh, ev_time) -> None:
+    """Validate the ABCI component of light-client-attack evidence
+    (ref: types/evidence.go:445 ValidateABCI): total voting power,
+    timestamp, and the byzantine-validator list must match what we
+    derive locally (ordering included — the reference sorts by power)."""
+
+    def fail(msg: str):
+        raise EvidenceABCIError(
+            msg, lambda: ev.generate_abci(common_vals, trusted_sh, ev_time)
+        )
+
+    if ev.total_voting_power != common_vals.total_voting_power():
+        fail(
+            f"total voting power from the evidence and our validator set does not match "
+            f"({ev.total_voting_power} != {common_vals.total_voting_power()})"
+        )
+    if ev.timestamp != ev_time:
+        fail(
+            f"evidence has a different time to the block it is associated with "
+            f"({ev.timestamp} != {ev_time})"
+        )
+    derived = ev.get_byzantine_validators(common_vals, trusted_sh)
+    if len(derived) != len(ev.byzantine_validators):
+        fail(
+            f"expected {len(derived)} byzantine validators from evidence but got "
+            f"{len(ev.byzantine_validators)}"
+        )
+    for want, got in zip(derived, ev.byzantine_validators):
+        if want.address != got.address:
+            fail("evidence contained an unexpected byzantine validator address")
+        if want.voting_power != got.voting_power:
+            fail("evidence contained an unexpected byzantine validator power")
 
 
 def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set) -> None:
@@ -100,16 +176,8 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set) -> 
     if val is None:
         raise EvidenceVerifyError(f"address {a.validator_address.hex()} was not a validator at height {a.height}")
     pub_key = val.pub_key
-
-    # vote power and total power must match the evidence record (:246)
-    if ev.validator_power != val.voting_power:
-        raise EvidenceVerifyError(
-            f"validator power from evidence {ev.validator_power} != {val.voting_power}"
-        )
-    if ev.total_voting_power != val_set.total_voting_power():
-        raise EvidenceVerifyError(
-            f"total voting power from evidence {ev.total_voting_power} != {val_set.total_voting_power()}"
-        )
+    # power/total/timestamp checks live in the ABCI-component validation
+    # (verify_evidence), matching the reference's ValidateABCI split.
 
     if not pub_key.verify_signature(a.sign_bytes(chain_id), a.signature):
         raise EvidenceVerifyError("verifying VoteA: invalid signature")
@@ -151,7 +219,15 @@ def verify_light_client_attack(
             sh.commit,
         )
 
-    # evidence must actually conflict: same height, different hash, or
-    # an invalid header chain (:169-181)
-    if trusted_header.height == sh.header.height and trusted_header.hash() == sh.header.hash():
+    # Forward lunatic: a conflicting block past our head must VIOLATE
+    # monotonically increasing time to be an attack (ref: verify.go:183);
+    # otherwise the headers must actually differ (:188).
+    if (
+        sh.header.height > trusted_header.height
+        and sh.header.time.unix_ns() > trusted_header.time.unix_ns()
+    ):
+        raise EvidenceVerifyError(
+            "conflicting block doesn't violate monotonically increasing time"
+        )
+    if trusted_header.hash() == sh.header.hash():
         raise EvidenceVerifyError("headers are equal — no attack")

@@ -123,6 +123,17 @@ class LightClient:
         latest = self.primary.light_block(0)
         trusted = self.store.latest_light_block()
         if trusted is not None and latest.height <= trusted.height:
+            # A primary serving a DIFFERENT header at our trusted height
+            # is a conflict signal, not a no-op (ref: client.go Update
+            # errors on same-height hash mismatch).
+            if (
+                latest.height == trusted.height
+                and latest.signed_header.hash() != trusted.signed_header.hash()
+            ):
+                raise LightClientError(
+                    f"primary returned a conflicting header at trusted height "
+                    f"{trusted.height}"
+                )
             return trusted
         # verify the block already in hand — no refetch round-trip
         latest.validate_basic(self.chain_id)
@@ -286,14 +297,25 @@ class LightClient:
             if w_lb.signed_header.hash() == primary_hash:
                 continue
             # Diverging witness: build attack evidence against whichever
-            # chain is lying (ref: detector.go:120 handleConflictingHeaders)
+            # chain is lying, with the ABCI component fully populated so
+            # full nodes accept it as-is (ref: detector.go:404
+            # newLightClientAttackEvidence).
             common = self.store.light_block_before(new_lb.height)
-            ev = LightClientAttackEvidence(
-                conflicting_block=w_lb,
-                common_height=common.height if common else new_lb.height - 1,
-                timestamp=common.signed_header.header.time if common else now,
-                total_voting_power=new_lb.validator_set.total_voting_power(),
-            )
+            ev = LightClientAttackEvidence(conflicting_block=w_lb)
+            if common is not None and ev.conflicting_header_is_invalid(new_lb.signed_header.header):
+                # lunatic: root at the common header
+                ev.common_height = common.height
+                ev.timestamp = common.signed_header.header.time
+                ev.total_voting_power = common.validator_set.total_voting_power()
+            else:
+                # equivocation/amnesia: validator sets are the same
+                ev.common_height = new_lb.height
+                ev.timestamp = new_lb.signed_header.header.time
+                ev.total_voting_power = new_lb.validator_set.total_voting_power()
+            if common is not None:
+                ev.byzantine_validators = ev.get_byzantine_validators(
+                    common.validator_set, new_lb.signed_header
+                )
             self.latest_attack_evidence = ev
             for p in [self.primary] + self.witnesses:
                 try:

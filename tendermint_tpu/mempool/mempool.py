@@ -82,8 +82,10 @@ class TxMempool:
         cache_size: int = 10000,
         keep_invalid_txs_in_cache: bool = False,
         post_check=None,
+        metrics=None,
     ):
         self._app = app_client
+        self._metrics = metrics  # MempoolMetrics (ref: mempool/metrics.go)
         self._size = size
         self._max_tx_bytes = max_tx_bytes
         self._max_txs_bytes = max_txs_bytes
@@ -183,9 +185,14 @@ class TxMempool:
                     wtx.peers.add(sender)
                 self._insert(wtx)
                 self._notify_txs_available()
+            if self._metrics is not None:
+                self._metrics.size.set(self.size())
+                self._metrics.tx_size_bytes.observe(len(tx))
         else:
             if not self._keep_invalid:
                 self._cache.remove(key)
+            if self._metrics is not None:
+                self._metrics.failed_txs.add(1)
         return res
 
     def _insert(self, wtx: WrappedTx) -> None:
@@ -271,6 +278,10 @@ class TxMempool:
                 self._remove(key)
         if recheck and self._txs:
             self._recheck_txs()
+            if self._metrics is not None:
+                self._metrics.recheck_times.add(1)
+        if self._metrics is not None:
+            self._metrics.size.set(self.size())
         self._notify_txs_available()
 
     def _recheck_txs(self) -> None:

@@ -1,0 +1,285 @@
+"""Prometheus-compatible metrics (ref: libs + scripts/metricsgen plane).
+
+The reference generates one go-kit Metrics struct per package with
+metricsgen and serves them from a Prometheus endpoint
+(node/node.go:575). Here the same shape is hand-rolled: Counter /
+Gauge / Histogram primitives with label support, a Registry that
+renders the text exposition format, per-subsystem factories
+(consensus/mempool/p2p/state — mirroring internal/*/metrics.go), and a
+tiny threaded HTTP server for the `/metrics` endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+NAMESPACE = "tendermint"  # ref: config.Instrumentation.Namespace default
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, float] = {}
+
+    def _key(self, label_values: tuple) -> tuple:
+        if len(label_values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels {self.label_names}")
+        return label_values
+
+    def samples(self) -> list[tuple[str, dict, float]]:
+        with self._lock:
+            return [
+                (self.name, dict(zip(self.label_names, k)), v)
+                for k, v in self._children.items()
+            ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def add(self, delta: float = 1.0, *label_values: str) -> None:
+        k = self._key(label_values)
+        with self._lock:
+            self._children[k] = self._children.get(k, 0.0) + delta
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, *label_values: str) -> None:
+        k = self._key(label_values)
+        with self._lock:
+            self._children[k] = float(value)
+
+    def add(self, delta: float, *label_values: str) -> None:
+        k = self._key(label_values)
+        with self._lock:
+            self._children[k] = self._children.get(k, 0.0) + delta
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, name, help_, labels=(), buckets: Sequence[float] | None = None):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(buckets) if buckets is not None else self.DEFAULT_BUCKETS
+        self._hist: dict[tuple, list] = {}  # key -> [bucket_counts, sum, count]
+
+    def observe(self, value: float, *label_values: str) -> None:
+        k = self._key(label_values)
+        with self._lock:
+            h = self._hist.get(k)
+            if h is None:
+                h = [[0] * len(self.buckets), 0.0, 0]
+                self._hist[k] = h
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    h[0][i] += 1
+            h[1] += value
+            h[2] += 1
+
+    def samples(self):
+        out = []
+        with self._lock:
+            for k, (counts, total, n) in self._hist.items():
+                labels = dict(zip(self.label_names, k))
+                cum = 0
+                for i, ub in enumerate(self.buckets):
+                    cum = counts[i]
+                    out.append((self.name + "_bucket", {**labels, "le": _fmt(ub)}, cum))
+                out.append((self.name + "_bucket", {**labels, "le": "+Inf"}, n))
+                out.append((self.name + "_sum", labels, total))
+                out.append((self.name + "_count", labels, n))
+        return out
+
+
+def _fmt(v: float) -> str:
+    return repr(v) if v != int(v) else str(int(v))
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name, help_="", labels=()) -> Counter:
+        return self.register(Counter(name, help_, labels))
+
+    def gauge(self, name, help_="", labels=()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))
+
+    def histogram(self, name, help_="", labels=(), buckets=None) -> Histogram:
+        return self.register(Histogram(name, help_, labels, buckets))
+
+    def gather(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, labels, value in m.samples():
+                if labels:
+                    lbl = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    lines.append(f"{name}{{{lbl}}} {_num(value)}")
+                else:
+                    lines.append(f"{name} {_num(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+# ---------------------------------------------------------- subsystems
+
+
+class ConsensusMetrics:
+    """ref: internal/consensus/metrics.go:20 (metricsgen struct)."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_consensus"
+        self.height = reg.gauge(f"{ns}_height", "Height of the chain")
+        self.rounds = reg.gauge(f"{ns}_rounds", "Round of the current height")
+        self.round_duration = reg.histogram(
+            f"{ns}_round_duration_seconds", "Time spent in a round"
+        )
+        self.step_duration = reg.histogram(
+            f"{ns}_step_duration_seconds", "Time spent per step", labels=("step",)
+        )
+        self.block_interval = reg.histogram(
+            f"{ns}_block_interval_seconds",
+            "Time between this and the last block",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30),
+        )
+        self.validators = reg.gauge(f"{ns}_validators", "Number of validators")
+        self.validators_power = reg.gauge(f"{ns}_validators_power", "Total voting power")
+        self.num_txs = reg.gauge(f"{ns}_num_txs", "Transactions in the latest block")
+        self.block_size = reg.gauge(f"{ns}_block_size_bytes", "Size of the latest block")
+        self.total_txs = reg.counter(f"{ns}_total_txs", "Total committed transactions")
+        self.commit_sigs = reg.gauge(
+            f"{ns}_commit_signatures", "Signatures in the latest commit"
+        )
+        self.proposal_receive_count = reg.counter(
+            f"{ns}_proposal_receive_count", "Proposals received", labels=("status",)
+        )
+        self._step_start = time.monotonic()
+        self._round_start = time.monotonic()
+        self._last_step: str | None = None
+
+    def mark_step(self, step: str) -> None:
+        """Observe the duration of the step we're leaving (ref:
+        metrics.go MarkStep)."""
+        now = time.monotonic()
+        if self._last_step is not None:
+            self.step_duration.observe(now - self._step_start, self._last_step)
+        self._step_start = now
+        self._last_step = step
+
+    def mark_round(self) -> None:
+        now = time.monotonic()
+        self.round_duration.observe(now - self._round_start)
+        self._round_start = now
+
+
+class MempoolMetrics:
+    """ref: internal/mempool/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_mempool"
+        self.size = reg.gauge(f"{ns}_size", "Number of uncommitted transactions")
+        self.tx_size_bytes = reg.histogram(
+            f"{ns}_tx_size_bytes", "Transaction sizes", buckets=(32, 256, 1024, 65536, 1048576)
+        )
+        self.failed_txs = reg.counter(f"{ns}_failed_txs", "Rejected transactions")
+        self.evicted_txs = reg.counter(f"{ns}_evicted_txs", "Evicted transactions")
+        self.recheck_times = reg.counter(f"{ns}_recheck_times", "Recheck runs")
+
+
+class P2PMetrics:
+    """ref: internal/p2p/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_p2p"
+        self.peers = reg.gauge(f"{ns}_peers", "Connected peers")
+        self.message_send_bytes_total = reg.counter(
+            f"{ns}_message_send_bytes_total", "Bytes sent", labels=("chID",)
+        )
+        self.message_receive_bytes_total = reg.counter(
+            f"{ns}_message_receive_bytes_total", "Bytes received", labels=("chID",)
+        )
+
+
+class StateMetrics:
+    """ref: internal/state/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_state"
+        self.block_processing_time = reg.histogram(
+            f"{ns}_block_processing_time", "Time of ApplyBlock", buckets=(0.01, 0.05, 0.1, 0.5, 1, 5)
+        )
+        self.block_verify_time = reg.histogram(
+            f"{ns}_block_verify_time", "Time of LastCommit verification", buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1)
+        )
+
+    def observe(self, name: str, value: float) -> None:
+        """Name-based hook used by BlockExecutor (keeps the state layer
+        decoupled from this package)."""
+        h = getattr(self, name, None)
+        if h is not None:
+            h.observe(value)
+
+
+class PrometheusServer:
+    """Minimal /metrics HTTP endpoint (ref: node/node.go:575)."""
+
+    def __init__(self, registry: Registry, addr: str = "127.0.0.1:26660"):
+        self.registry = registry
+        host, _, port = addr.rpartition(":")
+        self.host = host.lstrip("/") or "127.0.0.1"
+        self.port = int(port)
+        self._httpd = None
+
+    def start(self) -> None:
+        import http.server
+
+        registry = self.registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = registry.gather().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True, name="prometheus").start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

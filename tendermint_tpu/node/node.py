@@ -95,8 +95,35 @@ class Node:
         priv_validator=None,
         node_key: NodeKey | None = None,
     ):
+        from ..metrics import (
+            ConsensusMetrics,
+            MempoolMetrics,
+            P2PMetrics,
+            PrometheusServer,
+            Registry,
+            StateMetrics,
+        )
+        from ..utils.log import Logger, parse_level
+
         self.config = config
         config.validate_basic()
+
+        # ---- observability (ref: node/node.go:575 Prometheus; libs/log)
+        self.metrics_registry = Registry()
+        self.consensus_metrics = ConsensusMetrics(self.metrics_registry)
+        self.mempool_metrics = MempoolMetrics(self.metrics_registry)
+        self.p2p_metrics = P2PMetrics(self.metrics_registry)
+        self.state_metrics = StateMetrics(self.metrics_registry)
+        self.prometheus_server = (
+            PrometheusServer(self.metrics_registry, config.instrumentation.prometheus_listen_addr)
+            if config.instrumentation.prometheus
+            else None
+        )
+        self.logger = Logger(level=parse_level(config.base.log_level)).with_fields(
+            module="node"
+        )
+        self._halted = threading.Event()
+        self.halt_reason: BaseException | None = None
 
         # ---- genesis + state (node/node.go:691 loadStateFromDBOrGenesisDocProvider)
         self.gen_doc = gen_doc if gen_doc is not None else GenesisDoc.from_file(config.genesis_file)
@@ -160,6 +187,7 @@ class Node:
         self.router = Router(
             self.node_info, self.node_key.priv_key, self.peer_manager, [self.transport],
             options=RouterOptions(),
+            metrics=self.p2p_metrics,
         )
         cs_chs = [self.router.open_channel(d) for d in consensus_channel_descriptors()]
         mp_ch = self.router.open_channel(mempool_channel_descriptor())
@@ -175,6 +203,7 @@ class Node:
             cache_size=config.mempool.cache_size,
             max_tx_bytes=config.mempool.max_tx_bytes,
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+            metrics=self.mempool_metrics,
         )
         self.evidence_pool = EvidencePool(
             _make_db(config, "evidence"), self.state_store, self.block_store
@@ -186,6 +215,7 @@ class Node:
             evidence_pool=self.evidence_pool,
             block_store=self.block_store,
             event_publisher=self.event_bus.block_event_publisher(),
+            metrics=self.state_metrics,
         )
 
         # ---- consensus (node/node.go:300,316)
@@ -197,6 +227,9 @@ class Node:
             priv_validator=self.priv_validator,
             wal=wal,
             evidence_pool=self.evidence_pool,
+            metrics=self.consensus_metrics,
+            logger=self.logger.with_fields(module="consensus"),
+            on_fatal=self._on_fatal,
         )
         self.consensus_reactor = ConsensusReactor(
             self.consensus, cs_chs[0], cs_chs[1], cs_chs[2], cs_chs[3], self.peer_manager, self.block_store
@@ -214,6 +247,7 @@ class Node:
             self.peer_manager,
             on_caught_up=self._on_blocksync_done,
             block_sync=self._should_blocksync(state),
+            on_fatal=self._on_fatal,
         )
 
         # ---- statesync (node/node.go:352-377): always serves snapshots/
@@ -278,6 +312,8 @@ class Node:
         """ref: OnStart ordering (node/node.go:403-520)."""
         if self.indexer_service is not None:
             self.indexer_service.start()
+        if self.prometheus_server is not None:
+            self.prometheus_server.start()
 
         # ABCI handshake: sync the app to the stores (node/node.go:430)
         hs = Handshaker(
@@ -288,6 +324,11 @@ class Node:
         self._initial_state = state
         self.consensus.update_to_state(state)
         self.blocksync_reactor.state = state
+        # Handshake replay may have advanced state past what the reactor
+        # saw at construction (crash between blockstore and state saves);
+        # re-anchor the pool so it doesn't re-request an applied height
+        # (the statesync path below resets it the same way).
+        self.blocksync_reactor.pool.height = max(state.last_block_height + 1, state.initial_height)
 
         self.router.start()
         self.evidence_reactor.start()
@@ -361,6 +402,20 @@ class Node:
             self._consensus_running.set()
             self.consensus.start()
 
+    def _on_fatal(self, exc: BaseException) -> None:
+        """Fatal subsystem failure (consensus state machine, blocksync
+        apply): halt the whole node — router, RPC, mempool must not keep
+        serving from a dead engine (ref: state.go:899-938 re-panics to
+        stop the process; blocksync poolRoutine panics on apply error)."""
+        self.halt_reason = exc
+        self._halted.set()
+        self.logger.error("halting node on fatal failure", err=repr(exc))
+        threading.Thread(target=self.stop, daemon=True, name="node-halt").start()
+
+    @property
+    def halted(self) -> bool:
+        return self._halted.is_set()
+
     def stop(self) -> None:
         if self._consensus_running.is_set():
             self.consensus.stop()
@@ -374,6 +429,8 @@ class Node:
             self.rpc_server.stop()
         if self.indexer_service is not None:
             self.indexer_service.stop()
+        if self.prometheus_server is not None:
+            self.prometheus_server.stop()
         self.consensus.wal.close()
 
     # -------------------------------------------------------------- helpers

@@ -1,23 +1,32 @@
 """GF(2^255-19) arithmetic on int32 limb vectors (TPU-native).
 
 Representation: a field element is a vector of 32 limbs in radix 2^8,
-little-endian, dtype int32, with a trailing axis of length 32 — so the
-canonical form of an element is exactly its 32-byte little-endian
-encoding. Limbs are *signed*: subtraction is plain limb-wise subtraction,
-and carries use floor division, which keeps every operation branch-free
-and XLA-friendly.
+little-endian, dtype int32, with a LEADING axis of length 32 — shape
+(32, *batch). Putting the batch on the trailing axes maps it onto the
+VPU's 128-wide lane dimension (XLA tiles the two minor-most dims as
+(8 sublanes, 128 lanes)); with the limb axis last, as in a naive layout,
+only 32 of 128 lanes carry data and 3/4 of the VPU is idle. Limbs are
+*signed*: subtraction is plain limb-wise subtraction, and carries use
+floor division (arithmetic shift), which keeps every operation
+branch-free and XLA-friendly.
 
 Bounds contract (|limb| = magnitude bound):
-  - inputs to `fe_mul` must satisfy |limb| <= 2^10
-  - `fe_mul` output is carry-normalized to limbs in [0, 2^9)
+  - inputs to `fe_mul` must satisfy |limb| <= 2^10 (and the product of
+    the two inputs' bounds must stay <= 2^20; one side may be larger if
+    the other is smaller)
+  - `fe_mul` / `fe_square` outputs are carry-normalized to |limb| < 2^9
   - one add/sub of two mul outputs stays within the mul input contract
+  - `fe_carry(x, 1)` on |limb| <= 2^11 input yields |limb| <= 255 + 8
+    + 38*8 < 2^10 (limb 0 absorbs the x38 wrap), used to re-normalize
+    sums of mul outputs before squaring where bounds get tight
   - `fe_canonical` accepts |limb| <= 2^13 and returns the unique
     canonical representative (limbs in [0, 255], value < p)
 
 Why radix 2^8 / int32: TPU has no native 64-bit multiply; 8-bit limb
-products accumulate to at most 32*39*(2^10)^2 < 2^31 in the worst case
-(32 partial products, x38 reduction fold), so the whole convolution fits
-int32 MACs on the VPU. The 2^8 radix also makes encode/decode free.
+products accumulate to at most (32 + 38*31) * 2^10 * 2^10 < 2^31 in the
+worst case (32 partial products plus the x38 reduction fold), so the
+whole convolution fits int32 MACs on the VPU. The 2^8 radix also makes
+encode/decode free.
 
 Reference semantics being replaced: the field layer of curve25519-voi
 (crypto/ed25519/ed25519.go's verifier).
@@ -40,13 +49,14 @@ SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
 
 
 def _int_to_limbs(v: int) -> np.ndarray:
-    return np.array([(v >> (8 * i)) & 0xFF for i in range(LIMBS)], dtype=np.int32)
+    """(32, 1) column vector so constants broadcast over trailing batch."""
+    return np.array([[(v >> (8 * i)) & 0xFF] for i in range(LIMBS)], dtype=np.int32)
 
 
 def limbs_to_int(z) -> int:
-    """Host-side helper: interpret a limb vector as a Python int."""
-    arr = np.asarray(z, dtype=np.int64)
-    return sum(int(arr[..., i]) << (8 * i) for i in range(LIMBS))
+    """Host-side helper: interpret a 1-D (32,) limb vector as an int."""
+    arr = np.asarray(z, dtype=np.int64).reshape(LIMBS)
+    return sum(int(arr[i]) << (8 * i) for i in range(LIMBS))
 
 
 P_LIMBS = _int_to_limbs(P_INT)
@@ -61,8 +71,10 @@ ZERO_LIMBS = _int_to_limbs(0)
 # subsequent carry chain monotone (no borrow ping-pong across passes).
 _V0 = sum((1 << 14) << (8 * i) for i in range(LIMBS))
 _A = (-_V0) % P_INT
-BIAS_LIMBS = np.array([(1 << 14) + ((_A >> (8 * i)) & 0xFF) for i in range(LIMBS)], dtype=np.int32)
-assert (sum(int(b) << (8 * i) for i, b in enumerate(BIAS_LIMBS)) % P_INT) == 0
+BIAS_LIMBS = np.array(
+    [[(1 << 14) + ((_A >> (8 * i)) & 0xFF)] for i in range(LIMBS)], dtype=np.int32
+)
+assert (sum(int(b) << (8 * i) for i, b in enumerate(BIAS_LIMBS[:, 0])) % P_INT) == 0
 
 
 def fe_from_int(v: int) -> jnp.ndarray:
@@ -76,40 +88,70 @@ def fe_carry(z, passes: int = 4):
     for _ in range(passes):
         c = z >> 8  # arithmetic shift = floor division by 256
         z = z - (c << 8)
-        z = z.at[..., 1:].add(c[..., :-1])
-        z = z.at[..., 0].add(38 * c[..., -1])
+        z = z.at[1:].add(c[:-1])
+        z = z.at[0].add(38 * c[-1])
     return z
 
 
-def fe_mul(x, y):
-    """Field multiplication: 63-coefficient schoolbook convolution, fold
-    coefficients 32..62 back with x38 (2^256 === 38), then carry.
+def _fold_and_carry(z):
+    """Reduce a 63-coefficient convolution: fold coefficients 32..62 back
+    with x38 (2^256 === 38 mod p), then carry-normalize."""
+    lo = z[:LIMBS]
+    hi = z[LIMBS:]
+    lo = lo.at[: LIMBS - 1].add(38 * hi)
+    return fe_carry(lo, passes=4)
 
-    The convolution is phrased as padded partial products summed in a
-    balanced tree (no serial dynamic-update-slice chain — XLA compiles
-    and schedules this orders of magnitude faster, and the adds fuse)."""
-    shape = jnp.broadcast_shapes(x.shape, y.shape)
-    x = jnp.broadcast_to(x, shape)
-    y = jnp.broadcast_to(y, shape)
-    pad_cfg = [(0, 0, 0)] * (len(shape) - 1)
-    terms = [
-        lax.pad(x[..., i : i + 1] * y, jnp.int32(0), pad_cfg + [(i, NUM_CONV - LIMBS - i, 0)])
-        for i in range(LIMBS)
-    ]
-    while len(terms) > 1:  # balanced reduction tree
+
+def _tree_sum(terms):
+    """Balanced reduction tree — XLA schedules this orders of magnitude
+    better than a serial accumulation chain, and the adds all fuse."""
+    while len(terms) > 1:
         nxt = [terms[i] + terms[i + 1] for i in range(0, len(terms) - 1, 2)]
         if len(terms) % 2:
             nxt.append(terms[-1])
         terms = nxt
-    z = terms[0]
-    lo = z[..., :LIMBS]
-    hi = z[..., LIMBS:]
-    lo = lo.at[..., : LIMBS - 1].add(38 * hi)
-    return fe_carry(lo, passes=4)
+    return terms[0]
+
+
+def _with_batch_rank(x, rank):
+    """Insert singleton batch axes right after the limb axis so arrays of
+    different batch rank broadcast (batch dims stay trailing-aligned)."""
+    return x.reshape((x.shape[0],) + (1,) * (rank - (x.ndim - 1)) + x.shape[1:])
+
+
+def fe_mul(x, y):
+    """Field multiplication: 63-coefficient schoolbook convolution as 32
+    shifted partial products summed in a balanced tree, reduced mod p."""
+    rank = max(x.ndim, y.ndim) - 1
+    x = _with_batch_rank(x, rank)
+    y = _with_batch_rank(y, rank)
+    batch = jnp.broadcast_shapes(x.shape[1:], y.shape[1:])
+    x = jnp.broadcast_to(x, (LIMBS,) + batch)
+    y = jnp.broadcast_to(y, (LIMBS,) + batch)
+    pad_batch = [(0, 0, 0)] * len(batch)
+    terms = [
+        lax.pad(x[i][None] * y, jnp.int32(0), [(i, NUM_CONV - LIMBS - i, 0)] + pad_batch)
+        for i in range(LIMBS)
+    ]
+    return _fold_and_carry(_tree_sum(terms))
 
 
 def fe_square(x):
-    return fe_mul(x, x)
+    """Squaring via the symmetric convolution: z_k = sum_{i<j} 2 x_i x_j
+    + x_{k/2}^2 — half the partial-product MACs of fe_mul. Input bound
+    |limb| <= 2^10: the doubled terms merely account for the (i,j)/(j,i)
+    pair once each, so the folded coefficient bound is the same as
+    fe_mul's: (32 + 38*31) * 2^10 * 2^10 = 1210 * 2^20 < 2^31."""
+    batch = x.shape[1:]
+    xd = x + x
+    pad_batch = [(0, 0, 0)] * len(batch)
+    terms = []
+    for i in range(LIMBS):
+        # coefficient j=i contributes x_i^2 once; j>i contribute 2 x_i x_j
+        row = jnp.concatenate([x[i : i + 1], xd[i + 1 :]], axis=0)  # (32-i, ...)
+        prod = x[i][None] * row
+        terms.append(lax.pad(prod, jnp.int32(0), [(2 * i, NUM_CONV - LIMBS - i, 0)] + pad_batch))
+    return _fold_and_carry(_tree_sum(terms))
 
 
 def fe_add(x, y):
@@ -125,23 +167,20 @@ def fe_neg(x):
 
 
 def fe_mul_const(x, c_limbs):
-    """Multiply by a canonical constant (host numpy limb array)."""
+    """Multiply by a canonical constant (host numpy (32,1) limb array)."""
     return fe_mul(x, jnp.asarray(c_limbs))
 
 
 def _exact_carry(z):
-    """Full ripple-carry via lax.scan over the limb axis; returns byte
-    limbs plus the carry out of limb 31 (weight 2^256)."""
-    from jax import lax
-
-    zt = jnp.moveaxis(z, -1, 0)  # (32, ...)
+    """Full ripple-carry via lax.scan over the leading limb axis; returns
+    byte limbs plus the carry out of limb 31 (weight 2^256)."""
 
     def step(carry, limb):
         total = limb + carry
         return total >> 8, total & 255
 
-    carry_out, limbs = lax.scan(step, jnp.zeros_like(zt[0]), zt)
-    return jnp.moveaxis(limbs, 0, -1), carry_out
+    carry_out, limbs = lax.scan(step, jnp.zeros_like(z[0]), z)
+    return limbs, carry_out
 
 
 def fe_canonical(z):
@@ -149,32 +188,28 @@ def fe_canonical(z):
     Accepts |limb| <= 2^13 (the bias keeps everything positive). Uses
     exact scans — called only a handful of times per verification, so the
     sequential ripple is irrelevant to throughput."""
-    z = z + jnp.asarray(BIAS_LIMBS)
+    z = z + _with_batch_rank(jnp.asarray(BIAS_LIMBS), z.ndim - 1)
     for _ in range(3):
         z, c = _exact_carry(z)
-        z = z.at[..., 0].add(38 * c)
+        z = z.at[0].add(38 * c)
     # Fold bit 255 (weight === 19 mod p); twice for the wrap-into-[2^255,
     # 2^255+19) edge.
     for _ in range(2):
-        hi = z[..., 31] >> 7
-        z = z.at[..., 31].add(-(hi << 7))
-        z = z.at[..., 0].add(19 * hi)
+        hi = z[31] >> 7
+        z = z.at[31].add(-(hi << 7))
+        z = z.at[0].add(19 * hi)
         z, _ = _exact_carry(z)
     # Conditional subtract p. Here z has byte limbs and z < 2^255, so
     # z >= p iff limb0 >= 237 and limbs 1..30 == 255 and limb31 == 127 —
     # and then z - p is in [0, 19), i.e. just limb0 - 237.
-    ge = (
-        (z[..., 0] >= 237)
-        & jnp.all(z[..., 1:31] == 255, axis=-1)
-        & (z[..., 31] == 127)
-    )
-    sub = jnp.zeros_like(z).at[..., 0].set(z[..., 0] - 237)
-    return jnp.where(ge[..., None], sub, z)
+    ge = (z[0] >= 237) & jnp.all(z[1:31] == 255, axis=0) & (z[31] == 127)
+    sub = jnp.zeros_like(z).at[0].set(z[0] - 237)
+    return jnp.where(ge, sub, z)
 
 
 def fe_is_zero(z):
     """Boolean mask (shape = batch shape): z === 0 mod p."""
-    return jnp.all(fe_canonical(z) == 0, axis=-1)
+    return jnp.all(fe_canonical(z) == 0, axis=0)
 
 
 def fe_eq(x, y):
@@ -182,20 +217,19 @@ def fe_eq(x, y):
 
 
 def fe_select(mask, x, y):
-    """mask ? x : y, with mask of batch shape (broadcast over limbs)."""
-    return jnp.where(mask[..., None], x, y)
+    """mask ? x : y, with mask of batch shape (broadcast over the leading
+    limb axis by trailing-aligned numpy broadcasting)."""
+    return jnp.where(mask, x, y)
 
 
 def _pow2k(x, k: int):
     """x^(2^k) via a fori_loop so exponentiation chains trace one square
     body instead of k copies (compile-time control)."""
-    from jax import lax as _lax
-
     if k <= 2:
         for _ in range(k):
             x = fe_square(x)
         return x
-    return _lax.fori_loop(0, k, lambda _, v: fe_square(v), x)
+    return lax.fori_loop(0, k, lambda _, v: fe_square(v), x)
 
 
 def fe_pow_p58(z):

@@ -14,8 +14,10 @@ types/validation.go:245-255), and accepts exactly the same signatures.
 Split of labor:
   host   — SHA-512 challenges (cheap vs curve math), s < L range check,
            input shaping/padding
-  device — point decompression, double-scalar multiplication, cofactor
-           clearing, identity test: one fused XLA program
+  device — point decompression (A and R in one stacked pass), the joint
+           [s]B + [k](-A) Straus ladder with shared doublings, the R
+           subtraction, cofactor clearing, identity test: one fused XLA
+           program with the batch on the VPU lane axis throughout
 """
 
 from __future__ import annotations
@@ -37,14 +39,20 @@ def verify_kernel_impl(a_enc, r_enc, s_bytes, k_bytes):
 
     a_enc/r_enc are raw encodings (ZIP-215 decoding on device); s_bytes
     must be pre-checked < L on host; k_bytes is the SHA-512 challenge
-    already reduced mod L.
+    already reduced mod L. Inputs arrive batch-major (the natural host
+    and sharding layout) and are transposed on device to the limb-major
+    layout the field kernels want (ops/field.py).
     """
-    a_pt, a_ok = C.decompress(a_enc, zip215=True)
-    r_pt, r_ok = C.decompress(r_enc, zip215=True)
-    sb = C.fixed_base_mul(s_bytes)  # [s]B
-    ka = C.variable_base_mul(k_bytes, a_pt)  # [k]A
-    q = C.point_add(C.point_add(sb, C.point_neg(ka)), C.point_neg(r_pt))
-    q = C.point_double(C.point_double(C.point_double(q)))  # clear cofactor
+    a, r, s, k = a_enc.T, r_enc.T, s_bytes.T, k_bytes.T  # (32, B)
+    n = a.shape[1]
+    pts, oks = C.decompress(jnp.concatenate([a, r], axis=1), zip215=True)
+    a_pt, r_pt = pts[..., :n], pts[..., n:]
+    a_ok, r_ok = oks[:n], oks[n:]
+    q = C.double_scalar_mul_base(s, k, C.point_neg(a_pt))  # [s]B - [k]A
+    q = C.point_add(q, C.point_neg(r_pt), out_t=False)
+    q = C.point_double(q, out_t=False)  # clear cofactor: x8
+    q = C.point_double(q, out_t=False)
+    q = C.point_double(q, out_t=False)
     return a_ok & r_ok & C.point_is_identity(q)
 
 
@@ -63,24 +71,24 @@ def prepare_batch(pubkeys, msgs, sigs):
     precheck) numpy arrays of shape (B, 32)/(B,). Malformed inputs fail
     precheck instead of raising (callers map them to invalid)."""
     n = len(sigs)
-    a_enc = np.zeros((n, 32), np.int32)
-    r_enc = np.zeros((n, 32), np.int32)
-    s_bytes = np.zeros((n, 32), np.int32)
-    k_bytes = np.zeros((n, 32), np.int32)
+    raw = np.zeros((4, n, 32), np.uint8)  # a, r, s, k rows
     precheck = np.zeros((n,), bool)
+    sha512 = hashlib.sha512
+    from_bytes = int.from_bytes
     for i in range(n):
-        pk, msg, sig = pubkeys[i], msgs[i], sigs[i]
+        pk, sig = pubkeys[i], sigs[i]
         if len(pk) != 32 or len(sig) != 64:
             continue
-        s = int.from_bytes(sig[32:], "little")
+        s = from_bytes(sig[32:], "little")
         if s >= L:
             continue
-        k = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
-        a_enc[i] = np.frombuffer(pk, np.uint8)
-        r_enc[i] = np.frombuffer(sig[:32], np.uint8)
-        s_bytes[i] = np.frombuffer(sig[32:], np.uint8)
-        k_bytes[i] = np.frombuffer(int.to_bytes(k, 32, "little"), np.uint8)
+        k = from_bytes(sha512(sig[:32] + pk + msgs[i]).digest(), "little") % L
+        raw[0, i] = np.frombuffer(pk, np.uint8)
+        raw[1, i] = np.frombuffer(sig, np.uint8, count=32)
+        raw[2, i] = np.frombuffer(sig, np.uint8, count=32, offset=32)
+        raw[3, i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
         precheck[i] = True
+    a_enc, r_enc, s_bytes, k_bytes = raw.astype(np.int32)
     return a_enc, r_enc, s_bytes, k_bytes, precheck
 
 

@@ -83,6 +83,7 @@ class Router:
         endpoint_for: dict[str, Transport] | None = None,
         options: RouterOptions | None = None,
         logger=None,
+        metrics=None,
     ):
         self.node_info = node_info
         self.priv_key = priv_key
@@ -90,6 +91,7 @@ class Router:
         self.transports = list(transports)
         self.options = options or RouterOptions()
         self.logger = logger
+        self.metrics = metrics  # P2PMetrics (ref: p2p/metrics.go)
 
         self._channels: dict[int, Channel] = {}
         self._channel_lock = threading.RLock()
@@ -252,11 +254,23 @@ class Router:
 
         peer_channels = set(peer_info.channels)
         pq = _PeerQueue(self.options.queue_size)
+        if self.metrics is not None:
+            metrics = self.metrics
+
+            def on_traffic(direction: str, channel_id: int, nbytes: int) -> None:
+                if direction == "send":
+                    metrics.message_send_bytes_total.add(nbytes, f"{channel_id:#x}")
+                else:
+                    metrics.message_receive_bytes_total.add(nbytes, f"{channel_id:#x}")
+
+            conn.on_traffic = on_traffic
         with self._peer_lock:
             old = self._peer_conns.pop(peer_id, None)
             self._peer_queues[peer_id] = pq
             self._peer_conns[peer_id] = conn
             self._peer_channels[peer_id] = peer_channels & self.channel_ids()
+            if self.metrics is not None:
+                self.metrics.peers.set(len(self._peer_conns))
         if old is not None:
             old.close()
 
@@ -279,6 +293,8 @@ class Router:
                     del self._peer_conns[peer_id]
                     self._peer_queues.pop(peer_id, None)
                     self._peer_channels.pop(peer_id, None)
+                if self.metrics is not None:
+                    self.metrics.peers.set(len(self._peer_conns))
             self.peer_manager.disconnected(peer_id)
 
     # --------------------------------------------------------------- dial

@@ -93,6 +93,7 @@ class MemoryConnection(Connection):
         self._send_q = send_q
         self._recv_q = recv_q
         self._closed = threading.Event()
+        self.on_traffic = None  # parity with TCP connections (unused in-proc)
 
     def handshake(self, node_info: NodeInfo, priv_key, timeout: float | None = None) -> tuple[NodeInfo, Any]:
         """Symmetric NodeInfo/pubkey exchange (ref: transport_memory.go

@@ -47,6 +47,9 @@ class TcpConnection(Connection):
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
         self._closed = threading.Event()
+        self._varint_result = 0  # resumable length-prefix state
+        self._varint_shift = 0
+        self.on_traffic = None  # optional (direction, channel_id, nbytes) hook
         try:
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
@@ -87,16 +90,24 @@ class TcpConnection(Connection):
             except (OSError, ConnectionError) as e:
                 self._closed.set()
                 raise ConnectionClosed(str(e))
+        if self.on_traffic is not None:
+            self.on_traffic("send", channel_id, len(frame))
 
     def _read_uvarint(self) -> int:
-        result, shift = 0, 0
+        """Resumable uvarint read: bytes consumed before a poll timeout
+        are kept in (_varint_result, _varint_shift) so the next call
+        continues the prefix instead of desynchronizing the plaintext
+        stream (a multi-byte prefix can straddle two SecretConnection
+        frames; cf. SecretConnection's own resumable _raw_buf)."""
         while True:
             b = self._secret.read_exact(1)[0]
-            result |= (b & 0x7F) << shift
+            self._varint_result |= (b & 0x7F) << self._varint_shift
             if not (b & 0x80):
+                result = self._varint_result
+                self._varint_result, self._varint_shift = 0, 0
                 return result
-            shift += 7
-            if shift > 63:
+            self._varint_shift += 7
+            if self._varint_shift > 63:
                 raise ValueError("uvarint overflow")
 
     def receive_message(self, timeout: float | None = None) -> tuple[int, Any]:
@@ -116,6 +127,10 @@ class TcpConnection(Connection):
                 self._closed.set()
                 raise ConnectionClosed(str(e))
         channel_id = body[0]
+        if self.on_traffic is not None:
+            # count the uvarint prefix too, symmetric with send_message
+            prefix_len = max(1, (total.bit_length() + 6) // 7)
+            self.on_traffic("recv", channel_id, total + prefix_len)
         desc = self._descs.get(channel_id)
         if desc is None or desc.decode is None:
             return channel_id, body[1:]  # router drops unknown channels

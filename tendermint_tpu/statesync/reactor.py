@@ -330,6 +330,16 @@ class StateSyncReactor:
         trusted_lb = fetch_light_block(height)
         if trusted_lb is None:
             return 0
+        # Root the hash chain at the state's own LastBlockID: a malicious
+        # provider must not be able to seed a forged history (ref:
+        # reactor.go:432,550 — trustedBlockID from state, per-block
+        # ValidateBasic before persisting).
+        if state.last_block_id is not None and state.last_block_id.hash:
+            if trusted_lb.signed_header.hash() != state.last_block_id.hash:
+                raise ValueError(
+                    f"backfill: light block at {height} does not match state.last_block_id"
+                )
+        trusted_lb.validate_basic(state.chain_id)
         stored = 0
         cur = trusted_lb
         self.state_store.save_validator_sets(cur.height, cur.height, cur.validator_set)
@@ -341,6 +351,7 @@ class StateSyncReactor:
                 raise ValueError(
                     f"backfill: header at {prev.height} does not hash-chain to {cur.height}"
                 )
+            prev.validate_basic(state.chain_id)
             self.state_store.save_validator_sets(prev.height, prev.height, prev.validator_set)
             self.block_store.save_seen_commit(prev.height, prev.signed_header.commit)
             stored += 1

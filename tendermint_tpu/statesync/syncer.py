@@ -187,8 +187,17 @@ class Syncer:
     def _sync_snapshot(self, snapshot: abci.Snapshot, stop_event: threading.Event):
         """ref: syncer.go:262 Sync: verify app hash via light client,
         OfferSnapshot, fetch+apply chunks, verify final state."""
-        # 1. trusted app hash for the snapshot height (+1 header carries it)
-        app_hash = self.state_provider.app_hash(snapshot.height)
+        # 1. trusted app hash for the snapshot height (+1 header carries
+        # it). Any light-client failure here — e.g. the +1 block doesn't
+        # exist yet because the snapshot sits at the provider's tip —
+        # drops THIS snapshot and tries the next (ref: syncer.go:269-282
+        # "Dropping snapshot and trying again" → errRejectSnapshot).
+        try:
+            app_hash = self.state_provider.app_hash(snapshot.height)
+        except Exception as e:
+            raise ErrRejectSnapshot(
+                f"failed to verify state at snapshot height {snapshot.height}: {e}"
+            )
 
         # 2. offer to the app (syncer.go:320 offerSnapshot)
         resp = self.app.offer_snapshot(abci.RequestOfferSnapshot(snapshot=snapshot, app_hash=app_hash))

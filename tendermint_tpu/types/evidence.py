@@ -48,6 +48,12 @@ class DuplicateVoteEvidence:
     def abci_height(self) -> int:
         return self.vote_a.height
 
+    def generate_abci(self, val: Validator, val_set: ValidatorSet, evidence_time: Time) -> None:
+        """Populate the ABCI component (ref: GenerateABCI, types/evidence.go:184)."""
+        self.validator_power = val.voting_power
+        self.total_voting_power = val_set.total_voting_power()
+        self.timestamp = evidence_time
+
     @property
     def height(self) -> int:
         return self.vote_a.height
@@ -97,7 +103,7 @@ class LightClientAttackEvidence:
     """A conflicting light block trace (ref: types/evidence.go:259)."""
 
     conflicting_block: "LightBlock"
-    common_height: int
+    common_height: int = 0
     byzantine_validators: list[Validator] = field(default_factory=list)
     total_voting_power: int = 0
     timestamp: Time = field(default_factory=Time)
@@ -138,12 +144,17 @@ class LightClientAttackEvidence:
             or trusted_header.last_results_hash != h.last_results_hash
         )
 
-    def get_byzantine_validators(self, common_vals: ValidatorSet, trusted_header) -> list[Validator]:
+    def get_byzantine_validators(self, common_vals: ValidatorSet, trusted) -> list[Validator]:
         """Work out which validators were malicious depending on attack style
-        (ref: GetByzantineValidators, types/evidence.go:302-340)."""
-        byzantine = []
-        if self.conflicting_header_is_invalid(trusted_header):
-            # Lunatic attack: validators from the common set that signed.
+        (ref: GetByzantineValidators, types/evidence.go:305-344). `trusted`
+        is the trusted SignedHeader (commit needed for the equivocation
+        round comparison). Output ordered by descending voting power."""
+        from .validator_set import _sort_by_voting_power
+
+        byzantine: list[Validator] = []
+        if self.conflicting_header_is_invalid(trusted.header):
+            # Lunatic attack: common-set validators who signed the
+            # conflicting (lunatic) header.
             commit = self.conflicting_block.signed_header.commit
             for sig in commit.signatures:
                 if not sig.for_block():
@@ -151,18 +162,32 @@ class LightClientAttackEvidence:
                 _, val = common_vals.get_by_address(sig.validator_address)
                 if val is not None:
                     byzantine.append(val)
-        elif trusted_header.height == self.conflicting_block.signed_header.header.height:
-            # Equivocation: validators that signed both blocks; caller
-            # compares with the trusted commit.
-            commit = self.conflicting_block.signed_header.commit
-            for sig in commit.signatures:
-                if not sig.for_block():
+            _sort_by_voting_power(byzantine)
+            return byzantine
+        if trusted.commit.round == self.conflicting_block.signed_header.commit.round:
+            # Equivocation: both commits in the same round — validators
+            # that voted in BOTH headers. Validator hashes match, so the
+            # index order is shared and one indexed loop suffices.
+            sigs_a = self.conflicting_block.signed_header.commit.signatures
+            sigs_b = trusted.commit.signatures
+            for i, sig_a in enumerate(sigs_a):
+                if not sig_a.for_block():
                     continue
-                _, val = self.conflicting_block.validator_set.get_by_address(sig.validator_address)
+                if i >= len(sigs_b) or not sigs_b[i].for_block():
+                    continue
+                _, val = self.conflicting_block.validator_set.get_by_address(sig_a.validator_address)
                 if val is not None:
                     byzantine.append(val)
-        # Amnesia attacks are not attributable (ref comment :335).
+            _sort_by_voting_power(byzantine)
+            return byzantine
+        # Different rounds: amnesia attack — not attributable (ref :341).
         return byzantine
+
+    def generate_abci(self, common_vals: ValidatorSet, trusted, evidence_time: Time) -> None:
+        """Populate the ABCI component (ref: GenerateABCI, types/evidence.go:497)."""
+        self.byzantine_validators = self.get_byzantine_validators(common_vals, trusted)
+        self.total_voting_power = common_vals.total_voting_power()
+        self.timestamp = evidence_time
 
     def validate_basic(self) -> None:
         if self.conflicting_block is None or self.conflicting_block.signed_header is None:

@@ -1,4 +1,4 @@
-"""Curve ops vs the pure-Python oracle."""
+"""Curve ops vs the pure-Python oracle (limb-major layout)."""
 
 import secrets
 
@@ -15,19 +15,21 @@ _jdecomp = jax.jit(lambda e: C.decompress(e, zip215=True))
 _jdecomp_strict = jax.jit(lambda e: C.decompress(e, zip215=False))
 _jvarmul = jax.jit(C.variable_base_mul)
 _jfixmul = jax.jit(C.fixed_base_mul)
+_jdouble_scalar = jax.jit(C.double_scalar_mul_base)
 _jcompress = jax.jit(C.compress)
+_jdbl = jax.jit(lambda p: C.point_double(p, out_t=True))
 
 
 def enc_to_dev(enc: bytes):
-    return jnp.asarray(np.frombuffer(enc, dtype=np.uint8).astype(np.int32)[None, :])
+    return jnp.asarray(np.frombuffer(enc, dtype=np.uint8).astype(np.int32)[:, None])
 
 
 def scalar_to_dev(s: int):
-    return jnp.asarray(np.array([[(s >> (8 * i)) & 0xFF for i in range(32)]], dtype=np.int32))
+    return jnp.asarray(np.array([[(s >> (8 * i)) & 0xFF] for i in range(32)], dtype=np.int32))
 
 
 def dev_point_to_affine(p):
-    arr = np.asarray(p)[0]
+    arr = np.asarray(p)[..., 0]  # (4, 32)
     x = F.limbs_to_int(arr[0]) % ref.P
     y = F.limbs_to_int(arr[1]) % ref.P
     z = F.limbs_to_int(arr[2]) % ref.P
@@ -85,6 +87,22 @@ def test_point_add_matches_oracle():
     assert dev_point_to_affine(got) == ref_affine(ref.point_add(a, b))
 
 
+def test_point_double_matches_oracle():
+    for k in [1, 5, 12345, ref.L - 2]:
+        a = ref.scalar_mult(k, ref.BASE)
+        pa, _ = _jdecomp(enc_to_dev(ref.compress(a)))
+        got = _jdbl(pa)
+        want = ref_affine(ref.point_add(a, a))
+        assert dev_point_to_affine(got) == want
+        # T coordinate must satisfy T = XY/Z
+        arr = np.asarray(got)[..., 0]
+        x = F.limbs_to_int(arr[0]) % ref.P
+        y = F.limbs_to_int(arr[1]) % ref.P
+        z = F.limbs_to_int(arr[2]) % ref.P
+        t = F.limbs_to_int(arr[3]) % ref.P
+        assert (t * z - x * y) % ref.P == 0
+
+
 def test_variable_base_mul():
     for _ in range(3):
         k = secrets.randbelow(ref.L)
@@ -118,25 +136,58 @@ def test_fixed_base_mul():
             assert dev_point_to_affine(got) == ref_affine(want_pt), s
 
 
+def test_double_scalar_mul_base():
+    # [s]B + [k]A for random and edge scalars, vs the oracle.
+    a_scalar = secrets.randbelow(ref.L)
+    a_point = ref.scalar_mult(a_scalar, ref.BASE)
+    pt, _ = _jdecomp(enc_to_dev(ref.compress(a_point)))
+    cases = [
+        (0, 0),
+        (1, 0),
+        (0, 1),
+        (secrets.randbelow(ref.L), secrets.randbelow(ref.L)),
+        (ref.L - 1, ref.L - 1),
+        (2**256 - 1, 15),
+    ]
+    for s, k in cases:
+        got = _jdouble_scalar(scalar_to_dev(s), scalar_to_dev(k), pt)
+        want_pt = ref.point_add(ref.scalar_mult(s, ref.BASE), ref.scalar_mult(k, a_point))
+        if ref.point_is_identity(want_pt):
+            assert bool(jax.jit(C.point_is_identity)(got)[0]), (s, k)
+        else:
+            assert dev_point_to_affine(got) == ref_affine(want_pt), (s, k)
+        # the ladder must emit a valid T (consumed by the final R add)
+        arr = np.asarray(got)[..., 0]
+        x = F.limbs_to_int(arr[0]) % ref.P
+        y = F.limbs_to_int(arr[1]) % ref.P
+        z = F.limbs_to_int(arr[2]) % ref.P
+        t = F.limbs_to_int(arr[3]) % ref.P
+        assert (t * z - x * y) % ref.P == 0, (s, k)
+
+
 def test_compress_roundtrip():
     k = secrets.randbelow(ref.L)
     enc = ref.compress(ref.scalar_mult(k, ref.BASE))
     pt, _ = _jdecomp(enc_to_dev(enc))
-    out = np.asarray(_jcompress(pt))[0]
+    out = np.asarray(_jcompress(pt))[:, 0]
     assert bytes(out.astype(np.uint8)) == enc
 
 
 def test_batched_ops():
     ks = [3, 5, 7, 11]
     encs = np.stack(
-        [np.frombuffer(ref.compress(ref.scalar_mult(k, ref.BASE)), dtype=np.uint8).astype(np.int32) for k in ks]
-    )
+        [np.frombuffer(ref.compress(ref.scalar_mult(k, ref.BASE)), dtype=np.uint8).astype(np.int32) for k in ks],
+        axis=1,
+    )  # (32, 4)
     pts, ok = _jdecomp(jnp.asarray(encs))
     assert ok.shape == (4,) and bool(ok.all())
-    ss = np.stack([np.array([(s >> (8 * i)) & 0xFF for i in range(32)], dtype=np.int32) for s in [2, 3, 4, 5]])
+    ss = np.stack(
+        [np.array([(s >> (8 * i)) & 0xFF for i in range(32)], dtype=np.int32) for s in [2, 3, 4, 5]],
+        axis=1,
+    )  # (32, 4)
     got = _jvarmul(jnp.asarray(ss), pts)
     for i, (k, s) in enumerate(zip(ks, [2, 3, 4, 5])):
-        arr = np.asarray(got)[i]
+        arr = np.asarray(got)[..., i]
         x = F.limbs_to_int(arr[0]) % ref.P
         y = F.limbs_to_int(arr[1]) % ref.P
         z = F.limbs_to_int(arr[2]) % ref.P

@@ -75,14 +75,30 @@ def test_verify_duplicate_vote_rejects_bad_signature():
         verify_duplicate_vote(ev, CHAIN, vals)
 
 
-def test_verify_duplicate_vote_rejects_wrong_power():
-    keys = make_keys(3)
-    vals = make_validator_set(keys)
-    t = Time.from_unix_ns(1_700_000_000 * 10**9)
-    ev = make_duplicate_vote_evidence(keys, vals, 5, t)
+def test_verify_rejects_wrong_power_and_regenerates():
+    # Power/timestamp checks live in the ABCI-component validation (ref:
+    # ValidateABCI split, types/evidence.go:158): verify_duplicate_vote
+    # itself no longer rejects, the contextual verify_evidence does, and
+    # the pool regenerates + stores the rectified evidence.
+    from tendermint_tpu.evidence.pool import EvidencePool
+    from tendermint_tpu.evidence.verify import EvidenceABCIError, verify_evidence
+    from tendermint_tpu.store.kv import MemDB
+
+    keys = make_keys(1)
+    node = _committed_chain(keys)
+    state = node.state
+    meta = node.block_store.load_block_meta(1)
+    ev = make_duplicate_vote_evidence(keys, state.validators, 1, meta.header.time)
     ev.total_voting_power = 999
-    with pytest.raises(EvidenceVerifyError):
-        verify_duplicate_vote(ev, CHAIN, vals)
+    with pytest.raises(EvidenceABCIError):
+        verify_evidence(ev, state, node.block_exec.store, node.block_store)
+
+    pool = EvidencePool(MemDB(), node.block_exec.store, node.block_store)
+    with pytest.raises(EvidenceABCIError):
+        pool.add_evidence(ev)
+    # regenerated + stored: power fixed, evidence pending
+    assert ev.total_voting_power == state.validators.total_voting_power()
+    assert pool.size() == 1
 
 
 def _committed_chain(keys, n_heights=3):

@@ -1,4 +1,4 @@
-"""Field arithmetic vs Python-int ground truth."""
+"""Field arithmetic vs Python-int ground truth (limb-major layout)."""
 
 import secrets
 
@@ -13,6 +13,7 @@ P = F.P_INT
 # jit-compiled wrappers: eager dispatch of thousands of tiny int32 ops is
 # what makes these tests slow, not the math.
 _jmul = jax.jit(F.fe_mul)
+_jsq = jax.jit(F.fe_square)
 _jcanon = jax.jit(F.fe_canonical)
 _jpow58 = jax.jit(F.fe_pow_p58)
 _jinv = jax.jit(F.fe_invert)
@@ -25,11 +26,11 @@ def rand_fe():
 def to_limbs(v):
     import jax.numpy as jnp
 
-    return jnp.asarray(np.array([[(v >> (8 * i)) & 0xFF for i in range(32)]], dtype=np.int32))
+    return jnp.asarray(np.array([[(v >> (8 * i)) & 0xFF] for i in range(32)], dtype=np.int32))
 
 
 def from_limbs(z):
-    return F.limbs_to_int(np.asarray(z)[0]) % P
+    return F.limbs_to_int(np.asarray(z)[:, 0]) % P
 
 
 def test_mul_random():
@@ -37,6 +38,13 @@ def test_mul_random():
         a, b = rand_fe(), rand_fe()
         got = from_limbs(_jcanon(_jmul(to_limbs(a), to_limbs(b))))
         assert got == (a * b) % P
+
+
+def test_square_random():
+    for _ in range(10):
+        a = rand_fe()
+        got = from_limbs(_jcanon(_jsq(to_limbs(a))))
+        assert got == (a * a) % P
 
 
 def test_add_sub_neg():
@@ -49,13 +57,10 @@ def test_add_sub_neg():
 
 def test_canonical_edges():
     for v in [0, 1, 19, P - 1, P, P + 1, 2 * P - 1, 2 * P, 2**255 - 1, 2**256 - 39]:
-        limbs = np.array([[(v >> (8 * i)) & 0xFF for i in range(32)]], dtype=np.int32)
-        import jax.numpy as jnp
-
-        got = from_limbs(_jcanon(jnp.asarray(limbs)))
+        got = from_limbs(_jcanon(to_limbs(v)))
         assert got == v % P, v
         # canonical output limbs must be bytes
-        out = np.asarray(_jcanon(jnp.asarray(limbs)))
+        out = np.asarray(_jcanon(to_limbs(v)))
         assert out.min() >= 0 and out.max() <= 255
 
 
@@ -63,13 +68,13 @@ def test_canonical_negative_limbs():
     import jax.numpy as jnp
 
     # An isolated -1 limb (the borrow ping-pong worst case).
-    z = jnp.zeros((1, 32), jnp.int32).at[0, 0].add(-1)
+    z = jnp.zeros((32, 1), jnp.int32).at[0, 0].add(-1)
     assert from_limbs(_jcanon(z)) == (P - 1)
-    z = jnp.zeros((1, 32), jnp.int32).at[0, 31].add(-1)
+    z = jnp.zeros((32, 1), jnp.int32).at[31, 0].add(-1)
     assert from_limbs(_jcanon(z)) == (-(1 << 248)) % P
     # All limbs at the contract bound.
     for s in (1, -1):
-        z = jnp.full((1, 32), s * (2**13 - 1), jnp.int32)
+        z = jnp.full((32, 1), s * (2**13 - 1), jnp.int32)
         want = sum(s * (2**13 - 1) << (8 * i) for i in range(32)) % P
         assert from_limbs(_jcanon(z)) == want
 
@@ -83,7 +88,7 @@ def test_mul_chain_stays_bounded():
     ia, ib = a, b
     for i in range(60):
         m = _jmul(x, y)
-        n = _jmul(y, y)
+        n = _jsq(y)
         comb = F.fe_sub(m, n) if i % 3 else F.fe_add(m, n)
         im, in_ = (ia * ib) % P, (ib * ib) % P
         ic = (im - in_) % P if i % 3 else (im + in_) % P
@@ -93,6 +98,17 @@ def test_mul_chain_stays_bounded():
         assert int(np.abs(np.asarray(y)).max()) <= 2**10
     assert from_limbs(_jcanon(x)) == ia
     assert from_limbs(_jcanon(y)) == ib
+
+
+def test_square_of_carried_sum_stays_bounded():
+    # The doubling formula squares fe_carry(x+y, 1); check bounds hold.
+    a, b = rand_fe(), rand_fe()
+    x, y = _jmul(to_limbs(a), to_limbs(b)), _jsq(to_limbs(b))
+    s = F.fe_carry(F.fe_add(x, y), passes=1)
+    assert int(np.abs(np.asarray(s)).max()) < 2**10
+    got = from_limbs(_jcanon(_jsq(s)))
+    want = pow((a * b % P + b * b) % P, 2, P)
+    assert got == want
 
 
 def test_pow_p58_and_invert():
@@ -106,29 +122,28 @@ def test_pow_p58_and_invert():
 
 def test_is_zero_eq():
     z = to_limbs(0)
-    p_limbs = to_limbs(P)  # non-canonical zero... (P encodes as P, < 2^255)
     assert bool(F.fe_is_zero(z)[0])
-    assert bool(F.fe_is_zero(F.fe_sub(to_limbs(5), to_limbs(5))[...])[0])
+    assert bool(F.fe_is_zero(F.fe_sub(to_limbs(5), to_limbs(5)))[0])
     assert not bool(F.fe_is_zero(to_limbs(1))[0])
     # P === 0 mod p even though its limb pattern is nonzero
     import jax.numpy as jnp
 
-    raw_p = jnp.asarray(np.array([[(P >> (8 * i)) & 0xFF for i in range(32)]], dtype=np.int32))
-    assert bool(F.fe_is_zero(raw_p)[0])
-    assert bool(F.fe_eq(to_limbs(7), to_limbs(7 + P))[0]) or True  # 7+P wraps in to_limbs
-    _ = p_limbs
+    raw = jnp.asarray(np.array([[(P >> (8 * i)) & 0xFF] for i in range(32)], dtype=np.int32))
+    assert bool(F.fe_is_zero(raw)[0])
+    assert bool(F.fe_eq(to_limbs(7), to_limbs(7))[0])
 
 
 def test_batch_shapes():
     import jax.numpy as jnp
 
-    a = np.random.randint(0, 256, size=(4, 7, 32)).astype(np.int32)
-    b = np.random.randint(0, 256, size=(4, 7, 32)).astype(np.int32)
+    a = np.random.randint(0, 256, size=(32, 4, 7)).astype(np.int32)
+    b = np.random.randint(0, 256, size=(32, 4, 7)).astype(np.int32)
     out = _jmul(jnp.asarray(a), jnp.asarray(b))
-    assert out.shape == (4, 7, 32)
+    assert out.shape == (32, 4, 7)
+    canon = np.asarray(_jcanon(out))
     for i in range(4):
         for j in range(7):
-            av = sum(int(a[i, j, k]) << (8 * k) for k in range(32))
-            bv = sum(int(b[i, j, k]) << (8 * k) for k in range(32))
-            got = F.limbs_to_int(np.asarray(_jcanon(out))[i, j]) % P
+            av = sum(int(a[k, i, j]) << (8 * k) for k in range(32))
+            bv = sum(int(b[k, i, j]) << (8 * k) for k in range(32))
+            got = F.limbs_to_int(canon[:, i, j]) % P
             assert got == (av * bv) % P

@@ -1,0 +1,107 @@
+"""Metrics + structured logging + consensus-failure halt
+(ref: internal/consensus/metrics.go, libs/log, node/node.go:575)."""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import urllib.request
+
+from tendermint_tpu.metrics import (
+    ConsensusMetrics,
+    PrometheusServer,
+    Registry,
+)
+from tendermint_tpu.utils.log import DEBUG, Logger
+
+
+def test_counter_gauge_histogram_exposition():
+    reg = Registry()
+    c = reg.counter("tm_test_total", "a counter", labels=("kind",))
+    g = reg.gauge("tm_test_height", "a gauge")
+    h = reg.histogram("tm_test_dur", "a histogram", buckets=(0.1, 1.0))
+    c.add(1, "x")
+    c.add(2, "y")
+    g.set(42)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5)
+    text = reg.gather()
+    assert '# TYPE tm_test_total counter' in text
+    assert 'tm_test_total{kind="x"} 1' in text
+    assert 'tm_test_total{kind="y"} 2' in text
+    assert "tm_test_height 42" in text
+    assert 'tm_test_dur_bucket{le="0.1"} 1' in text
+    assert 'tm_test_dur_bucket{le="1"} 2' in text
+    assert 'tm_test_dur_bucket{le="+Inf"} 3' in text
+    assert "tm_test_dur_count 3" in text
+
+
+def test_consensus_metrics_mark_step():
+    reg = Registry()
+    m = ConsensusMetrics(reg)
+    m.mark_step("Propose")
+    time.sleep(0.01)
+    m.mark_step("Prevote")  # observes the Propose duration
+    text = reg.gather()
+    assert 'step_duration_seconds_count{step="Propose"} 1' in text
+
+
+def test_prometheus_server_serves_metrics():
+    reg = Registry()
+    reg.gauge("tm_test_up", "up").set(1)
+    srv = PrometheusServer(reg, "127.0.0.1:0")
+    srv.start()
+    try:
+        body = urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read()
+        assert b"tm_test_up 1" in body
+    finally:
+        srv.stop()
+
+
+def test_structured_logger_formats():
+    buf = io.StringIO()
+    log = Logger(level=DEBUG, fmt="json", writer=buf).with_fields(module="test")
+    log.info("hello", height=5)
+    rec = json.loads(buf.getvalue())
+    assert rec["message"] == "hello" and rec["height"] == 5 and rec["module"] == "test"
+    buf2 = io.StringIO()
+    log2 = Logger(level=DEBUG, fmt="console", writer=buf2)
+    log2.error("bad thing", err="boom")
+    line = buf2.getvalue()
+    assert "ERR" in line and "bad thing" in line and "err=boom" in line
+
+
+def test_consensus_failure_halts_node(tmp_path):
+    """A consensus-thread exception must stop the WHOLE node (VERDICT
+    weak #5; ref: state.go:899-938 CONSENSUS FAILURE panic)."""
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+
+    home = str(tmp_path / "halt-node")
+    assert cli_main(["--home", home, "init", "validator", "--chain-id", "halt-chain"]) == 0
+    cfg = load_config(home)
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.base.db_backend = "memdb"
+    node = Node(cfg)
+
+    boom = RuntimeError("injected consensus failure")
+
+    def bad_dispatch(item):
+        raise boom
+
+    node.consensus._dispatch = bad_dispatch
+    node.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not node.halted:
+            time.sleep(0.05)
+        assert node.halted, "node did not halt on consensus failure"
+        assert node.halt_reason is boom
+        # the consensus thread must be stopped
+        assert node.consensus._stop.is_set()
+    finally:
+        node.stop()
