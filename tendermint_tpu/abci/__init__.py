@@ -2,3 +2,11 @@
 
 from .client import Client, LocalClient  # noqa: F401
 from .types import Application, BaseApplication  # noqa: F401
+
+
+def __getattr__(name):  # lazy: socket transport pulls in utils.log
+    if name in ("SocketClient", "SocketServer", "serve_app"):
+        from . import socket as _socket
+
+        return getattr(_socket, name)
+    raise AttributeError(name)

@@ -2,8 +2,9 @@
 
 ref: abci/client/client.go:25 (interface), local_client.go (in-process,
 mutex-serialized). The local client is the `builtin` transport the
-reference's e2e suite exercises most; socket/grpc transports live in
-abci/socket.py and follow the same Client surface.
+reference's e2e suite exercises most; the socket transport (external
+apps over tcp/unix, async pipelined) lives in abci/socket.py and
+follows the same Client surface.
 """
 
 from __future__ import annotations

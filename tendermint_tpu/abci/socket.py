@@ -1,0 +1,366 @@
+"""Out-of-process ABCI: socket server + async pipelined client.
+
+ref: abci/client/socket_client.go:110-160 (pipelined request queue,
+FIFO response matching, flush batching) and abci/server/socket_server.go
+(per-connection read→handle→respond loop). Wire format: varint
+length-delimited Request/Response protos (protoio), byte-compatible
+with the reference's `tcp://` and `unix://` ABCI transports.
+
+The client is synchronous per call but pipelined across callers: each
+call enqueues a (method, event) pair, writes the request, and waits;
+one reader thread matches responses FIFO — so concurrent callers (e.g.
+mempool CheckTx under RPC load while consensus drives FinalizeBlock on
+its own connection) keep multiple requests in flight, like the
+reference's reqQueue.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from collections import deque
+from urllib.parse import urlparse
+
+from ..utils.log import new_logger
+from . import proto as apb
+from . import types as abci
+from .client import Client
+from .types import Application
+
+MAX_MESSAGE_SIZE = 64 << 20  # generous; snapshots chunk at ~16 MB
+
+
+def _encode_uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_uvarint(sock_file) -> int:
+    result, shift = 0, 0
+    while True:
+        b = sock_file.read(1)
+        if not b:
+            raise ConnectionError("ABCI connection closed")
+        result |= (b[0] & 0x7F) << shift
+        if not (b[0] & 0x80):
+            return result
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint overflow")
+
+
+def _read_msg(sock_file, cls):
+    size = _read_uvarint(sock_file)
+    if size > MAX_MESSAGE_SIZE:
+        raise ValueError(f"ABCI message too large: {size}")
+    body = sock_file.read(size)
+    if len(body) != size:
+        raise ConnectionError("short read on ABCI connection")
+    return cls.decode(body)
+
+
+def _parse_addr(addr: str):
+    """'unix:///path' | 'tcp://host:port' -> (family, sockaddr)."""
+    u = urlparse(addr)
+    if u.scheme == "unix":
+        return socket.AF_UNIX, (u.netloc + u.path)
+    if u.scheme == "tcp":
+        return socket.AF_INET, (u.hostname or "127.0.0.1", u.port or 26658)
+    raise ValueError(f"unsupported ABCI address {addr!r} (want tcp:// or unix://)")
+
+
+class SocketServer:
+    """Serves an Application over unix/tcp
+    (ref: abci/server/socket_server.go). Requests on one connection are
+    handled strictly in order; responses are written in the same order;
+    app calls across connections serialize on one mutex, preserving the
+    reference's single-threaded app execution model."""
+
+    def __init__(self, app: Application, addr: str, logger=None):
+        self.app = app
+        self.addr = addr
+        self.logger = logger or new_logger("abci-server")
+        self._app_mtx = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._stop = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        family, sockaddr = _parse_addr(self.addr)
+        if family == socket.AF_UNIX:
+            import os
+
+            try:
+                os.unlink(sockaddr)
+            except FileNotFoundError:
+                pass
+        self._listener = socket.socket(family, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(sockaddr)
+        self._listener.listen(8)
+        if family == socket.AF_INET:
+            host, port = self._listener.getsockname()[:2]
+            self.addr = f"tcp://{host}:{port}"
+        threading.Thread(target=self._accept_loop, daemon=True, name="abci-accept").start()
+
+    @property
+    def listen_addr(self) -> str:
+        return self.addr
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            for c in self._conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True, name="abci-conn"
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) if conn.family == socket.AF_INET else None
+        rfile = conn.makefile("rb")
+        wfile = conn.makefile("wb")
+        try:
+            while not self._stop.is_set():
+                req = _read_msg(rfile, apb.RequestPB)
+                resp = self._handle(req)
+                body = resp.encode()
+                wfile.write(_encode_uvarint(len(body)) + body)
+                # flush per response: the reference only flushes on
+                # RequestFlush, but callers here block per call, so
+                # buffering would deadlock the pipelined client.
+                wfile.flush()
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def _handle(self, req: apb.RequestPB) -> apb.ResponsePB:
+        try:
+            method, dc = apb.request_from_pb(req)
+            if method == "echo":
+                return apb.response_to_pb("echo", dc)
+            if method == "flush":
+                return apb.response_to_pb("flush", None)
+            with self._app_mtx:
+                if method == "commit":
+                    res = self.app.commit()
+                else:
+                    res = getattr(self.app, method)(dc)
+            return apb.response_to_pb(method, res)
+        except Exception as e:  # noqa: BLE001 — exceptions cross the wire
+            self.logger.error("ABCI handler error", err=repr(e))
+            return apb.ResponsePB(exception=apb.ResponseExceptionPB(error=repr(e)))
+
+
+class SocketClient(Client):
+    """Engine-side client dialing an external app
+    (ref: abci/client/socket_client.go). Pipelined: writes go out under
+    a short lock, responses are matched FIFO by a reader thread."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self.addr = addr
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._wfile = None
+        self._write_lock = threading.Lock()
+        self._pending: deque = deque()  # (method, event-slot dict)
+        self._pending_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._err: Exception | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        family, sockaddr = _parse_addr(self.addr)
+        self._sock = socket.socket(family, socket.SOCK_STREAM)
+        self._sock.settimeout(self.timeout)
+        self._sock.connect(sockaddr)
+        self._sock.settimeout(None)
+        if family == socket.AF_INET:
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rfile = self._sock.makefile("rb")
+        self._wfile = self._sock.makefile("wb")
+        threading.Thread(target=self._recv_loop, daemon=True, name="abci-client-recv").start()
+        # connection sanity: echo roundtrip (ref: client handshake usage)
+        got = self._call("echo", "ping")
+        if got != "ping":
+            raise ConnectionError(f"ABCI echo mismatch: {got!r}")
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- plumbing
+
+    def _recv_loop(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                resp = _read_msg(self._rfile, apb.ResponsePB)
+                with self._pending_lock:
+                    if not self._pending:
+                        raise ConnectionError("unsolicited ABCI response")
+                    method, slot = self._pending.popleft()
+                try:
+                    kind, dc = apb.response_from_pb(resp)
+                    if kind != method:
+                        raise ConnectionError(
+                            f"ABCI response type mismatch: want {method}, got {kind}"
+                        )
+                    slot["result"] = dc
+                except Exception as e:  # ABCIRemoteError or protocol error
+                    slot["error"] = e
+                slot["event"].set()
+        except (ConnectionError, OSError, ValueError) as e:
+            self._fail_all(e)
+
+    def _fail_all(self, err: Exception) -> None:
+        self._err = err
+        with self._pending_lock:
+            pending, self._pending = list(self._pending), deque()
+        for _method, slot in pending:
+            slot["error"] = err
+            slot["event"].set()
+
+    def _call(self, method: str, req):
+        if self._err is not None:
+            raise ConnectionError(f"ABCI client failed: {self._err}")
+        pb = apb.request_to_pb(method, req)
+        body = pb.encode()
+        slot = {"event": threading.Event(), "result": None, "error": None}
+        with self._write_lock:
+            # enqueue under the write lock so queue order == wire order
+            with self._pending_lock:
+                self._pending.append((method, slot))
+            try:
+                self._wfile.write(_encode_uvarint(len(body)) + body)
+                self._wfile.flush()
+            except (OSError, ValueError) as e:
+                self._fail_all(e)
+                raise ConnectionError(str(e))
+        if not slot["event"].wait(self.timeout):
+            raise TimeoutError(f"ABCI {method} timed out after {self.timeout}s")
+        if slot["error"] is not None:
+            raise slot["error"]
+        return slot["result"]
+
+    # --------------------------------------------------------------- calls
+
+    def echo(self, message: str) -> str:
+        return self._call("echo", message)
+
+    def flush(self) -> None:
+        self._call("flush", None)
+
+    def info(self, req: abci.RequestInfo):
+        return self._call("info", req)
+
+    def query(self, req: abci.RequestQuery):
+        return self._call("query", req)
+
+    def check_tx(self, req: abci.RequestCheckTx):
+        return self._call("check_tx", req)
+
+    def init_chain(self, req: abci.RequestInitChain):
+        return self._call("init_chain", req)
+
+    def prepare_proposal(self, req: abci.RequestPrepareProposal):
+        return self._call("prepare_proposal", req)
+
+    def process_proposal(self, req: abci.RequestProcessProposal):
+        return self._call("process_proposal", req)
+
+    def extend_vote(self, req: abci.RequestExtendVote):
+        return self._call("extend_vote", req)
+
+    def verify_vote_extension(self, req: abci.RequestVerifyVoteExtension):
+        return self._call("verify_vote_extension", req)
+
+    def finalize_block(self, req: abci.RequestFinalizeBlock):
+        return self._call("finalize_block", req)
+
+    def commit(self):
+        return self._call("commit", None)
+
+    def list_snapshots(self, req: abci.RequestListSnapshots):
+        return self._call("list_snapshots", req)
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot):
+        return self._call("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req: abci.RequestLoadSnapshotChunk):
+        return self._call("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk):
+        return self._call("apply_snapshot_chunk", req)
+
+
+def serve_app(app: Application, addr: str) -> SocketServer:
+    """Convenience: start a socket server for `app` (the reference's
+    `abci-cli kvstore`-style entry; used by `python -m
+    tendermint_tpu.abci.socket`)."""
+    srv = SocketServer(app, addr)
+    srv.start()
+    return srv
+
+
+def main(argv=None) -> int:
+    """Run the builtin kvstore app as an external ABCI process:
+    python -m tendermint_tpu.abci.socket --addr tcp://127.0.0.1:26658"""
+    import argparse
+    import time
+
+    from .kvstore import KVStoreApplication
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    ap.add_argument("--snapshot-interval", type=int, default=0)
+    args = ap.parse_args(argv)
+    app = KVStoreApplication(snapshot_interval=args.snapshot_interval)
+    srv = serve_app(app, args.addr)
+    print(f"ABCI kvstore listening on {srv.listen_addr}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

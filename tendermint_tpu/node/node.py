@@ -71,7 +71,8 @@ def _make_db(config: Config, name: str):
 def _make_app(proxy_app: str):
     """ref: internal/proxy/client.go:26 ClientFactory. The builtin
     kvstore accepts a snapshot-interval suffix:
-    builtin:kvstore:snapshot=N."""
+    builtin:kvstore:snapshot=N. tcp:// and unix:// addresses dial an
+    external app over the socket ABCI transport (abci/socket.py)."""
     if proxy_app.startswith("builtin:kvstore:snapshot="):
         interval = int(proxy_app.rsplit("=", 1)[1])
         return LocalClient(KVStoreApplication(snapshot_interval=interval))
@@ -81,7 +82,13 @@ def _make_app(proxy_app: str):
         from ..abci.types import BaseApplication
 
         return LocalClient(BaseApplication())
-    raise ValueError(f"unsupported proxy_app {proxy_app!r} (socket/grpc transports TBD)")
+    if proxy_app.startswith(("tcp://", "unix://")):
+        from ..abci.socket import SocketClient
+
+        client = SocketClient(proxy_app)
+        client.start()
+        return client
+    raise ValueError(f"unsupported proxy_app {proxy_app!r}")
 
 
 class Node:
