@@ -19,7 +19,21 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _ROOT)
+
+
+def _enable_compile_cache():
+    """Persistent XLA compile cache: repeat driver runs skip the heavy
+    curve-kernel compile entirely (same setup as __graft_entry__.py)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+_enable_compile_cache()
 
 BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
 CPU_SAMPLE = 256

@@ -425,6 +425,18 @@ def _ts_ns(ts: Timestamp | None) -> int:
     return (ts.seconds or 0) * 1_000_000_000 + (ts.nanos or 0)
 
 
+def _params_pb(p) -> ConsensusParamsUpdate | None:
+    """Accept either the wire message or the internal ConsensusParams
+    dataclass (node code hands InitChain the dataclass; apps may return
+    either) and produce the proto for encoding."""
+    if p is None or isinstance(p, ConsensusParamsUpdate):
+        return p
+    to_proto = getattr(p, "to_proto_update", None)
+    if to_proto is None:
+        raise TypeError(f"cannot encode consensus params of type {type(p).__name__}")
+    return to_proto()
+
+
 def _val_to_pb(v: T.Validator) -> ValidatorPB:
     return ValidatorPB(address=v.address, power=v.power)
 
@@ -595,7 +607,7 @@ def request_to_pb(method: str, req) -> RequestPB:
     if method == "init_chain":
         return RequestPB(init_chain=RequestInitChainPB(
             time=_ts(req.time_ns), chain_id=req.chain_id,
-            consensus_params=req.consensus_params,
+            consensus_params=_params_pb(req.consensus_params),
             validators=[_vu_to_pb(v) for v in req.validators],
             app_state_bytes=req.app_state_bytes, initial_height=req.initial_height))
     if method == "query":
@@ -740,7 +752,7 @@ def response_to_pb(method: str, res) -> ResponsePB:
             last_block_app_hash=res.last_block_app_hash))
     if method == "init_chain":
         return ResponsePB(init_chain=ResponseInitChainPB(
-            consensus_params=res.consensus_params,
+            consensus_params=_params_pb(res.consensus_params),
             validators=[_vu_to_pb(v) for v in res.validators],
             app_hash=res.app_hash))
     if method == "query":
@@ -778,7 +790,7 @@ def response_to_pb(method: str, res) -> ResponsePB:
             events=[_event_to_pb(e) for e in res.events],
             tx_results=[_txres_to_pb(r) for r in res.tx_results],
             validator_updates=[_vu_to_pb(v) for v in res.validator_updates],
-            consensus_param_updates=res.consensus_param_updates,
+            consensus_param_updates=_params_pb(res.consensus_param_updates),
             app_hash=res.app_hash))
     raise ValueError(f"unknown ABCI method {method!r}")
 

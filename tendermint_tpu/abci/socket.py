@@ -72,7 +72,10 @@ def _parse_addr(addr: str):
     if u.scheme == "unix":
         return socket.AF_UNIX, (u.netloc + u.path)
     if u.scheme == "tcp":
-        return socket.AF_INET, (u.hostname or "127.0.0.1", u.port or 26658)
+        # port 0 means "bind an ephemeral port" — only a *missing* port
+        # falls back to the conventional ABCI default 26658.
+        port = u.port if u.port is not None else 26658
+        return socket.AF_INET, (u.hostname or "127.0.0.1", port)
     raise ValueError(f"unsupported ABCI address {addr!r} (want tcp:// or unix://)")
 
 

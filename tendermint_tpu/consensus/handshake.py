@@ -87,8 +87,16 @@ class Handshaker:
                     state.app_hash = ri.app_hash
                     app_hash = ri.app_hash
                 if ri.consensus_params is not None:
-                    state.consensus_params = ri.consensus_params
-                    state.version_app = ri.consensus_params.version.app_version
+                    # The wire form is a nullable-sectioned params update
+                    # (pb.ConsensusParamsUpdate from a socket app); apply
+                    # it over the current params, matching the reference
+                    # (replay.go:311 UpdateConsensusParams). In-process
+                    # apps may hand back the dataclass directly.
+                    cp = ri.consensus_params
+                    if not hasattr(cp, "hash_consensus_params"):
+                        cp = state.consensus_params.update_consensus_params(cp)
+                    state.consensus_params = cp
+                    state.version_app = cp.version.app_version
                 if ri.validators:
                     vals = validator_updates_from_abci(ri.validators)
                     state.validators = ValidatorSet.new(vals)

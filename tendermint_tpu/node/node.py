@@ -162,11 +162,15 @@ class Node:
         self.node_id = self.node_key.node_id
         from ..statesync import statesync_channel_descriptors
 
+        from ..p2p.pex import PexReactor, pex_channel_descriptor
+
         descs = (
             consensus_channel_descriptors()
             + [mempool_channel_descriptor(), evidence_channel_descriptor(), blocksync_channel_descriptor()]
             + statesync_channel_descriptors()
         )
+        if config.p2p.pex:
+            descs.append(pex_channel_descriptor())
         laddr = urlparse(config.p2p.laddr if "//" in config.p2p.laddr else "tcp://" + config.p2p.laddr)
         self.transport = TcpTransport(descs, bind_host=laddr.hostname or "0.0.0.0", bind_port=laddr.port or 0)
         persistent = []
@@ -184,9 +188,15 @@ class Node:
         for ep in persistent:
             self.peer_manager.add(ep)
         ep = self.transport.endpoint()
+        # Advertise external_address when configured — the bind address
+        # (e.g. 0.0.0.0) is not dialable by peers (ref: config.p2p
+        # ExternalAddress, config/config.go).
+        advertised = config.p2p.external_address or f"{ep.host}:{ep.port}"
+        if "://" in advertised:
+            advertised = advertised.split("://", 1)[1]
         self.node_info = NodeInfo(
             node_id=self.node_id,
-            listen_addr=f"{ep.host}:{ep.port}",
+            listen_addr=advertised,
             network=self.gen_doc.chain_id,
             moniker=config.base.moniker,
             rpc_address=config.rpc.laddr,
@@ -201,6 +211,14 @@ class Node:
         ev_ch = self.router.open_channel(evidence_channel_descriptor())
         bs_ch = self.router.open_channel(blocksync_channel_descriptor())
         ss_chs = [self.router.open_channel(d) for d in statesync_channel_descriptors()]
+
+        # ---- PEX (node/node.go:346; internal/p2p/pex/reactor.go)
+        self.pex_reactor = None
+        if config.p2p.pex:
+            pex_ch = self.router.open_channel(pex_channel_descriptor())
+            self.pex_reactor = PexReactor(
+                self.peer_manager, pex_ch, logger=self.logger.with_fields(module="pex")
+            )
 
         # ---- pools + executor (node/setup.go:142,177; node/node.go:276)
         self.mempool = TxMempool(
@@ -342,6 +360,8 @@ class Node:
         self.mempool_reactor.start()
         self.consensus_reactor.start()
         self.statesync_reactor.start()
+        if self.pex_reactor is not None:
+            self.pex_reactor.start()
         if self.config.statesync.enable and state.last_block_height == 0:
             threading.Thread(target=self._run_statesync, daemon=True, name="statesync").start()
         elif self.blocksync_reactor.block_sync:
@@ -426,6 +446,8 @@ class Node:
     def stop(self) -> None:
         if self._consensus_running.is_set():
             self.consensus.stop()
+        if self.pex_reactor is not None:
+            self.pex_reactor.stop()
         self.blocksync_reactor.stop()
         self.statesync_reactor.stop()
         self.consensus_reactor.stop()

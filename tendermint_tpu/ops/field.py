@@ -40,7 +40,6 @@ import jax.numpy as jnp
 from jax import lax
 
 LIMBS = 32
-NUM_CONV = 2 * LIMBS - 1  # 63
 
 P_INT = 2**255 - 19
 D_INT = (-121665 * pow(121666, P_INT - 2, P_INT)) % P_INT
@@ -93,15 +92,6 @@ def fe_carry(z, passes: int = 4):
     return z
 
 
-def _fold_and_carry(z):
-    """Reduce a 63-coefficient convolution: fold coefficients 32..62 back
-    with x38 (2^256 === 38 mod p), then carry-normalize."""
-    lo = z[:LIMBS]
-    hi = z[LIMBS:]
-    lo = lo.at[: LIMBS - 1].add(38 * hi)
-    return fe_carry(lo, passes=4)
-
-
 def _tree_sum(terms):
     """Balanced reduction tree — XLA schedules this orders of magnitude
     better than a serial accumulation chain, and the adds all fuse."""
@@ -120,38 +110,58 @@ def _with_batch_rank(x, rank):
 
 
 def fe_mul(x, y):
-    """Field multiplication: 63-coefficient schoolbook convolution as 32
-    shifted partial products summed in a balanced tree, reduced mod p."""
+    """Field multiplication as a pre-folded Toeplitz convolution.
+
+    z_k = sum_i x_i * Y2[k - i + 32]  with  Y2 = [38*y || y]  (length 64):
+    the slice offset folds 2^256 === 38 mod p into the operand itself, so
+    the whole product is 32 static slices of Y2, each multiplied by one
+    x-limb and summed in a balanced tree — no lax.pad, no 63-length axis,
+    every intermediate the same (32, *batch) shape. This keeps the XLA-TPU
+    graph a plain fuse-friendly elementwise pipeline (the r2 pad-based
+    formulation sent the TPU compiler into a >480 s pathological compile).
+
+    Bounds: |x_i| <= 2^10 and |y_j| <= 2^10 give per-term magnitude
+    38 * 2^20 and a 32-term sum < 1216 * 2^20 < 2^31: fits int32."""
     rank = max(x.ndim, y.ndim) - 1
     x = _with_batch_rank(x, rank)
     y = _with_batch_rank(y, rank)
     batch = jnp.broadcast_shapes(x.shape[1:], y.shape[1:])
     x = jnp.broadcast_to(x, (LIMBS,) + batch)
     y = jnp.broadcast_to(y, (LIMBS,) + batch)
-    pad_batch = [(0, 0, 0)] * len(batch)
+    y2 = jnp.concatenate([38 * y, y], axis=0)  # (64, *batch)
     terms = [
-        lax.pad(x[i][None] * y, jnp.int32(0), [(i, NUM_CONV - LIMBS - i, 0)] + pad_batch)
+        x[i][None] * lax.slice_in_dim(y2, LIMBS - i, 2 * LIMBS - i, axis=0)
         for i in range(LIMBS)
     ]
-    return _fold_and_carry(_tree_sum(terms))
+    return fe_carry(_tree_sum(terms), passes=4)
+
+
+# Symmetry mask for fe_square: term i's window position k corresponds to
+# source limb j = (k - i) mod 32 (folded when k < i). Count each unordered
+# pair once: factor 2 for j > i, 1 for the diagonal j == i, 0 for j < i
+# (those pairs are owned by term j). The merged per-coefficient weight sum
+# equals fe_mul's ordered-pair total, so the int32 bound is unchanged.
+_SQ_MASK = np.zeros((LIMBS, LIMBS, 1), np.int32)
+for _i in range(LIMBS):
+    for _k in range(LIMBS):
+        _j = (_k - _i) % LIMBS
+        _SQ_MASK[_i, _k, 0] = 0 if _j < _i else (1 if _j == _i else 2)
+del _i, _k, _j
 
 
 def fe_square(x):
-    """Squaring via the symmetric convolution: z_k = sum_{i<j} 2 x_i x_j
-    + x_{k/2}^2 — half the partial-product MACs of fe_mul. Input bound
-    |limb| <= 2^10: the doubled terms merely account for the (i,j)/(j,i)
-    pair once each, so the folded coefficient bound is the same as
-    fe_mul's: (32 + 38*31) * 2^10 * 2^10 = 1210 * 2^20 < 2^31."""
+    """Squaring via the pre-folded Toeplitz form with the symmetry mask:
+    half the multiply-accumulates of fe_mul (each unordered limb pair is
+    visited once, with a {0,1,2} constant factor folded into the window)."""
     batch = x.shape[1:]
-    xd = x + x
-    pad_batch = [(0, 0, 0)] * len(batch)
-    terms = []
-    for i in range(LIMBS):
-        # coefficient j=i contributes x_i^2 once; j>i contribute 2 x_i x_j
-        row = jnp.concatenate([x[i : i + 1], xd[i + 1 :]], axis=0)  # (32-i, ...)
-        prod = x[i][None] * row
-        terms.append(lax.pad(prod, jnp.int32(0), [(2 * i, NUM_CONV - LIMBS - i, 0)] + pad_batch))
-    return _fold_and_carry(_tree_sum(terms))
+    x = jnp.broadcast_to(x, (LIMBS,) + batch)
+    x2 = jnp.concatenate([38 * x, x], axis=0)  # folded operand
+    mask = jnp.asarray(_SQ_MASK).reshape((LIMBS, LIMBS) + (1,) * len(batch))
+    terms = [
+        x[i][None] * (mask[i] * lax.slice_in_dim(x2, LIMBS - i, 2 * LIMBS - i, axis=0))
+        for i in range(LIMBS)
+    ]
+    return fe_carry(_tree_sum(terms), passes=4)
 
 
 def fe_add(x, y):

@@ -246,6 +246,12 @@ class Router:
                 self.peer_manager.dialed(endpoint)
             else:
                 self.peer_manager.accepted(peer_id)
+                # Record the peer's self-advertised listen address so the
+                # address book (and thus PEX) can hand out a dialable
+                # endpoint for inbound peers — this is what makes a seed
+                # node useful (ref: 0.34 address-book AddOurAddress flow;
+                # NodeInfo.ListenAddr, types/node_info.go).
+                self._record_listen_addr(peer_id, peer_info.listen_addr)
         except Exception:
             if outgoing and endpoint is not None:
                 self.peer_manager.dial_failed(endpoint)
@@ -296,6 +302,23 @@ class Router:
                 if self.metrics is not None:
                     self.metrics.peers.set(len(self._peer_conns))
             self.peer_manager.disconnected(peer_id)
+
+    def _record_listen_addr(self, peer_id: str, listen_addr: str) -> None:
+        """Add an inbound peer's advertised listen address to the book."""
+        if not listen_addr:
+            return
+        try:
+            host, _, port_s = listen_addr.rpartition(":")
+            port = int(port_s)
+            # Unspecified bind hosts are not dialable; advertising them
+            # would make PEX recipients dial themselves.
+            if not host or port <= 0 or host in ("0.0.0.0", "::", "[::]"):
+                return
+            self.peer_manager.add(
+                Endpoint(protocol="mconn", host=host, port=port, node_id=peer_id)
+            )
+        except (ValueError, TypeError):
+            pass
 
     # --------------------------------------------------------------- dial
 

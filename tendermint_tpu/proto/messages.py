@@ -458,3 +458,27 @@ class Block(Message):
         Field(3, "message", "evidence", always_emit=True, msg_cls=EvidenceList),
         Field(4, "message", "last_commit", msg_cls=Commit),
     ]
+
+
+# -- p2p PEX (proto/tendermint/p2p/pex.proto) -----------------------------
+
+
+class PexAddress(Message):
+    fields = [Field(1, "string", "url")]
+
+
+class PexRequest(Message):
+    fields = []
+
+
+class PexResponse(Message):
+    fields = [Field(1, "message", "addresses", repeated=True, msg_cls=PexAddress)]
+
+
+class PexMessage(Message):
+    """oneof sum — field numbers 1,2 reserved (spec PR #352)."""
+
+    fields = [
+        Field(3, "message", "pex_request", msg_cls=PexRequest),
+        Field(4, "message", "pex_response", msg_cls=PexResponse),
+    ]

@@ -202,6 +202,36 @@ class ConsensusParams:
             )
         return res
 
+    def to_proto_update(self) -> "pb.ConsensusParamsUpdate":
+        """Full proto image of these params, for ABCI InitChain and wire
+        transports (ref: ConsensusParams.ToProto, types/params.go:452)."""
+        return pb.ConsensusParamsUpdate(
+            block=pb.BlockParamsProto(max_bytes=self.block.max_bytes, max_gas=self.block.max_gas),
+            evidence=pb.EvidenceParamsProto(
+                max_age_num_blocks=self.evidence.max_age_num_blocks,
+                max_age_duration=pb.Duration.from_ns(self.evidence.max_age_duration),
+                max_bytes=self.evidence.max_bytes,
+            ),
+            validator=pb.ValidatorParamsProto(pub_key_types=list(self.validator.pub_key_types)),
+            version=pb.VersionParamsProto(app_version=self.version.app_version),
+            synchrony=pb.SynchronyParamsProto(
+                message_delay=pb.Duration.from_ns(self.synchrony.message_delay),
+                precision=pb.Duration.from_ns(self.synchrony.precision),
+            ),
+            timeout=pb.TimeoutParamsProto(
+                propose=pb.Duration.from_ns(self.timeout.propose),
+                propose_delta=pb.Duration.from_ns(self.timeout.propose_delta),
+                vote=pb.Duration.from_ns(self.timeout.vote),
+                vote_delta=pb.Duration.from_ns(self.timeout.vote_delta),
+                commit=pb.Duration.from_ns(self.timeout.commit),
+                bypass_commit_timeout=self.timeout.bypass_commit_timeout,
+            ),
+            abci=pb.ABCIParamsProto(
+                vote_extensions_enable_height=self.abci.vote_extensions_enable_height,
+                recheck_tx=self.abci.recheck_tx,
+            ),
+        )
+
 
 def default_consensus_params() -> ConsensusParams:
     return ConsensusParams()

@@ -1,0 +1,170 @@
+"""PEX reactor + seed node tests (ref: internal/p2p/pex/reactor_test.go,
+node/seed.go)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from test_consensus import fast_params
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.node.seed import SeedNode
+from tendermint_tpu.p2p.pex import (
+    MAX_ADDRESSES,
+    PexReactor,
+    pex_channel_descriptor,
+)
+from tendermint_tpu.p2p.peermanager import PeerManager, PeerManagerOptions
+from tendermint_tpu.p2p.transport import Endpoint
+from tendermint_tpu.p2p.types import Envelope
+from tendermint_tpu.proto import messages as pb
+
+
+def _wait(cond, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+NID_A = "a" * 40
+NID_B = "b" * 40
+NID_C = "c" * 40
+
+
+class _FakeChannel:
+    """Captures outbound envelopes; test feeds inbound ones."""
+
+    def __init__(self):
+        self.sent: list[Envelope] = []
+        self.errors = []
+        self.inbox: list[Envelope] = []
+
+    def send_to(self, peer_id, message, timeout=None):
+        self.sent.append(Envelope(message=message, to=peer_id))
+        return True
+
+    def send_error(self, perr):
+        self.errors.append(perr)
+
+    def receive_one(self, timeout=None):
+        return self.inbox.pop(0) if self.inbox else None
+
+
+@pytest.fixture
+def reactor():
+    pm = PeerManager(NID_A, PeerManagerOptions(max_connected=8))
+    ch = _FakeChannel()
+    r = PexReactor(pm, ch)
+    yield r, pm, ch
+    r._stop.set()
+
+
+def test_pex_request_returns_advertised_addresses(reactor):
+    r, pm, ch = reactor
+    pm.add(Endpoint(protocol="mconn", host="10.0.0.1", port=26656, node_id=NID_C))
+    r._handle_message(NID_B, pb.PexMessage(pex_request=pb.PexRequest()))
+    assert len(ch.sent) == 1
+    resp = ch.sent[0].message.pex_response
+    urls = [a.url for a in resp.addresses]
+    assert any(NID_C in u and "10.0.0.1" in u for u in urls)
+
+
+def test_pex_request_rate_limited(reactor):
+    r, pm, ch = reactor
+    r._handle_message(NID_B, pb.PexMessage(pex_request=pb.PexRequest()))
+    with pytest.raises(ValueError, match="too soon"):
+        r._handle_message(NID_B, pb.PexMessage(pex_request=pb.PexRequest()))
+
+
+def test_pex_unsolicited_response_rejected(reactor):
+    r, pm, ch = reactor
+    msg = pb.PexMessage(pex_response=pb.PexResponse(addresses=[]))
+    with pytest.raises(ValueError, match="unsolicited"):
+        r._handle_message(NID_B, msg)
+
+
+def test_pex_response_adds_addresses(reactor):
+    r, pm, ch = reactor
+    r._requests_sent.add(NID_B)
+    url = f"mconn://{NID_C}@10.1.2.3:26656"
+    msg = pb.PexMessage(pex_response=pb.PexResponse(addresses=[pb.PexAddress(url=url)]))
+    r._handle_message(NID_B, msg)
+    assert pm.store.get(NID_C) is not None
+    # peer becomes pollable again
+    assert NID_B in r._available and NID_B not in r._requests_sent
+
+
+def test_pex_oversized_response_rejected(reactor):
+    r, pm, ch = reactor
+    r._requests_sent.add(NID_B)
+    addrs = [pb.PexAddress(url=f"mconn://{NID_C}@10.0.0.{i}:1") for i in range(MAX_ADDRESSES + 1)]
+    with pytest.raises(ValueError, match="too many"):
+        r._handle_message(NID_B, pb.PexMessage(pex_response=pb.PexResponse(addresses=addrs)))
+
+
+def test_pex_channel_descriptor_wire_roundtrip():
+    desc = pex_channel_descriptor()
+    msg = pb.PexMessage(pex_request=pb.PexRequest())
+    assert desc.decode(desc.encode(msg)).pex_request is not None
+
+
+def test_seed_bootstraps_testnet(tmp_path):
+    """4 validators, no persistent peers, only a seed address: PEX must
+    discover the full mesh and the net must reach consensus
+    (ref: node/seed.go + pex/reactor.go end-to-end)."""
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(
+        ["testnet", "--validators", "4", "--output", out, "--chain-id", "pex-chain", "--starting-port", "0"]
+    ) == 0
+    g0 = os.path.join(out, "node0", "config", "genesis.json")
+    gen_doc = GenesisDoc.from_file(g0)
+    gen_doc.consensus_params = fast_params()
+    for i in range(4):
+        gen_doc.save_as(os.path.join(out, f"node{i}", "config", "genesis.json"))
+
+    seed_cfg = load_config(os.path.join(out, "node0"))  # borrow a home dir
+    seed_cfg.base.home = str(tmp_path / "seed")
+    os.makedirs(os.path.join(seed_cfg.base.home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(seed_cfg.base.home, "data"), exist_ok=True)
+    seed_cfg.base.mode = "seed"
+    seed_cfg.base.db_backend = "memdb"
+    seed_cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    seed = SeedNode(seed_cfg, gen_doc=gen_doc)
+    seed.start()
+
+    nodes = []
+    try:
+        for i in range(4):
+            cfg = load_config(os.path.join(out, f"node{i}"))
+            cfg.p2p.laddr = "tcp://127.0.0.1:0"
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+            cfg.p2p.persistent_peers = ""  # ONLY the seed is known
+            node = Node(cfg)
+            nodes.append(node)
+        for n in nodes:
+            n.start()
+            n.peer_manager.add(seed.endpoint())
+        # PEX discovery: every node must end up connected to ≥2 others
+        # (beyond the seed), then consensus must advance.
+        assert _wait(
+            lambda: all(
+                len([p for p in n.peer_manager.peers() if p != seed.node_id]) >= 2 for n in nodes
+            ),
+            timeout=60,
+        ), f"peer counts: {[len(n.peer_manager.peers()) for n in nodes]}"
+        assert _wait(lambda: all(n.block_store.height() >= 2 for n in nodes), timeout=120), (
+            f"heights: {[n.block_store.height() for n in nodes]}"
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+        seed.stop()
