@@ -1,0 +1,44 @@
+"""Probe the real TPU: device init, then the verify kernel at escalating
+batch sizes, with wall-clock timing per phase. Run under the default axon
+env. Exits 0 only if every phase completes."""
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+import jax
+jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+t0 = time.time()
+devs = jax.devices()
+log(f"devices: {devs} ({time.time()-t0:.1f}s)")
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import verify as V
+
+sk = ref.gen_privkey(b"\x42" * 32)
+pk = sk[32:]
+
+for batch in (8, 256, int(os.environ.get("PROBE_MAX_BATCH", "8192"))):
+    msgs = [b"probe-%d" % i for i in range(batch)]
+    sigs = [ref.sign(sk, m) for m in msgs]
+    t0 = time.time()
+    ok = V.verify_batch([pk] * batch, msgs, sigs)
+    t_compile = time.time() - t0
+    assert ok.all(), f"batch {batch}: valid sigs rejected"
+    t0 = time.time()
+    iters = 3
+    for _ in range(iters):
+        ok = V.verify_batch([pk] * batch, msgs, sigs)
+    dt = (time.time() - t0) / iters
+    log(f"batch {batch}: first call {t_compile:.1f}s, steady {dt*1000:.1f}ms -> {batch/dt:.0f} sigs/s")
+
+print(json.dumps({"ok": True}))

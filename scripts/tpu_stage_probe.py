@@ -1,0 +1,73 @@
+"""Stage-by-stage TPU compile probe for the verify kernel.
+
+Compiles and times each pipeline stage separately so a pathological
+XLA compile is attributable: field mul -> square chain -> pow_p58 ->
+decompress -> ladder windows -> full kernel. Run under the axon env.
+"""
+
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+B = int(os.environ.get("PROBE_BATCH", "256"))
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+t0 = time.time()
+log(f"devices: {jax.devices()} ({time.time()-t0:.1f}s)")
+
+from tendermint_tpu.ops import curve as C
+from tendermint_tpu.ops import field as F
+
+rng = np.random.RandomState(7)
+x = jnp.asarray(rng.randint(0, 256, size=(32, B), dtype=np.int32))
+y = jnp.asarray(rng.randint(0, 256, size=(32, B), dtype=np.int32))
+
+
+def stage(name, fn, *args):
+    t0 = time.time()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    t_compile = time.time() - t0
+    t0 = time.time()
+    for _ in range(5):
+        out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    log(f"{name:<24} compile+1st {t_compile:7.2f}s   steady {(time.time()-t0)/5*1000:8.2f}ms")
+    return out
+
+
+stage("fe_mul", F.fe_mul, x, y)
+stage("fe_square", F.fe_square, x)
+stage("square_chain_16", lambda v: __import__("jax").lax.fori_loop(0, 16, lambda _, a: F.fe_square(a), v), x)
+stage("fe_pow_p58", F.fe_pow_p58, x)
+stage("fe_canonical", F.fe_canonical, x)
+stage("decompress", lambda e: C.decompress(e)[0], x)
+
+s = jnp.asarray(rng.randint(0, 256, size=(32, B), dtype=np.int32))
+k = jnp.asarray(rng.randint(0, 256, size=(32, B), dtype=np.int32))
+pt = C.identity_point((B,)) + 0 * x[None]
+
+stage("build_var_table", C._build_var_table, pt)
+stage("var_base_mul", C.variable_base_mul, s, pt)
+stage("dbl_scalar_mul_base", C.double_scalar_mul_base, s, k, pt)
+
+from tendermint_tpu.ops import verify as V
+
+a_enc = jnp.asarray(rng.randint(0, 256, size=(B, 32), dtype=np.int32))
+stage("verify_kernel(all)", V.verify_kernel_impl, a_enc, a_enc, a_enc, a_enc)
+log("ALL STAGES DONE")

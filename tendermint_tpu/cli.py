@@ -262,6 +262,16 @@ def cmd_light(args) -> int:
         print(f"trusting current head: height {lb.height} hash {opts.hash.hex().upper()}")
     client = LightClient(args.chain_id, opts, primary, witnesses=witnesses)
     print(f"light client tracking {args.primary} (chain {args.chain_id})")
+    proxy = None
+    if getattr(args, "laddr", None):
+        from .light.proxy import LightProxy
+        from urllib.parse import urlparse as _up
+
+        u = _up(args.laddr if "//" in args.laddr else "tcp://" + args.laddr)
+        proxy = LightProxy(client, args.primary, host=u.hostname or "127.0.0.1", port=u.port or 8888)
+        proxy.start()
+        host, port = proxy.address
+        print(f"verifying RPC proxy listening on http://{host}:{port}")
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     while not stop:
@@ -271,6 +281,167 @@ def cmd_light(args) -> int:
         except Exception as e:
             print(f"update error: {e}")
         time.sleep(args.interval)
+    if proxy is not None:
+        proxy.stop()
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """`debug kill|dump` — capture a node's observable state into a zip
+    (ref: cmd/tendermint/commands/debug/{kill,dump}.go)."""
+    import io
+    import json as _json
+    import zipfile
+
+    from .config import load_config
+    from .rpc.client import HTTPClient
+
+    cfg = load_config(args.home)
+
+    def capture(zf: zipfile.ZipFile, tag: str) -> None:
+        client = HTTPClient(args.rpc_laddr, timeout=5.0)
+        for route in ("status", "consensus_state", "dump_consensus_state", "net_info",
+                      "num_unconfirmed_txs"):
+            try:
+                res = client.call(route)
+            except Exception as e:
+                res = {"error": str(e)}
+            zf.writestr(f"{tag}/{route}.json", _json.dumps(res, indent=2, default=str))
+        # WAL + config copies (ref: debug/util.go copyWAL/copyConfig)
+        wal_path = cfg.wal_file
+        if os.path.exists(wal_path):
+            with open(wal_path, "rb") as f:
+                zf.writestr(f"{tag}/cs.wal", f.read())
+        conf_path = os.path.join(args.home, "config", "config.toml")
+        if os.path.exists(conf_path):
+            zf.writestr(f"{tag}/config.toml", open(conf_path).read())
+
+    out = args.output or f"tendermint-debug-{int(time.time())}.zip"
+    if args.debug_command == "dump":
+        count = max(1, args.count)
+        with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as zf:
+            for i in range(count):
+                capture(zf, f"dump-{i:03d}")
+                if i + 1 < count:
+                    time.sleep(args.interval)
+        print(f"wrote {count} dump(s) to {out}")
+        return 0
+    # kill: capture once, then SIGABRT the process
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as zf:
+        capture(zf, "kill")
+    print(f"wrote state capture to {out}")
+    if args.pid:
+        os.kill(args.pid, signal.SIGABRT)
+        print(f"sent SIGABRT to pid {args.pid}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Re-sync the app from the block store by replaying every block
+    through ABCI (ref: `tendermint replay`, internal/consensus/replay_file.go
+    — ours replays committed blocks rather than the WAL tail)."""
+    from .config import load_config
+    from .consensus import Handshaker
+    from .node.node import _make_app, _make_db
+    from .state import StateStore, make_genesis_state
+    from .store.blockstore import BlockStore
+    from .types.genesis import GenesisDoc
+
+    cfg = load_config(args.home)
+    gen_doc = GenesisDoc.from_file(cfg.genesis_file)
+    state_store = StateStore(_make_db(cfg, "state"))
+    block_store = BlockStore(_make_db(cfg, "blockstore"))
+    state = state_store.load() or make_genesis_state(gen_doc)
+    app = _make_app(args.app or cfg.base.proxy_app)
+    hs = Handshaker(state_store, state, block_store, gen_doc)
+    final = hs.handshake(app)
+    print(
+        f"replayed to height {final.last_block_height} "
+        f"(app hash {final.app_hash.hex().upper()[:16]}) over {block_store.height()} stored blocks"
+    )
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """Rebuild the tx/block event index from stored FinalizeBlock
+    responses (ref: commands/reindex_event.go)."""
+    from .config import load_config
+    from .indexer import KVIndexer
+    from .node.node import _make_db
+    from .state import StateStore
+    from .store.blockstore import BlockStore
+
+    cfg = load_config(args.home)
+    state_store = StateStore(_make_db(cfg, "state"))
+    block_store = BlockStore(_make_db(cfg, "blockstore"))
+    indexer = KVIndexer(_make_db(cfg, "tx_index"))
+    start = args.start_height or block_store.base() or 1
+    end = args.end_height or block_store.height()
+    n = 0
+    for h in range(start, end + 1):
+        blk = block_store.load_block(h)
+        f_res = state_store.load_finalize_block_responses(h)
+        if blk is None or f_res is None:
+            continue
+        indexer.index_block_events(h, f_res)
+        indexer.index_tx_events(h, list(blk.txs), list(f_res.tx_results or []))
+        n += 1
+    print(f"reindexed events for {n} blocks in [{start}, {end}]")
+    return 0
+
+
+def cmd_compact(args) -> int:
+    """Compact the append-only FileDB logs (ref: commands/compact.go —
+    goleveldb compaction there, log rewrite here)."""
+    from .config import load_config
+    from .store.kv import FileDB
+
+    cfg = load_config(args.home)
+    total = 0
+    if not os.path.isdir(cfg.db_dir):
+        print(f"no data dir at {cfg.db_dir}")
+        return 1
+    for name in sorted(os.listdir(cfg.db_dir)):
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(cfg.db_dir, name)
+        db = FileDB(path)
+        freed = db.compact()
+        db.close()
+        total += freed
+        print(f"compacted {name}: reclaimed {freed} bytes")
+    print(f"total reclaimed: {total} bytes")
+    return 0
+
+
+def cmd_e2e(args) -> int:
+    """Run a manifest-driven multi-process e2e testnet
+    (ref: test/e2e/runner/main.go)."""
+    from .e2e.runner import run_manifest
+
+    out = args.output or os.path.join(args.home, "e2e-net")
+    run_manifest(args.manifest, out, duration=args.duration)
+    return 0
+
+
+def cmd_remote_signer(args) -> int:
+    """Run a standalone remote signer that dials a validator's privval
+    listen address (ref: the reference ships this as the external
+    tmkms-style process; endpoints at privval/signer_server.go)."""
+    from .config import load_config
+    from .privval import FilePV
+    from .privval.remote import SignerServer
+
+    cfg = load_config(args.home)
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    server = SignerServer(args.addr, pv, args.chain_id)
+    server.start()
+    print(f"remote signer for {pv.get_pub_key().address().hex().upper()} dialing {args.addr}")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    server.stop()
     return 0
 
 
@@ -308,6 +479,37 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("rollback", help="rewind state one height").set_defaults(fn=cmd_rollback)
     sub.add_parser("inspect", help="read-only RPC over node data").set_defaults(fn=cmd_inspect)
 
+    sp = sub.add_parser("e2e", help="run a manifest-driven multi-process e2e testnet")
+    sp.add_argument("manifest", help="path to a TOML manifest (see e2e/manifest.py)")
+    sp.add_argument("--output", default="", help="testnet working directory")
+    sp.add_argument("--duration", type=float, default=15.0, help="load duration seconds")
+    sp.set_defaults(fn=cmd_e2e)
+
+    sp = sub.add_parser("debug", help="capture a running node's state (kill|dump)")
+    sp.add_argument("debug_command", choices=["kill", "dump"])
+    sp.add_argument("--rpc-laddr", default="http://127.0.0.1:26657")
+    sp.add_argument("--output", default="", help="output zip path")
+    sp.add_argument("--pid", type=int, default=0, help="(kill) process to SIGABRT after capture")
+    sp.add_argument("--interval", type=float, default=2.0, help="(dump) seconds between dumps")
+    sp.add_argument("--count", type=int, default=1, help="(dump) number of dumps")
+    sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("replay", help="re-sync the app by replaying stored blocks over ABCI")
+    sp.add_argument("--app", default="", help="override proxy_app (e.g. builtin:kvstore)")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("reindex-event", help="rebuild the tx/block event index from stored blocks")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
+
+    sub.add_parser("compact", help="compact the node's append-only databases").set_defaults(fn=cmd_compact)
+
+    sp = sub.add_parser("remote-signer", help="run an external signer dialing a validator")
+    sp.add_argument("--addr", required=True, help="validator privval listen address (tcp:// or unix://)")
+    sp.add_argument("--chain-id", required=True)
+    sp.set_defaults(fn=cmd_remote_signer)
+
     sp = sub.add_parser("light", help="run a verifying light client against a primary")
     sp.add_argument("chain_id")
     sp.add_argument("primary", help="primary RPC address (http://host:port)")
@@ -316,6 +518,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--trusted-hash", default="")
     sp.add_argument("--trusting-period", type=float, default=168 * 3600)
     sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888",
+                    help="serve a verifying RPC proxy here (ref: light/proxy)")
     sp.set_defaults(fn=cmd_light)
 
     return p

@@ -41,6 +41,9 @@ class BaseConfig:
     genesis_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_GENESIS_FILE)
     priv_validator_key_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_PRIVVAL_KEY)
     priv_validator_state_file: str = os.path.join(DEFAULT_DATA_DIR, DEFAULT_PRIVVAL_STATE)
+    # When set, the node listens here and an external remote signer dials
+    # in (ref: config.PrivValidator.ListenAddr, config/config.go:354).
+    priv_validator_laddr: str = ""
     node_key_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_NODE_KEY)
 
 
@@ -66,6 +69,9 @@ class P2PConfig:
     max_incoming_connection_attempts: int = 100
     pex: bool = True
     private_peer_ids: str = ""
+    # per-connection flow control, bytes/sec (ref: conn/connection.go:45-46)
+    send_rate: int = 512000
+    recv_rate: int = 512000
 
 
 @dataclass

@@ -17,7 +17,6 @@ proposal/parts/votes costs duplicates at worst.
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 
@@ -52,124 +51,102 @@ from .round_state import STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PROP
 
 # ------------------------------------------------------------------ codecs
 #
-# Wire format: 1 tag byte + payload. Data-plane payloads are proto
-# (byte-identical with the canonical types); control payloads are JSON
-# (framework-internal, hex for bytes).
+# Wire format: the reference's `tendermint.consensus.Message` proto oneof
+# (proto/tendermint/consensus/types.proto) — byte-compatible field
+# numbers end to end; no framework-internal encodings remain.
 
 
-def _psh_to_wire(h: PartSetHeader | None) -> dict:
-    h = h or PartSetHeader()
-    return {"total": h.total, "hash": h.hash.hex()}
-
-
-def _psh_from_wire(d: dict) -> PartSetHeader:
-    return PartSetHeader(total=d["total"], hash=bytes.fromhex(d["hash"]))
-
-
-def _bid_to_wire(b: BlockID) -> dict:
-    return {"hash": b.hash.hex(), "psh": _psh_to_wire(b.part_set_header)}
-
-
-def _bid_from_wire(d: dict) -> BlockID:
-    return BlockID(hash=bytes.fromhex(d["hash"]), part_set_header=_psh_from_wire(d["psh"]))
-
-
-def _ba_to_wire(ba: BitArray | None) -> dict | None:
+def _ba_to_proto(ba: BitArray | None) -> pb.BitArrayProto | None:
     if ba is None:
         return None
-    return {"bits": ba.bits, "elems": ba.to_bytes().hex()}
+    raw = ba.to_bytes()
+    raw += b"\x00" * (-len(raw) % 8)  # pad to whole uint64 words
+    elems = [int.from_bytes(raw[i : i + 8], "little") for i in range(0, len(raw), 8)]
+    return pb.BitArrayProto(bits=ba.bits, elems=elems)
 
 
-def _ba_from_wire(d: dict | None) -> BitArray | None:
-    if d is None:
+def _ba_from_proto(p: pb.BitArrayProto | None) -> BitArray | None:
+    if p is None:
         return None
-    return BitArray.from_bytes(d["bits"], bytes.fromhex(d["elems"]))
+    bits = p.bits or 0
+    raw = b"".join(int(w).to_bytes(8, "little") for w in (p.elems or []))
+    return BitArray.from_bytes(bits, raw[: (bits + 7) // 8])
 
 
 def encode_consensus_msg(msg) -> bytes:
     """ref: internal/consensus/msgs.go MsgToProto."""
     if isinstance(msg, NewRoundStepMessage):
-        return b"\x01" + json.dumps(
-            {
-                "h": msg.height,
-                "r": msg.round,
-                "s": msg.step,
-                "t": msg.seconds_since_start_time,
-                "lcr": msg.last_commit_round,
-            }
-        ).encode()
-    if isinstance(msg, NewValidBlockMessage):
-        return b"\x02" + json.dumps(
-            {
-                "h": msg.height,
-                "r": msg.round,
-                "psh": _psh_to_wire(msg.block_part_set_header),
-                "parts": _ba_to_wire(msg.block_parts),
-                "commit": msg.is_commit,
-            }
-        ).encode()
-    if isinstance(msg, ProposalMessage):
-        return b"\x03" + msg.proposal.to_proto().encode()
-    if isinstance(msg, ProposalPOLMessage):
-        return b"\x04" + json.dumps(
-            {"h": msg.height, "pr": msg.proposal_pol_round, "pol": _ba_to_wire(msg.proposal_pol)}
-        ).encode()
-    if isinstance(msg, BlockPartMessage):
-        inner = msg.part.to_proto().encode()
-        return b"\x05" + msg.height.to_bytes(8, "big") + msg.round.to_bytes(4, "big") + inner
-    if isinstance(msg, VoteMessage):
-        return b"\x06" + msg.vote.to_proto().encode()
-    if isinstance(msg, HasVoteMessage):
-        return b"\x07" + json.dumps({"h": msg.height, "r": msg.round, "t": msg.type, "i": msg.index}).encode()
-    if isinstance(msg, VoteSetMaj23Message):
-        return b"\x08" + json.dumps(
-            {"h": msg.height, "r": msg.round, "t": msg.type, "bid": _bid_to_wire(msg.block_id)}
-        ).encode()
-    if isinstance(msg, VoteSetBitsMessage):
-        return b"\x09" + json.dumps(
-            {
-                "h": msg.height,
-                "r": msg.round,
-                "t": msg.type,
-                "bid": _bid_to_wire(msg.block_id),
-                "votes": _ba_to_wire(msg.votes),
-            }
-        ).encode()
-    raise TypeError(f"unknown consensus message {type(msg)}")
+        wrapped = pb.ConsensusMessage(new_round_step=pb.CsNewRoundStep(
+            height=msg.height, round=msg.round, step=msg.step,
+            seconds_since_start_time=msg.seconds_since_start_time,
+            last_commit_round=msg.last_commit_round))
+    elif isinstance(msg, NewValidBlockMessage):
+        wrapped = pb.ConsensusMessage(new_valid_block=pb.CsNewValidBlock(
+            height=msg.height, round=msg.round,
+            block_part_set_header=(msg.block_part_set_header or PartSetHeader()).to_proto(),
+            block_parts=_ba_to_proto(msg.block_parts), is_commit=msg.is_commit))
+    elif isinstance(msg, ProposalMessage):
+        wrapped = pb.ConsensusMessage(proposal=pb.CsProposal(proposal=msg.proposal.to_proto()))
+    elif isinstance(msg, ProposalPOLMessage):
+        wrapped = pb.ConsensusMessage(proposal_pol=pb.CsProposalPOL(
+            height=msg.height, proposal_pol_round=msg.proposal_pol_round,
+            proposal_pol=_ba_to_proto(msg.proposal_pol)))
+    elif isinstance(msg, BlockPartMessage):
+        wrapped = pb.ConsensusMessage(block_part=pb.CsBlockPart(
+            height=msg.height, round=msg.round, part=msg.part.to_proto()))
+    elif isinstance(msg, VoteMessage):
+        wrapped = pb.ConsensusMessage(vote=pb.CsVote(vote=msg.vote.to_proto()))
+    elif isinstance(msg, HasVoteMessage):
+        wrapped = pb.ConsensusMessage(has_vote=pb.CsHasVote(
+            height=msg.height, round=msg.round, type=msg.type, index=msg.index))
+    elif isinstance(msg, VoteSetMaj23Message):
+        wrapped = pb.ConsensusMessage(vote_set_maj23=pb.CsVoteSetMaj23(
+            height=msg.height, round=msg.round, type=msg.type,
+            block_id=msg.block_id.to_proto()))
+    elif isinstance(msg, VoteSetBitsMessage):
+        wrapped = pb.ConsensusMessage(vote_set_bits=pb.CsVoteSetBits(
+            height=msg.height, round=msg.round, type=msg.type,
+            block_id=msg.block_id.to_proto(), votes=_ba_to_proto(msg.votes)))
+    else:
+        raise TypeError(f"unknown consensus message {type(msg)}")
+    return wrapped.encode()
 
 
 def decode_consensus_msg(data: bytes):
     """ref: internal/consensus/msgs.go MsgFromProto."""
-    tag, body = data[0], data[1:]
-    if tag == 0x01:
-        d = json.loads(body)
-        return NewRoundStepMessage(d["h"], d["r"], d["s"], d["t"], d["lcr"])
-    if tag == 0x02:
-        d = json.loads(body)
+    w = pb.ConsensusMessage.decode(data)
+    if w.new_round_step is not None:
+        p = w.new_round_step
+        return NewRoundStepMessage(p.height or 0, p.round or 0, p.step or 0,
+                                   p.seconds_since_start_time or 0, p.last_commit_round or 0)
+    if w.new_valid_block is not None:
+        p = w.new_valid_block
         return NewValidBlockMessage(
-            d["h"], d["r"], _psh_from_wire(d["psh"]), _ba_from_wire(d["parts"]), d["commit"]
-        )
-    if tag == 0x03:
-        return ProposalMessage(Proposal.from_proto(pb.Proposal.decode(body)))
-    if tag == 0x04:
-        d = json.loads(body)
-        return ProposalPOLMessage(d["h"], d["pr"], _ba_from_wire(d["pol"]))
-    if tag == 0x05:
-        height = int.from_bytes(body[:8], "big")
-        round_ = int.from_bytes(body[8:12], "big")
-        return BlockPartMessage(height, round_, Part.from_proto(pb.Part.decode(body[12:])))
-    if tag == 0x06:
-        return VoteMessage(Vote.from_proto(pb.Vote.decode(body)))
-    if tag == 0x07:
-        d = json.loads(body)
-        return HasVoteMessage(d["h"], d["r"], d["t"], d["i"])
-    if tag == 0x08:
-        d = json.loads(body)
-        return VoteSetMaj23Message(d["h"], d["r"], d["t"], _bid_from_wire(d["bid"]))
-    if tag == 0x09:
-        d = json.loads(body)
-        return VoteSetBitsMessage(d["h"], d["r"], d["t"], _bid_from_wire(d["bid"]), _ba_from_wire(d["votes"]))
-    raise ValueError(f"unknown consensus message tag {tag}")
+            p.height or 0, p.round or 0, PartSetHeader.from_proto(p.block_part_set_header),
+            _ba_from_proto(p.block_parts), bool(p.is_commit))
+    if w.proposal is not None:
+        return ProposalMessage(Proposal.from_proto(w.proposal.proposal))
+    if w.proposal_pol is not None:
+        p = w.proposal_pol
+        return ProposalPOLMessage(p.height or 0, p.proposal_pol_round or 0,
+                                  _ba_from_proto(p.proposal_pol))
+    if w.block_part is not None:
+        p = w.block_part
+        return BlockPartMessage(p.height or 0, p.round or 0, Part.from_proto(p.part))
+    if w.vote is not None:
+        return VoteMessage(Vote.from_proto(w.vote.vote))
+    if w.has_vote is not None:
+        p = w.has_vote
+        return HasVoteMessage(p.height or 0, p.round or 0, p.type or 0, p.index or 0)
+    if w.vote_set_maj23 is not None:
+        p = w.vote_set_maj23
+        return VoteSetMaj23Message(p.height or 0, p.round or 0, p.type or 0,
+                                   BlockID.from_proto(p.block_id))
+    if w.vote_set_bits is not None:
+        p = w.vote_set_bits
+        return VoteSetBitsMessage(p.height or 0, p.round or 0, p.type or 0,
+                                  BlockID.from_proto(p.block_id), _ba_from_proto(p.votes))
+    raise ValueError("empty consensus message")
 
 
 def consensus_channel_descriptors() -> list[ChannelDescriptor]:

@@ -89,8 +89,16 @@ class ConsensusState:
         metrics=None,
         logger=None,
         on_fatal: Callable | None = None,
+        wait_for_txs: bool = False,
+        create_empty_blocks_interval: float = 0.0,
+        mempool=None,
     ):
         from ..utils.log import new_logger
+
+        # create_empty_blocks=false plumbing (ref: config.WaitForTxs)
+        self.wait_for_txs = wait_for_txs
+        self.create_empty_blocks_interval = create_empty_blocks_interval
+        self.mempool = mempool
 
         self.block_exec = block_executor
         self.block_store = block_store
@@ -178,9 +186,22 @@ class ConsensusState:
         self._queue.put(ti)
 
     def handle_txs_available(self) -> None:
-        """Mempool signal (ref: handleTxsAvailable state.go:1143).
-        With create-empty-blocks default-on, proposals don't wait for
-        txs, so this is a no-op wake."""
+        """Mempool signal (ref: handleTxsAvailable state.go:1143): with
+        create_empty_blocks=false, the waiting round 0 proceeds to
+        propose as soon as the mempool has txs. Enqueued to the consumer
+        thread like every other input."""
+        self._queue.put(("txs_available",))
+
+    def _handle_txs_available(self) -> None:
+        rs = self.rs
+        if not self.wait_for_txs:
+            return
+        if rs.step == STEP_NEW_HEIGHT:
+            # still in the commit timeout: shorten it (state.go:1150)
+            remaining = (rs.start_time.unix_ns() - self.now().unix_ns()) / 1e9
+            self._schedule_timeout(max(remaining, 0.0) + 1e-3, rs.height, 0, STEP_NEW_HEIGHT)
+        elif rs.step == STEP_NEW_ROUND:
+            self._enter_propose(rs.height, 0)
 
     # -------------------------------------------------------- the routine
 
@@ -211,7 +232,9 @@ class ConsensusState:
 
     def _dispatch(self, item) -> None:
         # Internal messages drain first (they carry our own votes).
-        if isinstance(item, tuple) and item and item[0] == "internal":
+        if isinstance(item, tuple) and item and item[0] == "txs_available":
+            self._handle_txs_available()
+        elif isinstance(item, tuple) and item and item[0] == "internal":
             try:
                 mi = self._internal_queue.get_nowait()
             except queue.Empty:
@@ -238,16 +261,35 @@ class ConsensusState:
             self._dispatch(item)
 
     def _handle_msg(self, mi: MsgInfo) -> None:
-        """ref: handleMsg (state.go:994)."""
+        """ref: handleMsg (state.go:994). Per-message validation failures
+        are logged, never fatal — a malformed or stale proposal/part must
+        not kill the node (the reference logs 'failed to process message'
+        and keeps going, state.go:1032-1086). This includes our OWN parts
+        from the internal queue: after a round race, a proposer's queued
+        parts can mismatch a newer accepted proposal's part-set header —
+        stale data, not corruption. Invariant breaks in the step
+        functions (ConsensusError) stay fatal."""
         msg, peer_id = mi.msg, mi.peer_id
-        if isinstance(msg, ProposalMessage):
-            self._set_proposal(msg.proposal, self.now())
-        elif isinstance(msg, BlockPartMessage):
-            added = self._add_proposal_block_part(msg)
-            if added and self.rs.proposal_block_parts.is_complete():
-                self._handle_complete_proposal(msg.height)
-        elif isinstance(msg, VoteMessage):
-            self._try_add_vote(msg.vote, peer_id)
+        added = False
+        try:
+            if isinstance(msg, ProposalMessage):
+                self._set_proposal(msg.proposal, self.now())
+            elif isinstance(msg, BlockPartMessage):
+                added = self._add_proposal_block_part(msg)
+            elif isinstance(msg, VoteMessage):
+                self._try_add_vote(msg.vote, peer_id)
+        except (ValueError, KeyError) as e:
+            self.logger.error(
+                "failed to process message",
+                peer=peer_id or "internal", msg_type=type(msg).__name__, err=str(e),
+                height=self.rs.height, round=self.rs.round,
+            )
+            return
+        # The complete-proposal path can drive prevote → commit; errors in
+        # THERE are invariant breaks and must stay fatal (the reference
+        # panics inside finalizeCommit), so it runs outside the catch.
+        if added and self.rs.proposal_block_parts.is_complete():
+            self._handle_complete_proposal(msg.height)
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         """ref: handleTimeout (state.go:1089)."""
@@ -407,7 +449,25 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_ + 1)  # track next round for skipping
         rs.triggered_timeout_precommit = False
+
+        # create_empty_blocks=false: round 0 waits for txs unless a proof
+        # block is needed (ref: enterNewRound state.go:1230 waitForTxs)
+        if self.wait_for_txs and round_ == 0 and not self._need_proof_block(height):
+            if self.mempool is not None and not self.mempool.has_txs():
+                if self.create_empty_blocks_interval > 0:
+                    self._schedule_timeout(
+                        self.create_empty_blocks_interval, height, round_, STEP_NEW_ROUND
+                    )
+                return  # handle_txs_available (or the interval) proceeds
         self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """First block, or the app hash changed — a block must be made to
+        prove the new state (ref: needProofBlock state.go:1259)."""
+        if height == self.state.initial_height:
+            return True
+        last = self.block_store.load_block_meta(height - 1)
+        return last is not None and last.header.app_hash != self.state.app_hash
 
     def _is_proposer(self, address: bytes) -> bool:
         proposer = self.rs.validators.get_proposer()
@@ -783,7 +843,7 @@ class ConsensusState:
                 # conflicting vote from ourselves — unsafe reset?
                 return False
             if self.evpool is not None:
-                self.evpool.report_conflicting_votes(e.conflicting, e.new)
+                self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
             return False
         except ValueError:
             # VoteSet.add_vote rejection (invalid index/address/signature)

@@ -162,6 +162,8 @@ class WAL:
 
     def flush_and_sync(self) -> None:
         with self._lock:
+            if self._f.closed:
+                return  # already closed by a fatal-halt teardown
             self._f.flush()
             os.fsync(self._f.fileno())
 

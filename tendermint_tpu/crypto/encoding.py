@@ -5,11 +5,14 @@ from __future__ import annotations
 from ..proto import messages as pb
 from . import PubKey
 from .ed25519 import Ed25519PubKey
+from .secp256k1 import Secp256k1PubKey
 
 
 def pubkey_to_proto(pk: PubKey) -> pb.PublicKey:
     if pk.type_name == "ed25519":
         return pb.PublicKey(ed25519=pk.bytes())
+    if pk.type_name == "secp256k1":
+        return pb.PublicKey(secp256k1=pk.bytes())
     raise ValueError(f"unsupported key type {pk.type_name}")
 
 
@@ -17,4 +20,6 @@ def pubkey_from_proto(p: pb.PublicKey) -> PubKey:
     name, data = p.sum
     if name == "ed25519":
         return Ed25519PubKey(data)
+    if name == "secp256k1":
+        return Secp256k1PubKey(data)
     raise ValueError(f"unsupported proto pubkey arm {name!r}")

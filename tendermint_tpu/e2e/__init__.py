@@ -1,0 +1,12 @@
+"""End-to-end testnet harness (ref: test/e2e/).
+
+Manifest-driven multi-PROCESS testnets: each node is a separate OS
+process running `python -m tendermint_tpu start`, with load injection,
+perturbations (kill / pause / restart / disconnect), convergence
+checks, and block-cadence benchmarking over RPC.
+"""
+
+from .manifest import Manifest, NodeManifest
+from .runner import Runner
+
+__all__ = ["Manifest", "NodeManifest", "Runner"]

@@ -1,0 +1,31 @@
+"""Standalone test app process for e2e testnets: the kvstore served over
+socket ABCI (ref: test/e2e/node/main.go + test/e2e/app/).
+
+Usage: python -m tendermint_tpu.e2e.app tcp://127.0.0.1:PORT
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ..abci.kvstore import KVStoreApplication
+from ..abci.socket import SocketServer
+
+
+def main() -> int:
+    addr = sys.argv[1] if len(sys.argv) > 1 else "tcp://127.0.0.1:26658"
+    server = SocketServer(KVStoreApplication(), addr)
+    server.start()
+    print(f"e2e kvstore app listening on {addr}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Testnet manifests (ref: test/e2e/pkg/manifest.go:12-87).
+
+A manifest describes the testnet: per-node mode, ABCI protocol,
+perturbations, and the load profile. TOML format mirroring the
+reference's:
+
+    chain_id = "e2e-net"
+    load_tx_rate = 20
+
+    [node.validator01]
+    perturb = ["kill", "pause"]
+
+    [node.validator02]
+
+    [node.full01]
+    mode = "full"
+    abci_protocol = "tcp"
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeManifest:
+    """ref: manifest.go ManifestNode."""
+
+    name: str
+    mode: str = "validator"  # validator | full | seed
+    abci_protocol: str = "builtin"  # builtin | tcp | unix
+    perturb: list[str] = field(default_factory=list)  # kill|pause|restart|disconnect
+    start_at: int = 0  # join later, at this height
+    send_rate: int = 5_000_000  # p2p flow-control bytes/sec for tests
+
+
+@dataclass
+class Manifest:
+    """ref: manifest.go Manifest."""
+
+    chain_id: str = "e2e-chain"
+    nodes: list[NodeManifest] = field(default_factory=list)
+    load_tx_rate: int = 10  # txs/sec injected during the run
+    initial_height: int = 1
+
+    @classmethod
+    def parse(cls, text: str) -> "Manifest":
+        doc = tomllib.loads(text)
+        m = cls(
+            chain_id=doc.get("chain_id", "e2e-chain"),
+            load_tx_rate=int(doc.get("load_tx_rate", 10)),
+            initial_height=int(doc.get("initial_height", 1)),
+        )
+        for name, nd in (doc.get("node") or {}).items():
+            m.nodes.append(
+                NodeManifest(
+                    name=name,
+                    mode=nd.get("mode", "validator"),
+                    abci_protocol=nd.get("abci_protocol", "builtin"),
+                    perturb=list(nd.get("perturb", [])),
+                    start_at=int(nd.get("start_at", 0)),
+                )
+            )
+        if not m.nodes:
+            m.nodes = [NodeManifest(name=f"validator{i:02d}") for i in range(4)]
+        return m
+
+    @property
+    def validators(self) -> list[NodeManifest]:
+        return [n for n in self.nodes if n.mode == "validator"]

@@ -1,0 +1,379 @@
+"""E2E testnet runner (ref: test/e2e/runner/main.go, perturb.go, load.go,
+benchmark.go).
+
+Spawns one OS process per node (`python -m tendermint_tpu start`),
+injects tx load, applies perturbations, waits for convergence, and
+measures block cadence — the reference's docker-compose flow collapsed
+onto one host with per-node home dirs and ports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import statistics
+import subprocess
+import sys
+import time
+
+from ..config import default_config, load_config
+from ..node import NodeKey
+from ..privval import FilePV
+from ..rpc.client import HTTPClient
+from ..types.genesis import GenesisDoc, GenesisValidator
+from ..utils.tmtime import Time
+from .manifest import Manifest, NodeManifest
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class E2ENode:
+    def __init__(self, manifest: NodeManifest, home: str, p2p_port: int, rpc_port: int, abci_port: int):
+        self.m = manifest
+        self.home = home
+        self.p2p_port = p2p_port
+        self.rpc_port = rpc_port
+        self.abci_port = abci_port
+        self.node_id = ""
+        self.proc: subprocess.Popen | None = None
+        self.app_proc: subprocess.Popen | None = None
+
+    @property
+    def rpc_url(self) -> str:
+        return f"http://127.0.0.1:{self.rpc_port}"
+
+    def client(self) -> HTTPClient:
+        return HTTPClient(self.rpc_url, timeout=5.0)
+
+    def height(self) -> int:
+        try:
+            return int(self.client().call("status")["sync_info"]["latest_block_height"])
+        except Exception:
+            return -1
+
+
+class Runner:
+    """ref: test/e2e/runner/main.go Cleanup/Setup/Start/Load/Perturb/
+    Wait/Test/Benchmark cycle."""
+
+    def __init__(self, manifest: Manifest, base_dir: str, logger=print):
+        self.manifest = manifest
+        self.base_dir = base_dir
+        self.log = logger
+        self.nodes: list[E2ENode] = []
+        self._load_proc_stop = False
+
+    # ----------------------------------------------------------------- setup
+
+    def setup(self) -> None:
+        """Generate homes, keys, genesis, configs (ref: runner/setup.go)."""
+        ms = self.manifest.nodes
+        ports = _free_ports(3 * len(ms))
+        pvs = {}
+        for i, nm in enumerate(ms):
+            home = os.path.join(self.base_dir, nm.name)
+            node = E2ENode(nm, home, ports[3 * i], ports[3 * i + 1], ports[3 * i + 2])
+            os.makedirs(os.path.join(home, "config"), exist_ok=True)
+            os.makedirs(os.path.join(home, "data"), exist_ok=True)
+            cfg = default_config(home)
+            pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+            node.node_id = NodeKey.load_or_gen(cfg.node_key_file).node_id
+            if nm.mode == "validator":
+                pvs[nm.name] = pv
+            self.nodes.append(node)
+
+        gen_doc = GenesisDoc(
+            chain_id=self.manifest.chain_id,
+            genesis_time=Time.now(),
+            initial_height=self.manifest.initial_height,
+            validators=[
+                GenesisValidator(
+                    address=pv.get_pub_key().address(), pub_key=pv.get_pub_key(), power=100, name=name
+                )
+                for name, pv in pvs.items()
+            ],
+        )
+        # test-speed consensus timeouts — e2e runs measure fault recovery
+        # and consistency, not production cadence (the reference's e2e
+        # manifests shorten timeouts the same way)
+        import dataclasses
+
+        from ..types.params import ConsensusParams, TimeoutParams
+
+        gen_doc.consensus_params = dataclasses.replace(
+            ConsensusParams(),
+            timeout=TimeoutParams(
+                propose=600_000_000,
+                propose_delta=200_000_000,
+                vote=300_000_000,
+                vote_delta=100_000_000,
+                commit=100_000_000,
+                bypass_commit_timeout=False,
+            ),
+        )
+
+        for node in self.nodes:
+            cfg = default_config(node.home)
+            gen_doc.save_as(cfg.genesis_file)
+            cfg.base.moniker = node.m.name
+            cfg.base.mode = node.m.mode
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{node.p2p_port}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{node.rpc_port}"
+            cfg.p2p.send_rate = node.m.send_rate
+            peers = [
+                f"{o.node_id}@127.0.0.1:{o.p2p_port}" for o in self.nodes if o is not node
+            ]
+            cfg.p2p.persistent_peers = ",".join(peers)
+            if node.m.abci_protocol in ("tcp", "unix"):
+                addr = (
+                    f"tcp://127.0.0.1:{node.abci_port}"
+                    if node.m.abci_protocol == "tcp"
+                    else f"unix://{node.home}/app.sock"
+                )
+                cfg.base.proxy_app = addr
+            cfg.save()
+
+    # ----------------------------------------------------------------- start
+
+    def _env(self) -> dict:
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU claims from e2e nodes
+        env["JAX_PLATFORMS"] = "cpu"
+        root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+
+    def _start_node(self, node: E2ENode) -> None:
+        if node.m.abci_protocol in ("tcp", "unix"):
+            cfg = load_config(node.home)
+            node.app_proc = subprocess.Popen(
+                [sys.executable, "-m", "tendermint_tpu.e2e.app", cfg.base.proxy_app],
+                env=self._env(),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            # the app process imports jax (seconds); the node dials the
+            # app in its constructor, so wait until the socket accepts
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    if node.m.abci_protocol == "tcp":
+                        socket.create_connection(("127.0.0.1", node.abci_port), timeout=1).close()
+                    else:
+                        s = socket.socket(socket.AF_UNIX)
+                        s.connect(f"{node.home}/app.sock")
+                        s.close()
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            else:
+                raise TimeoutError(f"{node.m.name}: ABCI app never came up")
+        log_f = open(os.path.join(node.home, "node.log"), "ab")
+        node.proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", node.home, "start"],
+            env=self._env(),
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+        log_f.close()
+
+    def start(self, timeout: float = 120.0) -> None:
+        """Start nodes in waves like the reference (runner/start.go):
+        all start_at=0 first, stragglers once the net is past their
+        start height."""
+        initial = [n for n in self.nodes if n.m.start_at == 0]
+        late = [n for n in self.nodes if n.m.start_at > 0]
+        for node in initial:
+            self._start_node(node)
+        self.wait_ready(initial, timeout=timeout)
+        for node in sorted(late, key=lambda n: n.m.start_at):
+            self.wait_for_height(node.m.start_at, nodes=initial, timeout=timeout)
+            self._start_node(node)
+        self.log(f"started {len(self.nodes)} node processes")
+
+    def wait_ready(self, nodes=None, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        pending = list(nodes or self.nodes)
+        while pending and time.monotonic() < deadline:
+            pending = [n for n in pending if n.height() < 0]
+            time.sleep(0.2)
+        if pending:
+            raise TimeoutError(f"nodes never became ready: {[n.m.name for n in pending]}")
+
+    # ------------------------------------------------------------------ load
+
+    def inject_load(self, duration: float) -> int:
+        """Round-robin kvstore txs at manifest.load_tx_rate
+        (ref: runner/load.go)."""
+        rate = max(1, self.manifest.load_tx_rate)
+        interval = 1.0 / rate
+        sent = 0
+        deadline = time.monotonic() + duration
+        i = 0
+        while time.monotonic() < deadline:
+            node = self.nodes[i % len(self.nodes)]
+            i += 1
+            try:
+                tx = f"load-{os.getpid()}-{i}={i}".encode()
+                node.client().call("broadcast_tx_async", tx=tx.hex())
+                sent += 1
+            except Exception:
+                pass
+            time.sleep(interval)
+        return sent
+
+    # ---------------------------------------------------------------- perturb
+
+    def perturb(self, node: E2ENode, kind: str) -> None:
+        """ref: runner/perturb.go:40-72 (disconnect/kill/pause/restart)."""
+        self.log(f"perturb {node.m.name}: {kind}")
+        if kind == "kill":
+            node.proc.send_signal(signal.SIGKILL)
+            node.proc.wait(timeout=10)
+            self._start_node(node)
+        elif kind == "restart":
+            node.proc.send_signal(signal.SIGTERM)
+            try:
+                node.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                node.proc.kill()
+                node.proc.wait(timeout=10)
+            self._start_node(node)
+        elif kind == "pause":
+            node.proc.send_signal(signal.SIGSTOP)
+            time.sleep(5.0)
+            node.proc.send_signal(signal.SIGCONT)
+        elif kind == "disconnect":
+            # closest host-level analog of docker network disconnect:
+            # long pause — peers drop the unresponsive connection, then
+            # the node reconnects on resume
+            node.proc.send_signal(signal.SIGSTOP)
+            time.sleep(8.0)
+            node.proc.send_signal(signal.SIGCONT)
+        else:
+            raise ValueError(f"unknown perturbation {kind!r}")
+
+    def run_perturbations(self) -> None:
+        for node in self.nodes:
+            for kind in node.m.perturb:
+                self.perturb(node, kind)
+                self.wait_progress(node, timeout=90)
+
+    # ------------------------------------------------------------------ wait
+
+    def wait_for_height(self, height: int, nodes=None, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        nodes = nodes or self.nodes
+        while time.monotonic() < deadline:
+            if all(n.height() >= height for n in nodes):
+                return
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"heights {[(n.m.name, n.height()) for n in nodes]} never reached {height}"
+        )
+
+    def wait_progress(self, node: E2ENode, timeout: float = 90.0) -> None:
+        """Node is back up and advancing."""
+        deadline = time.monotonic() + timeout
+        h0 = -1
+        while time.monotonic() < deadline:
+            h = node.height()
+            if h0 < 0 and h >= 0:
+                h0 = h
+            elif h0 >= 0 and h > h0:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"{node.m.name} not advancing after perturbation (h={node.height()})")
+
+    # ------------------------------------------------------------------ test
+
+    def check_consistency(self) -> None:
+        """All nodes agree on every committed block hash
+        (ref: test/e2e/tests/block_test.go)."""
+        heights = [n.height() for n in self.nodes if n.height() >= 0]
+        h = min(heights)
+        assert h >= 1, f"no committed blocks: {heights}"
+        for probe in range(max(1, h - 3), h + 1):
+            hashes = set()
+            for n in self.nodes:
+                try:
+                    res = n.client().call("block", height=str(probe))
+                    hashes.add(res["block_id"]["hash"])
+                except Exception:
+                    continue
+            assert len(hashes) == 1, f"divergent block {probe}: {hashes}"
+
+    def benchmark(self, blocks: int = 10) -> dict:
+        """Block cadence stats (ref: runner/benchmark.go:16-60)."""
+        client = self.nodes[0].client()
+        status = client.call("status")
+        to = int(status["sync_info"]["latest_block_height"])
+        frm = max(self.manifest.initial_height, to - blocks)
+        times = []
+        for h in range(frm, to + 1):
+            meta = client.call("block", height=str(h))
+            times.append(Time.parse_rfc3339(meta["block"]["header"]["time"]).unix_ns())
+        deltas = [(b - a) / 1e9 for a, b in zip(times, times[1:])]
+        return {
+            "blocks": len(deltas),
+            "avg_interval_s": round(statistics.mean(deltas), 4) if deltas else None,
+            "stddev_s": round(statistics.pstdev(deltas), 4) if len(deltas) > 1 else 0.0,
+            "min_s": round(min(deltas), 4) if deltas else None,
+            "max_s": round(max(deltas), 4) if deltas else None,
+        }
+
+    # ----------------------------------------------------------------- stop
+
+    def cleanup(self) -> None:
+        for node in self.nodes:
+            for proc in (node.proc, node.app_proc):
+                if proc is not None and proc.poll() is None:
+                    proc.send_signal(signal.SIGCONT)  # in case it's paused
+                    proc.terminate()
+        deadline = time.monotonic() + 10
+        for node in self.nodes:
+            for proc in (node.proc, node.app_proc):
+                if proc is None:
+                    continue
+                try:
+                    proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+def run_manifest(manifest_path: str, base_dir: str, duration: float = 10.0) -> dict:
+    """One full e2e cycle: setup → start → load+perturb → test →
+    benchmark → cleanup (ref: runner/main.go)."""
+    with open(manifest_path) as f:
+        manifest = Manifest.parse(f.read())
+    runner = Runner(manifest, base_dir)
+    runner.setup()
+    try:
+        runner.start()
+        runner.wait_for_height(2)
+        import threading
+
+        load_thread = threading.Thread(target=runner.inject_load, args=(duration,), daemon=True)
+        load_thread.start()
+        runner.run_perturbations()
+        load_thread.join(timeout=duration + 10)
+        h = max(n.height() for n in runner.nodes)
+        runner.wait_for_height(h + 2)
+        runner.check_consistency()
+        bench = runner.benchmark()
+        print(json.dumps(bench))
+        return bench
+    finally:
+        runner.cleanup()

@@ -93,8 +93,11 @@ class EventDataRoundState:
 class EventBus:
     """ref: eventbus.EventBus."""
 
-    def __init__(self):
+    def __init__(self, event_log=None):
         self.server = Server()
+        # Optional eventlog backing the polling /events RPC
+        # (ref: internal/eventlog wired at node/node.go:167)
+        self.event_log = event_log
 
     # ------------------------------------------------------------ subscribe
 
@@ -115,7 +118,13 @@ class EventBus:
         events = {TYPE_KEY: [event_value]}
         for k, v in (extra_events or {}).items():
             events.setdefault(k, []).extend(v)
+        self._publish_raw(event_value, data, events)
+
+    def _publish_raw(self, event_value: str, data: Any, events: dict[str, list[str]]) -> None:
+        """Single funnel: pubsub subscribers + the polling event log."""
         self.server.publish(data, events)
+        if self.event_log is not None:
+            self.event_log.add(event_value, data, events)
 
     def publish_event_new_block(self, block, block_id, f_res) -> None:
         """ref: event_bus.go:69 PublishEventNewBlock — indexes the
@@ -125,8 +134,10 @@ class EventBus:
             BLOCK_HEIGHT_KEY: [str(block.header.height)],
         }
         events = abci_events_to_map(getattr(f_res, "events", None), base)
-        self.server.publish(
-            EventDataNewBlock(block=block, block_id=block_id, result_finalize_block=f_res), events
+        self._publish_raw(
+            EVENT_NEW_BLOCK,
+            EventDataNewBlock(block=block, block_id=block_id, result_finalize_block=f_res),
+            events,
         )
 
     def publish_event_new_block_header(self, header, num_txs: int) -> None:
@@ -145,7 +156,9 @@ class EventBus:
             TX_HEIGHT_KEY: [str(height)],
         }
         events = abci_events_to_map(getattr(result, "events", None), base)
-        self.server.publish(EventDataTx(height=height, index=index, tx=tx, result=result), events)
+        self._publish_raw(
+            EVENT_TX, EventDataTx(height=height, index=index, tx=tx, result=result), events
+        )
 
     def publish_event_vote(self, vote) -> None:
         self.publish(EVENT_VOTE, EventDataVote(vote=vote))

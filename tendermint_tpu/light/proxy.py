@@ -1,0 +1,135 @@
+"""Light proxy — an RPC façade backed by the light client
+(ref: light/proxy/proxy.go + light/rpc/client.go).
+
+Serves the node's JSON-RPC surface locally while routing data through a
+verifying light client: header-bearing results (block, commit, header,
+validators) are checked against light-client-verified headers before
+being returned; pass-through calls (broadcast_tx*, abci_query, status)
+are forwarded to the primary untouched, with status' latest-block info
+rewritten to the verified view.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from ..rpc.client import HTTPClient
+from ..rpc.server import JSONRPCServer, RPCError
+from ..utils.log import new_logger
+
+
+class LightProxy:
+    """ref: light/proxy/proxy.go Proxy."""
+
+    def __init__(self, client, primary_addr: str, host: str = "127.0.0.1", port: int = 0, logger=None):
+        self.client = client  # LightClient
+        self.primary = HTTPClient(primary_addr)
+        self.logger = logger or new_logger("light-proxy")
+        self.server = JSONRPCServer(self._routes(), host=host, port=port)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.server.address
+
+    # ------------------------------------------------------------ helpers
+
+    def _verified_header(self, height: int):
+        """Light-verify the chain up to `height` and return the trusted
+        light block (ref: light/rpc/client.go updateLightClientIfNeededTo)."""
+        lb = self.client.verify_light_block_at_height(int(height))
+        return lb
+
+    @staticmethod
+    def _require(cond: bool, msg: str) -> None:
+        if not cond:
+            raise RPCError(-32603, f"light proxy verification failed: {msg}")
+
+    # ------------------------------------------------------------ routes
+
+    def _routes(self) -> dict:
+        def status():
+            res = self.primary.call("status")
+            head = self.client.update() or self.client.latest_trusted()
+            if head is not None:
+                res["sync_info"]["latest_block_height"] = str(head.height)
+                res["sync_info"]["latest_block_hash"] = head.signed_header.hash().hex().upper()
+            return res
+
+        def block(height=None):
+            self._require(height is not None, "light proxy requires an explicit height")
+            res = self.primary.call("block", height=str(height))
+            lb = self._verified_header(int(height))
+            got = bytes.fromhex(res["block_id"]["hash"])
+            want = lb.signed_header.hash()
+            self._require(got == want, f"primary returned block {got.hex()} != verified {want.hex()}")
+            return res
+
+        def commit(height=None):
+            self._require(height is not None, "light proxy requires an explicit height")
+            lb = self._verified_header(int(height))
+            sh = lb.signed_header
+            res = self.primary.call("commit", height=str(height))
+            got = bytes.fromhex(res["signed_header"]["commit"]["block_id"]["hash"])
+            self._require(got == sh.hash(), "primary commit diverges from verified header")
+            return res
+
+        def header(height=None):
+            self._require(height is not None, "light proxy requires an explicit height")
+            lb = self._verified_header(int(height))
+            h = lb.signed_header.header
+            return {
+                "header": {
+                    "chain_id": h.chain_id,
+                    "height": str(h.height),
+                    "time": h.time.rfc3339(),
+                    "app_hash": h.app_hash.hex().upper(),
+                    "validators_hash": h.validators_hash.hex().upper(),
+                    "next_validators_hash": h.next_validators_hash.hex().upper(),
+                    "proposer_address": h.proposer_address.hex().upper(),
+                    "last_block_id": {"hash": h.last_block_id.hash.hex().upper()},
+                }
+            }
+
+        def validators(height=None):
+            self._require(height is not None, "light proxy requires an explicit height")
+            lb = self._verified_header(int(height))
+            vs = lb.validator_set
+            return {
+                "block_height": str(lb.height),
+                "validators": [
+                    {
+                        "address": v.address.hex().upper(),
+                        "pub_key": {"type": v.pub_key.type_name, "value": base64.b64encode(v.pub_key.bytes()).decode()},
+                        "voting_power": str(v.voting_power),
+                    }
+                    for v in vs.validators
+                ],
+                "count": str(len(vs.validators)),
+                "total": str(len(vs.validators)),
+            }
+
+        def passthrough(method):
+            def fn(**params):
+                return self.primary.call(method, **params)
+            return fn
+
+        routes = {
+            "status": status,
+            "block": block,
+            "commit": commit,
+            "header": header,
+            "validators": validators,
+        }
+        for m in ("broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
+                  "abci_query", "abci_info", "tx", "net_info", "health", "genesis",
+                  "unconfirmed_txs", "num_unconfirmed_txs"):
+            routes[m] = passthrough(m)
+        return routes

@@ -58,7 +58,12 @@ def _verify_new_header_and_vals(
     chain_id: str,
 ) -> None:
     """ref: verifier.go:196 verifyNewHeaderAndVals."""
-    untrusted_header.validate_basic(chain_id)
+    try:
+        untrusted_header.validate_basic(chain_id)
+    except ErrInvalidHeader:
+        raise
+    except Exception as e:
+        raise ErrInvalidHeader(str(e))
     if untrusted_header.header.height <= trusted_header.header.height:
         raise ErrInvalidHeader(
             f"expected new header height {untrusted_header.header.height} to be greater than "
@@ -105,15 +110,20 @@ def verify_non_adjacent(
         verify_commit_light_trusting(chain_id, trusted_vals, untrusted_header.commit, trust_level)
     except NotEnoughVotingPowerError as e:
         raise ErrNewValSetCantBeTrusted(str(e))
+    except Exception as e:
+        raise ErrInvalidHeader(str(e))
 
     # the new validator set signed its own header with 2/3 (:85)
-    verify_commit_light(
-        chain_id,
-        untrusted_vals,
-        untrusted_header.commit.block_id,
-        untrusted_header.header.height,
-        untrusted_header.commit,
-    )
+    try:
+        verify_commit_light(
+            chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.header.height,
+            untrusted_header.commit,
+        )
+    except Exception as e:
+        raise ErrInvalidHeader(str(e))
 
 
 def verify_adjacent(
@@ -140,13 +150,16 @@ def verify_adjacent(
         )
 
     # 2/3 of the new set signed (:149)
-    verify_commit_light(
-        chain_id,
-        untrusted_vals,
-        untrusted_header.commit.block_id,
-        untrusted_header.header.height,
-        untrusted_header.commit,
-    )
+    try:
+        verify_commit_light(
+            chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.header.height,
+            untrusted_header.commit,
+        )
+    except Exception as e:
+        raise ErrInvalidHeader(str(e))
 
 
 def verify(

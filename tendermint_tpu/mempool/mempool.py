@@ -110,6 +110,10 @@ class TxMempool:
         with self._mtx:
             return len(self._txs)
 
+    def has_txs(self) -> bool:
+        with self._mtx:
+            return bool(self._txs)
+
     def total_bytes(self) -> int:
         with self._mtx:
             return self._total_bytes

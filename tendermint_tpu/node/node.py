@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from urllib.parse import urlparse
 
 from ..abci import LocalClient
@@ -88,6 +89,14 @@ def _make_app(proxy_app: str):
         client = SocketClient(proxy_app)
         client.start()
         return client
+    if proxy_app.startswith("grpc://"):
+        # The reference offers a gRPC ABCI transport (abci/client/
+        # grpc_client.go); this build has no grpc runtime available, so
+        # the socket transport is the out-of-process deployment mode.
+        raise ValueError(
+            "grpc:// ABCI transport is not available in this build "
+            "(no grpc runtime); use tcp:// or unix:// socket ABCI"
+        )
     raise ValueError(f"unsupported proxy_app {proxy_app!r}")
 
 
@@ -143,13 +152,25 @@ class Node:
 
         # ---- app + handshake prerequisites (node/node.go:159)
         self.app_client = app_client if app_client is not None else _make_app(config.base.proxy_app)
-        self.event_bus = EventBus()
+        from ..eventbus.eventlog import EventLog
+
+        self.event_bus = EventBus(event_log=EventLog())
         self.indexer = KVIndexer(_make_db(config, "tx_index")) if config.tx_index.indexer == "kv" else None
         self.indexer_service = IndexerService(self.indexer, self.event_bus) if self.indexer else None
 
-        # ---- privval (node/setup.go:489)
+        # ---- privval (node/setup.go:489: file | socket remote signer)
+        self.privval_endpoint = None
         if priv_validator is not None:
             self.priv_validator = priv_validator
+        elif config.base.mode == "validator" and config.base.priv_validator_laddr:
+            from ..privval.remote import SignerClient, SignerListenerEndpoint
+
+            self.privval_endpoint = SignerListenerEndpoint(
+                config.base.priv_validator_laddr,
+                logger=self.logger.with_fields(module="privval"),
+            )
+            self.privval_endpoint.start()
+            self.priv_validator = SignerClient(self.privval_endpoint, self.gen_doc.chain_id)
         elif config.base.mode == "validator":
             self.priv_validator = FilePV.load_or_generate(
                 config.priv_validator_key_file, config.priv_validator_state_file
@@ -172,7 +193,13 @@ class Node:
         if config.p2p.pex:
             descs.append(pex_channel_descriptor())
         laddr = urlparse(config.p2p.laddr if "//" in config.p2p.laddr else "tcp://" + config.p2p.laddr)
-        self.transport = TcpTransport(descs, bind_host=laddr.hostname or "0.0.0.0", bind_port=laddr.port or 0)
+        self.transport = TcpTransport(
+            descs,
+            bind_host=laddr.hostname or "0.0.0.0",
+            bind_port=laddr.port or 0,
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate,
+        )
         persistent = []
         for entry in filter(None, (s.strip() for s in config.p2p.persistent_peers.split(","))):
             persistent.append(Endpoint.parse("mconn://" + entry if "://" not in entry else entry))
@@ -255,7 +282,17 @@ class Node:
             metrics=self.consensus_metrics,
             logger=self.logger.with_fields(module="consensus"),
             on_fatal=self._on_fatal,
+            wait_for_txs=not config.consensus.create_empty_blocks,
+            create_empty_blocks_interval=config.consensus.create_empty_blocks_interval,
+            mempool=self.mempool,
         )
+        if not config.consensus.create_empty_blocks:
+            self.mempool.enable_txs_available()
+            self._txs_watcher = threading.Thread(
+                target=self._watch_txs_available, daemon=True, name="txs-available"
+            )
+        else:
+            self._txs_watcher = None
         self.consensus_reactor = ConsensusReactor(
             self.consensus, cs_chs[0], cs_chs[1], cs_chs[2], cs_chs[3], self.peer_manager, self.block_store
         )
@@ -320,6 +357,20 @@ class Node:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _watch_txs_available(self) -> None:
+        """Forward mempool tx-available signals to consensus
+        (ref: node wiring of TxsAvailable, consensus/state.go:1143)."""
+        while not self._halted.is_set():
+            try:
+                if self.mempool.wait_txs_available(timeout=0.2):
+                    self.consensus.handle_txs_available()
+                    time.sleep(0.05)  # signal latches until next height
+            except Exception as e:
+                # block production depends on this thread when
+                # create_empty_blocks=false — never die silently
+                self.logger.error("txs-available watcher error", err=str(e))
+                time.sleep(0.5)
+
     def _should_blocksync(self, state) -> bool:
         """Skip blocksync when we're the only validator
         (ref: node/setup.go:134 onlyValidatorIsUs)."""
@@ -362,6 +413,8 @@ class Node:
         self.statesync_reactor.start()
         if self.pex_reactor is not None:
             self.pex_reactor.start()
+        if self._txs_watcher is not None:
+            self._txs_watcher.start()
         if self.config.statesync.enable and state.last_block_height == 0:
             threading.Thread(target=self._run_statesync, daemon=True, name="statesync").start()
         elif self.blocksync_reactor.block_sync:
@@ -446,6 +499,8 @@ class Node:
     def stop(self) -> None:
         if self._consensus_running.is_set():
             self.consensus.stop()
+        if self.privval_endpoint is not None:
+            self.privval_endpoint.stop()
         if self.pex_reactor is not None:
             self.pex_reactor.stop()
         self.blocksync_reactor.stop()

@@ -21,6 +21,7 @@ import traceback
 from dataclasses import dataclass, field
 
 from .channel import Channel
+from .conn_tracker import ConnTracker
 from .transport import Connection, ConnectionClosed, Endpoint, Transport
 from .types import ChannelDescriptor, Envelope, NodeInfo, PeerError, node_id_from_pubkey
 from .peermanager import PeerManager
@@ -35,6 +36,9 @@ class RouterOptions:
     queue_size: int = 128
     num_dial_threads: int = 4
     filter_peer_by_id: object = None  # callable(node_id) -> None | raise
+    # per-IP inbound limits (ref: conn_tracker.go; 0 disables)
+    max_incoming_per_ip: int = 8
+    incoming_conn_window: float = 0.1
 
 
 class _PeerQueue:
@@ -102,6 +106,11 @@ class Router:
         self._threads: list[threading.Thread] = []  # long-lived loop threads only
         self._threads_lock = threading.Lock()
         self._stop = threading.Event()
+        self._conn_tracker = (
+            ConnTracker(self.options.max_incoming_per_ip, self.options.incoming_conn_window)
+            if self.options.max_incoming_per_ip > 0
+            else None
+        )
 
     # ------------------------------------------------------------- channels
 
@@ -212,7 +221,8 @@ class Router:
     # ------------------------------------------------------------- accept
 
     def _accept_loop(self, transport: Transport) -> None:
-        """ref: router.go:444 acceptPeers."""
+        """ref: router.go:444 acceptPeers (per-IP limiting per
+        conn_tracker.go via router.go:466 connTracker.AddConn)."""
         while not self._stop.is_set():
             try:
                 conn = transport.accept(timeout=0.2)
@@ -220,7 +230,28 @@ class Router:
                 continue
             except (ConnectionClosed, OSError):
                 return
-            self._spawn_conn(self._open_connection, conn, False, None, name="accept-conn")
+            ip = ""
+            if self._conn_tracker is not None:
+                try:
+                    host = conn.remote_endpoint().host
+                    # loopback is exempt: localnets legitimately open many
+                    # rapid connections from 127.0.0.1
+                    if host and not host.startswith("127.") and host != "::1":
+                        self._conn_tracker.add_conn(host)
+                        ip = host
+                except ConnectionRefusedError:
+                    conn.close()
+                    continue
+                except Exception:
+                    ip = ""
+            self._spawn_conn(self._run_inbound, conn, ip, name="accept-conn")
+
+    def _run_inbound(self, conn: Connection, ip: str) -> None:
+        try:
+            self._open_connection(conn, False, None)
+        finally:
+            if ip and self._conn_tracker is not None:
+                self._conn_tracker.remove_conn(ip)
 
     def _open_connection(self, conn: Connection, outgoing: bool, endpoint: Endpoint | None) -> None:
         """Handshake + register + run send/recv (ref: router.go:481

@@ -14,7 +14,6 @@ Protocol, matching the reference's construction:
 
 from __future__ import annotations
 
-import json
 import struct
 
 from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
@@ -23,6 +22,8 @@ from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 from cryptography.hazmat.primitives import hashes
 
 from ..crypto.ed25519 import Ed25519PubKey
+from ..proto import messages as pb
+from ..proto.wire import decode_varint, encode_varint
 
 DATA_LEN_SIZE = 4
 DATA_MAX_SIZE = 1024
@@ -85,19 +86,20 @@ class SecretConnection:
         self._recv_nonce = _NonceCounter()
         self._recv_buf = b""
 
-        # 4. authenticate: sign the shared challenge with the node key
+        # 4. authenticate: sign the shared challenge with the node key and
+        # exchange proto AuthSigMessage, length-delimited like the
+        # reference's protoio.WriteDelimited (:193-222 shareAuthSignature)
         sig = priv_key.sign(challenge)
-        auth = json.dumps(
-            {"pub_key": self.local_pub_key.bytes().hex(), "sig": sig.hex()}
+        auth = pb.AuthSigMessage(
+            pub_key=pb.PublicKey(ed25519=self.local_pub_key.bytes()), sig=sig
         ).encode()
-        self.write(struct.pack("<I", len(auth)) + auth)
-        hdr = self.read_exact(4)
-        (alen,) = struct.unpack("<I", hdr)
-        if alen > 4096:
-            raise ValueError("oversized auth message")
-        peer_auth = json.loads(self.read_exact(alen).decode())
-        peer_pub = Ed25519PubKey(bytes.fromhex(peer_auth["pub_key"]))
-        if not peer_pub.verify_signature(challenge, bytes.fromhex(peer_auth["sig"])):
+        self.write(encode_varint(len(auth)) + auth)
+        peer_auth = pb.AuthSigMessage.decode(self._read_delimited(4096))
+        kind, key_bytes = peer_auth.pub_key.sum if peer_auth.pub_key else (None, None)
+        if kind != "ed25519" or key_bytes is None:
+            raise ValueError(f"unsupported auth key type {kind!r}")
+        peer_pub = Ed25519PubKey(key_bytes)
+        if not peer_pub.verify_signature(challenge, peer_auth.sig or b""):
             raise ValueError("challenge verification failed")
         self.remote_pub_key = peer_pub
 
@@ -119,6 +121,21 @@ class SecretConnection:
         return bytes(out)
 
     # ------------------------------------------------------- sealed stream
+
+    def _read_delimited(self, max_size: int) -> bytes:
+        """Read a uvarint-length-prefixed message from the sealed stream
+        (ref: internal/libs/protoio ReadDelimited)."""
+        prefix = b""
+        while True:
+            prefix += self.read_exact(1)
+            if prefix[-1] < 0x80:
+                break
+            if len(prefix) > 5:
+                raise ValueError("oversized length prefix")
+        size, _ = decode_varint(prefix, 0)
+        if size > max_size:
+            raise ValueError(f"delimited message too large: {size}")
+        return self.read_exact(size)
 
     def write(self, data: bytes) -> int:
         """Frame + seal + send (ref: secret_connection.go:243 Write)."""

@@ -1,30 +1,37 @@
-"""TCP transport: SecretConnection + channel-multiplexed framing
+"""TCP transport: SecretConnection + MConnection-style packetized
+channel multiplexing with priorities and flow control
 (ref: internal/p2p/transport_mconn.go + internal/p2p/conn/connection.go).
 
-Wire format after the SecretConnection handshake: each message is one
-frame `varint(total_len) || channel_id byte || payload`. Channel codecs
-(ChannelDescriptor.encode/decode) translate payload bytes ↔ message
-objects; unknown channels are dropped by the router.
-
-The reference splits messages into 1024-byte MConnection packets with
-per-channel priority queues and flowrate throttling
-(conn/connection.go:45-46: 500 KB/s each way). Here the SecretConnection
-already chunks at 1024 bytes; prioritization happens in the router's
-per-peer queue, and OS socket buffering provides backpressure.
+Wire format after the SecretConnection handshake: messages are split
+into packets `uvarint(1 + 1 + chunk_len) || channel_id || eof || chunk`
+with chunks <= 1024 bytes (conn/connection.go maxPacketMsgPayloadSize).
+A dedicated send loop per connection picks the next packet from
+per-channel queues by least recently_sent/priority ratio — so a 64 KiB
+block part never queues a vote behind it — and a token bucket throttles
+the connection to `send_rate` bytes/sec (conn/connection.go:45-46,
+default 500 KB/s each way). Channel codecs (ChannelDescriptor.encode/
+decode) translate payload bytes ↔ message objects; unknown channels are
+dropped by the router.
 """
 
 from __future__ import annotations
 
+import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any
 
+from ..proto import messages as pb
 from .secret_connection import SecretConnection
 from .transport import Connection, ConnectionClosed, Endpoint, Transport
 from .types import ChannelDescriptor, NodeInfo, node_id_from_pubkey
 
 MAX_MSG_SIZE = 1 << 22  # 4 MiB, ref: conn/connection.go maxPacketMsgPayloadSize scaled
+PACKET_PAYLOAD_SIZE = 1024  # ref: conn/connection.go:39 defaultMaxPacketMsgPayloadSize
+DEFAULT_SEND_RATE = 512000  # bytes/sec, ref: conn/connection.go:45
+DEFAULT_RECV_RATE = 512000  # ref: conn/connection.go:46
 
 
 def _encode_uvarint(value: int) -> bytes:
@@ -39,8 +46,75 @@ def _encode_uvarint(value: int) -> bytes:
             return bytes(out)
 
 
+class _TokenBucket:
+    """Byte-rate throttle (ref: internal/libs/flowrate used at
+    conn/connection.go:124). Capacity = one second's burst."""
+
+    def __init__(self, rate: int):
+        self.rate = float(rate)
+        self._tokens = float(rate)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def consume(self, n: int) -> None:
+        """Blocks until n tokens are available. Requests larger than the
+        one-second capacity temporarily raise the cap (tokens go negative
+        never — the burst just takes n/rate seconds to accumulate), so a
+        frame bigger than a tiny configured rate still eventually sends
+        instead of spinning forever."""
+        cap = max(self.rate, float(n))
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                self._tokens = min(cap, self._tokens + (now - self._last) * self.rate)
+                self._last = now
+                if self._tokens >= n:
+                    self._tokens -= n
+                    return
+                time.sleep(min(0.1, (n - self._tokens) / self.rate))
+
+
+class _ChannelSendState:
+    """Per-channel outbound queue + fair-share accounting
+    (ref: conn/connection.go:600 channel)."""
+
+    __slots__ = ("desc", "queue", "sending", "offset", "recently_sent")
+
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.queue: queue.Queue = queue.Queue(maxsize=max(1, desc.send_queue_capacity))
+        self.sending: bytes | None = None  # message currently being packetized
+        self.offset = 0
+        self.recently_sent = 0.0
+
+    def next_packet(self) -> tuple[bytes, bool] | None:
+        """(chunk, eof) or None when idle."""
+        if self.sending is None:
+            try:
+                self.sending = self.queue.get_nowait()
+                self.offset = 0
+            except queue.Empty:
+                return None
+        chunk = self.sending[self.offset : self.offset + PACKET_PAYLOAD_SIZE]
+        self.offset += len(chunk)
+        eof = self.offset >= len(self.sending)
+        if eof:
+            self.sending = None
+            self.offset = 0
+        return chunk, eof
+
+    def has_data(self) -> bool:
+        return self.sending is not None or not self.queue.empty()
+
+
 class TcpConnection(Connection):
-    def __init__(self, sock: socket.socket, channel_descs: dict[int, ChannelDescriptor]):
+    def __init__(
+        self,
+        sock: socket.socket,
+        channel_descs: dict[int, ChannelDescriptor],
+        send_rate: int = DEFAULT_SEND_RATE,
+        recv_rate: int = DEFAULT_RECV_RATE,
+    ):
         self._sock = sock
         self._descs = channel_descs
         self._secret: SecretConnection | None = None
@@ -50,24 +124,32 @@ class TcpConnection(Connection):
         self._varint_result = 0  # resumable length-prefix state
         self._varint_shift = 0
         self.on_traffic = None  # optional (direction, channel_id, nbytes) hook
+        # -- packetized send plane (ref: conn/connection.go sendRoutine)
+        self._channels: dict[int, _ChannelSendState] = {}
+        self._channels_lock = threading.Lock()
+        self._send_bucket = _TokenBucket(send_rate)
+        self._recv_bucket = _TokenBucket(recv_rate)
+        self._send_wake = threading.Event()
+        self._send_thread: threading.Thread | None = None
+        self._send_error: Exception | None = None
+        # -- receive reassembly (per-channel partial messages)
+        self._recv_partial: dict[int, bytearray] = {}
         try:
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
 
     def handshake(self, node_info: NodeInfo, priv_key, timeout: float | None = None) -> tuple[NodeInfo, Any]:
-        """SecretConnection handshake authenticates keys; then NodeInfo
-        exchange (ref: transport_mconn.go:116 Handshake)."""
+        """SecretConnection handshake authenticates keys; then proto
+        NodeInfo exchange, uvarint-length-delimited like the reference's
+        protoio (ref: transport_mconn.go:116 Handshake)."""
         self._sock.settimeout(timeout)
         self._secret = SecretConnection(self._sock, priv_key)
-        import json
-
-        payload = json.dumps(node_info.to_wire()).encode()
-        self._secret.write(struct.pack("<I", len(payload)) + payload)
-        (plen,) = struct.unpack("<I", self._secret.read_exact(4))
-        if plen > 1 << 20:
-            raise ValueError("oversized NodeInfo")
-        peer_info = NodeInfo.from_wire(json.loads(self._secret.read_exact(plen).decode()))
+        payload = node_info.to_proto().encode()
+        self._secret.write(_encode_uvarint(len(payload)) + payload)
+        peer_info = NodeInfo.from_proto(
+            pb.NodeInfoProto.decode(self._secret._read_delimited(1 << 20))
+        )
         peer_key = self._secret.remote_pub_key
         if node_id_from_pubkey(peer_key) != peer_info.node_id:
             raise ValueError("peer's public key does not match its node ID")
@@ -75,23 +157,86 @@ class TcpConnection(Connection):
         return peer_info, peer_key
 
     def send_message(self, channel_id: int, message) -> None:
+        """Enqueue on the channel's send queue; the connection's send loop
+        packetizes and interleaves by priority (ref: conn/connection.go:370
+        Send). Blocks briefly on a full queue (backpressure), then drops —
+        gossip is idempotent, matching the reference's timeout-drop."""
         if self._closed.is_set():
-            raise ConnectionClosed("connection closed")
+            raise ConnectionClosed(str(self._send_error or "connection closed"))
         desc = self._descs.get(channel_id)
         if desc is None or desc.encode is None:
             raise ValueError(f"no codec for channel {channel_id:#x}")
         payload = desc.encode(message)
-        if len(payload) + 1 > MAX_MSG_SIZE:
+        if len(payload) > MAX_MSG_SIZE:
             raise ValueError("message exceeds maximum size")
-        frame = _encode_uvarint(len(payload) + 1) + bytes([channel_id]) + payload
-        with self._send_lock:
-            try:
-                self._secret.write(frame)
-            except (OSError, ConnectionError) as e:
-                self._closed.set()
-                raise ConnectionClosed(str(e))
+        with self._channels_lock:
+            ch = self._channels.get(channel_id)
+            if ch is None:
+                ch = self._channels[channel_id] = _ChannelSendState(desc)
+            if self._send_thread is None:
+                self._send_thread = threading.Thread(
+                    target=self._send_loop, daemon=True, name="mconn-send"
+                )
+                self._send_thread.start()
+        try:
+            ch.queue.put(payload, timeout=2.0)
+        except queue.Full:
+            return  # dropped under sustained backpressure
+        self._send_wake.set()
         if self.on_traffic is not None:
-            self.on_traffic("send", channel_id, len(frame))
+            self.on_traffic("send", channel_id, len(payload))
+
+    def _pick_channel(self) -> _ChannelSendState | None:
+        """Least recently_sent/priority among channels with data
+        (ref: conn/connection.go:478 sendPacketMsg channel selection)."""
+        best, best_ratio = None, None
+        with self._channels_lock:
+            states = list(self._channels.values())
+        for ch in states:
+            if not ch.has_data():
+                continue
+            ratio = ch.recently_sent / max(1, ch.desc.priority)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    def _send_loop(self) -> None:
+        """ref: conn/connection.go:420 sendRoutine."""
+        idle_since = None
+        while not self._closed.is_set():
+            ch = self._pick_channel()
+            if ch is None:
+                # decay fair-share counters while idle so a long-quiet
+                # channel doesn't start permanently favored
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > 2.0:
+                    with self._channels_lock:
+                        for st in self._channels.values():
+                            st.recently_sent *= 0.5
+                    idle_since = time.monotonic()
+                self._send_wake.wait(timeout=0.05)
+                self._send_wake.clear()
+                continue
+            idle_since = None
+            nxt = ch.next_packet()
+            if nxt is None:
+                continue
+            chunk, eof = nxt
+            frame = (
+                _encode_uvarint(2 + len(chunk))
+                + bytes([ch.desc.id, 1 if eof else 0])
+                + chunk
+            )
+            self._send_bucket.consume(len(frame))
+            ch.recently_sent += len(frame)
+            with self._send_lock:
+                try:
+                    self._secret.write(frame)
+                except (OSError, ConnectionError) as e:
+                    self._send_error = e
+                    self._closed.set()
+                    return
 
     def _read_uvarint(self) -> int:
         """Resumable uvarint read: bytes consumed before a poll timeout
@@ -111,30 +256,44 @@ class TcpConnection(Connection):
                 raise ValueError("uvarint overflow")
 
     def receive_message(self, timeout: float | None = None) -> tuple[int, Any]:
+        """Read packets, reassembling per-channel until one message
+        completes (ref: conn/connection.go:545 recvRoutine)."""
         if self._closed.is_set():
             raise ConnectionClosed("connection closed")
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._recv_lock:
-            try:
-                self._sock.settimeout(timeout)
-                total = self._read_uvarint()
-                if total < 1 or total > MAX_MSG_SIZE:
-                    raise ValueError(f"invalid frame length {total}")
-                self._sock.settimeout(None)  # got a header; finish the frame
-                body = self._secret.read_exact(total)
-            except socket.timeout:
-                raise TimeoutError("receive timed out")
-            except (OSError, ConnectionError, ValueError) as e:
-                self._closed.set()
-                raise ConnectionClosed(str(e))
-        channel_id = body[0]
-        if self.on_traffic is not None:
-            # count the uvarint prefix too, symmetric with send_message
-            prefix_len = max(1, (total.bit_length() + 6) // 7)
-            self.on_traffic("recv", channel_id, total + prefix_len)
-        desc = self._descs.get(channel_id)
-        if desc is None or desc.decode is None:
-            return channel_id, body[1:]  # router drops unknown channels
-        return channel_id, desc.decode(body[1:])
+            while True:
+                try:
+                    remaining = None if deadline is None else max(0.01, deadline - time.monotonic())
+                    self._sock.settimeout(remaining)
+                    total = self._read_uvarint()
+                    if total < 2 or total > PACKET_PAYLOAD_SIZE + 2:
+                        raise ValueError(f"invalid packet length {total}")
+                    self._sock.settimeout(None)  # got a header; finish the packet
+                    body = self._secret.read_exact(total)
+                except socket.timeout:
+                    raise TimeoutError("receive timed out")
+                except (OSError, ConnectionError, ValueError) as e:
+                    self._closed.set()
+                    raise ConnectionClosed(str(e))
+                channel_id, eof, chunk = body[0], body[1], body[2:]
+                # inbound flow control (ref: conn/connection.go:46 recvRate):
+                # throttling our read drains the peer via TCP backpressure
+                self._recv_bucket.consume(len(body))
+                buf = self._recv_partial.setdefault(channel_id, bytearray())
+                buf += chunk
+                if len(buf) > MAX_MSG_SIZE:
+                    self._closed.set()
+                    raise ConnectionClosed(f"peer message exceeds maximum size on channel {channel_id:#x}")
+                if not eof:
+                    continue
+                payload = bytes(self._recv_partial.pop(channel_id))
+                if self.on_traffic is not None:
+                    self.on_traffic("recv", channel_id, len(payload))
+                desc = self._descs.get(channel_id)
+                if desc is None or desc.decode is None:
+                    return channel_id, payload  # router drops unknown channels
+                return channel_id, desc.decode(payload)
 
     def local_endpoint(self) -> Endpoint:
         try:
@@ -152,6 +311,7 @@ class TcpConnection(Connection):
 
     def close(self) -> None:
         self._closed.set()
+        self._send_wake.set()
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -167,7 +327,16 @@ class TcpTransport(Transport):
 
     protocol = "mconn"
 
-    def __init__(self, channel_descs: list[ChannelDescriptor], bind_host: str = "127.0.0.1", bind_port: int = 0):
+    def __init__(
+        self,
+        channel_descs: list[ChannelDescriptor],
+        bind_host: str = "127.0.0.1",
+        bind_port: int = 0,
+        send_rate: int = DEFAULT_SEND_RATE,
+        recv_rate: int = DEFAULT_RECV_RATE,
+    ):
+        self._send_rate = send_rate
+        self._recv_rate = recv_rate
         self._descs = {d.id: d for d in channel_descs}
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -193,12 +362,12 @@ class TcpTransport(Transport):
             raise TimeoutError("accept timed out")
         except OSError as e:
             raise ConnectionClosed(str(e))
-        return TcpConnection(sock, self._descs)
+        return TcpConnection(sock, self._descs, send_rate=self._send_rate, recv_rate=self._recv_rate)
 
     def dial(self, endpoint: Endpoint, timeout: float | None = None) -> Connection:
         sock = socket.create_connection((endpoint.host, endpoint.port), timeout=timeout)
         sock.settimeout(None)
-        return TcpConnection(sock, self._descs)
+        return TcpConnection(sock, self._descs, send_rate=self._send_rate, recv_rate=self._recv_rate)
 
     def close(self) -> None:
         self._closed.set()

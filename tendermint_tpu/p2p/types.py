@@ -121,38 +121,43 @@ class NodeInfo:
         if self.channels and other.channels and not (set(self.channels) & set(other.channels)):
             raise ValueError("no common channels with peer")
 
-    def to_wire(self) -> dict:
-        return {
-            "node_id": self.node_id,
-            "listen_addr": self.listen_addr,
-            "network": self.network,
-            "version": self.version,
-            "channels": self.channels.hex(),
-            "moniker": self.moniker,
-            "protocol_version": {
-                "p2p": self.protocol_version.p2p,
-                "block": self.protocol_version.block,
-                "app": self.protocol_version.app,
-            },
-            "rpc_address": self.rpc_address,
-            "tx_index": self.tx_index,
-        }
+    def to_proto(self) -> "pb.NodeInfoProto":
+        """tendermint.p2p.NodeInfo wire form (proto/tendermint/p2p/types.proto:15)."""
+        from ..proto import messages as pb
+
+        return pb.NodeInfoProto(
+            protocol_version=pb.ProtocolVersionProto(
+                p2p=self.protocol_version.p2p,
+                block=self.protocol_version.block,
+                app=self.protocol_version.app,
+            ),
+            node_id=self.node_id,
+            listen_addr=self.listen_addr,
+            network=self.network,
+            version=self.version,
+            channels=self.channels,
+            moniker=self.moniker,
+            other=pb.NodeInfoOtherProto(tx_index=self.tx_index, rpc_address=self.rpc_address),
+        )
 
     @classmethod
-    def from_wire(cls, d: dict) -> "NodeInfo":
-        pv = d.get("protocol_version", {})
+    def from_proto(cls, p) -> "NodeInfo":
+        pv = p.protocol_version
+        other = p.other
         return cls(
-            node_id=d.get("node_id", ""),
-            listen_addr=d.get("listen_addr", ""),
-            network=d.get("network", ""),
-            version=d.get("version", ""),
-            channels=bytes.fromhex(d.get("channels", "")),
-            moniker=d.get("moniker", ""),
+            node_id=p.node_id or "",
+            listen_addr=p.listen_addr or "",
+            network=p.network or "",
+            version=p.version or "",
+            channels=p.channels or b"",
+            moniker=p.moniker or "",
             protocol_version=ProtocolVersion(
-                p2p=pv.get("p2p", 0), block=pv.get("block", 0), app=pv.get("app", 0)
+                p2p=(pv.p2p or 0) if pv else 0,
+                block=(pv.block or 0) if pv else 0,
+                app=(pv.app or 0) if pv else 0,
             ),
-            rpc_address=d.get("rpc_address", ""),
-            tx_index=d.get("tx_index", "on"),
+            rpc_address=(other.rpc_address or "") if other else "",
+            tx_index=(other.tx_index or "on") if other else "on",
         )
 
 

@@ -482,3 +482,146 @@ class PexMessage(Message):
         Field(3, "message", "pex_request", msg_cls=PexRequest),
         Field(4, "message", "pex_response", msg_cls=PexResponse),
     ]
+
+
+class AuthSigMessage(Message):
+    """Secret-connection authentication (proto/tendermint/p2p/conn.proto
+    and duplicated at proto/tendermint/privval/types.proto)."""
+
+    fields = [
+        Field(1, "message", "pub_key", always_emit=True, msg_cls=PublicKey),
+        Field(2, "bytes", "sig"),
+    ]
+
+
+# -- libs/bits (proto/tendermint/libs/bits/types.proto) --------------------
+
+
+class BitArrayProto(Message):
+    fields = [
+        Field(1, "int64", "bits"),
+        Field(2, "uint64", "elems", repeated=True),
+    ]
+
+
+# -- consensus wire messages (proto/tendermint/consensus/types.proto) ------
+
+
+class CsNewRoundStep(Message):
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "round"),
+        Field(3, "uint32", "step"),
+        Field(4, "int64", "seconds_since_start_time"),
+        Field(5, "int32", "last_commit_round"),
+    ]
+
+
+class CsNewValidBlock(Message):
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "round"),
+        Field(3, "message", "block_part_set_header", always_emit=True, msg_cls=PartSetHeader),
+        Field(4, "message", "block_parts", msg_cls=BitArrayProto),
+        Field(5, "bool", "is_commit"),
+    ]
+
+
+class CsProposal(Message):
+    fields = [Field(1, "message", "proposal", always_emit=True, msg_cls=Proposal)]
+
+
+class CsProposalPOL(Message):
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "proposal_pol_round"),
+        Field(3, "message", "proposal_pol", always_emit=True, msg_cls=BitArrayProto),
+    ]
+
+
+class CsBlockPart(Message):
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "round"),
+        Field(3, "message", "part", always_emit=True, msg_cls=Part),
+    ]
+
+
+class CsVote(Message):
+    fields = [Field(1, "message", "vote", msg_cls=Vote)]
+
+
+class CsHasVote(Message):
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "round"),
+        Field(3, "enum", "type"),
+        Field(4, "int32", "index"),
+    ]
+
+
+class CsVoteSetMaj23(Message):
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "round"),
+        Field(3, "enum", "type"),
+        Field(4, "message", "block_id", always_emit=True, msg_cls=BlockID),
+    ]
+
+
+class CsVoteSetBits(Message):
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "round"),
+        Field(3, "enum", "type"),
+        Field(4, "message", "block_id", always_emit=True, msg_cls=BlockID),
+        Field(5, "message", "votes", always_emit=True, msg_cls=BitArrayProto),
+    ]
+
+
+class ConsensusMessage(Message):
+    """tendermint.consensus.Message oneof (consensus/types.proto:88-100)."""
+
+    fields = [
+        Field(1, "message", "new_round_step", msg_cls=CsNewRoundStep),
+        Field(2, "message", "new_valid_block", msg_cls=CsNewValidBlock),
+        Field(3, "message", "proposal", msg_cls=CsProposal),
+        Field(4, "message", "proposal_pol", msg_cls=CsProposalPOL),
+        Field(5, "message", "block_part", msg_cls=CsBlockPart),
+        Field(6, "message", "vote", msg_cls=CsVote),
+        Field(7, "message", "has_vote", msg_cls=CsHasVote),
+        Field(8, "message", "vote_set_maj23", msg_cls=CsVoteSetMaj23),
+        Field(9, "message", "vote_set_bits", msg_cls=CsVoteSetBits),
+    ]
+
+
+class ProtocolVersionProto(Message):
+    """tendermint.p2p.ProtocolVersion (proto/tendermint/p2p/types.proto:9)."""
+
+    fields = [
+        Field(1, "uint64", "p2p"),
+        Field(2, "uint64", "block"),
+        Field(3, "uint64", "app"),
+    ]
+
+
+class NodeInfoOtherProto(Message):
+    fields = [
+        Field(1, "string", "tx_index"),
+        Field(2, "string", "rpc_address"),
+    ]
+
+
+class NodeInfoProto(Message):
+    """tendermint.p2p.NodeInfo (proto/tendermint/p2p/types.proto:15)."""
+
+    fields = [
+        Field(1, "message", "protocol_version", always_emit=True, msg_cls=ProtocolVersionProto),
+        Field(2, "string", "node_id"),
+        Field(3, "string", "listen_addr"),
+        Field(4, "string", "network"),
+        Field(5, "string", "version"),
+        Field(6, "bytes", "channels"),
+        Field(7, "string", "moniker"),
+        Field(8, "message", "other", always_emit=True, msg_cls=NodeInfoOtherProto),
+    ]

@@ -209,3 +209,58 @@ class WSClient:
             self.sock.close()
         except OSError:
             pass
+
+
+class EventStream:
+    """Resumable event consumption over the polling /events RPC
+    (ref: rpc/client/eventstream/eventstream.go).
+
+    Tracks the newest-seen cursor and long-polls for newer items,
+    yielding events oldest-first without a WebSocket; survives client
+    restarts if the caller persists `cursor`."""
+
+    def __init__(self, client: HTTPClient, query: str = "", batch_size: int = 32,
+                 wait_time_s: float = 5.0, cursor: str = ""):
+        self.client = client
+        self.query = query
+        self.batch_size = batch_size
+        self.wait_time_s = wait_time_s
+        self.cursor = cursor
+
+    def _params(self, **extra):
+        params = {"maxItems": self.batch_size, **extra}
+        if self.query:
+            params["filter"] = {"query": self.query}
+        return params
+
+    def next_batch(self) -> list[dict]:
+        """All events newer than the cursor, oldest-first. Pages with
+        `before` while the server reports more, so a burst larger than
+        batch_size is never silently skipped (ref: eventstream.go:86
+        fetches the tail pages before advancing its cursor)."""
+        if not self.cursor:
+            # start at the head: remember the newest cursor, yield nothing
+            res = self.client.call("events", **self._params(maxItems=1))
+            self.cursor = res.get("newest") or ""
+            if not res.get("items"):
+                return []
+        res = self.client.call(
+            "events",
+            **self._params(after=self.cursor, waitTime=int(self.wait_time_s * 1e9)),
+        )
+        pages = [res.get("items") or []]
+        while res.get("more") and pages[-1]:
+            res = self.client.call(
+                "events",
+                **self._params(after=self.cursor, before=pages[-1][-1]["cursor"]),
+            )
+            pages.append(res.get("items") or [])
+        items = [it for page in pages for it in page]
+        items.reverse()  # newest-first pages -> oldest-first stream
+        if items:
+            self.cursor = items[-1]["cursor"]
+        return items
+
+    def __iter__(self):
+        while True:
+            yield from self.next_batch()

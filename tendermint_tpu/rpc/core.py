@@ -240,8 +240,27 @@ def build_routes(env: RPCEnvironment) -> dict:
                 "pub_key": {"type": env.pub_key.type_name, "value": _b64(env.pub_key.bytes())},
                 "voting_power": str(val.voting_power) if val else "0",
             }
+        ni = env.node_info
+        node_info_json = (
+            {
+                "protocol_version": {
+                    "p2p": str(ni.protocol_version.p2p),
+                    "block": str(ni.protocol_version.block),
+                    "app": str(ni.protocol_version.app),
+                },
+                "id": ni.node_id,
+                "listen_addr": ni.listen_addr,
+                "network": ni.network,
+                "version": ni.version,
+                "channels": ni.channels.hex(),
+                "moniker": ni.moniker,
+                "other": {"tx_index": ni.tx_index, "rpc_address": ni.rpc_address},
+            }
+            if ni
+            else {}
+        )
         return {
-            "node_info": env.node_info.to_wire() if env.node_info else {},
+            "node_info": node_info_json,
             "sync_info": {
                 "latest_block_hash": _hex(latest_meta.block_id.hash if latest_meta else b""),
                 "latest_app_hash": _hex(latest_meta.header.app_hash if latest_meta else b""),
@@ -310,6 +329,53 @@ def build_routes(env: RPCEnvironment) -> dict:
             return {"block_id": block_id_to_json(None), "block": None}
         meta = env.block_store.load_block_meta(blk.header.height)
         return {"block_id": block_id_to_json(meta.block_id), "block": block_to_json(blk)}
+
+    def header(height=None):
+        """ref: internal/rpc/core/blocks.go Header (routes.go:37)."""
+        h = _height_or_latest(height)
+        meta = env.block_store.load_block_meta(h)
+        return {"header": header_to_json(meta.header) if meta else None}
+
+    def header_by_hash(hash=None):
+        """ref: internal/rpc/core/blocks.go HeaderByHash (routes.go:38)."""
+        hb = _as_bytes_hex(hash, "hash")
+        blk = env.block_store.load_block_by_hash(hb)
+        return {"header": header_to_json(blk.header) if blk else None}
+
+    def events(filter=None, maxItems=None, before=None, after=None, waitTime=None):
+        """Cursor-paged polling over the event log
+        (ref: internal/rpc/core/events.go Events, routes.go:31)."""
+        from ..eventbus.eventlog import Cursor
+        from ..pubsub.query import parse_query
+
+        log = getattr(env.event_bus, "event_log", None) if env.event_bus else None
+        if log is None:
+            raise RPCError(-32603, "event log is not enabled on this node")
+        max_items = _as_int(maxItems, "maxItems") or 10
+        query = None
+        if filter and isinstance(filter, dict) and filter.get("query"):
+            query = parse_query(filter["query"])
+        match = (lambda it: query.matches(it.events)) if query is not None else None
+        wait = float(waitTime) / 1e9 if waitTime else 0.0  # duration ns like the reference
+        after_c = Cursor.parse(after) if after else None
+        before_c = Cursor.parse(before) if before else None
+        if before_c is not None and not before_c.is_zero():
+            items, more, oldest, newest = log.scan(
+                before=before_c, after=after_c, max_items=max_items, match=match
+            )
+        else:
+            items, more, oldest, newest = log.wait_scan(
+                after=after_c, max_items=max_items, match=match, timeout=min(wait, 10.0)
+            )
+        return {
+            "items": [
+                {"cursor": str(it.cursor), "event": it.type, "data": event_to_json(it.data)}
+                for it in items
+            ],
+            "more": more,
+            "oldest": str(oldest),
+            "newest": str(newest),
+        }
 
     def block_results(height=None):
         h = _height_or_latest(height)
@@ -630,6 +696,9 @@ def build_routes(env: RPCEnvironment) -> dict:
         "blockchain": blockchain,
         "block": block,
         "block_by_hash": block_by_hash,
+        "header": header,
+        "header_by_hash": header_by_hash,
+        "events": events,
         "block_results": block_results,
         "commit": commit,
         "validators": validators,

@@ -46,12 +46,16 @@ def tx_results_hash(tx_results: list[abci.ExecTxResult]) -> bytes:
 def validator_updates_from_abci(updates: list[abci.ValidatorUpdate]) -> list[Validator]:
     """ref: types.PB2TM.ValidatorUpdates (types/protobuf.go)."""
     from ..crypto.ed25519 import Ed25519PubKey
+    from ..crypto.secp256k1 import Secp256k1PubKey
 
     out = []
     for u in updates:
-        if u.pub_key_type not in ("ed25519", "tendermint/PubKeyEd25519"):
+        if u.pub_key_type in ("ed25519", "tendermint/PubKeyEd25519"):
+            pk = Ed25519PubKey(u.pub_key_bytes)
+        elif u.pub_key_type in ("secp256k1", "tendermint/PubKeySecp256k1"):
+            pk = Secp256k1PubKey(u.pub_key_bytes)
+        else:
             raise ValueError(f"unsupported pubkey type {u.pub_key_type}")
-        pk = Ed25519PubKey(u.pub_key_bytes)
         out.append(Validator(address=pk.address(), pub_key=pk, voting_power=u.power))
     return out
 

@@ -246,9 +246,12 @@ class FileDB(MemDB):
                     MemDB.delete(self, k)
             self._append(_OP_BATCH, b"", _pack_batch(ops))
 
-    def compact(self) -> None:
-        """Rewrite the log as one sorted pass of live records."""
+    def compact(self) -> int:
+        """Rewrite the log as one sorted pass of live records (the
+        append-only log keeps every historical set/delete otherwise).
+        Returns bytes reclaimed — analog of `tendermint compact`."""
         with self._lock:
+            old_size = os.path.getsize(self._path) if os.path.exists(self._path) else 0
             self._f.close()
             tmp = self._path + ".compact"
             with open(tmp, "wb") as out:
@@ -260,6 +263,7 @@ class FileDB(MemDB):
                 os.fsync(out.fileno())
             os.replace(tmp, self._path)
             self._f = open(self._path, "ab")
+            return max(0, old_size - os.path.getsize(self._path))
 
     def close(self) -> None:
         with self._lock:

@@ -71,7 +71,7 @@ class GenesisDoc:
             "validators": [
                 {
                     "address": v.address.hex().upper(),
-                    "pub_key": {"type": "tendermint/PubKeyEd25519", "value": _b64(v.pub_key.bytes())},
+                    "pub_key": {"type": _PUBKEY_JSON_TYPES[v.pub_key.type_name], "value": _b64(v.pub_key.bytes())},
                     "power": str(v.power),
                     "name": v.name,
                 }
@@ -88,7 +88,17 @@ class GenesisDoc:
         doc = json.loads(data)
         validators = []
         for v in doc.get("validators") or []:
-            pk = Ed25519PubKey(_unb64(v["pub_key"]["value"]))
+            ktype = v["pub_key"].get("type", "tendermint/PubKeyEd25519")
+            if ktype == "tendermint/PubKeySecp256k1":
+                from ..crypto.secp256k1 import Secp256k1PubKey
+
+                pk = Secp256k1PubKey(_unb64(v["pub_key"]["value"]))
+            elif ktype == "tendermint/PubKeyEd25519":
+                pk = Ed25519PubKey(_unb64(v["pub_key"]["value"]))
+            else:
+                # fail fast like the reference's jsontypes decoding — a
+                # mis-parsed key type would yield a bogus validator set
+                raise ValueError(f"unsupported genesis validator key type {ktype!r}")
             validators.append(
                 GenesisValidator(
                     address=bytes.fromhex(v["address"]) if v.get("address") else pk.address(),
@@ -122,6 +132,13 @@ class GenesisDoc:
     def hash(self) -> bytes:
         """Stable digest of the genesis document (used for chunked RPC)."""
         return hashlib.sha256(self.to_json().encode()).digest()
+
+
+# Amino-era JSON type tags (ref: jsontypes registrations in crypto/*)
+_PUBKEY_JSON_TYPES = {
+    "ed25519": "tendermint/PubKeyEd25519",
+    "secp256k1": "tendermint/PubKeySecp256k1",
+}
 
 
 def _b64(data: bytes) -> str:

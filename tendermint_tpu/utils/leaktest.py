@@ -1,0 +1,53 @@
+"""Thread-leak detection for tests (ref: fortytw2/leaktest used across
+~40 reference tests; Go's leaktest asserts goroutine hygiene, this
+asserts thread hygiene).
+
+Usage:
+
+    with assert_no_thread_leaks():
+        node = Node(cfg); node.start(); ...; node.stop()
+
+At exit, any thread that appeared during the block and is still alive
+after a grace period (excluding known-daemon infrastructure) raises.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+# Threads whose lifetime legitimately exceeds a single test body.
+_ALLOWED_PREFIXES = (
+    "pydev", "ThreadPoolExecutor", "asyncio_",
+)
+
+
+def _snapshot() -> set[int]:
+    return {t.ident for t in threading.enumerate() if t.ident is not None}
+
+
+@contextmanager
+def assert_no_thread_leaks(grace: float = 3.0, allowed_prefixes: tuple = ()):
+    """Fail if threads created inside the block outlive it.
+
+    `grace` gives teardown paths time to join their workers — matching
+    leaktest.CheckTimeout semantics."""
+    before = _snapshot()
+    yield
+    deadline = time.monotonic() + grace
+    allowed = _ALLOWED_PREFIXES + tuple(allowed_prefixes)
+    while time.monotonic() < deadline:
+        leaked = [
+            t for t in threading.enumerate()
+            if t.ident is not None
+            and t.ident not in before
+            and t.is_alive()
+            and not any(t.name.startswith(p) for p in allowed)
+        ]
+        if not leaked:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        "leaked threads: " + ", ".join(sorted(t.name for t in leaked))
+    )

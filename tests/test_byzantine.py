@@ -1,0 +1,321 @@
+"""Byzantine-fault and fault-injection tests
+(ref: internal/consensus/byzantine_test.go, test/e2e/runner/perturb.go:40-72).
+
+Three scenarios:
+  1. an equivocating validator whose DuplicateVoteEvidence is committed
+     to a block while the chain keeps advancing
+  2. kill + restart of a validator node (WAL replay + catch-up)
+  3. network partition (no progress without 2/3) and heal (progress
+     resumes)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from helpers import make_genesis_doc, make_keys
+from test_consensus import fast_params, wait_for_height
+
+from tendermint_tpu.abci import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus import ConsensusState, Handshaker
+from tendermint_tpu.consensus.messages import VoteMessage
+from tendermint_tpu.evidence import EvidencePool
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.proto.messages import SIGNED_MSG_TYPE_PREVOTE
+from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from tendermint_tpu.store.blockstore import BlockStore
+from tendermint_tpu.store.kv import MemDB
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN = "byz-chain"
+
+
+def make_ev_node(keys, idx, gen_doc):
+    """In-process consensus node with a real evidence pool wired through
+    the executor, so double-signs end up committed in blocks."""
+    state = make_genesis_state(gen_doc)
+    client = LocalClient(KVStoreApplication())
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state_store.save(state)
+    state = Handshaker(state_store, state, block_store, gen_doc).handshake(client)
+    evpool = EvidencePool(MemDB(), state_store, block_store)
+    executor = BlockExecutor(
+        state_store, client, block_store=block_store, evidence_pool=evpool
+    )
+    cs = ConsensusState(
+        state,
+        executor,
+        block_store,
+        priv_validator=FilePV(priv_key=keys[idx]),
+        evidence_pool=evpool,
+    )
+    cs.evpool_ref = evpool
+    return cs
+
+
+def _wire_fanout(nodes, partitions=None):
+    """Broadcast wiring with an optional mutable partition map:
+    partitions[i] = group id; messages cross groups only when the map is
+    None (healed)."""
+
+    def wire(sender_idx):
+        def fan_out(msg):
+            for j, other in enumerate(nodes):
+                if j == sender_idx:
+                    continue
+                if partitions is not None and partitions.get("map") is not None:
+                    groups = partitions["map"]
+                    if groups[sender_idx] != groups[j]:
+                        continue  # dropped by the partition
+                other.add_peer_message(msg, peer_id=f"node{sender_idx}")
+        return fan_out
+
+    for i, n in enumerate(nodes):
+        n.broadcast = wire(i)
+
+
+def test_equivocating_validator_evidence_committed():
+    """Conflicting prevotes from validator 3 must become
+    DuplicateVoteEvidence committed in a block, and the chain must keep
+    advancing (ref: byzantine_test.go TestByzantinePrevoteEquivocation)."""
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    nodes = [make_ev_node(keys, i, gen_doc) for i in range(4)]
+    _wire_fanout(nodes)
+
+    byz_key = keys[3]
+    byz_addr = byz_key.pub_key().address()
+    state0 = nodes[0].state  # genesis-era state for the val index
+    byz_idx, _ = state0.validators.get_by_address(byz_addr)
+    assert byz_idx is not None
+
+    injected = threading.Event()
+
+    def equivocate():
+        """Watch node0's round state; at height >= 2 sign two conflicting
+        prevotes from validator 3 and deliver them everywhere."""
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not injected.is_set():
+            rs = nodes[0].rs
+            h, r = rs.height, rs.round
+            if h < 2:
+                time.sleep(0.01)
+                continue
+            ts = Time.now()
+            fakes = []
+            for tag in (b"\xaa", b"\xbb"):
+                v = Vote(
+                    type=SIGNED_MSG_TYPE_PREVOTE, height=h, round=r,
+                    block_id=BlockID(hash=tag * 32,
+                                     part_set_header=PartSetHeader(total=1, hash=tag * 32)),
+                    timestamp=ts, validator_address=byz_addr, validator_index=byz_idx,
+                )
+                v.signature = byz_key.sign(v.sign_bytes(CHAIN))
+                fakes.append(v)
+            for n in nodes[:3]:
+                for v in fakes:
+                    n.add_peer_message(VoteMessage(vote=v), peer_id="byzantine")
+            # success once any honest node buffered/pended the double-sign
+            time.sleep(0.2)
+            for n in nodes[:3]:
+                pending, _ = n.evpool_ref.pending_evidence(1 << 20)
+                with n.evpool_ref._lock:
+                    buffered = bool(n.evpool_ref._consensus_buffer)
+                if pending or buffered:
+                    injected.set()
+                    return
+
+    for n in nodes:
+        n.start()
+    th = threading.Thread(target=equivocate)
+    th.start()
+    try:
+        th.join(timeout=70)
+        assert injected.is_set(), "double-sign was never registered by any node"
+        # the evidence must be committed into some block, chain advancing
+        deadline = time.monotonic() + 60
+        committed = None
+        while time.monotonic() < deadline and committed is None:
+            store = nodes[0].block_store
+            for h in range(1, store.height() + 1):
+                b = store.load_block(h)
+                if b is not None and b.evidence:
+                    committed = (h, b.evidence)
+                    break
+            time.sleep(0.1)
+        assert committed, "evidence never committed to a block"
+        h_ev, ev_list = committed
+        assert any(
+            getattr(ev, "vote_a", None) is not None and ev.vote_a.validator_address == byz_addr
+            for ev in ev_list
+        ), f"committed evidence {ev_list} does not implicate the byzantine validator"
+        # liveness: chain continues past the evidence block
+        assert wait_for_height(nodes[:3], h_ev + 2, timeout=60)
+    finally:
+        injected.set()
+        for n in nodes:
+            n.stop()
+
+
+def test_partition_halts_then_heals(tmp_path):
+    """2-2 partition of a TCP testnet: neither side has 2/3, so no
+    progress; healing resumes progress — recovery rides the consensus
+    reactor's vote-catchup gossip (ref: e2e disconnect perturbation,
+    test/e2e/runner/perturb.go:40-72)."""
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "4", "--output", out,
+                     "--chain-id", "part-chain", "--starting-port", "0"]) == 0
+    g0 = os.path.join(out, "node0", "config", "genesis.json")
+    gen_doc = GenesisDoc.from_file(g0)
+    gen_doc.consensus_params = fast_params()
+    for i in range(4):
+        gen_doc.save_as(os.path.join(out, f"node{i}", "config", "genesis.json"))
+
+    nodes = []
+    for i in range(4):
+        cfg = load_config(os.path.join(out, f"node{i}"))
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.persistent_peers = ""
+        nodes.append(Node(cfg))
+    for n in nodes:
+        n.start()
+    for i, a in enumerate(nodes):
+        for j, b in enumerate(nodes):
+            if i < j:
+                a.dial(b)
+
+    group = {nodes[0].node_id: 0, nodes[1].node_id: 0, nodes[2].node_id: 1, nodes[3].node_id: 1}
+    partitioned = {"on": False}
+
+    def make_filter(own_id):
+        def flt(peer_id):
+            if partitioned["on"] and group.get(peer_id) is not None and group[peer_id] != group[own_id]:
+                raise ValueError("partitioned")
+        return flt
+
+    def _wait(cond, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.05)
+        return False
+
+    try:
+        assert _wait(lambda: all(n.block_store.height() >= 2 for n in nodes), 90), (
+            f"no progress before partition: {[n.block_store.height() for n in nodes]}"
+        )
+        # engage the partition: reject cross-group handshakes and evict
+        # current cross-group connections
+        for n in nodes:
+            n.router.options.filter_peer_by_id = make_filter(n.node_id)
+        partitioned["on"] = True
+        for n in nodes:
+            for pid in n.peer_manager.peers():
+                if group.get(pid) is not None and group[pid] != group[n.node_id]:
+                    n.peer_manager.errored(pid, ValueError("partition"))
+        assert _wait(
+            lambda: all(
+                not any(group.get(p) != group[n.node_id] for p in n.peer_manager.peers())
+                for n in nodes
+            ),
+            30,
+        ), "cross-group connections survived the partition"
+        h0 = max(n.block_store.height() for n in nodes)
+        time.sleep(4.0)
+        h1 = max(n.block_store.height() for n in nodes)
+        assert h1 <= h0 + 1, f"chain advanced {h0}->{h1} during a 2-2 partition"
+        # heal
+        partitioned["on"] = False
+        assert _wait(lambda: all(n.block_store.height() >= h1 + 2 for n in nodes), 120), (
+            f"no progress after heal: {[n.block_store.height() for n in nodes]}"
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_kill_and_restart_validator(tmp_path):
+    """Kill one of four TCP validators mid-run; the survivors advance
+    (3/4 > 2/3); a restarted node on the same home dir WAL-replays and
+    catches up (ref: e2e kill/restart perturbation)."""
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "4", "--output", out,
+                     "--chain-id", "kill-chain", "--starting-port", "0"]) == 0
+    g0 = os.path.join(out, "node0", "config", "genesis.json")
+    gen_doc = GenesisDoc.from_file(g0)
+    gen_doc.consensus_params = fast_params()
+    for i in range(4):
+        gen_doc.save_as(os.path.join(out, f"node{i}", "config", "genesis.json"))
+
+    cfgs, nodes = [], []
+    for i in range(4):
+        cfg = load_config(os.path.join(out, f"node{i}"))
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.persistent_peers = ""
+        cfgs.append(cfg)
+        nodes.append(Node(cfg))
+    for n in nodes:
+        n.start()
+    for i, a in enumerate(nodes):
+        for j, b in enumerate(nodes):
+            if i < j:
+                a.dial(b)
+
+    def _wait(cond, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.05)
+        return False
+
+    try:
+        assert _wait(lambda: all(n.block_store.height() >= 2 for n in nodes), 90)
+        # kill node3
+        victim_height = nodes[3].block_store.height()
+        nodes[3].stop()
+        # survivors keep advancing without it
+        target = max(n.block_store.height() for n in nodes[:3]) + 3
+        assert _wait(lambda: all(n.block_store.height() >= target for n in nodes[:3]), 90), (
+            f"survivors stalled at {[n.block_store.height() for n in nodes[:3]]}"
+        )
+        # restart on the same home dir: WAL replay + blocksync catch-up
+        restarted = Node(cfgs[3])
+        nodes[3] = restarted
+        restarted.start()
+        for peer in nodes[:3]:
+            restarted.dial(peer)
+        assert restarted.block_store.height() >= victim_height, "lost committed blocks on restart"
+        goal = max(n.block_store.height() for n in nodes[:3]) + 1
+        assert _wait(lambda: restarted.block_store.height() >= goal, 120), (
+            f"restarted node stuck at {restarted.block_store.height()} < {goal}"
+        )
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass

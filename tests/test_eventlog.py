@@ -1,0 +1,167 @@
+"""Event log + /events polling RPC tests (ref: internal/eventlog/
+eventlog_test.go, internal/rpc/core/events.go)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu.eventbus.eventlog import Cursor, EventLog
+
+
+def test_cursor_ordering_and_parse():
+    a, b = Cursor(100, 0), Cursor(100, 1)
+    c = Cursor(101, 0)
+    assert a < b < c
+    assert Cursor.parse(str(b)) == b
+    assert str(a) < str(b) < str(c)  # lexicographic == temporal
+
+
+def test_add_scan_newest_first():
+    clock = {"t": 1_000_000_000_000}
+    log = EventLog(window_ns=60_000_000_000, now=lambda: clock["t"])
+    for i in range(5):
+        clock["t"] += 1_000_000
+        log.add("NewBlock", {"i": i})
+    items, more, oldest, newest = log.scan(max_items=3)
+    assert [it.data["i"] for it in items] == [4, 3, 2]
+    assert more
+    assert newest == items[0].cursor
+
+
+def test_window_pruning():
+    clock = {"t": 1_000_000_000_000}
+    log = EventLog(window_ns=1_000_000_000, now=lambda: clock["t"])  # 1s window
+    log.add("Old", {})
+    clock["t"] += 5_000_000_000  # 5s later
+    log.add("New", {})
+    items, _, _, _ = log.scan(max_items=10)
+    assert [it.type for it in items] == ["New"]
+
+
+def test_after_cursor_pagination():
+    clock = {"t": 1_000_000_000_000}
+    log = EventLog(now=lambda: clock["t"])
+    for i in range(4):
+        clock["t"] += 1_000_000
+        log.add("E", {"i": i})
+    first, _, _, newest = log.scan(max_items=10)
+    # poll for newer items only: nothing yet
+    items, more, _, _ = log.scan(after=newest, max_items=10)
+    assert items == []
+    clock["t"] += 1_000_000
+    log.add("E", {"i": 99})
+    items, _, _, _ = log.scan(after=newest, max_items=10)
+    assert [it.data["i"] for it in items] == [99]
+
+
+def test_wait_scan_long_poll():
+    log = EventLog()
+    import threading
+
+    def later():
+        time.sleep(0.15)
+        log.add("Ping", {"x": 1})
+
+    threading.Thread(target=later).start()
+    t0 = time.monotonic()
+    items, _, _, _ = log.wait_scan(after=None, max_items=5, timeout=3.0)
+    assert items and time.monotonic() - t0 < 2.0
+
+
+def test_events_rpc_over_running_node(tmp_path):
+    """A client pages all block events of a live node via /events without
+    a WebSocket (ref: rpc/client/eventstream in spirit)."""
+    from test_consensus import fast_params
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc.client import HTTPClient
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", "ev-chain", "--starting-port", "0"]) == 0
+    gp = os.path.join(out, "node0", "config", "genesis.json")
+    gd = GenesisDoc.from_file(gp)
+    gd.consensus_params = fast_params()
+    gd.save_as(gp)
+    cfg = load_config(os.path.join(out, "node0"))
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    n = Node(cfg)
+    n.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and n.block_store.height() < 3:
+            time.sleep(0.05)
+        assert n.block_store.height() >= 3
+        host, port = n.rpc_address
+        c = HTTPClient(f"http://{host}:{port}")
+        res = c.call("events", filter={"query": "tm.event = 'NewBlock'"}, maxItems=2)
+        assert res["items"], "no NewBlock events in the log"
+        assert all(it["data"]["type"] == "tendermint/event/NewBlock" for it in res["items"])
+        # page backwards with `before` until exhausted
+        seen = {it["cursor"] for it in res["items"]}
+        cursor = res["items"][-1]["cursor"]
+        for _ in range(50):
+            page = c.call("events", filter={"query": "tm.event = 'NewBlock'"},
+                          maxItems=2, before=cursor)
+            if not page["items"]:
+                break
+            for it in page["items"]:
+                assert it["cursor"] not in seen, "duplicate event across pages"
+                seen.add(it["cursor"])
+            cursor = page["items"][-1]["cursor"]
+        assert len(seen) >= 3  # one per committed block at least
+        # long-poll returns a fresh event
+        newest = c.call("events", maxItems=1)["newest"]
+        res = c.call("events", after=newest, waitTime=5_000_000_000, maxItems=5)
+        assert res["items"], "long-poll returned nothing while blocks are being produced"
+    finally:
+        n.stop()
+
+
+def test_eventstream_client_pages_live_events(tmp_path):
+    """EventStream long-polls /events and yields each NewBlock exactly
+    once, oldest-first (ref: rpc/client/eventstream)."""
+    from test_consensus import fast_params
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc.client import EventStream, HTTPClient
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", "es-chain", "--starting-port", "0"]) == 0
+    gp = os.path.join(out, "node0", "config", "genesis.json")
+    gd = GenesisDoc.from_file(gp)
+    gd.consensus_params = fast_params()
+    gd.save_as(gp)
+    cfg = load_config(os.path.join(out, "node0"))
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    n = Node(cfg)
+    n.start()
+    try:
+        host, port = n.rpc_address
+        stream = EventStream(HTTPClient(f"http://{host}:{port}"),
+                             query="tm.event = 'NewBlock'", wait_time_s=3.0)
+        heights, cursors = [], set()
+        deadline = time.monotonic() + 30
+        while len(heights) < 4 and time.monotonic() < deadline:
+            for it in stream.next_batch():
+                assert it["cursor"] not in cursors
+                cursors.add(it["cursor"])
+                heights.append(int(it["data"]["value"]["block"]["header"]["height"]))
+        assert len(heights) >= 4
+        assert heights == sorted(heights), f"out of order: {heights}"
+        assert len(set(heights)) == len(heights), "duplicate blocks"
+    finally:
+        n.stop()

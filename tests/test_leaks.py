@@ -1,0 +1,58 @@
+"""Thread-hygiene tests (ref: the reference's leaktest usage — e.g.
+internal/p2p/router_test.go wraps tests in leaktest.Check)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_consensus import fast_params
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.node.seed import SeedNode
+from tendermint_tpu.types.genesis import GenesisDoc
+from tendermint_tpu.utils.leaktest import assert_no_thread_leaks
+
+
+def test_node_start_stop_leaks_no_threads(tmp_path):
+    """A full node start/stop cycle must join every thread it spawned
+    (router loops, reactors, consensus, RPC, watchers)."""
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", "leak-chain", "--starting-port", "0"]) == 0
+    gp = os.path.join(out, "node0", "config", "genesis.json")
+    gd = GenesisDoc.from_file(gp)
+    gd.consensus_params = fast_params()
+    gd.save_as(gp)
+    cfg = load_config(os.path.join(out, "node0"))
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+
+    with assert_no_thread_leaks(grace=8.0):
+        n = Node(cfg)
+        n.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and n.block_store.height() < 2:
+            time.sleep(0.05)
+        assert n.block_store.height() >= 2
+        n.stop()
+
+
+def test_seed_node_start_stop_leaks_no_threads(tmp_path):
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", "leak2-chain", "--starting-port", "0"]) == 0
+    cfg = load_config(os.path.join(out, "node0"))
+    cfg.base.mode = "seed"
+    cfg.base.db_backend = "memdb"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    with assert_no_thread_leaks(grace=5.0):
+        s = SeedNode(cfg)
+        s.start()
+        time.sleep(0.5)
+        s.stop()

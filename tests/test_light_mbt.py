@@ -1,0 +1,151 @@
+"""Model-based light-client conformance: replay the TLA+-derived JSON
+traces against our verifier (ref: light/mbt/driver_test.go:18; traces at
+/root/reference/light/mbt/json, generated from spec/light-client TLA+).
+
+The traces are spec-generated public test *data*, read in place — each
+carries a trusted state plus a sequence of (light block, now, verdict)
+inputs; verdicts: SUCCESS / NOT_ENOUGH_TRUST / INVALID.
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+from tendermint_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired,
+    verify,
+)
+from tendermint_tpu.types.block import BlockID, Commit, CommitSig, Header, PartSetHeader
+from tendermint_tpu.types.light_block import SignedHeader
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.utils.tmtime import Time
+
+JSON_DIR = "/root/reference/light/mbt/json"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(JSON_DIR), reason="reference MBT traces not mounted"
+)
+
+
+def _hex(s) -> bytes:
+    return bytes.fromhex(s) if s else b""
+
+
+def _header(d: dict) -> Header:
+    lbi = d.get("last_block_id") or {}
+    parts = lbi.get("parts") or {}
+    return Header(
+        version_block=int(d["version"]["block"]),
+        version_app=int(d["version"].get("app") or 0),
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time=Time.parse_rfc3339(d["time"]),
+        last_block_id=BlockID(
+            hash=_hex(lbi.get("hash")),
+            part_set_header=PartSetHeader(total=parts.get("total") or 0, hash=_hex(parts.get("hash"))),
+        ),
+        last_commit_hash=_hex(d.get("last_commit_hash")),
+        data_hash=_hex(d.get("data_hash")),
+        validators_hash=_hex(d.get("validators_hash")),
+        next_validators_hash=_hex(d.get("next_validators_hash")),
+        consensus_hash=_hex(d.get("consensus_hash")),
+        app_hash=_hex(d.get("app_hash")),
+        last_results_hash=_hex(d.get("last_results_hash")),
+        evidence_hash=_hex(d.get("evidence_hash")),
+        proposer_address=_hex(d.get("proposer_address")),
+    )
+
+
+def _commit(d: dict) -> Commit:
+    bid = d["block_id"]
+    parts = bid.get("parts") or {}
+    sigs = []
+    for s in d.get("signatures") or []:
+        sigs.append(
+            CommitSig(
+                block_id_flag=s["block_id_flag"],
+                validator_address=_hex(s.get("validator_address")),
+                timestamp=Time.parse_rfc3339(s["timestamp"]) if s.get("timestamp") else Time(),
+                signature=base64.b64decode(s["signature"]) if s.get("signature") else b"",
+            )
+        )
+    return Commit(
+        height=int(d["height"]),
+        round=d.get("round") or 0,
+        block_id=BlockID(
+            hash=_hex(bid.get("hash")),
+            part_set_header=PartSetHeader(total=parts.get("total") or 0, hash=_hex(parts.get("hash"))),
+        ),
+        signatures=sigs,
+    )
+
+
+def _valset(d: dict) -> ValidatorSet:
+    vals = []
+    for v in d.get("validators") or []:
+        pk = Ed25519PubKey(base64.b64decode(v["pub_key"]["value"]))
+        vals.append(Validator(address=_hex(v["address"]), pub_key=pk, voting_power=int(v["voting_power"])))
+    return ValidatorSet.new(vals)
+
+
+def _signed_header(d: dict) -> SignedHeader:
+    return SignedHeader(header=_header(d["header"]), commit=_commit(d["commit"]))
+
+
+TRACES = sorted(glob.glob(os.path.join(JSON_DIR, "*.json")))
+
+
+@pytest.mark.parametrize("path", TRACES, ids=[os.path.basename(p) for p in TRACES])
+def test_mbt_trace(path):
+    tc = json.load(open(path))
+    initial = tc["initial"]
+    trusted_sh = _signed_header(initial["signed_header"])
+    trusted_next_vals = _valset(initial["next_validator_set"])
+    trusting_period_ns = int(initial["trusting_period"])
+    chain_id = trusted_sh.header.chain_id
+
+    for step, inp in enumerate(tc["input"]):
+        lb = inp["block"]
+        new_sh = _signed_header(lb["signed_header"])
+        new_vals = _valset(lb["validator_set"])
+        now = Time.parse_rfc3339(inp["now"])
+        verdict = inp["verdict"]
+        err = None
+        try:
+            verify(
+                chain_id,
+                trusted_sh,
+                trusted_next_vals,
+                new_sh,
+                new_vals,
+                trusting_period_ns,
+                now,
+                1_000_000_000,  # 1s max clock drift, as the driver uses
+                DEFAULT_TRUST_LEVEL,
+            )
+        except Exception as e:
+            err = e
+        ctx = f"{os.path.basename(path)} step {step} ({trusted_sh.height}->{new_sh.height})"
+        if verdict == "SUCCESS":
+            assert err is None, f"{ctx}: expected SUCCESS, got {type(err).__name__}: {err}"
+            trusted_sh = new_sh
+            trusted_next_vals = _valset(lb["next_validator_set"])
+        elif verdict == "NOT_ENOUGH_TRUST":
+            assert isinstance(err, ErrNewValSetCantBeTrusted), (
+                f"{ctx}: expected NOT_ENOUGH_TRUST, got {type(err).__name__}: {err}"
+            )
+        elif verdict == "INVALID":
+            assert isinstance(err, (ErrInvalidHeader, ErrOldHeaderExpired)), (
+                f"{ctx}: expected INVALID, got {type(err).__name__}: {err}"
+            )
+        else:
+            raise AssertionError(f"unexpected verdict {verdict!r}")

@@ -1,0 +1,99 @@
+"""Light proxy tests: verifying RPC façade over a running node
+(ref: light/proxy/proxy.go, light/rpc/client.go)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_consensus import fast_params
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.light import LightClient, TrustOptions
+from tendermint_tpu.light.http_provider import HTTPProvider
+from tendermint_tpu.light.proxy import LightProxy
+from tendermint_tpu.node import Node
+from tendermint_tpu.rpc.client import HTTPClient, RPCClientError
+from tendermint_tpu.types.genesis import GenesisDoc
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("lpnet"))
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", "lp-chain", "--starting-port", "0"]) == 0
+    gp = os.path.join(out, "node0", "config", "genesis.json")
+    gd = GenesisDoc.from_file(gp)
+    gd.consensus_params = fast_params()
+    gd.save_as(gp)
+    cfg = load_config(os.path.join(out, "node0"))
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    n = Node(cfg)
+    n.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and n.block_store.height() < 4:
+        time.sleep(0.05)
+    assert n.block_store.height() >= 4
+    yield n
+    n.stop()
+
+
+@pytest.fixture(scope="module")
+def proxy(node):
+    host, port = node.rpc_address
+    primary_url = f"http://{host}:{port}"
+    primary = HTTPProvider("lp-chain", primary_url)
+    lb1 = primary.light_block(1)
+    opts = TrustOptions(period_ns=3600 * 10**9, height=1, hash=lb1.signed_header.hash())
+    lc = LightClient("lp-chain", opts, primary)
+    p = LightProxy(lc, primary_url)
+    p.start()
+    yield p
+    p.stop()
+
+
+def _client(proxy) -> HTTPClient:
+    host, port = proxy.address
+    return HTTPClient(f"http://{host}:{port}")
+
+
+def test_proxy_block_verified(proxy, node):
+    c = _client(proxy)
+    res = c.call("block", height="2")
+    direct = HTTPClient(f"http://{node.rpc_address[0]}:{node.rpc_address[1]}").call("block", height="2")
+    assert res["block_id"]["hash"] == direct["block_id"]["hash"]
+
+
+def test_proxy_header_and_validators(proxy):
+    c = _client(proxy)
+    h = c.call("header", height="3")
+    assert h["header"]["height"] == "3" and h["header"]["chain_id"] == "lp-chain"
+    v = c.call("validators", height="3")
+    assert v["count"] == "1" and len(v["validators"]) == 1
+
+
+def test_proxy_status_reports_verified_head(proxy):
+    c = _client(proxy)
+    res = c.call("status")
+    assert int(res["sync_info"]["latest_block_height"]) >= 2
+    assert res["node_info"]  # forwarded from primary
+
+
+def test_proxy_commit_and_passthrough(proxy):
+    c = _client(proxy)
+    res = c.call("commit", height="2")
+    assert res["signed_header"]["commit"]["height"] == "2"
+    assert c.call("health") == {}
+
+
+def test_proxy_requires_height(proxy):
+    c = _client(proxy)
+    with pytest.raises(RPCClientError, match="height"):
+        c.call("block")

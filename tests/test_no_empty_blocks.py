@@ -1,0 +1,64 @@
+"""create_empty_blocks=false: the chain must stall without txs and make
+a block promptly once a tx arrives (ref: consensus/state.go:1143
+handleTxsAvailable + enterNewRound waitForTxs)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_consensus import fast_params
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.rpc.client import HTTPClient
+from tendermint_tpu.types.genesis import GenesisDoc
+
+
+def test_no_empty_blocks_waits_for_txs(tmp_path):
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", "neb-chain", "--starting-port", "0"]) == 0
+    gp = os.path.join(out, "node0", "config", "genesis.json")
+    gd = GenesisDoc.from_file(gp)
+    gd.consensus_params = fast_params()
+    gd.save_as(gp)
+    cfg = load_config(os.path.join(out, "node0"))
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.create_empty_blocks = False
+    n = Node(cfg)
+    n.start()
+    try:
+        # height 1 is the proof block (initial height) and may commit;
+        # beyond that the chain must stall with an empty mempool
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+        h_stalled = n.block_store.height()
+        time.sleep(3.0)
+        assert n.block_store.height() <= h_stalled + 1, (
+            f"empty blocks kept flowing: {h_stalled} -> {n.block_store.height()}"
+        )
+        # a tx must unblock block production promptly
+        host, port = n.rpc_address
+        c = HTTPClient(f"http://{host}:{port}")
+        res = c.call("broadcast_tx_sync", tx=b"neb=1".hex())
+        assert int(res["code"]) == 0, res
+        h0 = n.block_store.height()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and n.block_store.height() <= h0:
+            time.sleep(0.05)
+        assert n.block_store.height() > h0, "tx did not trigger a block"
+        # the tx is committed
+        blk = n.block_store.load_block(n.block_store.height())
+        found = any(b"neb=1" in (blk2 := n.block_store.load_block(h)).txs
+                    for h in range(h0, n.block_store.height() + 1)
+                    if n.block_store.load_block(h) is not None)
+        assert found, "tx not found in any new block"
+    finally:
+        n.stop()

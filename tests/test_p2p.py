@@ -242,3 +242,25 @@ def test_node_info_compatibility():
     c = NodeInfo(node_id="cc" * 20, network="net-2", channels=bytes([0x20]))
     with pytest.raises(ValueError):
         a.compatible_with(c)
+
+
+def test_conn_tracker_limits_per_ip():
+    """ref: internal/p2p/conn_tracker_test.go."""
+    from tendermint_tpu.p2p.conn_tracker import ConnTracker
+
+    t = ConnTracker(max_per_ip=2, window=0.0)
+    t.add_conn("10.0.0.1")
+    t.add_conn("10.0.0.1")
+    import pytest as _pytest
+
+    with _pytest.raises(ConnectionRefusedError, match="too many"):
+        t.add_conn("10.0.0.1")
+    t.add_conn("10.0.0.2")  # other IPs unaffected
+    t.remove_conn("10.0.0.1")
+    t.add_conn("10.0.0.1")  # slot freed
+    assert t.len("10.0.0.1") == 2
+
+    t2 = ConnTracker(max_per_ip=8, window=10.0)
+    t2.add_conn("10.0.0.3")
+    with _pytest.raises(ConnectionRefusedError, match="rate-limited"):
+        t2.add_conn("10.0.0.3")

@@ -1,0 +1,179 @@
+"""Remote signer tests (ref: privval/signer_client_test.go,
+signer_listener_endpoint_test.go)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.privval.file_pv import DoubleSignError
+from tendermint_tpu.privval.remote import (
+    RemoteSignerErrorException,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.proto.messages import SIGNED_MSG_TYPE_PRECOMMIT, SIGNED_MSG_TYPE_PREVOTE
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN_ID = "remote-signer-chain"
+
+
+def _block_id() -> BlockID:
+    return BlockID(hash=b"\x11" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32))
+
+
+def _vote(height=5, round_=0, type_=SIGNED_MSG_TYPE_PREVOTE) -> Vote:
+    return Vote(
+        type=type_, height=height, round=round_, block_id=_block_id(),
+        timestamp=Time.now(), validator_address=b"\x01" * 20, validator_index=0,
+    )
+
+
+@pytest.fixture(params=["tcp", "unix"])
+def signer_pair(request, tmp_path):
+    """(endpoint, client, server, file_pv) over tcp (SecretConnection)
+    or unix (plain)."""
+    if request.param == "tcp":
+        addr = "tcp://127.0.0.1:0"
+    else:
+        addr = f"unix://{tmp_path}/signer.sock"
+    pv = FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+    pv.save_key()
+    endpoint = SignerListenerEndpoint(addr)
+    endpoint.start()
+    server = SignerServer(endpoint.bound_addr, pv, CHAIN_ID)
+    server.start()
+    client = SignerClient(endpoint, CHAIN_ID)
+    yield endpoint, client, server, pv
+    server.stop()
+    endpoint.stop()
+
+
+def test_remote_pubkey(signer_pair):
+    endpoint, client, server, pv = signer_pair
+    assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+    assert client.address() == pv.get_pub_key().address()
+
+
+def test_remote_sign_vote_verifies(signer_pair):
+    endpoint, client, server, pv = signer_pair
+    vote = _vote()
+    client.sign_vote(CHAIN_ID, vote)
+    assert vote.signature
+    assert pv.get_pub_key().verify_signature(vote.sign_bytes(CHAIN_ID), vote.signature)
+
+
+def test_remote_sign_proposal_verifies(signer_pair):
+    endpoint, client, server, pv = signer_pair
+    prop = Proposal(height=5, round=0, pol_round=-1, block_id=_block_id(), timestamp=Time.now())
+    client.sign_proposal(CHAIN_ID, prop)
+    assert prop.signature
+    assert pv.get_pub_key().verify_signature(prop.sign_bytes(CHAIN_ID), prop.signature)
+
+
+def test_remote_double_sign_rejected(signer_pair):
+    endpoint, client, server, pv = signer_pair
+    v1 = _vote(height=7)
+    client.sign_vote(CHAIN_ID, v1)
+    conflicting = _vote(height=7)
+    conflicting.block_id = BlockID(hash=b"\x99" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x88" * 32))
+    with pytest.raises(RemoteSignerErrorException):
+        client.sign_vote(CHAIN_ID, conflicting)
+
+
+def test_remote_ping(signer_pair):
+    endpoint, client, server, pv = signer_pair
+    assert client.ping()
+
+
+def test_double_sign_guard_across_signer_restart(tmp_path):
+    """Kill the signer, restart it on the same state file: the conflicting
+    vote must still be refused (the guard lives in the signer's
+    last-sign-state, not the connection)."""
+    addr = "tcp://127.0.0.1:0"
+    key_f, state_f = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(key_f, state_f)
+    pv.save_key()
+    endpoint = SignerListenerEndpoint(addr)
+    endpoint.start()
+    server = SignerServer(endpoint.bound_addr, pv, CHAIN_ID)
+    server.start()
+    client = SignerClient(endpoint, CHAIN_ID)
+    try:
+        v1 = _vote(height=9, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+        client.sign_vote(CHAIN_ID, v1)
+        server.stop()
+        # reload the privval from disk — a fresh signer process
+        pv2 = FilePV.load(key_f, state_f)
+        server = SignerServer(endpoint.bound_addr, pv2, CHAIN_ID)
+        server.start()
+        conflicting = _vote(height=9, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+        conflicting.block_id = BlockID(hash=b"\x99" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x88" * 32))
+        deadline = time.monotonic() + 10
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                with pytest.raises(RemoteSignerErrorException):
+                    client.sign_vote(CHAIN_ID, conflicting)
+                break
+            except (TimeoutError, ConnectionError, OSError) as e:
+                last_err = e  # signer still reconnecting
+                time.sleep(0.2)
+        else:
+            raise AssertionError(f"signer never reconnected: {last_err}")
+        # re-signing the SAME vote is fine (idempotent re-sign)
+        same = _vote(height=9, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+        same.timestamp = v1.timestamp
+        client.sign_vote(CHAIN_ID, same)
+        assert same.signature == v1.signature
+    finally:
+        server.stop()
+        endpoint.stop()
+
+
+def test_node_with_remote_signer(tmp_path):
+    """A single-validator node whose votes are signed by an external
+    signer process over the privval socket produces blocks."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_consensus import fast_params
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out, "--chain-id", "rs-chain",
+                     "--starting-port", "0"]) == 0
+    gen_path = os.path.join(out, "node0", "config", "genesis.json")
+    gen_doc = GenesisDoc.from_file(gen_path)
+    gen_doc.consensus_params = fast_params()
+    gen_doc.save_as(gen_path)
+
+    cfg = load_config(os.path.join(out, "node0"))
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.base.priv_validator_laddr = f"unix://{tmp_path}/pv.sock"
+
+    # external signer holding the validator key
+    pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    server = SignerServer(cfg.base.priv_validator_laddr, pv, "rs-chain")
+    server.start()
+
+    node = Node(cfg)
+    node.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and node.block_store.height() < 2:
+            time.sleep(0.1)
+        assert node.block_store.height() >= 2
+    finally:
+        node.stop()
+        server.stop()

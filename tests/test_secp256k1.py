@@ -1,0 +1,125 @@
+"""secp256k1 + mixed-keytype commit tests (ref: crypto/secp256k1/
+secp256k1_test.go, types/validation.go serial fallback)."""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from helpers import make_block_id
+from tendermint_tpu.crypto.batch import supports_batch_verifier
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.crypto.encoding import pubkey_from_proto, pubkey_to_proto
+from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey, Secp256k1PubKey, _HALF_N, _N
+from tendermint_tpu.proto.messages import BLOCK_ID_FLAG_COMMIT, SIGNED_MSG_TYPE_PRECOMMIT
+from tendermint_tpu.types.block import Commit, CommitSig
+from tendermint_tpu.types.validation import verify_commit, verify_commit_light
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN_ID = "secp-chain"
+
+
+def test_sign_verify_roundtrip():
+    sk = Secp256k1PrivKey.generate(b"test-secret")
+    pk = sk.pub_key()
+    sig = sk.sign(b"hello")
+    assert len(sig) == 64
+    assert pk.verify_signature(b"hello", sig)
+    assert not pk.verify_signature(b"hellp", sig)
+    assert not pk.verify_signature(b"hello", sig[:-1] + bytes([sig[-1] ^ 1]))
+
+
+def test_low_s_enforced():
+    sk = Secp256k1PrivKey.generate(b"low-s")
+    sig = sk.sign(b"msg")
+    s = int.from_bytes(sig[32:], "big")
+    assert s <= _HALF_N
+    # the high-S twin must be rejected (malleability guard)
+    high_s = _N - s
+    mal = sig[:32] + high_s.to_bytes(32, "big")
+    assert not sk.pub_key().verify_signature(b"msg", mal)
+
+
+def test_deterministic_keygen_matches_reference_formula():
+    secret = b"the quick brown fox"
+    sk = Secp256k1PrivKey.generate(secret)
+    fe = int.from_bytes(hashlib.sha256(secret).digest(), "big")
+    expected = (fe % (_N - 1)) + 1
+    assert int.from_bytes(sk.bytes(), "big") == expected
+
+
+def test_address_is_bitcoin_style():
+    sk = Secp256k1PrivKey.generate(b"addr")
+    pk = sk.pub_key()
+    sha = hashlib.sha256(pk.bytes()).digest()
+    assert pk.address() == hashlib.new("ripemd160", sha).digest()
+    assert len(pk.address()) == 20
+    assert pk.bytes()[0] in (2, 3) and len(pk.bytes()) == 33
+
+
+def test_proto_roundtrip():
+    pk = Secp256k1PrivKey.generate(b"proto").pub_key()
+    p = pubkey_to_proto(pk)
+    back = pubkey_from_proto(p)
+    assert isinstance(back, Secp256k1PubKey) and back.bytes() == pk.bytes()
+
+
+def test_no_batch_support():
+    assert not supports_batch_verifier(Secp256k1PrivKey.generate(b"x").pub_key())
+
+
+def _signed_commit(keys, powers, height=3):
+    """Build a valset + fully signed commit for a mixed key list."""
+    vals = ValidatorSet.new(
+        [Validator.new(k.pub_key(), p) for k, p in zip(keys, powers)]
+    )
+    block_id = make_block_id()
+    sigs = [None] * len(keys)
+    ts = Time.now()
+    ordered = {v.address: i for i, v in enumerate(vals.validators)}
+    for k in keys:
+        idx = ordered[k.pub_key().address()]
+        vote = Vote(
+            type=SIGNED_MSG_TYPE_PRECOMMIT, height=height, round=0, block_id=block_id,
+            timestamp=ts, validator_address=k.pub_key().address(), validator_index=idx,
+        )
+        sigs[idx] = CommitSig(
+            block_id_flag=BLOCK_ID_FLAG_COMMIT,
+            validator_address=k.pub_key().address(),
+            timestamp=ts,
+            signature=k.sign(vote.sign_bytes(CHAIN_ID)),
+        )
+    return vals, Commit(height=height, round=0, block_id=block_id, signatures=sigs)
+
+
+def test_mixed_commit_secp_proposer_serial_fallback():
+    """Proposer secp256k1 -> shouldBatchVerify false -> serial path
+    verifies the mixed commit (ref: types/validation.go:14,267)."""
+    keys = [Secp256k1PrivKey.generate(b"v0"), Ed25519PrivKey.generate(b"\x01" * 32),
+            Ed25519PrivKey.generate(b"\x02" * 32)]
+    powers = [100, 10, 10]  # secp val has max priority -> proposer
+    vals, commit = _signed_commit(keys, powers)
+    assert vals.get_proposer().pub_key.type_name == "secp256k1"
+    verify_commit(CHAIN_ID, vals, commit.block_id, commit.height, commit)
+    verify_commit_light(CHAIN_ID, vals, commit.block_id, commit.height, commit)
+
+
+def test_all_secp_commit_verifies():
+    keys = [Secp256k1PrivKey.generate(bytes([i])) for i in range(4)]
+    vals, commit = _signed_commit(keys, [10, 10, 10, 10])
+    verify_commit(CHAIN_ID, vals, commit.block_id, commit.height, commit)
+
+
+def test_mixed_commit_bad_sig_rejected():
+    keys = [Secp256k1PrivKey.generate(b"v0"), Ed25519PrivKey.generate(b"\x03" * 32)]
+    vals, commit = _signed_commit(keys, [100, 10])
+    bad = commit.signatures[1]
+    commit.signatures[1] = CommitSig(
+        block_id_flag=bad.block_id_flag, validator_address=bad.validator_address,
+        timestamp=bad.timestamp, signature=bytes(64),
+    )
+    with pytest.raises(ValueError, match="wrong signature"):
+        verify_commit(CHAIN_ID, vals, commit.block_id, commit.height, commit)
