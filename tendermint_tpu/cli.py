@@ -43,20 +43,28 @@ def cmd_init(args) -> int:
 
 
 def cmd_start(args) -> int:
-    """ref: commands/run_node.go:97 NewRunNodeCmd."""
+    """ref: commands/run_node.go:97 NewRunNodeCmd (seed mode dispatches
+    to the pex-only seed node, node/seed.go)."""
     from .config import load_config
     from .node import Node
 
     cfg = load_config(args.home)
     if args.proxy_app:
         cfg.base.proxy_app = args.proxy_app
-    node = Node(cfg)
-    node.start()
-    rpc = node.rpc_address
-    print(f"node {node.node_id} started")
-    print(f"  p2p: {node.p2p_endpoint}")
-    if rpc:
-        print(f"  rpc: http://{rpc[0]}:{rpc[1]}")
+    if cfg.base.mode == "seed":
+        from .node.seed import SeedNode
+
+        node = SeedNode(cfg)
+        node.start()
+        print(f"seed node {node.node_id} started\n  p2p: {node.endpoint()}")
+    else:
+        node = Node(cfg)
+        node.start()
+        rpc = node.rpc_address
+        print(f"node {node.node_id} started")
+        print(f"  p2p: {node.p2p_endpoint}")
+        if rpc:
+            print(f"  rpc: http://{rpc[0]}:{rpc[1]}")
 
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -301,7 +309,7 @@ def cmd_debug(args) -> int:
     def capture(zf: zipfile.ZipFile, tag: str) -> None:
         client = HTTPClient(args.rpc_laddr, timeout=5.0)
         for route in ("status", "consensus_state", "dump_consensus_state", "net_info",
-                      "num_unconfirmed_txs"):
+                      "num_unconfirmed_txs", "debug_threads"):
             try:
                 res = client.call(route)
             except Exception as e:

@@ -60,6 +60,7 @@ class Manifest:
                     abci_protocol=nd.get("abci_protocol", "builtin"),
                     perturb=list(nd.get("perturb", [])),
                     start_at=int(nd.get("start_at", 0)),
+                    send_rate=int(nd.get("send_rate", NodeManifest.send_rate)),
                 )
             )
         if not m.nodes:

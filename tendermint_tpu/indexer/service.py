@@ -64,10 +64,14 @@ class IndexerService:
         data = msg.data  # EventDataNewBlock
         block = data.block
         f_res = data.result_finalize_block
-        try:
-            self.indexer.index_block_events(block.header.height, f_res)
-            self.indexer.index_tx_events(block.header.height, list(block.txs), list(f_res.tx_results))
-        except Exception:
-            import traceback
+        # self.indexer may be one sink or a list of sinks (ref:
+        # EventSinksFromConfig returns a slice, node/setup.go)
+        sinks = self.indexer if isinstance(self.indexer, (list, tuple)) else [self.indexer]
+        for sink in sinks:
+            try:
+                sink.index_block_events(block.header.height, f_res)
+                sink.index_tx_events(block.header.height, list(block.txs), list(f_res.tx_results))
+            except Exception:
+                import traceback
 
-            traceback.print_exc()
+                traceback.print_exc()

@@ -15,7 +15,38 @@ import base64
 
 from ..rpc.client import HTTPClient
 from ..rpc.server import JSONRPCServer, RPCError
+from ..types.block import BlockID, Header, PartSetHeader, txs_hash
 from ..utils.log import new_logger
+from ..utils.tmtime import Time
+
+
+def _header_from_json(d: dict) -> Header:
+    """Inverse of rpc.core.header_to_json — the proxy must RECOMPUTE
+    hashes from the primary's response, never trust its self-reported
+    block_id (ref: light/rpc/client.go Block recomputes res.Block.Hash())."""
+    hx = lambda s: bytes.fromhex(s) if s else b""
+    lbi = d.get("last_block_id") or {}
+    parts = lbi.get("parts") or {}
+    return Header(
+        version_block=int(d["version"]["block"]),
+        version_app=int(d["version"].get("app") or 0),
+        chain_id=d.get("chain_id", ""),
+        height=int(d["height"]),
+        time=Time.parse_rfc3339(d["time"]),
+        last_block_id=BlockID(
+            hash=hx(lbi.get("hash")),
+            part_set_header=PartSetHeader(total=parts.get("total") or 0, hash=hx(parts.get("hash"))),
+        ),
+        last_commit_hash=hx(d.get("last_commit_hash")),
+        data_hash=hx(d.get("data_hash")),
+        validators_hash=hx(d.get("validators_hash")),
+        next_validators_hash=hx(d.get("next_validators_hash")),
+        consensus_hash=hx(d.get("consensus_hash")),
+        app_hash=hx(d.get("app_hash")),
+        last_results_hash=hx(d.get("last_results_hash")),
+        evidence_hash=hx(d.get("evidence_hash")),
+        proposer_address=hx(d.get("proposer_address")),
+    )
 
 
 class LightProxy:
@@ -67,9 +98,21 @@ class LightProxy:
             self._require(height is not None, "light proxy requires an explicit height")
             res = self.primary.call("block", height=str(height))
             lb = self._verified_header(int(height))
-            got = bytes.fromhex(res["block_id"]["hash"])
             want = lb.signed_header.hash()
+            # RECOMPUTE the hash from the returned header — the primary's
+            # self-reported block_id is attacker-controlled
+            try:
+                hdr = _header_from_json(res["block"]["header"])
+            except Exception as e:
+                raise RPCError(-32603, f"light proxy: malformed block from primary: {e}")
+            got = hdr.hash() or b""
             self._require(got == want, f"primary returned block {got.hex()} != verified {want.hex()}")
+            # and the tx payload must match the header's own data_hash
+            txs = [base64.b64decode(t) for t in (res["block"].get("data") or {}).get("txs") or []]
+            self._require(
+                txs_hash(txs) == hdr.data_hash,
+                "primary block txs do not hash to the header's data_hash",
+            )
             return res
 
         def commit(height=None):
@@ -77,8 +120,14 @@ class LightProxy:
             lb = self._verified_header(int(height))
             sh = lb.signed_header
             res = self.primary.call("commit", height=str(height))
-            got = bytes.fromhex(res["signed_header"]["commit"]["block_id"]["hash"])
-            self._require(got == sh.hash(), "primary commit diverges from verified header")
+            try:
+                hdr = _header_from_json(res["signed_header"]["header"])
+            except Exception as e:
+                raise RPCError(-32603, f"light proxy: malformed commit from primary: {e}")
+            self._require(
+                (hdr.hash() or b"") == sh.hash(),
+                "primary commit diverges from verified header",
+            )
             return res
 
         def header(height=None):

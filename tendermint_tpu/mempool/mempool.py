@@ -102,6 +102,7 @@ class TxMempool:
 
         self._txs_available_cond = threading.Condition(self._mtx)
         self._notified_txs_available = False
+        self._txs_signal_pending = False  # un-consumed notification
         self._txs_available_enabled = False
 
     # -------------------------------------------------------- properties
@@ -146,14 +147,26 @@ class TxMempool:
             self._txs_available_enabled = True
 
     def wait_txs_available(self, timeout: float | None = None) -> bool:
+        """One-shot delivery per height, like the reference's cap-1
+        TxsAvailable channel: the pending notification is CONSUMED by the
+        waiter (mempool.go notifyTxsAvailable fires once; re-armed on the
+        next Update), so the consensus watcher doesn't spin re-delivering
+        the same signal for the whole block interval."""
         with self._txs_available_cond:
-            if self._txs and self._notified_txs_available:
+            if self._txs_signal_pending:
+                self._txs_signal_pending = False
                 return True
-            return self._txs_available_cond.wait(timeout)
+            if not self._txs_available_cond.wait(timeout):
+                return False
+            if self._txs_signal_pending:
+                self._txs_signal_pending = False
+                return True
+            return False
 
     def _notify_txs_available(self) -> None:
         if self._txs and self._txs_available_enabled and not self._notified_txs_available:
             self._notified_txs_available = True
+            self._txs_signal_pending = True
             self._txs_available_cond.notify_all()
 
     # ----------------------------------------------------------- checktx

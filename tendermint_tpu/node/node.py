@@ -155,8 +155,28 @@ class Node:
         from ..eventbus.eventlog import EventLog
 
         self.event_bus = EventBus(event_log=EventLog())
-        self.indexer = KVIndexer(_make_db(config, "tx_index")) if config.tx_index.indexer == "kv" else None
-        self.indexer_service = IndexerService(self.indexer, self.event_bus) if self.indexer else None
+        # Event sinks (ref: EventSinksFromConfig, node/setup.go): "kv"
+        # and/or "sqlite" (the psql-sink analog), comma-separated.
+        self.indexer = None
+        self.sql_sink = None
+        sinks = []
+        for name in filter(None, (s.strip() for s in config.tx_index.indexer.split(","))):
+            if name == "kv":
+                self.indexer = KVIndexer(_make_db(config, "tx_index"))
+                sinks.append(self.indexer)
+            elif name in ("sqlite", "psql"):
+                from ..indexer.sink_sql import SQLSink
+
+                os.makedirs(config.db_dir, exist_ok=True)
+                self.sql_sink = SQLSink(
+                    os.path.join(config.db_dir, "events.sqlite"), self.gen_doc.chain_id
+                )
+                sinks.append(self.sql_sink)
+            elif name in ("null", "none"):
+                continue
+            else:
+                raise ValueError(f"unsupported tx_index.indexer {name!r}")
+        self.indexer_service = IndexerService(sinks, self.event_bus) if sinks else None
 
         # ---- privval (node/setup.go:489: file | socket remote signer)
         self.privval_endpoint = None
@@ -497,6 +517,7 @@ class Node:
         return self._halted.is_set()
 
     def stop(self) -> None:
+        self._halted.set()  # stops the txs-available watcher too
         if self._consensus_running.is_set():
             self.consensus.stop()
         if self.privval_endpoint is not None:
@@ -515,6 +536,8 @@ class Node:
             self.indexer_service.stop()
         if self.prometheus_server is not None:
             self.prometheus_server.stop()
+        if self.sql_sink is not None:
+            self.sql_sink.close()
         self.consensus.wal.close()
 
     # -------------------------------------------------------------- helpers

@@ -34,6 +34,7 @@ Reference semantics being replaced: the field layer of curve25519-voi
 
 from __future__ import annotations
 
+import os
 import numpy as np
 
 import jax.numpy as jnp
@@ -109,6 +110,39 @@ def _with_batch_rank(x, rank):
     return x.reshape((x.shape[0],) + (1,) * (rank - (x.ndim - 1)) + x.shape[1:])
 
 
+# Alternative formulation: the whole folded convolution as ONE
+# dot_general against a constant fold matrix — the MXU path. Selected
+# with TM_TPU_FE_MUL=dot for on-chip A/B against the slice formulation.
+# FOLD[(i*32+j), k] = weight of x_i*y_j in output coefficient k.
+_FOLD = np.zeros((LIMBS * LIMBS, LIMBS), np.int32)
+for _i in range(LIMBS):
+    for _j in range(LIMBS):
+        _k = _i + _j
+        if _k < LIMBS:
+            _FOLD[_i * LIMBS + _j, _k] = 1
+        else:
+            _FOLD[_i * LIMBS + _j, _k - LIMBS] = 38
+del _i, _j, _k
+
+_FE_MUL_MODE = os.environ.get("TM_TPU_FE_MUL", "slice")
+
+
+def _fe_mul_dot(x, y):
+    """z_k = sum_{ij} FOLD[ij,k] * x_i * y_j: an outer product reshaped
+    to (1024, batch) contracted with the constant (1024, 32) fold matrix
+    — a single int32 dot per field mul, landing on the MXU's integer
+    path instead of the VPU. Same bounds as the slice form."""
+    rank = max(x.ndim, y.ndim) - 1
+    x = _with_batch_rank(x, rank)
+    y = _with_batch_rank(y, rank)
+    batch = jnp.broadcast_shapes(x.shape[1:], y.shape[1:])
+    x = jnp.broadcast_to(x, (LIMBS,) + batch)
+    y = jnp.broadcast_to(y, (LIMBS,) + batch)
+    outer = (x[:, None] * y[None, :]).reshape((LIMBS * LIMBS,) + batch)
+    z = jnp.tensordot(jnp.asarray(_FOLD), outer, axes=[[0], [0]])
+    return fe_carry(z, passes=4)
+
+
 def fe_mul(x, y):
     """Field multiplication as a pre-folded Toeplitz convolution.
 
@@ -122,6 +156,8 @@ def fe_mul(x, y):
 
     Bounds: |x_i| <= 2^10 and |y_j| <= 2^10 give per-term magnitude
     38 * 2^20 and a 32-term sum < 1216 * 2^20 < 2^31: fits int32."""
+    if _FE_MUL_MODE == "dot":
+        return _fe_mul_dot(x, y)
     rank = max(x.ndim, y.ndim) - 1
     x = _with_batch_rank(x, rank)
     y = _with_batch_rank(y, rank)
@@ -153,6 +189,8 @@ def fe_square(x):
     """Squaring via the pre-folded Toeplitz form with the symmetry mask:
     half the multiply-accumulates of fe_mul (each unordered limb pair is
     visited once, with a {0,1,2} constant factor folded into the window)."""
+    if _FE_MUL_MODE == "dot":
+        return _fe_mul_dot(x, x)
     batch = x.shape[1:]
     x = jnp.broadcast_to(x, (LIMBS,) + batch)
     x2 = jnp.concatenate([38 * x, x], axis=0)  # folded operand

@@ -125,17 +125,9 @@ class SecretConnection:
     def _read_delimited(self, max_size: int) -> bytes:
         """Read a uvarint-length-prefixed message from the sealed stream
         (ref: internal/libs/protoio ReadDelimited)."""
-        prefix = b""
-        while True:
-            prefix += self.read_exact(1)
-            if prefix[-1] < 0x80:
-                break
-            if len(prefix) > 5:
-                raise ValueError("oversized length prefix")
-        size, _ = decode_varint(prefix, 0)
-        if size > max_size:
-            raise ValueError(f"delimited message too large: {size}")
-        return self.read_exact(size)
+        from ..proto.wire import read_delimited
+
+        return read_delimited(self.read_exact, max_size)
 
     def write(self, data: bytes) -> int:
         """Frame + seal + send (ref: secret_connection.go:243 Write)."""

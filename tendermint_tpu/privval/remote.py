@@ -18,7 +18,7 @@ from urllib.parse import urlparse
 
 from ..crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
 from ..p2p.secret_connection import SecretConnection
-from ..proto.wire import decode_varint, encode_varint
+from ..proto.wire import encode_varint, read_delimited
 from ..types.proposal import Proposal
 from ..types.vote import Vote
 from ..utils.log import new_logger
@@ -72,17 +72,25 @@ def _write_msg(conn, msg: pv.PrivvalMessage) -> None:
 
 
 def _read_msg(conn) -> pv.PrivvalMessage:
-    prefix = b""
-    while True:
-        prefix += conn.read_exact(1)
-        if prefix[-1] < 0x80:
-            break
-        if len(prefix) > 5:
-            raise ValueError("oversized length prefix")
-    size, _ = decode_varint(prefix, 0)
-    if size > MAX_MSG_SIZE:
-        raise ValueError(f"privval message too large: {size}")
-    return pv.PrivvalMessage.decode(conn.read_exact(size))
+    """Read one privval message. A timeout BEFORE any byte is consumed
+    re-raises socket.timeout (the caller's idle poll); a timeout
+    mid-message would desync the plaintext stream, so it becomes a
+    ConnectionError and the endpoint reconnects."""
+    started = False
+
+    def read_exact(n: int) -> bytes:
+        nonlocal started
+        try:
+            data = conn.read_exact(n)
+        except socket.timeout:
+            if started:
+                raise ConnectionError("timeout mid-message: privval stream desynced")
+            raise
+        started = True
+        return data
+
+    body = read_delimited(read_exact, MAX_MSG_SIZE)
+    return pv.PrivvalMessage.decode(body)
 
 
 def _parse_addr(addr: str):
@@ -120,6 +128,7 @@ class SignerListenerEndpoint:
         self._instance_lock = threading.Lock()  # serializes send_request
         self._stop = threading.Event()
         self._accept_thread: threading.Thread | None = None
+        self._ping_thread: threading.Thread | None = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -135,6 +144,10 @@ class SignerListenerEndpoint:
             target=self._accept_loop, daemon=True, name="privval-accept"
         )
         self._accept_thread.start()
+        self._ping_thread = threading.Thread(
+            target=self._ping_loop, daemon=True, name="privval-ping"
+        )
+        self._ping_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -144,6 +157,21 @@ class SignerListenerEndpoint:
             self._listener.close()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2)
+        if self._ping_thread is not None:
+            self._ping_thread.join(timeout=2)
+
+    def _ping_loop(self) -> None:
+        """Keepalive at 2/3 of the read/write timeout — detects a dead or
+        NAT-dropped signer connection before a sign request has to block
+        on it (ref: signer_listener_endpoint.go:29 pingInterval)."""
+        interval = self.timeout_read_write * PING_FRACTION
+        while not self._stop.wait(timeout=interval):
+            if not self._conn_ready.is_set():
+                continue
+            try:
+                self.send_request(pv.PrivvalMessage(ping_request=pv.PingRequest()))
+            except Exception:
+                pass  # send_request already dropped the dead connection
 
     @property
     def bound_addr(self) -> str:
@@ -337,8 +365,10 @@ class SignerServer:
             self.logger.info("connected to validator", addr=self.addr)
             try:
                 self._serve(conn)
-            except (ConnectionError, OSError, socket.timeout, ValueError):
-                pass
+            except Exception as e:
+                # any escape here must lead back to the redial loop — a
+                # dead signer thread means the validator can never sign
+                self.logger.error("signer connection error", err=repr(e))
             finally:
                 conn.close()
 
@@ -347,11 +377,13 @@ class SignerServer:
             try:
                 req = _read_msg(conn)
             except socket.timeout:
-                continue
+                continue  # idle poll; mid-message timeouts raise ConnectionError
             _write_msg(conn, self._handle(req))
 
     def _handle(self, req: pv.PrivvalMessage) -> pv.PrivvalMessage:
-        """ref: privval/signer_requestHandler.go DefaultValidationRequestHandler."""
+        """ref: privval/signer_requestHandler.go DefaultValidationRequestHandler.
+        Always answers — malformed request contents become error
+        responses, never a dead connection."""
         from ..proto.messages import PublicKey
 
         if req.ping_request is not None:
@@ -363,8 +395,8 @@ class SignerServer:
             )
         if req.sign_vote_request is not None:
             svr = req.sign_vote_request
-            vote = Vote.from_proto(svr.vote)
             try:
+                vote = Vote.from_proto(svr.vote)
                 self.file_pv.sign_vote(svr.chain_id or self.chain_id, vote)
                 return pv.PrivvalMessage(
                     signed_vote_response=pv.SignedVoteResponse(vote=vote.to_proto())
@@ -377,8 +409,8 @@ class SignerServer:
                 )
         if req.sign_proposal_request is not None:
             spr = req.sign_proposal_request
-            proposal = Proposal.from_proto(spr.proposal)
             try:
+                proposal = Proposal.from_proto(spr.proposal)
                 self.file_pv.sign_proposal(spr.chain_id or self.chain_id, proposal)
                 return pv.PrivvalMessage(
                     signed_proposal_response=pv.SignedProposalResponse(proposal=proposal.to_proto())

@@ -377,6 +377,27 @@ def build_routes(env: RPCEnvironment) -> dict:
             "newest": str(newest),
         }
 
+    def debug_threads():
+        """Per-thread stack traces — the goroutine-profile analog of the
+        reference's pprof endpoint (node/node.go:446 pprof server)."""
+        import sys
+        import threading as _threading
+        import traceback as _tb
+
+        frames = sys._current_frames()
+        by_ident = {t.ident: t for t in _threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            t = by_ident.get(ident)
+            out.append(
+                {
+                    "name": t.name if t else str(ident),
+                    "daemon": bool(t.daemon) if t else None,
+                    "stack": _tb.format_stack(frame),
+                }
+            )
+        return {"count": len(out), "threads": out}
+
     def block_results(height=None):
         h = _height_or_latest(height)
         f_res = env.state_store.load_finalize_block_responses(h)
@@ -699,6 +720,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         "header": header,
         "header_by_hash": header_by_hash,
         "events": events,
+        "debug_threads": debug_threads,
         "block_results": block_results,
         "commit": commit,
         "validators": validators,

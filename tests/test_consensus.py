@@ -158,3 +158,37 @@ def test_wal_written_and_replayable(tmp_path):
     after = wal.search_for_end_height(1)
     assert after is not None and len(after) > 0
     wal.close()
+
+
+def test_wal_corrupt_tail_replay(tmp_path):
+    """A torn/corrupted WAL tail must not prevent replay of the intact
+    prefix (ref: repairWalFile, internal/consensus/wal_test.go)."""
+    wal_path = os.path.join(tmp_path, "cs.wal")
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    node = make_node(keys, 0, gen_doc, wal_path=wal_path)
+    node.start()
+    try:
+        assert wait_for_height([node], 2, timeout=30)
+    finally:
+        node.stop()
+    size = os.path.getsize(wal_path)
+    assert size > 0
+    # corrupt the tail: flip bytes in the last record
+    with open(wal_path, "r+b") as f:
+        f.seek(size - 7)
+        f.write(b"\xff\xff\xff\xff\xff\xff\xff")
+    from tendermint_tpu.consensus.wal import WAL
+
+    wal = WAL(wal_path)
+    records = wal._read_all()
+    assert records, "intact prefix lost after tail corruption"
+    wal.close()
+    # a fresh node on the same WAL replays and keeps producing blocks
+    node2 = make_node(keys, 0, gen_doc, wal_path=wal_path)
+    node2.start()
+    try:
+        assert wait_for_height([node2], 2, timeout=30)
+    finally:
+        node2.stop()

@@ -97,3 +97,38 @@ def test_proxy_requires_height(proxy):
     c = _client(proxy)
     with pytest.raises(RPCClientError, match="height"):
         c.call("block")
+
+
+def test_proxy_rejects_spoofed_block(proxy, node, monkeypatch):
+    """A primary that self-reports the verified hash but returns a
+    tampered body must be rejected — the proxy recomputes hashes
+    (ref: light/rpc/client.go Block)."""
+    real = proxy.primary.call
+
+    def spoofing_call(method, **params):
+        res = real(method, **params)
+        if method == "block":
+            res["block"]["data"]["txs"] = ["c3Bvb2ZlZA=="]  # injected tx
+        return res
+
+    monkeypatch.setattr(proxy.primary, "call", spoofing_call)
+    c = _client(proxy)
+    with pytest.raises(RPCClientError, match="data_hash|verification failed"):
+        c.call("block", height="2")
+    monkeypatch.setattr(proxy.primary, "call", real)
+
+
+def test_proxy_rejects_wrong_header(proxy, node, monkeypatch):
+    real = proxy.primary.call
+
+    def spoofing_call(method, **params):
+        res = real(method, **params)
+        if method == "block":
+            res["block"]["header"]["app_hash"] = "ff" * 32  # forged header field
+        return res
+
+    monkeypatch.setattr(proxy.primary, "call", spoofing_call)
+    c = _client(proxy)
+    with pytest.raises(RPCClientError, match="!= verified|verification failed"):
+        c.call("block", height="3")
+    monkeypatch.setattr(proxy.primary, "call", real)
