@@ -99,6 +99,17 @@ class ExecTxResultPB(Message):
     ]
 
 
+class TxResultPB(Message):
+    """abci.TxResult — the indexing record (types.proto:385)."""
+
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "uint32", "index"),
+        Field(3, "bytes", "tx"),
+        Field(4, "message", "result", always_emit=True, msg_cls=ExecTxResultPB),
+    ]
+
+
 TXRECORD_UNKNOWN = 0
 TXRECORD_UNMODIFIED = 1
 TXRECORD_ADDED = 2

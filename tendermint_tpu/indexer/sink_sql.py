@@ -86,41 +86,45 @@ class SQLSink:
                     (event_id, key, f"{ev.type}.{key}", getattr(attr, "value", "") or ""),
                 )
 
+    def _block_rowid(self, cur, height: int) -> int:
+        """Upsert the block row, return its rowid."""
+        cur.execute(
+            "INSERT OR IGNORE INTO blocks (height, chain_id) VALUES (?, ?)",
+            (height, self.chain_id),
+        )
+        cur.execute(
+            "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
+            (height, self.chain_id),
+        )
+        return cur.fetchone()[0]
+
     def index_block_events(self, height: int, f_res) -> None:
         """ref: psql.go IndexBlockEvents."""
         with self._lock:
             cur = self._conn.cursor()
-            cur.execute(
-                "INSERT OR IGNORE INTO blocks (height, chain_id) VALUES (?, ?)",
-                (height, self.chain_id),
-            )
-            cur.execute(
-                "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
-                (height, self.chain_id),
-            )
-            block_rowid = cur.fetchone()[0]
+            block_rowid = self._block_rowid(cur, height)
             self._insert_events(cur, block_rowid, None, getattr(f_res, "events", None))
             self._conn.commit()
 
     def index_tx_events(self, height: int, txs: list[bytes], tx_results: list) -> None:
-        """ref: psql.go IndexTxEvents."""
+        """ref: psql.go IndexTxEvents — the tx_result column stores the
+        serialized TxResult (tx + execution outcome), so the execution
+        code/log/gas are recoverable from the database."""
+        from ..abci.proto import TxResultPB, _txres_to_pb
+
         with self._lock:
             cur = self._conn.cursor()
-            cur.execute(
-                "INSERT OR IGNORE INTO blocks (height, chain_id) VALUES (?, ?)",
-                (height, self.chain_id),
-            )
-            cur.execute(
-                "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
-                (height, self.chain_id),
-            )
-            block_rowid = cur.fetchone()[0]
+            block_rowid = self._block_rowid(cur, height)
             for i, tx in enumerate(txs):
                 result = tx_results[i] if i < len(tx_results) else None
+                record = TxResultPB(
+                    height=height, index=i, tx=tx,
+                    result=_txres_to_pb(result) if result is not None else None,
+                ).encode()
                 cur.execute(
                     "INSERT OR IGNORE INTO tx_results"
                     " (block_id, index_in_block, tx_hash, tx_result) VALUES (?, ?, ?, ?)",
-                    (block_rowid, i, tx_hash(tx).hex().upper(), tx),
+                    (block_rowid, i, tx_hash(tx).hex().upper(), record),
                 )
                 cur.execute(
                     "SELECT rowid FROM tx_results WHERE block_id = ? AND index_in_block = ?",
@@ -137,9 +141,12 @@ class SQLSink:
         with self._lock:
             return list(self._conn.execute(sql, params))
 
-    def get_tx_by_hash(self, h: bytes) -> bytes | None:
+    def get_tx_by_hash(self, h: bytes):
+        """Decoded TxResult record (height, index, tx, result) or None."""
+        from ..abci.proto import TxResultPB
+
         rows = self.query("SELECT tx_result FROM tx_results WHERE tx_hash = ?", (h.hex().upper(),))
-        return rows[0][0] if rows else None
+        return TxResultPB.decode(rows[0][0]) if rows else None
 
     def close(self) -> None:
         with self._lock:

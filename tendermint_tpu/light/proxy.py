@@ -113,22 +113,32 @@ class LightProxy:
                 txs_hash(txs) == hdr.data_hash,
                 "primary block txs do not hash to the header's data_hash",
             )
+            # never relay the primary's self-reported block_id — rebuild
+            # it from the light-verified commit
+            from ..rpc.core import block_id_to_json
+
+            res["block_id"] = block_id_to_json(lb.signed_header.commit.block_id)
             return res
 
         def commit(height=None):
+            """Serve the LIGHT-VERIFIED signed header directly — the
+            client already holds a commit whose signatures were checked
+            against the validator set; relaying the primary's commit body
+            would hand back attacker-controlled signatures
+            (ref: light/rpc/client.go Commit serves the trusted copy for
+            verified heights)."""
             self._require(height is not None, "light proxy requires an explicit height")
             lb = self._verified_header(int(height))
             sh = lb.signed_header
-            res = self.primary.call("commit", height=str(height))
-            try:
-                hdr = _header_from_json(res["signed_header"]["header"])
-            except Exception as e:
-                raise RPCError(-32603, f"light proxy: malformed commit from primary: {e}")
-            self._require(
-                (hdr.hash() or b"") == sh.hash(),
-                "primary commit diverges from verified header",
-            )
-            return res
+            from ..rpc.core import commit_to_json, header_to_json
+
+            return {
+                "signed_header": {
+                    "header": header_to_json(sh.header),
+                    "commit": commit_to_json(sh.commit),
+                },
+                "canonical": True,
+            }
 
         def header(height=None):
             self._require(height is not None, "light proxy requires an explicit height")

@@ -1,0 +1,69 @@
+"""Native runtime components (C, built on demand with the system gcc).
+
+`prep` — the batch-prep hot path feeding the TPU verify kernel
+(SHA-512 challenges + mod-L reduction + int32 shaping). Loaded via
+ctypes from a .so compiled next to the source on first use; falls back
+to the pure-Python path if no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "prep.c")
+_SO = os.path.join(_DIR, "prep.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
+            return True
+        subprocess.run(
+            ["cc", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            check=True, capture_output=True,
+        )
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except Exception:
+        return False
+
+
+def load_prep():
+    """ctypes handle to the prep library, or None (fallback to Python)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.prepare_batch.argtypes = [
+                ctypes.c_char_p,  # pks
+                ctypes.c_char_p,  # sigs
+                ctypes.c_char_p,  # msgs (concatenated)
+                ctypes.POINTER(ctypes.c_int64),  # offsets
+                ctypes.c_int64,  # n
+                ctypes.POINTER(ctypes.c_int32),  # out_a
+                ctypes.POINTER(ctypes.c_int32),  # out_r
+                ctypes.POINTER(ctypes.c_int32),  # out_s
+                ctypes.POINTER(ctypes.c_int32),  # out_k
+                ctypes.c_char_p,  # precheck
+            ]
+            lib.prepare_batch.restype = None
+            _lib = lib
+        except Exception:
+            _load_failed = True
+    return _lib

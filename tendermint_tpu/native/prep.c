@@ -1,0 +1,242 @@
+/* Native batch-prep for the TPU verify pipeline (the host side of
+ * ops/verify.prepare_batch): per signature, SHA-512(R||A||M) reduced
+ * mod L plus byte->int32 shaping of (A, R, S) and the s < L precheck.
+ *
+ * Python-side prep caps host throughput at ~170k sigs/s — below the
+ * >=50x north-star (~400k+ sigs/s), so the chip would starve. This is
+ * the framework's native runtime component for keeping the device fed
+ * (environment brief: native code expected for the runtime around the
+ * compute path).
+ *
+ * SHA-512 is implemented from FIPS 180-4 (constants generated from the
+ * prime square/cube-root definitions); the mod-L reduction uses
+ * 2^256 === R (mod L) folding with 64-bit limbs and __int128 products.
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+
+/* ------------------------------------------------------------ SHA-512 */
+
+static const u64 K[80] = {
+0x428a2f98d728ae22ULL,0x7137449123ef65cdULL,0xb5c0fbcfec4d3b2fULL,0xe9b5dba58189dbbcULL,
+0x3956c25bf348b538ULL,0x59f111f1b605d019ULL,0x923f82a4af194f9bULL,0xab1c5ed5da6d8118ULL,
+0xd807aa98a3030242ULL,0x12835b0145706fbeULL,0x243185be4ee4b28cULL,0x550c7dc3d5ffb4e2ULL,
+0x72be5d74f27b896fULL,0x80deb1fe3b1696b1ULL,0x9bdc06a725c71235ULL,0xc19bf174cf692694ULL,
+0xe49b69c19ef14ad2ULL,0xefbe4786384f25e3ULL,0x0fc19dc68b8cd5b5ULL,0x240ca1cc77ac9c65ULL,
+0x2de92c6f592b0275ULL,0x4a7484aa6ea6e483ULL,0x5cb0a9dcbd41fbd4ULL,0x76f988da831153b5ULL,
+0x983e5152ee66dfabULL,0xa831c66d2db43210ULL,0xb00327c898fb213fULL,0xbf597fc7beef0ee4ULL,
+0xc6e00bf33da88fc2ULL,0xd5a79147930aa725ULL,0x06ca6351e003826fULL,0x142929670a0e6e70ULL,
+0x27b70a8546d22ffcULL,0x2e1b21385c26c926ULL,0x4d2c6dfc5ac42aedULL,0x53380d139d95b3dfULL,
+0x650a73548baf63deULL,0x766a0abb3c77b2a8ULL,0x81c2c92e47edaee6ULL,0x92722c851482353bULL,
+0xa2bfe8a14cf10364ULL,0xa81a664bbc423001ULL,0xc24b8b70d0f89791ULL,0xc76c51a30654be30ULL,
+0xd192e819d6ef5218ULL,0xd69906245565a910ULL,0xf40e35855771202aULL,0x106aa07032bbd1b8ULL,
+0x19a4c116b8d2d0c8ULL,0x1e376c085141ab53ULL,0x2748774cdf8eeb99ULL,0x34b0bcb5e19b48a8ULL,
+0x391c0cb3c5c95a63ULL,0x4ed8aa4ae3418acbULL,0x5b9cca4f7763e373ULL,0x682e6ff3d6b2b8a3ULL,
+0x748f82ee5defb2fcULL,0x78a5636f43172f60ULL,0x84c87814a1f0ab72ULL,0x8cc702081a6439ecULL,
+0x90befffa23631e28ULL,0xa4506cebde82bde9ULL,0xbef9a3f7b2c67915ULL,0xc67178f2e372532bULL,
+0xca273eceea26619cULL,0xd186b8c721c0c207ULL,0xeada7dd6cde0eb1eULL,0xf57d4f7fee6ed178ULL,
+0x06f067aa72176fbaULL,0x0a637dc5a2c898a6ULL,0x113f9804bef90daeULL,0x1b710b35131c471bULL,
+0x28db77f523047d84ULL,0x32caab7b40c72493ULL,0x3c9ebe0a15c9bebcULL,0x431d67c49c100d4cULL,
+0x4cc5d4becb3e42b6ULL,0x597f299cfc657e2aULL,0x5fcb6fab3ad6faecULL,0x6c44198c4a475817ULL};
+
+#define ROR(x,n) (((x) >> (n)) | ((x) << (64-(n))))
+
+static void sha512_compress(u64 st[8], const uint8_t blk[128]) {
+    u64 w[80];
+    for (int i = 0; i < 16; i++) {
+        w[i] = ((u64)blk[8*i] << 56) | ((u64)blk[8*i+1] << 48) |
+               ((u64)blk[8*i+2] << 40) | ((u64)blk[8*i+3] << 32) |
+               ((u64)blk[8*i+4] << 24) | ((u64)blk[8*i+5] << 16) |
+               ((u64)blk[8*i+6] << 8) | (u64)blk[8*i+7];
+    }
+    for (int i = 16; i < 80; i++) {
+        u64 s0 = ROR(w[i-15],1) ^ ROR(w[i-15],8) ^ (w[i-15] >> 7);
+        u64 s1 = ROR(w[i-2],19) ^ ROR(w[i-2],61) ^ (w[i-2] >> 6);
+        w[i] = w[i-16] + s0 + w[i-7] + s1;
+    }
+    u64 a=st[0],b=st[1],c=st[2],d=st[3],e=st[4],f=st[5],g=st[6],h=st[7];
+    for (int i = 0; i < 80; i++) {
+        u64 S1 = ROR(e,14) ^ ROR(e,18) ^ ROR(e,41);
+        u64 ch = (e & f) ^ (~e & g);
+        u64 t1 = h + S1 + ch + K[i] + w[i];
+        u64 S0 = ROR(a,28) ^ ROR(a,34) ^ ROR(a,39);
+        u64 mj = (a & b) ^ (a & c) ^ (b & c);
+        u64 t2 = S0 + mj;
+        h=g; g=f; f=e; e=d+t1; d=c; c=b; b=a; a=t1+t2;
+    }
+    st[0]+=a; st[1]+=b; st[2]+=c; st[3]+=d; st[4]+=e; st[5]+=f; st[6]+=g; st[7]+=h;
+}
+
+static void sha512(const uint8_t *data, u64 len, uint8_t out[64]) {
+    u64 st[8] = {0x6a09e667f3bcc908ULL,0xbb67ae8584caa73bULL,0x3c6ef372fe94f82bULL,
+                 0xa54ff53a5f1d36f1ULL,0x510e527fade682d1ULL,0x9b05688c2b3e6c1fULL,
+                 0x1f83d9abfb41bd6bULL,0x5be0cd19137e2179ULL};
+    u64 full = len / 128;
+    for (u64 i = 0; i < full; i++) sha512_compress(st, data + 128*i);
+    uint8_t tail[256];
+    u64 rem = len - 128*full;
+    memcpy(tail, data + 128*full, rem);
+    tail[rem] = 0x80;
+    u64 tail_len = (rem + 1 + 16 <= 128) ? 128 : 256;
+    memset(tail + rem + 1, 0, tail_len - rem - 1);
+    u64 bits = len * 8;  /* messages here are far below 2^64 bits */
+    for (int i = 0; i < 8; i++) tail[tail_len-1-i] = (uint8_t)(bits >> (8*i));
+    sha512_compress(st, tail);
+    if (tail_len == 256) sha512_compress(st, tail + 128);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++)
+            out[8*i+j] = (uint8_t)(st[i] >> (56 - 8*j));
+}
+
+/* ------------------------------------------------- mod L (group order) */
+
+/* L = 2^252 + 27742317777372353535851937790883648493, little-endian limbs */
+static const u64 L_LIMBS[4] = {0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL,
+                               0x0ULL, 0x1000000000000000ULL};
+/* R = 2^256 mod L, 255 bits, little-endian limbs */
+static const u64 R_LIMBS[4] = {0xd6ec31748d98951dULL, 0xc6ef5bf4737dcf70ULL,
+                               0xfffffffffffffffeULL, 0x0fffffffffffffffULL};
+
+/* x (nx limbs) * R (4 limbs) + lo (4 limbs) -> out (nx+5 limbs capacity) */
+static int mul_add(const u64 *x, int nx, const u64 *lo, u64 *out, int cap) {
+    for (int i = 0; i < cap; i++) out[i] = 0;
+    for (int i = 0; i < 4; i++) out[i] = lo[i];
+    u64 carry = 0;
+    for (int i = 0; i < nx; i++) {
+        carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)x[i] * R_LIMBS[j] + out[i+j] + carry;
+            out[i+j] = (u64)t;
+            carry = (u64)(t >> 64);
+        }
+        int k = i + 4;
+        while (carry) {
+            u128 t = (u128)out[k] + carry;
+            out[k] = (u64)t;
+            carry = (u64)(t >> 64);
+            k++;
+        }
+    }
+    int n = cap;
+    while (n > 1 && out[n-1] == 0) n--;
+    return n;
+}
+
+static int ge(const u64 *a, const u64 *b, int n) {
+    for (int i = n-1; i >= 0; i--) {
+        if (a[i] > b[i]) return 1;
+        if (a[i] < b[i]) return 0;
+    }
+    return 1;
+}
+
+/* multi-limb subtract with borrow */
+static void sub_n(u64 *a, const u64 *b, int nb, int n) {
+    u64 borrow = 0;
+    for (int i = 0; i < n; i++) {
+        u64 bi = (i < nb) ? b[i] : 0;
+        u64 ai = a[i];
+        u64 t1 = ai - bi;
+        u64 borrow1 = (ai < bi);
+        u64 t2 = t1 - borrow;
+        u64 borrow2 = (t1 < borrow);
+        a[i] = t2;
+        borrow = borrow1 | borrow2;
+    }
+}
+
+/* digest (64 bytes LE) mod L -> 32 bytes LE */
+static void mod_l(const uint8_t digest[64], uint8_t out[32]) {
+    u64 x[9], tmp[9];
+    for (int i = 0; i < 8; i++) {
+        x[i] = 0;
+        for (int j = 0; j < 8; j++) x[i] |= (u64)digest[8*i+j] << (8*j);
+    }
+    x[8] = 0;
+    int n = 8;
+    while (n > 4) {
+        /* x = hi * R + lo, where lo = x[0..3], hi = x[4..n-1] */
+        int nhi = n - 4;
+        u64 hi[5], lo[4];
+        for (int i = 0; i < nhi; i++) hi[i] = x[4+i];
+        for (int i = 0; i < 4; i++) lo[i] = x[i];
+        n = mul_add(hi, nhi, lo, tmp, nhi + 5 > 9 ? 9 : nhi + 5);
+        for (int i = 0; i < n; i++) x[i] = tmp[i];
+        for (int i = n; i < 9; i++) x[i] = 0;
+        if (n <= 4) break;
+    }
+    /* now x < 2^257-ish across 5 limbs at most; subtract L while >= L */
+    while (x[4] != 0 || ge(x, L_LIMBS, 4)) {
+        if (x[4] != 0) {
+            sub_n(x, L_LIMBS, 4, 5);
+        } else {
+            sub_n(x, L_LIMBS, 4, 4);
+        }
+    }
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++) out[8*i+j] = (uint8_t)(x[i] >> (8*j));
+}
+
+/* ------------------------------------------------------------ batch API */
+
+/* s (32 bytes LE) < L ? */
+static int s_in_range(const uint8_t s[32]) {
+    u64 sl[4];
+    for (int i = 0; i < 4; i++) {
+        sl[i] = 0;
+        for (int j = 0; j < 8; j++) sl[i] |= (u64)s[8*i+j] << (8*j);
+    }
+    return !ge(sl, L_LIMBS, 4);
+}
+
+/* Inputs: pks n*32, sigs n*64, msgs concatenated with offsets[n+1].
+ * Outputs: a/r/s/k as int32 arrays (n*32), precheck bytes (n). */
+void prepare_batch(const uint8_t *pks, const uint8_t *sigs,
+                   const uint8_t *msgs, const int64_t *offsets, int64_t n,
+                   int32_t *out_a, int32_t *out_r, int32_t *out_s,
+                   int32_t *out_k, uint8_t *precheck) {
+    uint8_t buf[64 + 4096];
+    uint8_t digest[64], k[32];
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *pk = pks + 32*i;
+        const uint8_t *sig = sigs + 64*i;
+        const uint8_t *msg = msgs + offsets[i];
+        int64_t mlen = offsets[i+1] - offsets[i];
+        precheck[i] = 0;
+        if (!s_in_range(sig + 32)) {
+            for (int j = 0; j < 32; j++) {
+                out_a[32*i+j] = out_r[32*i+j] = out_s[32*i+j] = out_k[32*i+j] = 0;
+            }
+            continue;
+        }
+        const uint8_t *hash_input;
+        uint8_t *heap = 0;
+        u64 total = 64 + (u64)mlen;
+        if (mlen <= 4096) {
+            memcpy(buf, sig, 32);
+            memcpy(buf + 32, pk, 32);
+            memcpy(buf + 64, msg, mlen);
+            hash_input = buf;
+        } else {
+            heap = (uint8_t *)__builtin_malloc(total);
+            memcpy(heap, sig, 32);
+            memcpy(heap + 32, pk, 32);
+            memcpy(heap + 64, msg, mlen);
+            hash_input = heap;
+        }
+        sha512(hash_input, total, digest);
+        if (heap) __builtin_free(heap);
+        mod_l(digest, k);
+        for (int j = 0; j < 32; j++) {
+            out_a[32*i+j] = pk[j];
+            out_r[32*i+j] = sig[j];
+            out_s[32*i+j] = sig[32+j];
+            out_k[32*i+j] = k[j];
+        }
+        precheck[i] = 1;
+    }
+}

@@ -66,10 +66,8 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return size
 
 
-def prepare_batch(pubkeys, msgs, sigs):
-    """Host-side shaping: returns (a_enc, r_enc, s_bytes, k_bytes,
-    precheck) numpy arrays of shape (B, 32)/(B,). Malformed inputs fail
-    precheck instead of raising (callers map them to invalid)."""
+def _prepare_batch_py(pubkeys, msgs, sigs):
+    """Pure-Python prep (fallback + oracle for the native path)."""
     n = len(sigs)
     raw = np.zeros((4, n, 32), np.uint8)  # a, r, s, k rows
     precheck = np.zeros((n,), bool)
@@ -90,6 +88,55 @@ def prepare_batch(pubkeys, msgs, sigs):
         precheck[i] = True
     a_enc, r_enc, s_bytes, k_bytes = raw.astype(np.int32)
     return a_enc, r_enc, s_bytes, k_bytes, precheck
+
+
+def _prepare_batch_native(lib, pubkeys, msgs, sigs):
+    """C fast path (native/prep.c): one call hashes + reduces + shapes
+    the whole batch — the host must sustain the chip's throughput."""
+    import ctypes
+
+    n = len(sigs)
+    pks_buf = b"".join(pubkeys)
+    sigs_buf = b"".join(sigs)
+    msgs_buf = b"".join(msgs)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(m) for m in msgs], out=offsets[1:])
+    a = np.zeros((n, 32), np.int32)
+    r = np.zeros((n, 32), np.int32)
+    s = np.zeros((n, 32), np.int32)
+    k = np.zeros((n, 32), np.int32)
+    pre = np.zeros(n, np.uint8)
+    as_i32 = lambda arr: arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    lib.prepare_batch(
+        pks_buf, sigs_buf, msgs_buf,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        as_i32(a), as_i32(r), as_i32(s), as_i32(k),
+        pre.ctypes.data_as(ctypes.c_char_p),
+    )
+    return a, r, s, k, pre.astype(bool)
+
+
+def prepare_batch(pubkeys, msgs, sigs):
+    """Host-side shaping: returns (a_enc, r_enc, s_bytes, k_bytes,
+    precheck) numpy arrays of shape (B, 32)/(B,). Malformed inputs fail
+    precheck instead of raising (callers map them to invalid). Uses the
+    native prep library when available (native/prep.c); inputs with
+    non-standard lengths take the Python path (the C ABI packs fixed
+    32/64-byte keys and sigs)."""
+    n = len(sigs)
+    if (
+        n
+        and len(pubkeys) == n
+        and len(msgs) == n
+        and all(len(pk) == 32 for pk in pubkeys)
+        and all(len(sg) == 64 for sg in sigs)
+    ):
+        from ..native import load_prep
+
+        lib = load_prep()
+        if lib is not None:
+            return _prepare_batch_native(lib, pubkeys, msgs, sigs)
+    return _prepare_batch_py(pubkeys, msgs, sigs)
 
 
 def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:

@@ -220,7 +220,9 @@ def test_sql_sink_indexes_blocks_and_txs(tmp_path):
     assert rows == [(7, "transfer", "sender", "alice")]
     from tendermint_tpu.eventbus.event_bus import tx_hash
 
-    assert sink.get_tx_by_hash(tx_hash(b"tx-payload")) == b"tx-payload"
+    rec = sink.get_tx_by_hash(tx_hash(b"tx-payload"))
+    assert rec.tx == b"tx-payload" and rec.height == 7 and (rec.result.code or 0) == 0
+    assert rec.result.events and rec.result.events[0].type == "transfer"
     n_blocks = sink.query("SELECT COUNT(*) FROM blocks")[0][0]
     assert n_blocks == 1  # same height reused, not duplicated
     sink.close()
