@@ -131,11 +131,17 @@ def _decode_msg(doc: dict):
 
 
 class WAL:
-    """ref: BaseWAL (wal.go:61). Single-file append log (the reference
-    rotates via autofile.Group; size-based rotation can layer on)."""
+    """ref: BaseWAL (wal.go:61) over an autofile.Group-style rotating
+    file set: the head file rotates at `max_file_size`, rotated files
+    keep numbered suffixes (`<path>.000`, `.001`, …, oldest first), and
+    at most `max_files` rotated files are retained (ref:
+    internal/libs/autofile/group.go RotateFile/checkTotalSizeLimit).
+    Replay reads the retained files oldest → head."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_file_size: int = 8 << 20, max_files: int = 8):
         self._path = path
+        self.max_file_size = max_file_size
+        self.max_files = max_files
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._lock = threading.Lock()
         self._f = open(path, "ab")
@@ -149,12 +155,54 @@ class WAL:
         (ref: WriteSync wal.go:132; state.go:964)."""
         self._append(msg, fsync=True)
 
+    def _rotated_paths(self) -> list[str]:
+        """Existing rotated files, oldest first (.000 is always oldest —
+        the shift scheme below keeps indices dense from zero)."""
+        import glob as _glob
+
+        return sorted(_glob.glob(self._path + ".[0-9][0-9][0-9]"))
+
+    def _fsync_dir(self) -> None:
+        """Persist directory entries after renames/creates — without
+        this, a post-rotation write_sync fsyncs file data whose directory
+        entry may still be volatile (the record would vanish on power
+        loss, breaking the double-sign guard the WAL exists for)."""
+        dfd = os.open(os.path.dirname(os.path.abspath(self._path)) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def _maybe_rotate_locked(self) -> None:
+        if self._f.tell() < self.max_file_size:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        # Shift scheme: rotated files are always .000 (oldest) .. .NNN
+        # (newest); at capacity the oldest is dropped and the rest shift
+        # down. Indices stay dense and bounded — a fixed-width counter
+        # scheme silently collides once the suffix overflows its glob.
+        rotated = self._rotated_paths()
+        if len(rotated) >= self.max_files:
+            os.remove(rotated[0])
+            survivors = rotated[1:]
+            for i, p in enumerate(survivors):
+                os.replace(p, f"{self._path}.{i:03d}")
+            next_idx = len(survivors)
+        else:
+            next_idx = len(rotated)
+        os.replace(self._path, f"{self._path}.{next_idx:03d}")
+        self._f = open(self._path, "ab")
+        self._fsync_dir()
+
     def _append(self, msg, fsync: bool) -> None:
         payload = json.dumps(_encode_msg(msg), separators=(",", ":")).encode()
         if len(payload) > MAX_WAL_MSG_SIZE:
             raise ValueError(f"msg is too big: {len(payload)} bytes, max: {MAX_WAL_MSG_SIZE} bytes")
         rec = struct.pack("<II", zlib.crc32(payload), len(payload)) + payload
         with self._lock:
+            self._maybe_rotate_locked()
             self._f.write(rec)
             self._f.flush()
             if fsync:
@@ -177,29 +225,41 @@ class WAL:
     # ------------------------------------------------------------ replay
 
     def _read_all(self) -> list:
-        """Decode every intact record; stop at first corruption (the
-        reference truncates there via repairWalFile)."""
+        """Decode every intact record across the rotated set + head,
+        oldest first; stop at the FIRST corruption anywhere and do not
+        read later files — replaying past a hole would hand the state
+        machine a log with a silent gap (the reference's repairWalFile
+        truncates at the corruption point for the same reason)."""
         out = []
-        if not os.path.exists(self._path):
-            return out
         with self._lock:
-            self._f.flush()
-        with open(self._path, "rb") as f:
-            data = f.read()
-        pos = 0
-        while pos + 8 <= len(data):
-            crc, length = struct.unpack_from("<II", data, pos)
-            end = pos + 8 + length
-            if end > len(data) or length > MAX_WAL_MSG_SIZE:
-                break
-            payload = data[pos + 8 : end]
-            if zlib.crc32(payload) != crc:
-                break
-            try:
-                out.append(_decode_msg(json.loads(payload)))
-            except Exception:
-                break
-            pos = end
+            if not self._f.closed:
+                self._f.flush()
+            paths = self._rotated_paths() + (
+                [self._path] if os.path.exists(self._path) else []
+            )
+        for path in paths:
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            clean = True
+            while pos + 8 <= len(data):
+                crc, length = struct.unpack_from("<II", data, pos)
+                end = pos + 8 + length
+                if end > len(data) or length > MAX_WAL_MSG_SIZE:
+                    clean = False
+                    break
+                payload = data[pos + 8 : end]
+                if zlib.crc32(payload) != crc:
+                    clean = False
+                    break
+                try:
+                    out.append(_decode_msg(json.loads(payload)))
+                except Exception:
+                    clean = False
+                    break
+                pos = end
+            if not clean:
+                break  # truncate replay at the corruption point
         return out
 
     def search_for_end_height(self, height: int) -> list | None:

@@ -118,7 +118,9 @@ def decompress(enc_bytes, zip215: bool = True):
     RFC 8032 strict checks (canonical y, no -0).
     """
     sign = (enc_bytes[31] >> 7) & 1
-    y = enc_bytes.at[31].add(-(enc_bytes[31] & 0x80)).astype(jnp.int32)
+    y = jnp.concatenate(
+        [enc_bytes[:31], (enc_bytes[31] & 0x7F)[None]], axis=0
+    ).astype(jnp.int32)
     yy = F.fe_square(y)
     u = F.fe_sub(yy, jnp.asarray(F.ONE_LIMBS))  # y^2 - 1
     v = F.fe_add(F.fe_mul(yy, jnp.asarray(F.D_LIMBS)), jnp.asarray(F.ONE_LIMBS))  # d*y^2 + 1
@@ -312,4 +314,4 @@ def compress(p):
     zinv = F.fe_invert(p[2])
     xa = F.fe_canonical(F.fe_mul(p[0], zinv))
     ya = F.fe_canonical(F.fe_mul(p[1], zinv))
-    return ya.at[31].add((xa[0] & 1) << 7)
+    return jnp.concatenate([ya[:31], (ya[31] + ((xa[0] & 1) << 7))[None]], axis=0)

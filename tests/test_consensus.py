@@ -192,3 +192,70 @@ def test_wal_corrupt_tail_replay(tmp_path):
         assert wait_for_height([node2], 2, timeout=30)
     finally:
         node2.stop()
+
+
+def test_wal_rotation_and_retention(tmp_path):
+    """WAL rotates at max_file_size and retains max_files rotated files;
+    replay spans the whole retained set (ref: internal/libs/autofile
+    group.go RotateFile + checkTotalSizeLimit)."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    path = os.path.join(tmp_path, "cs.wal")
+    wal = WAL(path, max_file_size=4096, max_files=3)
+    for h in range(1, 200):
+        wal.write_sync(EndHeightMessage(height=h))
+    rotated = wal._rotated_paths()
+    assert rotated, "no rotation happened"
+    assert len(rotated) <= 3, f"retention failed: {rotated}"
+    assert all(os.path.getsize(p) >= 4096 for p in rotated)
+    # replay yields a contiguous TAIL of heights ending at the last write
+    msgs = wal._read_all()
+    heights = [m.height for m in msgs]
+    assert heights[-1] == 199
+    assert heights == list(range(heights[0], 200)), "replay not contiguous"
+    # search still finds recent end-heights across the rotated boundary
+    tail = wal.search_for_end_height(heights[-2])
+    assert tail is not None and len(tail) == 1
+    wal.close()
+
+
+def test_wal_rotation_many_cycles_no_collision(tmp_path):
+    """Hundreds of rotations must never collide or lose the tail (the
+    naive fixed-width-counter scheme overflowed its own glob at .999 and
+    silently overwrote segments)."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    path = os.path.join(tmp_path, "cs.wal")
+    wal = WAL(path, max_file_size=256, max_files=2)
+    for h in range(1, 1500):  # ~100+ rotations
+        wal.write_sync(EndHeightMessage(height=h))
+    files = wal._rotated_paths()
+    assert len(files) <= 2
+    msgs = wal._read_all()
+    heights = [m.height for m in msgs]
+    assert heights[-1] == 1499
+    assert heights == list(range(heights[0], 1500))
+    wal.close()
+
+
+def test_wal_mid_set_corruption_truncates_replay(tmp_path):
+    """Corruption in a ROTATED file stops replay there — no silent gap
+    with later records (double-sign safety)."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    path = os.path.join(tmp_path, "cs.wal")
+    wal = WAL(path, max_file_size=512, max_files=4)
+    for h in range(1, 200):
+        wal.write_sync(EndHeightMessage(height=h))
+    rotated = wal._rotated_paths()
+    assert len(rotated) >= 2
+    victim = rotated[1]
+    with open(victim, "r+b") as f:
+        f.seek(os.path.getsize(victim) // 2)
+        f.write(b"\xde\xad\xbe\xef")
+    msgs = wal._read_all()
+    heights = [m.height for m in msgs]
+    # contiguous prefix only; nothing after the corrupted segment
+    assert heights == list(range(heights[0], heights[-1] + 1))
+    assert heights[-1] < 199, "records after the corrupt segment leaked into replay"
+    wal.close()

@@ -25,41 +25,42 @@ def _descs():
     ]
 
 
-@pytest.fixture
-def conn_pair():
-    """Two handshaken TcpConnections (a: dialer, b: acceptor)."""
+from contextlib import contextmanager
+
+
+@contextmanager
+def make_conn_pair(send_rate=50_000_000, recv_rate=50_000_000, descs=None):
+    """Two handshaken TcpConnections (a: dialer, b: acceptor) with
+    teardown, parameterized by flow-control rates."""
+    descs = descs or _descs()
     k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+    chans = bytes(d.id for d in descs)
     ni = lambda k: NodeInfo(node_id=node_id_from_pubkey(k.pub_key()), network="mconn-test",
-                            channels=bytes([0x21, 0x22, 0x01]), listen_addr="127.0.0.1:1")
-    # generous rate so packetization, not the bucket, is under test
-    t1 = TcpTransport(_descs(), send_rate=50_000_000, recv_rate=50_000_000)
-    t2 = TcpTransport(_descs(), send_rate=50_000_000, recv_rate=50_000_000)
+                            channels=chans, listen_addr="127.0.0.1:1")
+    t1 = TcpTransport(descs, send_rate=send_rate, recv_rate=recv_rate)
+    t2 = TcpTransport(descs, send_rate=send_rate, recv_rate=recv_rate)
     results = {}
+    a = b = None
 
     def accept():
         c = t2.accept(timeout=5)
         results["b"] = c
-        results["b_peer"] = c.handshake(ni(k2), k2, timeout=5)
+        c.handshake(ni(k2), k2, timeout=5)
 
     th = threading.Thread(target=accept)
     th.start()
-    a = t1.dial(t2.endpoint(), timeout=5)
-    a_peer = a.handshake(ni(k1), k1, timeout=5)
-    th.join(timeout=5)
-    b = results["b"]
-    yield a, b
-    a.close()
-    b.close()
-    t1.close()
-    t2.close()
-
-
-def test_large_message_reassembled(conn_pair):
-    a, b = conn_pair
-    big = bytes(range(256)) * 1024  # 256 KiB, ~256 packets
-    a.send_message(0x01, big)
-    cid, got = _recv_until(b, 0x01)
-    assert cid == 0x01 and got == big
+    try:
+        a = t1.dial(t2.endpoint(), timeout=5)
+        a.handshake(ni(k1), k1, timeout=5)
+        th.join(timeout=5)
+        b = results["b"]
+        yield a, b
+    finally:
+        for c in (a, results.get("b")):
+            if c is not None:
+                c.close()
+        t1.close()
+        t2.close()
 
 
 def _recv_until(conn, want_cid, timeout=10.0):
@@ -74,75 +75,60 @@ def _recv_until(conn, want_cid, timeout=10.0):
     raise AssertionError(f"no message on {want_cid:#x}")
 
 
-def test_votes_interleave_with_bulk_transfer(conn_pair):
+def test_large_message_reassembled():
+    with make_conn_pair() as (a, b):
+        big = bytes(range(256)) * 1024  # 256 KiB, ~256 packets
+        a.send_message(0x01, big)
+        cid, got = _recv_until(b, 0x01)
+        assert cid == 0x01 and got == big
+
+
+def test_votes_interleave_with_bulk_transfer():
     """A high-priority vote sent mid-transfer of a 1 MiB low-priority blob
     must arrive long before the blob completes (the priority scheduler
-    interleaves packets; ref: conn/connection.go:478)."""
-    a, b = conn_pair
-    blob = b"\x5a" * (1 << 20)  # 1 MiB on priority-1 channel
-    votes_got = []
-    blob_got = []
+    interleaves packets; ref: conn/connection.go:478). Uses a 2 MB/s
+    send bucket so the blob takes ~0.5 s — at an unthrottled rate the
+    blob can finish before the vote is even enqueued, which would race."""
+    with make_conn_pair(send_rate=2_000_000) as (a, b):
+        blob = b"\x5a" * (1 << 20)  # 1 MiB on priority-1 channel
+        votes_got = []
+        blob_got = []
 
-    def reader():
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and not blob_got:
-            try:
-                cid, msg = b.receive_message(timeout=0.5)
-            except TimeoutError:
-                continue
-            if cid == 0x22:
-                votes_got.append(time.monotonic())
-            elif cid == 0x01:
-                blob_got.append(time.monotonic())
+        def reader():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not blob_got:
+                try:
+                    cid, msg = b.receive_message(timeout=0.5)
+                except TimeoutError:
+                    continue
+                if cid == 0x22:
+                    votes_got.append(time.monotonic())
+                elif cid == 0x01:
+                    blob_got.append(time.monotonic())
 
-    th = threading.Thread(target=reader)
-    th.start()
-    t0 = time.monotonic()
-    a.send_message(0x01, blob)
-    time.sleep(0.01)  # blob transfer in flight
-    a.send_message(0x22, b"vote-1")
-    th.join(timeout=35)
-    assert votes_got, "vote never arrived"
-    assert blob_got, "blob never arrived"
-    # the vote must not have waited for the 1 MiB blob to finish
-    assert votes_got[0] < blob_got[0], (
-        f"vote at +{votes_got[0]-t0:.3f}s arrived after blob at +{blob_got[0]-t0:.3f}s"
-    )
+        th = threading.Thread(target=reader)
+        th.start()
+        t0 = time.monotonic()
+        a.send_message(0x01, blob)
+        time.sleep(0.01)  # blob transfer in flight
+        a.send_message(0x22, b"vote-1")
+        th.join(timeout=35)
+        assert votes_got, "vote never arrived"
+        assert blob_got, "blob never arrived"
+        # the vote must not have waited for the 1 MiB blob to finish
+        assert votes_got[0] < blob_got[0], (
+            f"vote at +{votes_got[0]-t0:.3f}s arrived after blob at +{blob_got[0]-t0:.3f}s"
+        )
 
 
 def test_flow_control_bounds_send_rate():
-    """With a 200 KB/s bucket, 100 KiB must take >= ~0.3s to deliver."""
-    k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
-    ni = lambda k: NodeInfo(node_id=node_id_from_pubkey(k.pub_key()), network="mconn-test",
-                            channels=bytes([0x01]), listen_addr="127.0.0.1:1")
-    descs = [ChannelDescriptor(id=0x01, name="bulk", priority=1,
-                               encode=lambda b: b, decode=lambda b: b)]
-    t1 = TcpTransport(descs, send_rate=200_000)
-    t2 = TcpTransport(descs, send_rate=200_000)
-    results = {}
-
-    def accept():
-        c = t2.accept(timeout=5)
-        results["b"] = c
-        c.handshake(ni(k2), k2, timeout=5)
-
-    th = threading.Thread(target=accept)
-    th.start()
-    a = t1.dial(t2.endpoint(), timeout=5)
-    a.handshake(ni(k1), k1, timeout=5)
-    th.join(timeout=5)
-    b = results["b"]
-    try:
-        payload = b"\x11" * 300_000  # 300 KB at 200 KB/s: >= ~0.5s after burst
+    """With a 200 KB/s bucket, 300 KB must take >= ~0.4s to deliver."""
+    with make_conn_pair(send_rate=200_000, recv_rate=50_000_000) as (a, b):
+        payload = b"\x11" * 300_000
         t0 = time.monotonic()
         a.send_message(0x01, payload)
         cid, got = _recv_until(b, 0x01, timeout=15)
         dt = time.monotonic() - t0
         assert got == payload
-        # bucket starts with 200 KB burst; remaining 100 KB needs >= 0.5s
+        # bucket starts with a 200 KB burst; remaining 100 KB needs >= 0.5s
         assert dt >= 0.4, f"300 KB at 200 KB/s arrived in {dt:.2f}s — no throttling"
-    finally:
-        a.close()
-        b.close()
-        t1.close()
-        t2.close()
