@@ -156,11 +156,11 @@ class WAL:
         self._append(msg, fsync=True)
 
     def _rotated_paths(self) -> list[str]:
-        """Existing rotated files, oldest first (.000 is always oldest —
-        the shift scheme below keeps indices dense from zero)."""
+        """Existing rotated files, oldest first (fixed-width monotone
+        suffixes sort lexicographically = chronologically)."""
         import glob as _glob
 
-        return sorted(_glob.glob(self._path + ".[0-9][0-9][0-9]"))
+        return sorted(_glob.glob(self._path + "." + "[0-9]" * 9))
 
     def _fsync_dir(self) -> None:
         """Persist directory entries after renames/creates — without
@@ -179,20 +179,18 @@ class WAL:
         self._f.flush()
         os.fsync(self._f.fileno())
         self._f.close()
-        # Shift scheme: rotated files are always .000 (oldest) .. .NNN
-        # (newest); at capacity the oldest is dropped and the rest shift
-        # down. Indices stay dense and bounded — a fixed-width counter
-        # scheme silently collides once the suffix overflows its glob.
+        # Monotone 9-digit suffixes: the new segment takes max(existing)+1
+        # — one rename, crash-atomic, and a fresh index can never land on
+        # an occupied suffix (a shift scheme interrupted mid-shift leaves
+        # sparse indices that a dense counter would then overwrite).
+        # Retention deletes the oldest beyond max_files. 9 digits at the
+        # default 8 MiB per segment is ~8 EB of WAL before overflow.
         rotated = self._rotated_paths()
-        if len(rotated) >= self.max_files:
-            os.remove(rotated[0])
-            survivors = rotated[1:]
-            for i, p in enumerate(survivors):
-                os.replace(p, f"{self._path}.{i:03d}")
-            next_idx = len(survivors)
-        else:
-            next_idx = len(rotated)
-        os.replace(self._path, f"{self._path}.{next_idx:03d}")
+        next_idx = int(rotated[-1].rsplit(".", 1)[1]) + 1 if rotated else 0
+        os.replace(self._path, f"{self._path}.{next_idx:09d}")
+        rotated = self._rotated_paths()
+        while len(rotated) > self.max_files:
+            os.remove(rotated.pop(0))
         self._f = open(self._path, "ab")
         self._fsync_dir()
 

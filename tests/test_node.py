@@ -112,17 +112,26 @@ def test_testnet_rpc_tx_lifecycle(testnet):
     out, nodes = testnet
     host, port = nodes[0].rpc_address
     client = HTTPClient(f"http://{host}:{port}")
-    res = client.broadcast_tx_commit(tx=b"nodekey=nodeval".hex())
+    res = client.broadcast_tx_commit(tx=b"nodekey=nodeval".hex(), timeout=60.0)
     assert res["tx_result"]["code"] == 0
     # tx gossip: submit via node1's RPC, confirm via node2's app
     host2, port2 = nodes[1].rpc_address
     client2 = HTTPClient(f"http://{host2}:{port2}")
-    res2 = client2.broadcast_tx_commit(tx=b"gossip2=yes".hex())
+    res2 = client2.broadcast_tx_commit(tx=b"gossip2=yes".hex(), timeout=60.0)
     assert res2["tx_result"]["code"] == 0
     import base64
 
-    q = client.abci_query(data=b"gossip2".hex())
-    assert base64.b64decode(q["response"]["value"]) == b"yes"
+    # node1 committed the block; node0's app sees it only after the
+    # block propagates — poll instead of racing the gossip
+    last = {"value": None}
+
+    def _seen():
+        last["value"] = base64.b64decode(
+            client.abci_query(data=b"gossip2".hex())["response"].get("value") or b""
+        )
+        return last["value"] == b"yes"
+
+    assert _wait(_seen, timeout=30), f"node0 app never saw the tx (last value {last['value']!r})"
 
 
 def test_full_node_joins_and_syncs(testnet, tmp_path):
