@@ -144,7 +144,20 @@ class WAL:
         self.max_files = max_files
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._lock = threading.Lock()
+        self._migrate_legacy_suffixes()
         self._f = open(path, "ab")
+
+    def _migrate_legacy_suffixes(self) -> None:
+        """Rename 3-digit rotated segments from the earlier scheme into
+        the 9-digit sequence so replay and retention keep seeing them."""
+        import glob as _glob
+
+        legacy = sorted(_glob.glob(self._path + ".[0-9][0-9][0-9]"))
+        for p in legacy:
+            idx = int(p.rsplit(".", 1)[1])
+            target = f"{self._path}.{idx:09d}"
+            if not os.path.exists(target):
+                os.replace(p, target)
 
     def write(self, msg) -> None:
         """Buffered append (ref: Write wal.go:118 — fsync deferred)."""

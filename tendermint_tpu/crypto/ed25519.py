@@ -73,11 +73,57 @@ class Ed25519PrivKey(PrivKey):
         return KEY_TYPE
 
 
+_ACCEL_PROBE: dict = {}
+
+
+def _accelerator_present(timeout: float = 10.0) -> bool:
+    """True when jax resolves to a non-CPU backend (TPU here; the axon
+    platform registers under its own name). Backend init can HANG when
+    the TPU tunnel is down, so the probe runs once in a daemon thread
+    with a timeout — a validator must degrade to the host path, not
+    stall its first >=cutover commit for the tunnel's sake."""
+    if "result" in _ACCEL_PROBE:
+        return _ACCEL_PROBE["result"]
+    import threading
+
+    def probe():
+        try:
+            import jax
+
+            _ACCEL_PROBE["result"] = jax.default_backend() not in ("cpu",)
+        except Exception:
+            _ACCEL_PROBE["result"] = False
+
+    t = threading.Thread(target=probe, daemon=True, name="accel-probe")
+    t.start()
+    t.join(timeout=timeout)
+    if "result" not in _ACCEL_PROBE:
+        # init is hanging; answer False for this process (cached)
+        _ACCEL_PROBE["result"] = False
+    return _ACCEL_PROBE["result"]
+
+
 def _use_device() -> bool:
-    """Batch verification backend: the JAX kernel unless explicitly
-    disabled (TM_TPU_CRYPTO=off forces the host path — the equivalent of
-    the reference running without its batch path)."""
-    return os.environ.get("TM_TPU_CRYPTO", "on") != "off"
+    """Batch verification backend selection:
+      TM_TPU_CRYPTO=on   — always the JAX kernel (tests exercise it on
+                           the virtual CPU mesh this way)
+      TM_TPU_CRYPTO=off  — always the host path (the reference without
+                           its batch verifier)
+      TM_TPU_CRYPTO=auto — the kernel only when an accelerator backend
+                           is present; on CPU-only deployments native
+                           OpenSSL serial verification outruns an
+                           emulated kernel, so the host path wins
+    Default: auto."""
+    mode = os.environ.get("TM_TPU_CRYPTO", "auto").strip().lower()
+    if mode in ("off", "0", "false", "no"):
+        return False
+    if mode in ("on", "1", "true", "yes"):
+        return True
+    if mode not in ("auto", ""):
+        import warnings
+
+        warnings.warn(f"unrecognized TM_TPU_CRYPTO={mode!r}; using auto", stacklevel=2)
+    return _accelerator_present()
 
 
 # Below this many signatures a device launch costs more than it saves

@@ -11,6 +11,9 @@ import sys
 # Hard assignment: the container sets JAX_PLATFORMS=axon (one real TPU
 # behind a tunnel); unit tests must run on the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Exercise the JAX batch-verify kernel in tests even though the backend is
+# the virtual CPU mesh (TM_TPU_CRYPTO auto would pick the host path there).
+os.environ.setdefault("TM_TPU_CRYPTO", "on")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

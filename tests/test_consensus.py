@@ -259,3 +259,25 @@ def test_wal_mid_set_corruption_truncates_replay(tmp_path):
     assert heights == list(range(heights[0], heights[-1] + 1))
     assert heights[-1] < 199, "records after the corrupt segment leaked into replay"
     wal.close()
+
+
+def test_wal_legacy_suffix_migration(tmp_path):
+    """3-digit rotated segments from the earlier rotation scheme are
+    renamed into the 9-digit sequence on open, so upgraded nodes keep
+    replaying them."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    path = os.path.join(tmp_path, "cs.wal")
+    w = WAL(path, max_file_size=1 << 20)
+    for h in range(1, 10):
+        w.write_sync(EndHeightMessage(height=h))
+    w.close()
+    # fake a legacy layout: the head becomes a 3-digit rotated segment
+    os.replace(path, path + ".000")
+    w2 = WAL(path, max_file_size=1 << 20)
+    for h in range(10, 15):
+        w2.write_sync(EndHeightMessage(height=h))
+    heights = [m.height for m in w2._read_all()]
+    assert heights == list(range(1, 15)), heights
+    assert not os.path.exists(path + ".000")
+    w2.close()

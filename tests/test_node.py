@@ -111,7 +111,7 @@ def test_testnet_reaches_consensus(testnet):
 def test_testnet_rpc_tx_lifecycle(testnet):
     out, nodes = testnet
     host, port = nodes[0].rpc_address
-    client = HTTPClient(f"http://{host}:{port}")
+    client = HTTPClient(f"http://{host}:{port}", timeout=90.0)
     res = client.broadcast_tx_commit(tx=b"nodekey=nodeval".hex(), timeout=60.0)
     assert res["tx_result"]["code"] == 0
     # tx gossip: submit via node1's RPC, confirm via node2's app
@@ -158,7 +158,7 @@ def test_full_node_joins_and_syncs(testnet, tmp_path):
         )
         # full node serves correct data over its own RPC
         host, port = full.rpc_address
-        client = HTTPClient(f"http://{host}:{port}")
+        client = HTTPClient(f"http://{host}:{port}", timeout=90.0)
         blk = client.block(height=2)
         ref = nodes[0].block_store.load_block_meta(2)
         assert blk["block_id"]["hash"] == ref.block_id.hash.hex().upper()

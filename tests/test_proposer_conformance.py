@@ -77,3 +77,82 @@ def test_proposer_order_stable_over_10000_rounds():
     for i in range(4, 1000):
         assert vset.get_proposer().address == order[i % 4], f"round {i}"
         vset.increment_proposer_priority(1)
+
+
+# --- deterministic update algorithm vectors -------------------------------
+# (ref: types/validator_set_test.go TestValSetUpdatesBasicTestsExecute and
+# TestValSetUpdatesOrderIndependenceTestsExecute — a divergent update
+# algorithm forks the chain at the first validator-set change)
+
+import random
+
+
+def _tv(name: str, power: int) -> Validator:
+    return Validator(address=name.encode().ljust(20, b"\x00"), pub_key=None, voting_power=power)
+
+
+def _to_list(vset: ValidatorSet):
+    return [(v.address.rstrip(b"\x00").decode(), v.voting_power) for v in vset.validators]
+
+
+def _expected(pairs):
+    # canonical set ordering: power desc, then address asc
+    return sorted(pairs, key=lambda p: (-p[1], p[0]))
+
+
+BASIC_UPDATE_VECTORS = [
+    # (start, updates, expected) — ref: valSetUpdatesBasicTests
+    ([("v2", 10), ("v1", 10)], [], [("v2", 10), ("v1", 10)]),
+    ([("v2", 10), ("v1", 10)], [("v2", 22), ("v1", 11)], [("v2", 22), ("v1", 11)]),
+    ([("v2", 20), ("v1", 10)], [("v4", 40), ("v3", 30)],
+     [("v4", 40), ("v3", 30), ("v2", 20), ("v1", 10)]),
+    ([("v3", 20), ("v1", 10)], [("v2", 30)], [("v2", 30), ("v3", 20), ("v1", 10)]),
+    ([("v3", 20), ("v2", 10)], [("v1", 30)], [("v1", 30), ("v3", 20), ("v2", 10)]),
+    ([("v3", 30), ("v2", 20), ("v1", 10)], [("v2", 0)], [("v3", 30), ("v1", 10)]),
+]
+
+
+def test_valset_updates_basic_vectors():
+    for i, (start, updates, expected) in enumerate(BASIC_UPDATE_VECTORS):
+        vset = ValidatorSet.new([_tv(n, p) for n, p in start])
+        vset.update_with_change_set([_tv(n, p) for n, p in updates])
+        assert _to_list(vset) == _expected(expected), f"vector {i}"
+        # set invariants: total power, centered priorities
+        assert vset.total_voting_power() == sum(p for _, p in expected)
+        assert abs(sum(v.proposer_priority for v in vset.validators)) < len(vset.validators)
+
+
+ORDER_INDEPENDENCE_VECTORS = [
+    ([("v4", 40), ("v3", 30), ("v2", 10), ("v1", 10)],
+     [("v4", 44), ("v3", 33), ("v2", 22), ("v1", 11)]),
+    ([("v2", 20), ("v1", 10)], [("v3", 30), ("v4", 40), ("v5", 50), ("v6", 60)]),
+    ([("v4", 40), ("v3", 30), ("v2", 20), ("v1", 10)], [("v1", 0), ("v3", 0), ("v4", 0)]),
+    ([("v4", 40), ("v3", 30), ("v2", 20), ("v1", 10)],
+     [("v1", 0), ("v3", 0), ("v2", 22), ("v5", 50), ("v4", 44)]),
+]
+
+
+def test_valset_updates_order_independent():
+    rng = random.Random(42)
+    for i, (start, updates) in enumerate(ORDER_INDEPENDENCE_VECTORS):
+        base = ValidatorSet.new([_tv(n, p) for n, p in start])
+        ref_set = base.copy()
+        ref_set.update_with_change_set([_tv(n, p) for n, p in updates])
+        expected = [(v.address, v.voting_power, v.proposer_priority) for v in ref_set.validators]
+        for _ in range(min(20, len(updates) ** 2)):
+            perm = list(updates)
+            rng.shuffle(perm)
+            trial = base.copy()
+            trial.update_with_change_set([_tv(n, p) for n, p in perm])
+            got = [(v.address, v.voting_power, v.proposer_priority) for v in trial.validators]
+            assert got == expected, f"vector {i} diverged for permutation {perm}"
+
+
+def test_valset_update_does_not_alias_inputs():
+    """UpdateWithChangeSet must copy validators — mutating the update
+    list afterwards must not reach into the set (ref: basic tests')."""
+    vset = ValidatorSet.new([_tv("v1", 10), _tv("v2", 20)])
+    updates = [_tv("v1", 11)]
+    vset.update_with_change_set(updates)
+    updates[0].voting_power = 999
+    assert _to_list(vset) == _expected([("v1", 11), ("v2", 20)])
