@@ -58,12 +58,16 @@ def bench_device(pks, msgs, sigs):
 
     # Warm-up launch compiles the program; measure steady state.
     V.verify_batch(pks, msgs, sigs)
+    # Throughput is measured pipelined: every iteration pays full host
+    # prep + uint8 H2D + kernel, but iterations are dispatched async so
+    # transfers overlap compute (the production mode: blocksync feeds
+    # the chip a stream of per-height commit batches). Sync once at end.
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
     t0 = time.perf_counter()
-    iters = 3
-    for _ in range(iters):
-        bitmap = V.verify_batch(pks, msgs, sigs)
+    inflight = [V.verify_batch_async(pks, msgs, sigs) for _ in range(iters)]
+    bitmaps = [V.collect(d) for d in inflight]
     dt = (time.perf_counter() - t0) / iters
-    assert bool(bitmap.all()), "device rejected valid signatures"
+    assert all(bool(b.all()) for b in bitmaps), "device rejected valid signatures"
     return len(sigs) / dt
 
 

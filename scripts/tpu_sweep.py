@@ -1,0 +1,88 @@
+"""Sweep batch sizes on the real chip: device-only vs end-to-end rates.
+
+Usage: TM_TPU_FE_MUL=dot python scripts/tpu_sweep.py
+"""
+
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import verify as V
+
+log(f"devices: {jax.devices()}  FE_MUL={os.environ.get('TM_TPU_FE_MUL', 'dot(default)')}")
+
+MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
+pks, msgs, sigs = [], [], []
+sk = ref.gen_privkey(b"\x42" * 32)
+pk = sk[32:]
+for i in range(MAX_B):
+    m = b"bench-commit-vote-%d" % i
+    pks.append(pk)
+    msgs.append(m)
+    sigs.append(ref.sign(sk, m))
+
+# host prep once at max size
+t0 = time.time()
+a, r, s, k, pre = V.prepare_batch(pks, msgs, sigs)
+log(f"host prep {MAX_B}: {time.time()-t0:.3f}s ({MAX_B/(time.time()-t0):,.0f} sigs/s)")
+
+for B in (256, 1024, 2048, 4096, 8192):
+    if B > MAX_B:
+        break
+    da = jnp.asarray(a[:B].astype(np.uint8)); dr = jnp.asarray(r[:B].astype(np.uint8)); ds = jnp.asarray(s[:B].astype(np.uint8)); dk = jnp.asarray(k[:B].astype(np.uint8))
+    t0 = time.time()
+    out = V.verify_kernel(da, dr, ds, dk)
+    jax.block_until_ready(out)
+    t_compile = time.time() - t0
+    assert bool(np.asarray(out).all()), f"kernel rejected valid sigs at B={B}"
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        out = V.verify_kernel(da, dr, ds, dk)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / iters
+    log(f"B={B:5d}  compile+1st {t_compile:7.2f}s   steady {dt*1000:9.3f}ms   device-only {B/dt:12,.0f} sigs/s")
+
+# H2D bandwidth probe: how fast can we push uint8 batches through?
+for mb in (1, 4):
+    buf = np.zeros((mb << 20,), np.uint8)
+    jax.block_until_ready(jnp.asarray(buf))  # warm path
+    t0 = time.time()
+    outs = [jnp.asarray(buf) for _ in range(4)]
+    jax.block_until_ready(outs)
+    dt = (time.time() - t0) / 4
+    log(f"H2D {mb}MB: {dt*1000:7.1f}ms = {mb/dt:8.1f} MB/s")
+
+# end-to-end sync vs pipelined (host prep + uint8 transfer + kernel + D2H)
+B = MAX_B
+t0 = time.time()
+iters = 3
+for _ in range(iters):
+    ok = V.verify_batch(pks, msgs, sigs)
+dt = (time.time() - t0) / iters
+log(f"end-to-end sync      B={B}: {dt*1000:8.1f}ms/call = {B/dt:10,.0f} sigs/s")
+
+iters = 8
+t0 = time.time()
+inflight = [V.verify_batch_async(pks, msgs, sigs) for _ in range(iters)]
+outs = [V.collect(d) for d in inflight]
+dt = (time.time() - t0) / iters
+assert all(bool(o.all()) for o in outs)
+log(f"end-to-end pipelined B={B}: {dt*1000:8.1f}ms/call = {B/dt:10,.0f} sigs/s")

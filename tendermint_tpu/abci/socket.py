@@ -355,7 +355,12 @@ def main(argv=None) -> int:
     ap.add_argument("--snapshot-interval", type=int, default=0)
     args = ap.parse_args(argv)
     app = KVStoreApplication(snapshot_interval=args.snapshot_interval)
-    srv = serve_app(app, args.addr)
+    if args.addr.startswith("grpc://"):
+        from .grpc import serve_app as serve_grpc
+
+        srv = serve_grpc(app, args.addr)
+    else:
+        srv = serve_app(app, args.addr)
     print(f"ABCI kvstore listening on {srv.listen_addr}", flush=True)
     try:
         while True:

@@ -442,9 +442,19 @@ def cmd_remote_signer(args) -> int:
 
     cfg = load_config(args.home)
     pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
-    server = SignerServer(args.addr, pv, args.chain_id)
-    server.start()
-    print(f"remote signer for {pv.get_pub_key().address().hex().upper()} dialing {args.addr}")
+    if args.addr.startswith("grpc://"):
+        # gRPC role inversion: the signer hosts the service and the
+        # validator dials it (ref: privval/grpc/server.go)
+        from .privval.grpc import GRPCSignerServer
+
+        server = GRPCSignerServer(pv, args.chain_id, args.addr)
+        server.start()
+        print(f"remote signer for {pv.get_pub_key().address().hex().upper()} "
+              f"listening on {server.listen_addr}")
+    else:
+        server = SignerServer(args.addr, pv, args.chain_id)
+        server.start()
+        print(f"remote signer for {pv.get_pub_key().address().hex().upper()} dialing {args.addr}")
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     while not stop:
@@ -513,8 +523,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("compact", help="compact the node's append-only databases").set_defaults(fn=cmd_compact)
 
-    sp = sub.add_parser("remote-signer", help="run an external signer dialing a validator")
-    sp.add_argument("--addr", required=True, help="validator privval listen address (tcp:// or unix://)")
+    sp = sub.add_parser(
+        "remote-signer",
+        help="run an external signer (dials tcp://|unix:// validators; "
+             "hosts the service itself for grpc://)",
+    )
+    sp.add_argument(
+        "--addr", required=True,
+        help="validator privval listen address (tcp:// or unix://), or a "
+             "grpc:// address for this signer to listen on (the validator "
+             "dials it; set priv_validator_laddr to the printed address)",
+    )
     sp.add_argument("--chain-id", required=True)
     sp.set_defaults(fn=cmd_remote_signer)
 

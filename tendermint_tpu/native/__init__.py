@@ -1,7 +1,7 @@
 """Native runtime components (C, built on demand with the system gcc).
 
 `prep` — the batch-prep hot path feeding the TPU verify kernel
-(SHA-512 challenges + mod-L reduction + int32 shaping). Loaded via
+(SHA-512 challenges + mod-L reduction + uint8 shaping). Loaded via
 ctypes from a .so compiled next to the source on first use; falls back
 to the pure-Python path if no compiler is available.
 """
@@ -56,10 +56,10 @@ def load_prep():
                 ctypes.c_char_p,  # msgs (concatenated)
                 ctypes.POINTER(ctypes.c_int64),  # offsets
                 ctypes.c_int64,  # n
-                ctypes.POINTER(ctypes.c_int32),  # out_a
-                ctypes.POINTER(ctypes.c_int32),  # out_r
-                ctypes.POINTER(ctypes.c_int32),  # out_s
-                ctypes.POINTER(ctypes.c_int32),  # out_k
+                ctypes.POINTER(ctypes.c_uint8),  # out_a
+                ctypes.POINTER(ctypes.c_uint8),  # out_r
+                ctypes.POINTER(ctypes.c_uint8),  # out_s
+                ctypes.POINTER(ctypes.c_uint8),  # out_k
                 ctypes.c_char_p,  # precheck
             ]
             lib.prepare_batch.restype = None

@@ -1,6 +1,6 @@
 /* Native batch-prep for the TPU verify pipeline (the host side of
  * ops/verify.prepare_batch): per signature, SHA-512(R||A||M) reduced
- * mod L plus byte->int32 shaping of (A, R, S) and the s < L precheck.
+ * mod L plus byte shaping of (A, R, S) and the s < L precheck.
  *
  * Python-side prep caps host throughput at ~170k sigs/s — below the
  * >=50x north-star (~400k+ sigs/s), so the chip would starve. This is
@@ -194,11 +194,12 @@ static int s_in_range(const uint8_t s[32]) {
 }
 
 /* Inputs: pks n*32, sigs n*64, msgs concatenated with offsets[n+1].
- * Outputs: a/r/s/k as int32 arrays (n*32), precheck bytes (n). */
+ * Outputs: a/r/s/k as uint8 arrays (n*32) — the device transfer
+ * format; the kernel widens to int32 on chip — precheck bytes (n). */
 void prepare_batch(const uint8_t *pks, const uint8_t *sigs,
                    const uint8_t *msgs, const int64_t *offsets, int64_t n,
-                   int32_t *out_a, int32_t *out_r, int32_t *out_s,
-                   int32_t *out_k, uint8_t *precheck) {
+                   uint8_t *out_a, uint8_t *out_r, uint8_t *out_s,
+                   uint8_t *out_k, uint8_t *precheck) {
     uint8_t buf[64 + 4096];
     uint8_t digest[64], k[32];
     for (int64_t i = 0; i < n; i++) {

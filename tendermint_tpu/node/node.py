@@ -90,13 +90,11 @@ def _make_app(proxy_app: str):
         client.start()
         return client
     if proxy_app.startswith("grpc://"):
-        # The reference offers a gRPC ABCI transport (abci/client/
-        # grpc_client.go); this build has no grpc runtime available, so
-        # the socket transport is the out-of-process deployment mode.
-        raise ValueError(
-            "grpc:// ABCI transport is not available in this build "
-            "(no grpc runtime); use tcp:// or unix:// socket ABCI"
-        )
+        from ..abci.grpc import GRPCClient
+
+        client = GRPCClient(proxy_app)
+        client.start()
+        return client
     raise ValueError(f"unsupported proxy_app {proxy_app!r}")
 
 
@@ -178,10 +176,19 @@ class Node:
                 raise ValueError(f"unsupported tx_index.indexer {name!r}")
         self.indexer_service = IndexerService(sinks, self.event_bus) if sinks else None
 
-        # ---- privval (node/setup.go:489: file | socket remote signer)
+        # ---- privval (node/setup.go:489: file | socket | grpc remote signer)
         self.privval_endpoint = None
         if priv_validator is not None:
             self.priv_validator = priv_validator
+        elif (
+            config.base.mode == "validator"
+            and config.base.priv_validator_laddr.startswith("grpc://")
+        ):
+            from ..privval.grpc import GRPCSignerClient
+
+            self.priv_validator = GRPCSignerClient(
+                config.base.priv_validator_laddr, self.gen_doc.chain_id
+            )
         elif config.base.mode == "validator" and config.base.priv_validator_laddr:
             from ..privval.remote import SignerClient, SignerListenerEndpoint
 
@@ -522,6 +529,8 @@ class Node:
             self.consensus.stop()
         if self.privval_endpoint is not None:
             self.privval_endpoint.stop()
+        if hasattr(self.priv_validator, "stop"):
+            self.priv_validator.stop()  # gRPC signer client channel
         if self.pex_reactor is not None:
             self.pex_reactor.stop()
         self.blocksync_reactor.stop()

@@ -1,0 +1,28 @@
+"""Shared helpers for the gRPC transports (abci/grpc.py, privval/grpc.py)."""
+
+from __future__ import annotations
+
+try:
+    import grpc
+except ImportError:  # pragma: no cover - grpcio is in the base image
+    grpc = None
+
+
+def require_grpc() -> None:
+    if grpc is None:
+        raise RuntimeError("grpcio is not available; use the socket transport")
+
+
+def strip_scheme(addr: str) -> str:
+    for scheme in ("grpc://", "tcp://"):
+        if addr.startswith(scheme):
+            return addr[len(scheme):]
+    return addr
+
+
+def listen_addr(requested: str, bound_port: int) -> str:
+    """grpc://<host-as-requested>:<actual port> — keeps the bind host
+    (0.0.0.0, a LAN IP, ...) instead of assuming loopback."""
+    hostport = strip_scheme(requested)
+    host = hostport.rsplit(":", 1)[0] if ":" in hostport else hostport
+    return f"grpc://{host or '127.0.0.1'}:{bound_port}"

@@ -1,0 +1,154 @@
+"""gRPC remote signer: pubkey/sign roundtrips, double-sign guard across
+a signer restart, and a validator node signing via the gRPC signer
+(ref: privval/grpc/client.go, server.go)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.privval.grpc import GRPCSignerClient, GRPCSignerServer
+from tendermint_tpu.privval.remote import RemoteSignerErrorException
+from tendermint_tpu.proto.messages import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+)
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN_ID = "grpc-signer-chain"
+
+
+def _vote(height=5, type_=SIGNED_MSG_TYPE_PREVOTE):
+    return Vote(
+        type=type_,
+        height=height,
+        round=0,
+        block_id=BlockID(hash=b"\x11" * 32,
+                         part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32)),
+        timestamp=Time.now(),
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+    )
+
+
+@pytest.fixture()
+def grpc_signer(tmp_path):
+    key_f, state_f = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(key_f, state_f)
+    pv.save_key()
+    server = GRPCSignerServer(pv, CHAIN_ID, "127.0.0.1:0")
+    server.start()
+    client = GRPCSignerClient(server.listen_addr, CHAIN_ID)
+    client.start()
+    yield pv, server, client, (key_f, state_f)
+    client.stop()
+    server.stop()
+
+
+def test_grpc_pubkey(grpc_signer):
+    pv, _, client, _ = grpc_signer
+    assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+    assert client.address() == pv.get_pub_key().address()
+
+
+def test_grpc_sign_vote_verifies(grpc_signer):
+    pv, _, client, _ = grpc_signer
+    v = _vote()
+    client.sign_vote(CHAIN_ID, v)
+    assert v.signature
+    assert pv.get_pub_key().verify_signature(v.sign_bytes(CHAIN_ID), v.signature)
+
+
+def test_grpc_double_sign_rejected(grpc_signer):
+    _, _, client, _ = grpc_signer
+    v1 = _vote(height=7, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+    client.sign_vote(CHAIN_ID, v1)
+    conflicting = _vote(height=7, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+    conflicting.block_id = BlockID(
+        hash=b"\x99" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x88" * 32)
+    )
+    with pytest.raises(RemoteSignerErrorException):
+        client.sign_vote(CHAIN_ID, conflicting)
+
+
+def test_grpc_guard_across_signer_restart(tmp_path):
+    key_f, state_f = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(key_f, state_f)
+    pv.save_key()
+    server = GRPCSignerServer(pv, CHAIN_ID, "127.0.0.1:0")
+    server.start()
+    client = GRPCSignerClient(server.listen_addr, CHAIN_ID)
+    try:
+        v1 = _vote(height=9, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+        client.sign_vote(CHAIN_ID, v1)
+        client.stop()
+        server.stop()
+        # fresh signer process on the same state file
+        pv2 = FilePV.load(key_f, state_f)
+        server = GRPCSignerServer(pv2, CHAIN_ID, "127.0.0.1:0")
+        server.start()
+        client = GRPCSignerClient(server.listen_addr, CHAIN_ID)
+        conflicting = _vote(height=9, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+        conflicting.block_id = BlockID(
+            hash=b"\x99" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x88" * 32)
+        )
+        with pytest.raises(RemoteSignerErrorException):
+            client.sign_vote(CHAIN_ID, conflicting)
+        # idempotent re-sign of the SAME vote still succeeds
+        same = _vote(height=9, type_=SIGNED_MSG_TYPE_PRECOMMIT)
+        same.timestamp = v1.timestamp
+        client.sign_vote(CHAIN_ID, same)
+        assert same.signature == v1.signature
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_node_with_grpc_signer(tmp_path):
+    """A single-validator node whose votes are signed via the gRPC
+    signer produces blocks (priv_validator_laddr = grpc://...)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_consensus import fast_params
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", CHAIN_ID, "--starting-port", "0"]) == 0
+    gen_path = os.path.join(out, "node0", "config", "genesis.json")
+    gen_doc = GenesisDoc.from_file(gen_path)
+    gen_doc.consensus_params = fast_params()
+    gen_doc.save_as(gen_path)
+
+    home = os.path.join(out, "node0")
+    cfg = load_config(home)
+    # the signer holds the real validator key (testnet wrote it to the
+    # node home); host it over gRPC and point the node at it
+    pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    server = GRPCSignerServer(pv, CHAIN_ID, "127.0.0.1:0")
+    server.start()
+
+    cfg.base.priv_validator_laddr = server.listen_addr
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.base.db_backend = "memdb"
+    node = Node(cfg)
+    node.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and node.consensus.rs.height < 3:
+            time.sleep(0.1)
+        assert node.consensus.rs.height >= 3, "no blocks with grpc signer"
+    finally:
+        node.stop()
+        server.stop()
