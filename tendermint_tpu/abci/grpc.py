@@ -15,14 +15,13 @@ wrapper (gRPC carries the method in the HTTP/2 path instead).
 from __future__ import annotations
 
 import threading
-from concurrent import futures
 
 try:
     import grpc
 except ImportError:  # pragma: no cover - grpcio is in the base image
     grpc = None
 
-from ..utils.grpcutil import listen_addr as _listen_addr
+from ..utils.grpcutil import GenericGrpcServer
 from ..utils.grpcutil import require_grpc as _require_grpc
 from ..utils.grpcutil import strip_scheme as _strip_scheme
 from . import proto as apb
@@ -103,28 +102,13 @@ class _AppHandler(grpc.GenericRpcHandler if grpc else object):
             context.abort(grpc.StatusCode.INTERNAL, repr(e))
 
 
-class GRPCServer:
+class GRPCServer(GenericGrpcServer):
     """gRPC ABCI server for out-of-process apps
     (ref: abci/server/grpc_server.go)."""
 
     def __init__(self, app: Application, addr: str, logger=None):
-        _require_grpc()
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
-        self._server.add_generic_rpc_handlers((_AppHandler(app, logger),))
-        self._port = self._server.add_insecure_port(_strip_scheme(addr))
-        if self._port == 0:
-            raise OSError(f"cannot bind ABCI gRPC server to {addr!r}")
-        self._requested_addr = addr
-
-    @property
-    def listen_addr(self) -> str:
-        return _listen_addr(self._requested_addr, self._port)
-
-    def start(self) -> None:
-        self._server.start()
-
-    def stop(self) -> None:
-        self._server.stop(grace=0.5)
+        super().__init__(_AppHandler(app, logger), addr,
+                         max_workers=4, what="ABCI gRPC server")
 
 
 class GRPCClient(Client):

@@ -422,6 +422,32 @@ def cmd_compact(args) -> int:
     return 0
 
 
+def cmd_key_migrate(args) -> int:
+    """`key-migrate` — upgrade legacy ASCII-decimal store keys to the
+    current fixed-width binary layout (ref: cmd/tendermint/main.go:28-48
+    key-migrate, scripts/keymigrate/migrate.go). Idempotent."""
+    from .config import load_config
+    from .store.kv import FileDB
+    from .store.migrate import migrate_db
+
+    cfg = load_config(args.home)
+    if not os.path.isdir(cfg.db_dir):
+        print(f"no data dir at {cfg.db_dir}")
+        return 1
+    total = 0
+    for name in sorted(os.listdir(cfg.db_dir)):
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(cfg.db_dir, name)
+        db = FileDB(path)
+        moved = migrate_db(db)
+        db.close()
+        total += moved
+        print(f"migrated {name}: {moved} keys")
+    print(f"total migrated: {total} keys")
+    return 0
+
+
 def cmd_e2e(args) -> int:
     """Run a manifest-driven multi-process e2e testnet
     (ref: test/e2e/runner/main.go)."""
@@ -522,6 +548,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_reindex_event)
 
     sub.add_parser("compact", help="compact the node's append-only databases").set_defaults(fn=cmd_compact)
+
+    sub.add_parser(
+        "key-migrate",
+        help="upgrade legacy DB key layouts to the current format",
+    ).set_defaults(fn=cmd_key_migrate)
 
     sp = sub.add_parser(
         "remote-signer",

@@ -28,7 +28,7 @@ def _build() -> bool:
         if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
             return True
         subprocess.run(
-            ["cc", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            ["cc", "-O3", "-march=native", "-shared", "-fPIC", "-pthread", "-o", _SO + ".tmp", _SRC],
             check=True, capture_output=True,
         )
         os.replace(_SO + ".tmp", _SO)

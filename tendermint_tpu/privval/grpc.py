@@ -14,7 +14,6 @@ field numbers).
 from __future__ import annotations
 
 import threading
-from concurrent import futures
 
 try:
     import grpc
@@ -22,7 +21,7 @@ except ImportError:  # pragma: no cover - grpcio is in the base image
     grpc = None
 
 from ..crypto.ed25519 import Ed25519PubKey
-from ..utils.grpcutil import listen_addr as _listen_addr
+from ..utils.grpcutil import GenericGrpcServer
 from ..utils.grpcutil import require_grpc as _require_grpc
 from ..utils.grpcutil import strip_scheme as _strip_scheme
 from ..proto.messages import PublicKey
@@ -87,30 +86,15 @@ class _SignerHandler(grpc.GenericRpcHandler if grpc else object):
             )
 
 
-class GRPCSignerServer:
+class GRPCSignerServer(GenericGrpcServer):
     """Signer process hosting PrivValidatorAPI over a FilePV
     (ref: privval/grpc/server.go)."""
 
     def __init__(self, file_pv, chain_id: str, addr: str = "127.0.0.1:0", logger=None):
-        _require_grpc()
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
-        self._server.add_generic_rpc_handlers(
-            (_SignerHandler(file_pv, chain_id, logger or new_logger("privval-grpc")),)
+        super().__init__(
+            _SignerHandler(file_pv, chain_id, logger or new_logger("privval-grpc")),
+            addr, max_workers=2, what="privval gRPC server",
         )
-        self._port = self._server.add_insecure_port(_strip_scheme(addr))
-        if self._port == 0:
-            raise OSError(f"cannot bind privval gRPC server to {addr!r}")
-        self._requested_addr = addr
-
-    @property
-    def listen_addr(self) -> str:
-        return _listen_addr(self._requested_addr, self._port)
-
-    def start(self) -> None:
-        self._server.start()
-
-    def stop(self) -> None:
-        self._server.stop(grace=0.5)
 
 
 class GRPCSignerClient:

@@ -26,3 +26,31 @@ def listen_addr(requested: str, bound_port: int) -> str:
     hostport = strip_scheme(requested)
     host = hostport.rsplit(":", 1)[0] if ":" in hostport else hostport
     return f"grpc://{host or '127.0.0.1'}:{bound_port}"
+
+
+class GenericGrpcServer:
+    """Shared server shell for the generic-bytes gRPC transports: bind,
+    port-0 failure check, listen address, start/stop lifecycle. The
+    transport supplies its GenericRpcHandler."""
+
+    def __init__(self, handler, addr: str, max_workers: int = 4,
+                 what: str = "gRPC server"):
+        require_grpc()
+        from concurrent import futures
+
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((handler,))
+        self._port = self._server.add_insecure_port(strip_scheme(addr))
+        if self._port == 0:
+            raise OSError(f"cannot bind {what} to {addr!r}")
+        self._requested_addr = addr
+
+    @property
+    def listen_addr(self) -> str:
+        return listen_addr(self._requested_addr, self._port)
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)

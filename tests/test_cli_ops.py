@@ -103,3 +103,46 @@ def test_compact_reclaims_space(tmp_path):
     bs = BlockStore(_make_db(cfg, "blockstore"))
     assert bs.height() == height
     assert bs.load_block(height) is not None
+
+
+def test_key_migrate_legacy_layout(tmp_path):
+    """key-migrate rewrites a legacy ASCII-decimal-key DB into the
+    current fixed-width layout, idempotently, and the blockstore then
+    reads it (ref: scripts/keymigrate/migrate.go semantics)."""
+    n, home, rpc, height = _mini_chain(tmp_path, "km-chain", txs=2)
+    n.stop()
+    cfg = load_config(home)
+    from tendermint_tpu.store.kv import FileDB
+    from tendermint_tpu.store.migrate import migrate_db
+
+    # rewrite the real blockstore into the LEGACY layout
+    path = os.path.join(cfg.db_dir, "blockstore.db")
+    db = FileDB(path)
+    rewrites = []
+    for key, value in list(db.iterator()):
+        for prefix in (b"H:", b"C:", b"SC:", b"EC:"):
+            if key.startswith(prefix) and len(key) == len(prefix) + 8:
+                h = int.from_bytes(key[len(prefix):], "big")
+                rewrites.append((key, prefix + str(h).encode(), value))
+        if key.startswith(b"P:") and len(key) >= 2 + 8 + 1 + 4:
+            h = int.from_bytes(key[2:10], "big")
+            idx = int.from_bytes(key[11:15], "big")
+            rewrites.append((key, b"P:%d:%d" % (h, idx), value))
+    assert rewrites, "expected height-keyed entries to legacy-ify"
+    for old, legacy, value in rewrites:
+        db.delete(old)
+        db.set(legacy, value)
+    db.close()
+
+    assert cli_main(["--home", home, "key-migrate"]) == 0
+    # idempotent: a second run migrates zero keys and changes nothing
+    assert cli_main(["--home", home, "key-migrate"]) == 0
+
+    from tendermint_tpu.node.node import _make_db
+    from tendermint_tpu.store.blockstore import BlockStore
+
+    bs = BlockStore(_make_db(cfg, "blockstore"))
+    assert bs.height() == height
+    blk = bs.load_block(height)
+    assert blk is not None
+    assert bs.load_block_commit(height - 1) is not None

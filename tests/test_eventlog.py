@@ -97,13 +97,19 @@ def test_events_rpc_over_running_node(tmp_path):
     n = Node(cfg)
     n.start()
     try:
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline and n.block_store.height() < 3:
             time.sleep(0.05)
         assert n.block_store.height() >= 3
         host, port = n.rpc_address
         c = HTTPClient(f"http://{host}:{port}")
-        res = c.call("events", filter={"query": "tm.event = 'NewBlock'"}, maxItems=2)
+        # the eventbus publishes asynchronously to block commit: poll
+        # until the log has items (CI machines under load can lag here)
+        res = {"items": []}
+        while time.monotonic() < deadline and not res["items"]:
+            res = c.call("events", filter={"query": "tm.event = 'NewBlock'"}, maxItems=2)
+            if not res["items"]:
+                time.sleep(0.1)
         assert res["items"], "no NewBlock events in the log"
         assert all(it["data"]["type"] == "tendermint/event/NewBlock" for it in res["items"])
         # page backwards with `before` until exhausted

@@ -72,3 +72,37 @@ def test_variable_length_messages_offsets():
     nat = V._prepare_batch_native(lib, [pk] * 5, msgs, sigs)
     for a, b in zip(py, nat):
         assert (a == b).all()
+
+
+def test_mod_l_adversarial_digests():
+    """Drive the exported tm_mod_l over digests that push the Horner
+    remainder into [2^252, L) — the intermediate states random fuzz
+    cannot reach (~2^-126/digest) where the 65-bit hi fold applies."""
+    import ctypes
+    import random
+
+    from tendermint_tpu.native import load_prep
+
+    lib = load_prep()
+    if lib is None:
+        import pytest
+
+        pytest.skip("no C toolchain")
+    lib.tm_mod_l.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    L = 2**252 + 27742317777372353535851937790883648493
+
+    def c_mod_l(digest: bytes) -> int:
+        out = ctypes.create_string_buffer(32)
+        lib.tm_mod_l(digest, out)
+        return int.from_bytes(out.raw, "little")
+
+    cases = [bytes([pat]) * 64 for pat in range(256)]
+    lm1 = (L - 1).to_bytes(32, "little")
+    cases += [bytes(32) + lm1, lm1 + bytes(32), lm1 + lm1, b"\xff" * 64]
+    for shift in range(0, 260, 4):
+        for off in (-2, -1, 0, 1, 2):
+            cases.append((((L << shift) + off) % 2**512).to_bytes(64, "little"))
+    rng = random.Random(77)
+    cases += [rng.randbytes(64) for _ in range(2000)]
+    for d in cases:
+        assert c_mod_l(d) == int.from_bytes(d, "little") % L, d.hex()
