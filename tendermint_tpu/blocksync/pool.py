@@ -120,6 +120,13 @@ class BlockPool:
             second = self.blocks.get(self.height + 1)
             return (first[0] if first else None), (second[0] if second else None)
 
+    def peek_third_block(self):
+        """Block at height+2 if downloaded — feeds the verify-ahead
+        pipeline (its LastCommit proves height+1 while height applies)."""
+        with self._lock:
+            third = self.blocks.get(self.height + 2)
+            return third[0] if third else None
+
     def block_sender(self, height: int) -> str | None:
         with self._lock:
             entry = self.blocks.get(height)

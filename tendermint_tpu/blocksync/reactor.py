@@ -19,7 +19,7 @@ import time
 from ..p2p.types import CHANNEL_BLOCKSYNC, ChannelDescriptor, PEER_STATUS_UP, PeerError
 from ..proto import messages as pb
 from ..types.block import Block, BlockID
-from ..types.validation import verify_commit_light
+from ..types.validation import verify_commit_light, verify_commit_light_async
 from .pool import BlockPool
 
 
@@ -134,6 +134,14 @@ class BlockSyncReactor:
         )
         self.blocks_synced = 0
         self.sync_error = False
+        # verify-ahead pipeline state: (height, block obj, commit-source
+        # block obj, valset hash, completion callable). Object identity
+        # guards against the pool refetching either block; the valset
+        # hash guards against validator-set changes (state.validators
+        # after applying h is exactly state.next_validators before —
+        # state/state.py:97 — so a mismatch means a dynamic update we
+        # must not have predicted).
+        self._verify_ahead = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._switched = False
@@ -252,14 +260,27 @@ class BlockSyncReactor:
             first_parts = first.make_part_set()
             first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
             # ★ the north-star call (reactor.go:582): batched verify of
-            # second.LastCommit against OUR current validator set
-            verify_commit_light(
-                self.state.chain_id,
-                self.state.validators,
-                first_id,
-                first.header.height,
-                second.last_commit,
-            )
+            # second.LastCommit against OUR current validator set — via
+            # the verify-ahead pipeline when the previous iteration
+            # already dispatched this height to the device.
+            ahead, self._verify_ahead = self._verify_ahead, None
+            if (
+                ahead is not None
+                and ahead[0] == first.header.height
+                and ahead[1] is first
+                and ahead[2] is second
+                and ahead[3] == self.state.validators.hash()
+            ):
+                ahead[4]()  # completes the dispatched kernel; raises as sync would
+            else:
+                verify_commit_light(
+                    self.state.chain_id,
+                    self.state.validators,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                )
+            self._dispatch_verify_ahead(second)
         except Exception as e:
             # Either sender could be lying (a forged second.LastCommit
             # fails an honest first block): ban BOTH and refetch both
@@ -279,3 +300,33 @@ class BlockSyncReactor:
         self.state = self.block_exec.apply_block(self.state, first_id, first)
         self.blocks_synced += 1
         return True
+
+    def _dispatch_verify_ahead(self, second) -> None:
+        """Launch the device verification of height h+1's commit while
+        height h applies host-side (ABCI + stores): `second` is proven
+        by third.last_commit against state.next_validators — the exact
+        set that becomes state.validators after the apply
+        (state/state.py:97). Host-side check failures are deferred to
+        the completion call so error handling stays in one place; a
+        dispatch that turns out stale (pool refetch, valset change) is
+        simply dropped by the identity/hash guards above."""
+        third = self.pool.peek_third_block()
+        if third is None:
+            return
+        next_vals = self.state.next_validators
+        try:
+            second_parts = second.make_part_set()
+            second_id = BlockID(hash=second.hash(), part_set_header=second_parts.header)
+            complete = verify_commit_light_async(
+                self.state.chain_id,
+                next_vals,
+                second_id,
+                second.header.height,
+                third.last_commit,
+            )
+        except Exception as e:
+            def complete(e=e):
+                raise e
+        self._verify_ahead = (
+            second.header.height, second, third, next_vals.hash(), complete,
+        )

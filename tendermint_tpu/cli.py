@@ -422,6 +422,63 @@ def cmd_compact(args) -> int:
     return 0
 
 
+def cmd_wal2json(args) -> int:
+    """`wal2json <file>` — decode a consensus WAL file's CRC-framed
+    records to JSON lines on stdout (ref: scripts/wal2json/main.go).
+    Stops at the first corrupt record, reporting the clean byte offset
+    so an operator can truncate there."""
+    import sys
+
+    from .consensus.wal import iter_wal_records
+
+    with open(args.file, "rb") as f:
+        data = f.read()
+    consumed = 0
+    for pos, payload in iter_wal_records(data):
+        sys.stdout.write(payload.decode() + "\n")
+        consumed = pos + 8 + len(payload)
+    if consumed < len(data):
+        print(f"# corrupt or torn record at byte {consumed} "
+              f"({len(data) - consumed} trailing bytes not decoded)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_json2wal(args) -> int:
+    """`json2wal <in.json> <out.wal>` — re-frame JSON lines (as produced
+    by wal2json, possibly hand-edited) into a CRC-framed WAL file
+    (ref: scripts/json2wal/main.go). Each line is validated against the
+    WAL message schema and size limit before framing so a bad edit
+    fails loudly here — with its line number — not at node replay."""
+    import json as _json
+    import struct
+    import zlib
+
+    from .consensus.wal import MAX_WAL_MSG_SIZE, _decode_msg
+
+    written = 0
+    with open(args.input) as inp, open(args.output, "wb") as out:
+        for ln, line in enumerate(inp, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                doc = _json.loads(line)
+                _decode_msg(doc)  # schema check
+            except Exception as e:
+                print(f"{args.input}:{ln}: invalid WAL record: {e}")
+                return 1
+            payload = _json.dumps(doc, separators=(",", ":")).encode()
+            if len(payload) > MAX_WAL_MSG_SIZE:
+                print(f"{args.input}:{ln}: record too big "
+                      f"({len(payload)} > {MAX_WAL_MSG_SIZE} bytes)")
+                return 1
+            out.write(struct.pack("<II", zlib.crc32(payload), len(payload)) + payload)
+            written += 8 + len(payload)
+    print(f"wrote {written} bytes to {args.output}")
+    return 0
+
+
 def cmd_key_migrate(args) -> int:
     """`key-migrate` — upgrade legacy ASCII-decimal store keys to the
     current fixed-width binary layout (ref: cmd/tendermint/main.go:28-48
@@ -553,6 +610,15 @@ def build_parser() -> argparse.ArgumentParser:
         "key-migrate",
         help="upgrade legacy DB key layouts to the current format",
     ).set_defaults(fn=cmd_key_migrate)
+
+    sp = sub.add_parser("wal2json", help="decode a consensus WAL file to JSON lines")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_wal2json)
+
+    sp = sub.add_parser("json2wal", help="re-frame JSON lines into a consensus WAL file")
+    sp.add_argument("input")
+    sp.add_argument("output")
+    sp.set_defaults(fn=cmd_json2wal)
 
     sp = sub.add_parser(
         "remote-signer",

@@ -130,6 +130,24 @@ def _decode_msg(doc: dict):
     raise ValueError(f"unknown WAL message type {t}")
 
 
+def iter_wal_records(data: bytes):
+    """Yield (offset, payload) for each clean CRC-framed record in
+    `data`, stopping at the first torn/corrupt frame. The single source
+    of truth for the WAL framing — used by replay (_read_all) and the
+    wal2json operator tool."""
+    pos = 0
+    while pos + 8 <= len(data):
+        crc, length = struct.unpack_from("<II", data, pos)
+        end = pos + 8 + length
+        if end > len(data) or length > MAX_WAL_MSG_SIZE:
+            return
+        payload = data[pos + 8 : end]
+        if zlib.crc32(payload) != crc:
+            return
+        yield pos, payload
+        pos = end
+
+
 class WAL:
     """ref: BaseWAL (wal.go:61) over an autofile.Group-style rotating
     file set: the head file rotates at `max_file_size`, rotated files
@@ -251,24 +269,17 @@ class WAL:
         for path in paths:
             with open(path, "rb") as f:
                 data = f.read()
-            pos = 0
+            consumed = 0
             clean = True
-            while pos + 8 <= len(data):
-                crc, length = struct.unpack_from("<II", data, pos)
-                end = pos + 8 + length
-                if end > len(data) or length > MAX_WAL_MSG_SIZE:
-                    clean = False
-                    break
-                payload = data[pos + 8 : end]
-                if zlib.crc32(payload) != crc:
-                    clean = False
-                    break
+            for pos, payload in iter_wal_records(data):
                 try:
                     out.append(_decode_msg(json.loads(payload)))
                 except Exception:
                     clean = False
                     break
-                pos = end
+                consumed = pos + 8 + len(payload)
+            if clean and consumed < len(data):
+                clean = False  # torn/corrupt frame stopped the iterator
             if not clean:
                 break  # truncate replay at the corruption point
         return out

@@ -70,3 +70,12 @@ class BatchVerifier(ABC):
     @abstractmethod
     def verify(self) -> tuple[bool, list[bool]]:
         """Returns (all_valid, per-job validity bitmap)."""
+
+    def verify_async(self):
+        """Dispatch verification without blocking; returns a no-arg
+        callable producing (all_valid, bitmap). Device-backed verifiers
+        override this to overlap their kernel with host work (the
+        blocksync verify-ahead pipeline); the default completes eagerly
+        — host verification has no latency to hide."""
+        result = self.verify()
+        return lambda: result

@@ -186,14 +186,26 @@ class Ed25519BatchVerifier(BatchVerifier):
         self._sigs.append(bytes(sig))
 
     def verify(self) -> tuple[bool, list[bool]]:
+        return self.verify_async()()
+
+    def verify_async(self):
+        """Device path: launch prep + H2D + kernel now, return a
+        completion callable — callers overlap the kernel with host work
+        (e.g. blocksync applies block h while h+1's commit verifies).
+        Host path: completes eagerly (nothing to overlap)."""
         n = len(self._sigs)
         if n == 0:
-            return False, []
+            return lambda: (False, [])
         if _use_device() and n >= DEVICE_BATCH_CUTOVER:
             from ..ops import verify as dev
 
-            bitmap = dev.verify_batch(self._pks, self._msgs, self._sigs)
-            bools = [bool(b) for b in bitmap]
-        else:
-            bools = [_single_verify(p, m, s) for p, m, s in zip(self._pks, self._msgs, self._sigs)]
-        return all(bools), bools
+            dispatched = dev.verify_batch_async(self._pks, self._msgs, self._sigs)
+
+            def complete():
+                bools = [bool(b) for b in dev.collect(dispatched)]
+                return all(bools), bools
+
+            return complete
+        bools = [_single_verify(p, m, s) for p, m, s in zip(self._pks, self._msgs, self._sigs)]
+        result = (all(bools), bools)
+        return lambda: result

@@ -86,7 +86,7 @@ static pthread_once_t ossl_once = PTHREAD_ONCE_INIT;
 static void ossl_resolve(void) {
     const char *names[] = {"libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so", 0};
     for (int i = 0; names[i]; i++) {
-        void *h = dlopen(names[i], RTLD_NOW | RTLD_GLOBAL);
+        void *h = dlopen(names[i], RTLD_NOW | RTLD_LOCAL);
         if (h) {
             ossl_sha512 = (ossl_sha512_fn)dlsym(h, "SHA512");
             if (ossl_sha512) return;

@@ -62,15 +62,33 @@ def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID, height: 
 
 def verify_commit_light(chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit) -> None:
     """Verify +2/3 signed, early-exit once reached (ref: VerifyCommitLight,
-    types/validation.go:61)."""
+    types/validation.go:61). One body with the async variant — the
+    blocksync verify-ahead guards rely on the two being semantically
+    identical."""
+    verify_commit_light_async(chain_id, vals, block_id, height, commit)()
+
+
+def verify_commit_light_async(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+):
+    """verify_commit_light split at the device boundary: all host-side
+    checks (structure, tally, power threshold) run NOW and raise
+    immediately; the signature kernel is dispatched and the returned
+    no-arg callable raises (or not) with verify_commit_light's exact
+    error surface when invoked. Lets blocksync verify height h+1 on the
+    chip while height h applies host-side (the verify-ahead pipeline —
+    a capability the reference's serial verify loop lacks)."""
     _verify_basic_vals_and_commit(vals, commit, height, block_id)
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = lambda c: c.block_id_flag != 2
     count = lambda c: True
     if _should_batch_verify(vals, commit):
-        _verify_commit_batch(chain_id, vals, commit, voting_power_needed, ignore, count, False, True)
-    else:
-        _verify_commit_single(chain_id, vals, commit, voting_power_needed, ignore, count, False, True)
+        return _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, True,
+            defer=True,
+        )
+    _verify_commit_single(chain_id, vals, commit, voting_power_needed, ignore, count, False, True)
+    return lambda: None
 
 
 def verify_commit_light_trusting(chain_id: str, vals: ValidatorSet, commit: Commit, trust_level: Fraction) -> None:
@@ -104,8 +122,13 @@ def _verify_commit_batch(
     count_sig: Callable[[CommitSig], bool],
     count_all_signatures: bool,
     look_up_by_index: bool,
-) -> None:
-    """ref: verifyCommitBatch (types/validation.go:154)."""
+    defer: bool = False,
+):
+    """ref: verifyCommitBatch (types/validation.go:154).
+
+    With defer=True the kernel is dispatched asynchronously and a no-arg
+    completion callable is returned (raising with the same errors the
+    synchronous path would); host-side failures still raise immediately."""
     proposer = vals.get_proposer()
     bv = crypto_batch.create_batch_verifier(proposer.pub_key)
     tallied = 0
@@ -135,15 +158,22 @@ def _verify_commit_batch(
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
 
-    ok, valid_sigs = bv.verify()
-    if ok:
-        return
-    for i, sig_ok in enumerate(valid_sigs):
-        if not sig_ok:
-            idx = batch_sig_idxs[i]
-            sig = commit.signatures[idx].signature
-            raise ValueError(f"wrong signature (#{idx}): {sig.hex().upper()}")
-    raise RuntimeError("BUG: batch verification failed with no invalid signatures")
+    pending = bv.verify_async()
+
+    def complete() -> None:
+        ok, valid_sigs = pending()
+        if ok:
+            return
+        for i, sig_ok in enumerate(valid_sigs):
+            if not sig_ok:
+                idx = batch_sig_idxs[i]
+                sig = commit.signatures[idx].signature
+                raise ValueError(f"wrong signature (#{idx}): {sig.hex().upper()}")
+        raise RuntimeError("BUG: batch verification failed with no invalid signatures")
+
+    if defer:
+        return complete
+    complete()
 
 
 def _verify_commit_single(

@@ -218,3 +218,107 @@ def test_blocksync_rejects_tampered_block():
     assert reactor._try_sync_one() is False
     assert errors and errors[0].node_id == peer
     assert peer not in reactor.pool.peers
+
+
+def _stub_reactor(fresh, errors):
+    class _Chan:
+        def send_to(self, *a, **k):
+            return True
+
+        def send_error(self, e):
+            errors.append(e)
+
+        def broadcast(self, *a, **k):
+            return True
+
+        def receive_one(self, timeout=None):
+            time.sleep(timeout or 0)
+            return None
+
+    class _PM:
+        def subscribe(self, cb):
+            pass
+
+        def unsubscribe(self, cb):
+            pass
+
+    return BlockSyncReactor(
+        fresh.block_exec.store.load(), fresh.block_exec, fresh.block_store, _Chan(), _PM()
+    )
+
+
+def test_blocksync_verify_ahead_pipeline():
+    """With >=3 blocks pooled, iteration h dispatches h+1's verification
+    ahead (device kernel overlapping the host-side apply) and iteration
+    h+1 consumes it via the identity/valset guards — same sync result,
+    one verification per height either way."""
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    source = make_node(keys, 0, gen_doc)
+    source.start()
+    try:
+        assert wait_for_height([source], 5, timeout=60)
+    finally:
+        source.stop()
+
+    fresh = make_node(keys, 0, gen_doc)
+    errors = []
+    reactor = _stub_reactor(fresh, errors)
+    peer = "aa" * 20
+    src_height = source.block_store.height()
+    reactor.pool.set_peer_range(peer, 1, src_height)
+    reactor.pool._fill_requests()
+    for h in range(1, src_height + 1):
+        reactor.pool.add_block(peer, source.block_store.load_block(h))
+
+    consumed = []
+    orig_try = reactor._try_sync_one
+
+    # track cache consumption: _verify_ahead is set after each iteration
+    # that saw a third block, and consumed (reset to None) by the next
+    for _ in range(src_height - 1):
+        had_ahead = reactor._verify_ahead is not None
+        assert orig_try() is True
+        consumed.append(had_ahead)
+    assert not errors
+    # every iteration after the first (while a third block existed) hit the cache
+    assert consumed[0] is False and any(consumed[1:]), consumed
+    assert reactor.state.last_block_height == src_height - 1
+    for h in range(1, src_height):
+        assert fresh.block_store.load_block(h).hash() == source.block_store.load_block(h).hash()
+
+
+def test_blocksync_verify_ahead_detects_tampering():
+    """A tampered block whose bad commit was dispatched through the
+    verify-ahead path still fails verification and bans the senders."""
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    source = make_node(keys, 0, gen_doc)
+    source.start()
+    try:
+        assert wait_for_height([source], 4, timeout=60)
+    finally:
+        source.stop()
+
+    fresh = make_node(keys, 0, gen_doc)
+    errors = []
+    reactor = _stub_reactor(fresh, errors)
+    peer = "bb" * 20
+    reactor.pool.set_peer_range(peer, 1, 4)
+    reactor.pool._fill_requests()
+    b1 = source.block_store.load_block(1)
+    b2 = source.block_store.load_block(2)
+    b3 = source.block_store.load_block(3)
+    # tamper block 2: the ahead-dispatch for height 2 (fired while height
+    # 1 processes, proven by b3.last_commit) must reject it
+    b2.txs = [b"evil"]
+    b2.header.data_hash = b"\x88" * 32
+    reactor.pool.add_block(peer, b1)
+    reactor.pool.add_block(peer, b2)
+    reactor.pool.add_block(peer, b3)
+    assert reactor._try_sync_one() is True  # height 1 OK; dispatches ahead for 2
+    assert reactor._verify_ahead is not None
+    assert reactor._try_sync_one() is False  # ahead completion raises
+    assert errors and errors[0].node_id == peer
