@@ -257,8 +257,6 @@ class BlockSyncReactor:
             return False
         first_parts = None
         try:
-            first_parts = first.make_part_set()
-            first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
             # ★ the north-star call (reactor.go:582): batched verify of
             # second.LastCommit against OUR current validator set — via
             # the verify-ahead pipeline when the previous iteration
@@ -271,8 +269,11 @@ class BlockSyncReactor:
                 and ahead[2] is second
                 and ahead[3] == self.state.validators.hash()
             ):
-                ahead[4]()  # completes the dispatched kernel; raises as sync would
+                first_parts, first_id = ahead[4], ahead[5]  # reuse dispatch-time work
+                ahead[6]()  # completes the dispatched kernel; raises as sync would
             else:
+                first_parts = first.make_part_set()
+                first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
                 verify_commit_light(
                     self.state.chain_id,
                     self.state.validators,
@@ -314,6 +315,7 @@ class BlockSyncReactor:
         if third is None:
             return
         next_vals = self.state.next_validators
+        second_parts = second_id = None
         try:
             second_parts = second.make_part_set()
             second_id = BlockID(hash=second.hash(), part_set_header=second_parts.header)
@@ -327,6 +329,9 @@ class BlockSyncReactor:
         except Exception as e:
             def complete(e=e):
                 raise e
+        # parts/id carried along so the consuming iteration reuses the
+        # serialization + merkle work instead of redoing it
         self._verify_ahead = (
-            second.header.height, second, third, next_vals.hash(), complete,
+            second.header.height, second, third, next_vals.hash(),
+            second_parts, second_id, complete,
         )

@@ -451,10 +451,9 @@ def cmd_json2wal(args) -> int:
     WAL message schema and size limit before framing so a bad edit
     fails loudly here — with its line number — not at node replay."""
     import json as _json
-    import struct
-    import zlib
+    import sys
 
-    from .consensus.wal import MAX_WAL_MSG_SIZE, _decode_msg
+    from .consensus.wal import _decode_msg, frame_record
 
     written = 0
     with open(args.input) as inp, open(args.output, "wb") as out:
@@ -465,16 +464,12 @@ def cmd_json2wal(args) -> int:
             try:
                 doc = _json.loads(line)
                 _decode_msg(doc)  # schema check
+                rec = frame_record(_json.dumps(doc, separators=(",", ":")).encode())
             except Exception as e:
-                print(f"{args.input}:{ln}: invalid WAL record: {e}")
+                print(f"{args.input}:{ln}: invalid WAL record: {e}", file=sys.stderr)
                 return 1
-            payload = _json.dumps(doc, separators=(",", ":")).encode()
-            if len(payload) > MAX_WAL_MSG_SIZE:
-                print(f"{args.input}:{ln}: record too big "
-                      f"({len(payload)} > {MAX_WAL_MSG_SIZE} bytes)")
-                return 1
-            out.write(struct.pack("<II", zlib.crc32(payload), len(payload)) + payload)
-            written += 8 + len(payload)
+            out.write(rec)
+            written += len(rec)
     print(f"wrote {written} bytes to {args.output}")
     return 0
 

@@ -130,6 +130,15 @@ def _decode_msg(doc: dict):
     raise ValueError(f"unknown WAL message type {t}")
 
 
+def frame_record(payload: bytes) -> bytes:
+    """CRC-frame one payload (u32 crc32 | u32 len | payload), enforcing
+    the size limit. Paired with iter_wal_records as the single source of
+    truth for the framing — used by WAL._append and the json2wal tool."""
+    if len(payload) > MAX_WAL_MSG_SIZE:
+        raise ValueError(f"msg is too big: {len(payload)} bytes, max: {MAX_WAL_MSG_SIZE} bytes")
+    return struct.pack("<II", zlib.crc32(payload), len(payload)) + payload
+
+
 def iter_wal_records(data: bytes):
     """Yield (offset, payload) for each clean CRC-framed record in
     `data`, stopping at the first torn/corrupt frame. The single source
@@ -226,10 +235,7 @@ class WAL:
         self._fsync_dir()
 
     def _append(self, msg, fsync: bool) -> None:
-        payload = json.dumps(_encode_msg(msg), separators=(",", ":")).encode()
-        if len(payload) > MAX_WAL_MSG_SIZE:
-            raise ValueError(f"msg is too big: {len(payload)} bytes, max: {MAX_WAL_MSG_SIZE} bytes")
-        rec = struct.pack("<II", zlib.crc32(payload), len(payload)) + payload
+        rec = frame_record(json.dumps(_encode_msg(msg), separators=(",", ":")).encode())
         with self._lock:
             self._maybe_rotate_locked()
             self._f.write(rec)

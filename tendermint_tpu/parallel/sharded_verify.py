@@ -39,7 +39,7 @@ _FN_CACHE: dict[Mesh, object] = {}
 
 
 def sharded_verify_fn(mesh: Mesh):
-    """Returns a jitted fn: (B,32)x4 int32 -> ((B,) bool bitmap sharded
+    """Returns a jitted fn: (B,32)x4 uint8 -> ((B,) bool bitmap sharded
     over the mesh, scalar all-valid replicated). B must divide evenly by
     the mesh size (pad on host). Memoized per mesh so jit's trace cache
     is effective across calls."""

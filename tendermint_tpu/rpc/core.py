@@ -534,6 +534,11 @@ def build_routes(env: RPCEnvironment) -> dict:
             "hash": _hex(tx_hash(raw)),
         }
 
+    def remove_tx(txKey=None):
+        """ref: mempool.go:190 RemoveTx -> Mempool.RemoveTxByKey."""
+        env.mempool.remove_tx_by_key(_as_bytes_hex(txKey, "txKey"))
+        return {}
+
     MAX_TX_COMMIT_TIMEOUT = 60.0
 
     def broadcast_tx_commit(tx=None, timeout=30.0):
@@ -729,6 +734,10 @@ def build_routes(env: RPCEnvironment) -> dict:
         "dump_consensus_state": dump_consensus_state,
         "broadcast_tx_async": broadcast_tx_async,
         "broadcast_tx_sync": broadcast_tx_sync,
+        # ref: routes.go:62 — broadcast_tx is the modern alias of the
+        # sync variant
+        "broadcast_tx": broadcast_tx_sync,
+        "remove_tx": remove_tx,
         "broadcast_tx_commit": broadcast_tx_commit,
         "check_tx": check_tx,
         "unconfirmed_txs": unconfirmed_txs,

@@ -129,6 +129,20 @@ def test_broadcast_tx_sync_and_mempool_endpoints(live_node):
     assert int(n["total_bytes"]) >= 0
 
 
+def test_broadcast_tx_alias_and_remove_tx(live_node):
+    """broadcast_tx aliases the sync variant (routes.go:62); remove_tx
+    evicts by tx key (mempool.go:190)."""
+    from tendermint_tpu.types.block import tx_hash
+
+    node, client, _ = live_node
+    raw = b"removeme=1"
+    res = client.call("broadcast_tx", tx=raw.hex())
+    assert res["code"] == 0 and res["hash"]
+    assert client.call("remove_tx", txKey=tx_hash(raw).hex()) == {}
+    with pytest.raises(RPCClientError):
+        client.call("remove_tx", txKey=tx_hash(raw).hex())  # already gone
+
+
 def test_error_paths(live_node):
     node, client, _ = live_node
     with pytest.raises(RPCClientError):
