@@ -149,6 +149,9 @@ class Config:
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+    # keys present in the TOML but not recognized (stale/misspelled) —
+    # populated by from_toml, warned about by load_config
+    unknown_keys: list = field(default_factory=list)
 
     # -------------------------------------------------------------- paths
 
@@ -228,27 +231,49 @@ class Config:
 
     @classmethod
     def from_toml(cls, text: str, home: str = "") -> "Config":
+        """Parse, collecting unrecognized keys into `cfg.unknown_keys` —
+        the reference warns on deprecated/unknown config (config.go's
+        deprecated-key detection at :1001-1090, and the confix migration
+        tool); load_config logs them so a stale config.toml (e.g. a
+        consensus timeout that moved on-chain) is visible, not silently
+        ignored."""
         if tomllib is None:
             raise RuntimeError("tomllib unavailable")
         doc = tomllib.loads(text)
         cfg = cls()
         cfg.base.home = home
+        unknown: list[str] = []
 
-        def apply(section_obj, d: dict):
+        def apply(section_obj, d: dict, prefix: str):
             for k, val in d.items():
+                if isinstance(val, dict):
+                    # no known section nests tables: a sub-table or an
+                    # inline-table value is always unrecognized config
+                    unknown.append(f"{prefix}{k}.*")
+                    continue
                 attr = k.replace("-", "_")
-                if hasattr(section_obj, attr) and not isinstance(val, dict):
+                if hasattr(section_obj, attr):
                     setattr(section_obj, attr, val)
+                else:
+                    unknown.append(f"{prefix}{k}")
 
-        apply(cfg.base, {k: v for k, v in doc.items() if not isinstance(v, dict)})
-        apply(cfg.rpc, doc.get("rpc", {}))
-        apply(cfg.p2p, doc.get("p2p", {}))
-        apply(cfg.mempool, doc.get("mempool", {}))
-        apply(cfg.statesync, doc.get("statesync", {}))
-        apply(cfg.blocksync, doc.get("blocksync", {}))
-        apply(cfg.consensus, doc.get("consensus", {}))
-        apply(cfg.tx_index, doc.get("tx-index", {}))
-        apply(cfg.instrumentation, doc.get("instrumentation", {}))
+        sections = {
+            "rpc": cfg.rpc,
+            "p2p": cfg.p2p,
+            "mempool": cfg.mempool,
+            "statesync": cfg.statesync,
+            "blocksync": cfg.blocksync,
+            "consensus": cfg.consensus,
+            "tx-index": cfg.tx_index,
+            "instrumentation": cfg.instrumentation,
+        }
+        apply(cfg.base, {k: v for k, v in doc.items() if not isinstance(v, dict)}, "")
+        for name, obj in sections.items():
+            apply(obj, doc.get(name, {}), name + ".")
+        for name in doc:
+            if isinstance(doc[name], dict) and name not in sections:
+                unknown.append(f"[{name}]")
+        cfg.unknown_keys = unknown
         return cfg
 
 
@@ -259,10 +284,16 @@ def default_config(home: str) -> Config:
 
 
 def load_config(home: str) -> Config:
-    """Load <home>/config/config.toml, defaulting when absent."""
+    """Load <home>/config/config.toml, defaulting when absent. Warns on
+    stderr about unrecognized keys (stale or misspelled config)."""
     path = os.path.join(home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE)
     if not os.path.exists(path):
         return default_config(home)
     with open(path) as f:
         cfg = Config.from_toml(f.read(), home=home)
+    if cfg.unknown_keys:
+        import sys
+
+        print(f"WARNING: unrecognized config keys in {path}: "
+              f"{', '.join(cfg.unknown_keys)}", file=sys.stderr)
     return cfg

@@ -1,7 +1,9 @@
 """Standalone test app process for e2e testnets: the kvstore served over
-socket ABCI (ref: test/e2e/node/main.go + test/e2e/app/).
+socket or gRPC ABCI (ref: test/e2e/node/main.go + test/e2e/app/;
+manifest abci_protocol in {builtin, tcp, unix, grpc}).
 
 Usage: python -m tendermint_tpu.e2e.app tcp://127.0.0.1:PORT
+       python -m tendermint_tpu.e2e.app grpc://127.0.0.1:PORT
 """
 
 from __future__ import annotations
@@ -15,7 +17,12 @@ from ..abci.socket import SocketServer
 
 def main() -> int:
     addr = sys.argv[1] if len(sys.argv) > 1 else "tcp://127.0.0.1:26658"
-    server = SocketServer(KVStoreApplication(), addr)
+    if addr.startswith("grpc://"):
+        from ..abci.grpc import GRPCServer
+
+        server = GRPCServer(KVStoreApplication(), addr)
+    else:
+        server = SocketServer(KVStoreApplication(), addr)
     server.start()
     print(f"e2e kvstore app listening on {addr}", flush=True)
     try:

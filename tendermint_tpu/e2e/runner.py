@@ -136,12 +136,11 @@ class Runner:
                 f"{o.node_id}@127.0.0.1:{o.p2p_port}" for o in self.nodes if o is not node
             ]
             cfg.p2p.persistent_peers = ",".join(peers)
-            if node.m.abci_protocol in ("tcp", "unix"):
-                addr = (
-                    f"tcp://127.0.0.1:{node.abci_port}"
-                    if node.m.abci_protocol == "tcp"
-                    else f"unix://{node.home}/app.sock"
-                )
+            if node.m.abci_protocol in ("tcp", "unix", "grpc"):
+                if node.m.abci_protocol == "unix":
+                    addr = f"unix://{node.home}/app.sock"
+                else:
+                    addr = f"{node.m.abci_protocol}://127.0.0.1:{node.abci_port}"
                 cfg.base.proxy_app = addr
             cfg.save()
 
@@ -156,7 +155,7 @@ class Runner:
         return env
 
     def _start_node(self, node: E2ENode) -> None:
-        if node.m.abci_protocol in ("tcp", "unix"):
+        if node.m.abci_protocol in ("tcp", "unix", "grpc"):
             cfg = load_config(node.home)
             node.app_proc = subprocess.Popen(
                 [sys.executable, "-m", "tendermint_tpu.e2e.app", cfg.base.proxy_app],
@@ -169,7 +168,7 @@ class Runner:
             deadline = time.monotonic() + 60
             while time.monotonic() < deadline:
                 try:
-                    if node.m.abci_protocol == "tcp":
+                    if node.m.abci_protocol in ("tcp", "grpc"):
                         socket.create_connection(("127.0.0.1", node.abci_port), timeout=1).close()
                     else:
                         s = socket.socket(socket.AF_UNIX)

@@ -24,6 +24,7 @@ perturb = ["kill"]
 perturb = ["pause"]
 
 [node.validator03]
+abci_protocol = "grpc"
 
 [node.validator04]
 abci_protocol = "tcp"
@@ -35,14 +36,15 @@ def test_manifest_parse():
     assert m.chain_id == "e2e-test"
     assert len(m.nodes) == 4 and len(m.validators) == 4
     assert m.nodes[0].perturb == ["kill"]
+    assert m.nodes[2].abci_protocol == "grpc"
     assert m.nodes[3].abci_protocol == "tcp"
 
 
 @pytest.mark.slow
 def test_e2e_perturbed_testnet(tmp_path):
     """Full cycle: 4 validator processes (one behind an out-of-process
-    socket app), tx load, kill + pause perturbations, consistency +
-    cadence checks."""
+    socket app, one behind a gRPC app), tx load, kill + pause
+    perturbations, consistency + cadence checks."""
     m = Manifest.parse(MANIFEST)
     runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
     runner.setup()

@@ -164,3 +164,37 @@ def test_full_node_joins_and_syncs(testnet, tmp_path):
         assert blk["block_id"]["hash"] == ref.block_id.hash.hex().upper()
     finally:
         full.stop()
+
+
+def test_config_unknown_keys_detected(tmp_path):
+    """Stale or misspelled config keys are surfaced, not silently
+    dropped (ref: config.go:1001-1090 deprecated-key detection)."""
+    from tendermint_tpu.config import Config
+
+    cfg = Config.from_toml("""
+moniker = "x"
+timeout_commit = "1s"
+
+[consensus]
+wal-file = "data/cs.wal"
+timeout_propose = "3s"
+
+[p2pp]
+laddr = "tcp://0.0.0.0:26656"
+""")
+    assert "timeout_commit" in cfg.unknown_keys
+    assert "consensus.timeout_propose" in cfg.unknown_keys
+    assert "[p2pp]" in cfg.unknown_keys
+    # nested tables inside known sections are flagged too
+    nested = Config.from_toml("""
+[consensus.timeout]
+propose = "3s"
+
+[rpc]
+laddr = { host = "x" }
+""")
+    assert "consensus.timeout.*" in nested.unknown_keys
+    assert "rpc.laddr.*" in nested.unknown_keys
+    assert cfg.base.moniker == "x"
+    # clean config has none
+    assert Config.from_toml(Config().to_toml()).unknown_keys == []
