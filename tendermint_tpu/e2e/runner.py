@@ -233,6 +233,85 @@ class Runner:
             time.sleep(interval)
         return sent
 
+    def inject_evidence(self, timeout: float = 60.0) -> str:
+        """Craft real duplicate-vote evidence — two conflicting
+        precommits at a committed height signed with a testnet
+        validator's own key — submit it via broadcast_evidence, and wait
+        for it to be committed into a block (ref:
+        test/e2e/runner/evidence.go InjectEvidence). Returns the
+        evidence hash hex."""
+        from ..proto.messages import SIGNED_MSG_TYPE_PRECOMMIT
+        from ..types.block import BlockID, PartSetHeader
+        from ..types.evidence import DuplicateVoteEvidence
+        from ..types.validator_set import Validator, ValidatorSet
+        from ..types.vote import Vote
+
+        offender = self.nodes[0]
+        cfg = load_config(offender.home)
+        pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+        priv = pv.priv_key
+        addr = priv.pub_key().address()
+        gen_doc = GenesisDoc.from_file(cfg.genesis_file)
+        # canonical (sorted) construction — must match make_genesis_state
+        # so validator_index lines up with the chain's real set
+        val_set = ValidatorSet.new(
+            [Validator(address=v.address, pub_key=v.pub_key, voting_power=v.power)
+             for v in gen_doc.validators]
+        )
+        val_idx, _ = val_set.get_by_address(addr)
+
+        live = next(n for n in self.nodes if n is not offender)
+        client = live.client()
+        status = client.call("status")
+        h = int(status["sync_info"]["latest_block_height"]) - 1
+        if h < self.manifest.initial_height:
+            raise RuntimeError("chain too short to inject evidence")
+        blk = client.call("block", height=h)
+        block_time = Time.parse_rfc3339(blk["block"]["header"]["time"])
+
+        def vote(tag: bytes) -> Vote:
+            v = Vote(
+                type=SIGNED_MSG_TYPE_PRECOMMIT,
+                height=h,
+                round=0,
+                block_id=BlockID(hash=tag * 32,
+                                 part_set_header=PartSetHeader(total=1, hash=tag * 32)),
+                timestamp=block_time,
+                validator_address=addr,
+                validator_index=val_idx,
+            )
+            v.signature = priv.sign(v.sign_bytes(gen_doc.chain_id))
+            return v
+
+        ev = DuplicateVoteEvidence.new(vote(b"\xaa"), vote(b"\xbb"), block_time, val_set)
+        from ..types.evidence import evidence_to_proto
+
+        res = client.call("broadcast_evidence",
+                          evidence=evidence_to_proto(ev).encode().hex())
+        # block JSON carries the BARE evidence proto (block_to_json),
+        # not the Evidence oneof wrapper the RPC ingests
+        ev_hex = ev.to_proto().encode().hex()
+        ev_hash = res["hash"]
+        self.log(f"injected duplicate-vote evidence {ev_hash} at height {h}")
+
+        # wait until a block commits THIS evidence (tx load and
+        # perturbations run concurrently: transient RPC failures retry
+        # within the deadline)
+        deadline = time.monotonic() + timeout
+        scanned = h
+        while time.monotonic() < deadline:
+            try:
+                head = live.height()
+                for look in range(scanned + 1, head + 1):
+                    b = client.call("block", height=look)
+                    if ev_hex in b["block"]["evidence"]["evidence"]:
+                        return ev_hash
+                    scanned = look
+            except Exception:
+                pass
+            time.sleep(0.25)
+        raise TimeoutError("evidence was never committed to a block")
+
     # ---------------------------------------------------------------- perturb
 
     def perturb(self, node: E2ENode, kind: str) -> None:

@@ -43,8 +43,9 @@ def test_manifest_parse():
 @pytest.mark.slow
 def test_e2e_perturbed_testnet(tmp_path):
     """Full cycle: 4 validator processes (one behind an out-of-process
-    socket app, one behind a gRPC app), tx load, kill + pause
-    perturbations, consistency + cadence checks."""
+    socket app, one behind a gRPC app), tx load, duplicate-vote evidence
+    injected and committed, kill + pause perturbations, consistency +
+    cadence checks."""
     m = Manifest.parse(MANIFEST)
     runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
     runner.setup()
@@ -53,6 +54,8 @@ def test_e2e_perturbed_testnet(tmp_path):
         runner.wait_for_height(2, timeout=120)
         load = threading.Thread(target=runner.inject_load, args=(8.0,), daemon=True)
         load.start()
+        ev_hash = runner.inject_evidence(timeout=90)
+        assert ev_hash
         runner.run_perturbations()
         load.join(timeout=30)
         h = max(n.height() for n in runner.nodes)
