@@ -132,10 +132,25 @@ class Runner:
             cfg.p2p.laddr = f"tcp://127.0.0.1:{node.p2p_port}"
             cfg.rpc.laddr = f"tcp://127.0.0.1:{node.rpc_port}"
             cfg.p2p.send_rate = node.m.send_rate
-            peers = [
-                f"{o.node_id}@127.0.0.1:{o.p2p_port}" for o in self.nodes if o is not node
-            ]
-            cfg.p2p.persistent_peers = ",".join(peers)
+            seeds = [o for o in self.nodes if o.m.mode == "seed"]
+            if node.m.mode == "seed":
+                # a seed dials nobody: it learns addresses from inbound
+                # bootstrap dials and serves them over PEX (node/seed.go)
+                cfg.p2p.persistent_peers = ""
+            elif seeds:
+                # seed-bootstrapped topology: nodes know ONLY the seeds;
+                # PEX discovers the mesh (ref: manifest seeds + pex)
+                cfg.p2p.bootstrap_peers = ",".join(
+                    f"{o.node_id}@127.0.0.1:{o.p2p_port}" for o in seeds
+                )
+                cfg.p2p.persistent_peers = ""
+            else:
+                peers = [
+                    f"{o.node_id}@127.0.0.1:{o.p2p_port}"
+                    for o in self.nodes
+                    if o is not node
+                ]
+                cfg.p2p.persistent_peers = ",".join(peers)
             if node.m.abci_protocol in ("tcp", "unix", "grpc"):
                 if node.m.abci_protocol == "unix":
                     addr = f"unix://{node.home}/app.sock"
@@ -143,6 +158,11 @@ class Runner:
                     addr = f"{node.m.abci_protocol}://127.0.0.1:{node.abci_port}"
                 cfg.base.proxy_app = addr
             cfg.save()
+
+    def _rpc_nodes(self, nodes=None) -> list:
+        """Consensus-participating, RPC-serving nodes — seeds run the
+        pex-only SeedNode with no RPC listener."""
+        return [n for n in (nodes or self.nodes) if n.m.mode != "seed"]
 
     # ----------------------------------------------------------------- start
 
@@ -204,7 +224,7 @@ class Runner:
 
     def wait_ready(self, nodes=None, timeout: float = 120.0) -> None:
         deadline = time.monotonic() + timeout
-        pending = list(nodes or self.nodes)
+        pending = self._rpc_nodes(nodes)
         while pending and time.monotonic() < deadline:
             pending = [n for n in pending if n.height() < 0]
             time.sleep(0.2)
@@ -221,8 +241,9 @@ class Runner:
         sent = 0
         deadline = time.monotonic() + duration
         i = 0
+        targets = self._rpc_nodes()
         while time.monotonic() < deadline:
-            node = self.nodes[i % len(self.nodes)]
+            node = targets[i % len(targets)]
             i += 1
             try:
                 tx = f"load-{os.getpid()}-{i}={i}".encode()
@@ -246,7 +267,7 @@ class Runner:
         from ..types.validator_set import Validator, ValidatorSet
         from ..types.vote import Vote
 
-        offender = self.nodes[0]
+        offender = next(n for n in self.nodes if n.m.mode == "validator")
         cfg = load_config(offender.home)
         pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
         priv = pv.priv_key
@@ -260,7 +281,7 @@ class Runner:
         )
         val_idx, _ = val_set.get_by_address(addr)
 
-        live = next(n for n in self.nodes if n is not offender)
+        live = next(n for n in self._rpc_nodes() if n is not offender)
         client = live.client()
         status = client.call("status")
         h = int(status["sync_info"]["latest_block_height"]) - 1
@@ -353,7 +374,7 @@ class Runner:
 
     def wait_for_height(self, height: int, nodes=None, timeout: float = 120.0) -> None:
         deadline = time.monotonic() + timeout
-        nodes = nodes or self.nodes
+        nodes = self._rpc_nodes(nodes)
         while time.monotonic() < deadline:
             if all(n.height() >= height for n in nodes):
                 return
@@ -380,7 +401,7 @@ class Runner:
     def check_consistency(self) -> None:
         """All nodes agree on every committed block hash
         (ref: test/e2e/tests/block_test.go)."""
-        heights = [n.height() for n in self.nodes if n.height() >= 0]
+        heights = [n.height() for n in self._rpc_nodes() if n.height() >= 0]
         h = min(heights)
         assert h >= 1, f"no committed blocks: {heights}"
         for probe in range(max(1, h - 3), h + 1):
@@ -395,7 +416,7 @@ class Runner:
 
     def benchmark(self, blocks: int = 10) -> dict:
         """Block cadence stats (ref: runner/benchmark.go:16-60)."""
-        client = self.nodes[0].client()
+        client = self._rpc_nodes()[0].client()
         status = client.call("status")
         to = int(status["sync_info"]["latest_block_height"])
         frm = max(self.manifest.initial_height, to - blocks)

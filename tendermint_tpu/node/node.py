@@ -31,7 +31,7 @@ from ..light.provider import LocalProvider
 from ..mempool.mempool import TxMempool
 from ..mempool.reactor import MempoolReactor, mempool_channel_descriptor
 from ..p2p import NodeInfo, PeerManager, PeerManagerOptions, Router, RouterOptions, node_id_from_pubkey
-from ..p2p.transport import Endpoint
+from ..p2p.transport import Endpoint, parse_peer_list
 from ..p2p.transport_tcp import TcpTransport
 from ..privval import FilePV
 from ..rpc import JSONRPCServer, RPCEnvironment, build_routes
@@ -228,8 +228,7 @@ class Node:
             recv_rate=config.p2p.recv_rate,
         )
         persistent = []
-        for entry in filter(None, (s.strip() for s in config.p2p.persistent_peers.split(","))):
-            persistent.append(Endpoint.parse("mconn://" + entry if "://" not in entry else entry))
+        persistent.extend(parse_peer_list(config.p2p.persistent_peers))
         self.peer_manager = PeerManager(
             self.node_id,
             PeerManagerOptions(
@@ -240,6 +239,12 @@ class Node:
             db=_make_db(config, "peerstore"),
         )
         for ep in persistent:
+            self.peer_manager.add(ep)
+        # bootstrap peers (typically seed nodes): dialed for PEX
+        # discovery but NOT pinned as persistent — the peer manager may
+        # drop them once the mesh is known (ref: config.P2P
+        # BootstrapPeers, node/setup.go peer wiring)
+        for ep in parse_peer_list(config.p2p.bootstrap_peers):
             self.peer_manager.add(ep)
         ep = self.transport.endpoint()
         # Advertise external_address when configured — the bind address

@@ -12,7 +12,7 @@ from urllib.parse import urlparse
 from ..config import Config
 from ..p2p import NodeInfo, PeerManager, PeerManagerOptions, Router, RouterOptions
 from ..p2p.pex import PexReactor, pex_channel_descriptor
-from ..p2p.transport import Endpoint
+from ..p2p.transport import Endpoint, parse_peer_list
 from ..p2p.transport_tcp import TcpTransport
 from ..types.genesis import GenesisDoc
 from ..utils.log import Logger, parse_level
@@ -42,8 +42,7 @@ class SeedNode:
         self.transport = TcpTransport(descs, bind_host=laddr.hostname or "0.0.0.0", bind_port=laddr.port or 0)
 
         persistent = []
-        for entry in filter(None, (s.strip() for s in config.p2p.persistent_peers.split(","))):
-            persistent.append(Endpoint.parse("mconn://" + entry if "://" not in entry else entry))
+        persistent.extend(parse_peer_list(config.p2p.persistent_peers))
         self.peer_manager = PeerManager(
             self.node_id,
             PeerManagerOptions(

@@ -94,3 +94,12 @@ class Transport:
 
     def close(self) -> None:
         raise NotImplementedError
+
+
+def parse_peer_list(csv: str) -> "list[Endpoint]":
+    """Parse a comma-separated `id@host:port` peer list (config
+    persistent_peers / bootstrap_peers format) into Endpoints."""
+    out = []
+    for entry in filter(None, (s.strip() for s in csv.split(","))):
+        out.append(Endpoint.parse("mconn://" + entry if "://" not in entry else entry))
+    return out

@@ -70,3 +70,41 @@ def test_e2e_perturbed_testnet(tmp_path):
         assert int(res["response"]["last_block_height"]) >= 2
     finally:
         runner.cleanup()
+
+
+SEED_MANIFEST = """
+chain_id = "e2e-seed"
+load_tx_rate = 5
+
+[node.seed01]
+mode = "seed"
+
+[node.validator01]
+
+[node.validator02]
+
+[node.validator03]
+"""
+
+
+@pytest.mark.slow
+def test_e2e_seed_bootstrapped_testnet(tmp_path):
+    """Validators know ONLY the seed's address (bootstrap_peers); PEX
+    must discover the mesh across real processes and consensus must
+    advance (ref: node/seed.go + pex reactor, e2e manifest seeds)."""
+    m = Manifest.parse(SEED_MANIFEST)
+    assert m.nodes[0].mode == "seed"
+    runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
+    runner.setup()
+    # the topology really is seed-only: no validator lists peers
+    from tendermint_tpu.config import load_config as _lc
+    for node in runner.nodes[1:]:
+        cfg = _lc(node.home)
+        assert cfg.p2p.persistent_peers == ""
+        assert runner.nodes[0].node_id in cfg.p2p.bootstrap_peers
+    try:
+        runner.start(timeout=120)
+        runner.wait_for_height(3, timeout=120)
+        runner.check_consistency()
+    finally:
+        runner.cleanup()
