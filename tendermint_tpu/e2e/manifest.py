@@ -43,6 +43,10 @@ class Manifest:
     nodes: list[NodeManifest] = field(default_factory=list)
     load_tx_rate: int = 10  # txs/sec injected during the run
     initial_height: int = 1
+    # height -> {node name: power} validator-set changes applied via
+    # the kvstore's val: txs once the chain passes that height
+    # (ref: manifest.go ValidatorUpdates)
+    validator_updates: dict = field(default_factory=dict)
 
     @classmethod
     def parse(cls, text: str) -> "Manifest":
@@ -52,6 +56,8 @@ class Manifest:
             load_tx_rate=int(doc.get("load_tx_rate", 10)),
             initial_height=int(doc.get("initial_height", 1)),
         )
+        for h, updates in (doc.get("validator_update") or {}).items():
+            m.validator_updates[int(h)] = {k: int(v) for k, v in updates.items()}
         for name, nd in (doc.get("node") or {}).items():
             m.nodes.append(
                 NodeManifest(

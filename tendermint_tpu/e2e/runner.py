@@ -254,6 +254,50 @@ class Runner:
             time.sleep(interval)
         return sent
 
+    def apply_validator_updates(self, timeout: float = 90.0) -> None:
+        """Apply the manifest's validator_update schedule: at each
+        listed height, submit the kvstore's val-change tx for the named
+        node's pubkey and wait until the chain's validator set reports
+        the new power (ref: manifest.go ValidatorUpdates +
+        runner/main.go applying them via the app)."""
+        if not self.manifest.validator_updates:
+            return
+        from ..abci.kvstore import make_validator_tx
+
+        client = self._rpc_nodes()[0].client()
+        by_name = {n.m.name: n for n in self.nodes}
+        for h in sorted(self.manifest.validator_updates):
+            updates = self.manifest.validator_updates[h]
+            self.wait_for_height(h, timeout=timeout)
+            want = {}
+            for name, power in updates.items():
+                cfg = load_config(by_name[name].home)
+                pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+                pub = pv.get_pub_key()
+                tx = make_validator_tx(pub.bytes(), power)
+                res = client.call("broadcast_tx_sync", tx=tx.hex())
+                if int(res.get("code", 0)) != 0:
+                    raise RuntimeError(
+                        f"validator-update tx rejected: {res.get('log')!r}"
+                    )
+                want[pub.address().hex().upper()] = power
+                self.log(f"validator update @ {h}: {name} -> power {power}")
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    res = client.call("validators")
+                    got = {v["address"]: int(v["voting_power"]) for v in res["validators"]}
+                    if all(
+                        (got.get(a) == p if p > 0 else a not in got)
+                        for a, p in want.items()
+                    ):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.25)
+            else:
+                raise TimeoutError(f"validator updates at height {h} never took effect: {want}")
+
     def inject_evidence(self, timeout: float = 60.0) -> str:
         """Craft real duplicate-vote evidence — two conflicting
         precommits at a committed height signed with a testnet
@@ -368,7 +412,18 @@ class Runner:
         for node in self.nodes:
             for kind in node.m.perturb:
                 self.perturb(node, kind)
-                self.wait_progress(node, timeout=90)
+                if node.m.mode == "seed":
+                    # seeds serve no RPC: "recovered" = process alive
+                    deadline = time.monotonic() + 10
+                    while time.monotonic() < deadline and (
+                        node.proc is None or node.proc.poll() is not None
+                    ):
+                        time.sleep(0.2)
+                    assert node.proc is not None and node.proc.poll() is None, (
+                        f"{node.m.name} did not survive {kind}"
+                    )
+                else:
+                    self.wait_progress(node, timeout=90)
 
     # ------------------------------------------------------------------ wait
 
@@ -466,6 +521,7 @@ def run_manifest(manifest_path: str, base_dir: str, duration: float = 10.0) -> d
 
         load_thread = threading.Thread(target=runner.inject_load, args=(duration,), daemon=True)
         load_thread.start()
+        runner.apply_validator_updates()
         runner.run_perturbations()
         load_thread.join(timeout=duration + 10)
         h = max(n.height() for n in runner.nodes)

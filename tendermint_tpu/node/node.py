@@ -227,8 +227,7 @@ class Node:
             send_rate=config.p2p.send_rate,
             recv_rate=config.p2p.recv_rate,
         )
-        persistent = []
-        persistent.extend(parse_peer_list(config.p2p.persistent_peers))
+        persistent = parse_peer_list(config.p2p.persistent_peers)
         self.peer_manager = PeerManager(
             self.node_id,
             PeerManagerOptions(

@@ -41,8 +41,7 @@ class SeedNode:
         laddr = urlparse(config.p2p.laddr if "//" in config.p2p.laddr else "tcp://" + config.p2p.laddr)
         self.transport = TcpTransport(descs, bind_host=laddr.hostname or "0.0.0.0", bind_port=laddr.port or 0)
 
-        persistent = []
-        persistent.extend(parse_peer_list(config.p2p.persistent_peers))
+        persistent = parse_peer_list(config.p2p.persistent_peers)
         self.peer_manager = PeerManager(
             self.node_id,
             PeerManagerOptions(

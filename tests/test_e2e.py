@@ -28,6 +28,9 @@ abci_protocol = "grpc"
 
 [node.validator04]
 abci_protocol = "tcp"
+
+[validator_update.3]
+validator03 = 250
 """
 
 
@@ -38,14 +41,16 @@ def test_manifest_parse():
     assert m.nodes[0].perturb == ["kill"]
     assert m.nodes[2].abci_protocol == "grpc"
     assert m.nodes[3].abci_protocol == "tcp"
+    assert m.validator_updates == {3: {"validator03": 250}}
 
 
 @pytest.mark.slow
 def test_e2e_perturbed_testnet(tmp_path):
     """Full cycle: 4 validator processes (one behind an out-of-process
     socket app, one behind a gRPC app), tx load, duplicate-vote evidence
-    injected and committed, kill + pause perturbations, consistency +
-    cadence checks."""
+    injected and committed, a scheduled validator power update taking
+    effect on-chain, kill + pause perturbations, consistency + cadence
+    checks."""
     m = Manifest.parse(MANIFEST)
     runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
     runner.setup()
@@ -56,6 +61,7 @@ def test_e2e_perturbed_testnet(tmp_path):
         load.start()
         ev_hash = runner.inject_evidence(timeout=90)
         assert ev_hash
+        runner.apply_validator_updates(timeout=90)
         runner.run_perturbations()
         load.join(timeout=30)
         h = max(n.height() for n in runner.nodes)
