@@ -17,12 +17,14 @@ from ..abci.socket import SocketServer
 
 def main() -> int:
     addr = sys.argv[1] if len(sys.argv) > 1 else "tcp://127.0.0.1:26658"
+    snapshot_interval = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    app = KVStoreApplication(snapshot_interval=snapshot_interval)
     if addr.startswith("grpc://"):
         from ..abci.grpc import GRPCServer
 
-        server = GRPCServer(KVStoreApplication(), addr)
+        server = GRPCServer(app, addr)
     else:
-        server = SocketServer(KVStoreApplication(), addr)
+        server = SocketServer(app, addr)
     server.start()
     print(f"e2e kvstore app listening on {addr}", flush=True)
     try:

@@ -32,6 +32,7 @@ class NodeManifest:
     abci_protocol: str = "builtin"  # builtin | tcp | unix | grpc
     perturb: list[str] = field(default_factory=list)  # kill|pause|restart|disconnect
     start_at: int = 0  # join later, at this height
+    state_sync: bool = False  # late joiner restores an app snapshot first
     send_rate: int = 5_000_000  # p2p flow-control bytes/sec for tests
 
 
@@ -47,6 +48,9 @@ class Manifest:
     # the kvstore's val: txs once the chain passes that height
     # (ref: manifest.go ValidatorUpdates)
     validator_updates: dict = field(default_factory=dict)
+    # builtin kvstore app snapshot cadence, 0 = no snapshots
+    # (ref: manifest.go SnapshotInterval)
+    snapshot_interval: int = 0
 
     @classmethod
     def parse(cls, text: str) -> "Manifest":
@@ -55,6 +59,7 @@ class Manifest:
             chain_id=doc.get("chain_id", "e2e-chain"),
             load_tx_rate=int(doc.get("load_tx_rate", 10)),
             initial_height=int(doc.get("initial_height", 1)),
+            snapshot_interval=int(doc.get("snapshot_interval", 0)),
         )
         for h, updates in (doc.get("validator_update") or {}).items():
             m.validator_updates[int(h)] = {k: int(v) for k, v in updates.items()}
@@ -66,6 +71,7 @@ class Manifest:
                     abci_protocol=nd.get("abci_protocol", "builtin"),
                     perturb=list(nd.get("perturb", [])),
                     start_at=int(nd.get("start_at", 0)),
+                    state_sync=bool(nd.get("state_sync", False)),
                     send_rate=int(nd.get("send_rate", NodeManifest.send_rate)),
                 )
             )

@@ -80,6 +80,18 @@ class Runner:
     def setup(self) -> None:
         """Generate homes, keys, genesis, configs (ref: runner/setup.go)."""
         ms = self.manifest.nodes
+        for nm in ms:
+            if nm.state_sync and nm.start_at <= 0:
+                raise ValueError(
+                    f"{nm.name}: state_sync requires start_at > 0 (a late "
+                    "joiner); a node started at genesis has nothing to restore"
+                )
+            if nm.state_sync and self.manifest.snapshot_interval <= 0:
+                raise ValueError(
+                    f"{nm.name}: state_sync requires manifest "
+                    "snapshot_interval > 0 so some node produces snapshots"
+                )
+
         ports = _free_ports(3 * len(ms))
         pvs = {}
         for i, nm in enumerate(ms):
@@ -157,7 +169,27 @@ class Runner:
                 else:
                     addr = f"{node.m.abci_protocol}://127.0.0.1:{node.abci_port}"
                 cfg.base.proxy_app = addr
+            elif self.manifest.snapshot_interval > 0 and node.m.mode != "seed":
+                cfg.base.proxy_app = (
+                    f"builtin:kvstore:snapshot={self.manifest.snapshot_interval}"
+                )
             cfg.save()
+
+    def _configure_statesync(self, node: E2ENode) -> None:
+        """Point a late joiner at a live node's RPC for the light-client
+        trust root so it restores an app snapshot instead of replaying
+        from genesis (ref: runner/setup.go state-sync config)."""
+        source = next(
+            n for n in self._rpc_nodes() if n is not node and n.height() > 0
+        )
+        trust_h = self.manifest.initial_height
+        trust = source.client().call("commit", height=trust_h)
+        cfg = load_config(node.home)
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = source.rpc_url
+        cfg.statesync.trust_height = trust_h
+        cfg.statesync.trust_hash = trust["signed_header"]["commit"]["block_id"]["hash"]
+        cfg.save()
 
     def _rpc_nodes(self, nodes=None) -> list:
         """Consensus-participating, RPC-serving nodes — seeds run the
@@ -178,7 +210,8 @@ class Runner:
         if node.m.abci_protocol in ("tcp", "unix", "grpc"):
             cfg = load_config(node.home)
             node.app_proc = subprocess.Popen(
-                [sys.executable, "-m", "tendermint_tpu.e2e.app", cfg.base.proxy_app],
+                [sys.executable, "-m", "tendermint_tpu.e2e.app", cfg.base.proxy_app,
+                 str(self.manifest.snapshot_interval)],
                 env=self._env(),
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
@@ -219,6 +252,8 @@ class Runner:
         self.wait_ready(initial, timeout=timeout)
         for node in sorted(late, key=lambda n: n.m.start_at):
             self.wait_for_height(node.m.start_at, nodes=initial, timeout=timeout)
+            if node.m.state_sync:
+                self._configure_statesync(node)
             self._start_node(node)
         self.log(f"started {len(self.nodes)} node processes")
 
@@ -413,12 +448,10 @@ class Runner:
             for kind in node.m.perturb:
                 self.perturb(node, kind)
                 if node.m.mode == "seed":
-                    # seeds serve no RPC: "recovered" = process alive
-                    deadline = time.monotonic() + 10
-                    while time.monotonic() < deadline and (
-                        node.proc is None or node.proc.poll() is not None
-                    ):
-                        time.sleep(0.2)
+                    # seeds serve no RPC: "recovered" = the (possibly
+                    # freshly restarted) process stays alive for a grace
+                    # period
+                    time.sleep(2)
                     assert node.proc is not None and node.proc.poll() is None, (
                         f"{node.m.name} did not survive {kind}"
                     )

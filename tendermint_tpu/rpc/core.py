@@ -312,15 +312,29 @@ def build_routes(env: RPCEnvironment) -> dict:
                 -32603,
                 f"height {h} must be less than or equal to the head height {env.block_store.height()}",
             )
+        base = env.block_store.base()
+        if h < base:
+            raise RPCError(
+                -32603,
+                f"height {h} is not available, lowest height is {base} "
+                f"(blocks pruned or state-synced past it)",
+            )
         return h
 
     def block(height=None):
+        """Mirrors the reference exactly (blocks.go:90-102): a missing
+        META yields the empty result; a present meta with a missing full
+        block (e.g. a backfilled light block on a state-synced node)
+        yields the REAL BlockID with a null block."""
         h = _height_or_latest(height)
-        blk = env.block_store.load_block(h)
         meta = env.block_store.load_block_meta(h)
-        if blk is None:
+        if meta is None:
             return {"block_id": block_id_to_json(None), "block": None}
-        return {"block_id": block_id_to_json(meta.block_id), "block": block_to_json(blk)}
+        blk = env.block_store.load_block(h)
+        return {
+            "block_id": block_id_to_json(meta.block_id),
+            "block": block_to_json(blk) if blk is not None else None,
+        }
 
     def block_by_hash(hash=None):
         h = _as_bytes_hex(hash, "hash")
