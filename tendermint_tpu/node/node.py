@@ -359,9 +359,10 @@ class Node:
 
         # ---- RPC (node/node.go:509)
         self.rpc_server = None
+        self.rpc_env = None
         if config.rpc.enable:
             rpc_addr = urlparse(config.rpc.laddr if "//" in config.rpc.laddr else "tcp://" + config.rpc.laddr)
-            env = RPCEnvironment(
+            env = self.rpc_env = RPCEnvironment(
                 chain_id=self.gen_doc.chain_id,
                 state_store=self.state_store,
                 block_store=self.block_store,

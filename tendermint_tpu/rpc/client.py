@@ -264,3 +264,35 @@ class EventStream:
     def __iter__(self):
         while True:
             yield from self.next_batch()
+
+
+class LocalClient:
+    """In-process client over an RPCEnvironment — no HTTP, same route
+    surface and error mapping as HTTPClient (ref: rpc/client/local).
+    Useful for embedding and for tools that run against a node object
+    (the reference's e2e tests use the local client the same way)."""
+
+    def __init__(self, env):
+        from .core import build_routes
+
+        self._routes = build_routes(env)
+
+    def call(self, method: str, **params):
+        from .server import RPCError
+
+        fn = self._routes.get(method)
+        if fn is None:
+            raise RPCClientError(-32601, f"Method not found: {method}")
+        try:
+            return fn(**params)
+        except RPCError as e:
+            raise RPCClientError(e.code, e.message, e.data) from None
+        except TypeError as e:
+            raise RPCClientError(-32602, f"Invalid params: {e}") from None
+        except Exception as e:  # parity with the HTTP server's ERR_INTERNAL
+            raise RPCClientError(-32603, f"Internal error: {e}") from e
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda **params: self.call(name, **params)

@@ -54,6 +54,7 @@ def live_node():
     node.start()
     assert wait_for_height([node], 2, timeout=60)
     host, port = server.address
+    node.rpc_env = env  # for the LocalClient parity test
     yield node, HTTPClient(f"http://{host}:{port}"), (host, port)
     node.stop()
     server.stop()
@@ -204,3 +205,21 @@ def test_light_client_over_http_provider(live_node):
     head = lc.update()
     assert head.height >= 2
     assert lc.latest_trusted().height == head.height
+
+
+def test_local_client_matches_http(live_node):
+    """The in-process LocalClient returns the same results as the HTTP
+    path for the same routes (ref: rpc/client/local)."""
+    from tendermint_tpu.rpc.client import LocalClient
+
+    node, http, _ = live_node
+    local = LocalClient(node.rpc_env)
+    assert local.call("health") == http.call("health")
+    lb = local.call("block", height=1)
+    hb = http.call("block", height=1)
+    assert lb["block_id"] == hb["block_id"]
+    assert local.abci_info()["response"]["data"] == http.abci_info()["response"]["data"]
+    with pytest.raises(RPCClientError):
+        local.call("no_such_method")
+    with pytest.raises(RPCClientError):
+        local.call("block", height=10**9)
