@@ -12,7 +12,6 @@ heights' commits into one TPU launch happens naturally here because
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 
@@ -52,34 +51,42 @@ class StatusResponse:
 
 
 def encode_blocksync_msg(msg) -> bytes:
-    """ref: blocksync wire messages (proto/tendermint/blocksync)."""
+    """Wire bytes = the reference's Message oneof
+    (proto/tendermint/blocksync/types.proto:34-42)."""
     if isinstance(msg, BlockRequest):
-        return b"\x01" + json.dumps({"h": msg.height}).encode()
-    if isinstance(msg, NoBlockResponse):
-        return b"\x02" + json.dumps({"h": msg.height}).encode()
-    if isinstance(msg, BlockResponse):
-        return b"\x03" + msg.block.to_proto().encode()
-    if isinstance(msg, StatusRequest):
-        return b"\x04"
-    if isinstance(msg, StatusResponse):
-        return b"\x05" + json.dumps({"b": msg.base, "h": msg.height}).encode()
-    raise TypeError(f"unknown blocksync message {type(msg)}")
+        env = pb.BlocksyncMessage(block_request=pb.BlocksyncBlockRequest(height=msg.height))
+    elif isinstance(msg, NoBlockResponse):
+        env = pb.BlocksyncMessage(no_block_response=pb.BlocksyncNoBlockResponse(height=msg.height))
+    elif isinstance(msg, BlockResponse):
+        env = pb.BlocksyncMessage(block_response=pb.BlocksyncBlockResponse(block=msg.block.to_proto()))
+    elif isinstance(msg, StatusRequest):
+        env = pb.BlocksyncMessage(status_request=pb.BlocksyncStatusRequest())
+    elif isinstance(msg, StatusResponse):
+        env = pb.BlocksyncMessage(
+            status_response=pb.BlocksyncStatusResponse(height=msg.height, base=msg.base)
+        )
+    else:
+        raise TypeError(f"unknown blocksync message {type(msg)}")
+    return env.encode()
 
 
 def decode_blocksync_msg(data: bytes):
-    tag, body = data[0], data[1:]
-    if tag == 0x01:
-        return BlockRequest(json.loads(body)["h"])
-    if tag == 0x02:
-        return NoBlockResponse(json.loads(body)["h"])
-    if tag == 0x03:
-        return BlockResponse(Block.from_proto(pb.Block.decode(body)))
-    if tag == 0x04:
+    env = pb.BlocksyncMessage.decode(data)
+    kind = env.which()
+    if kind == "block_request":
+        return BlockRequest(env.block_request.height or 0)
+    if kind == "no_block_response":
+        return NoBlockResponse(env.no_block_response.height or 0)
+    if kind == "block_response":
+        if env.block_response.block is None:
+            raise ValueError("block_response without a block")
+        return BlockResponse(Block.from_proto(env.block_response.block))
+    if kind == "status_request":
         return StatusRequest()
-    if tag == 0x05:
-        d = json.loads(body)
-        return StatusResponse(d["b"], d["h"])
-    raise ValueError(f"unknown blocksync tag {tag}")
+    if kind == "status_response":
+        r = env.status_response
+        return StatusResponse(r.base or 0, r.height or 0)
+    raise ValueError(f"empty or unknown blocksync oneof: {kind}")
 
 
 def blocksync_channel_descriptor() -> ChannelDescriptor:

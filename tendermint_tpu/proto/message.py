@@ -209,6 +209,15 @@ class Message:
         parts = ", ".join(f"{f.name}={getattr(self, f.name)!r}" for f in type(self).fields)
         return f"{type(self).__name__}({parts})"
 
+    def which(self) -> str | None:
+        """For oneof-shaped messages: the name of the (single) set
+        message field, or None. Usable by any envelope whose fields are
+        mutually exclusive submessages."""
+        for f in type(self).fields:
+            if f.ftype == "message" and getattr(self, f.name) is not None:
+                return f.name
+        return None
+
     def copy(self):
         return type(self).decode(self.encode())
 

@@ -625,3 +625,112 @@ class NodeInfoProto(Message):
         Field(7, "string", "moniker"),
         Field(8, "message", "other", always_emit=True, msg_cls=NodeInfoOtherProto),
     ]
+
+
+# ------------------------------------------------------------- blocksync wire
+# ref: proto/tendermint/blocksync/types.proto
+
+
+class BlocksyncBlockRequest(Message):
+    fields = [Field(1, "int64", "height")]
+
+
+class BlocksyncNoBlockResponse(Message):
+    fields = [Field(1, "int64", "height")]
+
+
+class BlocksyncBlockResponse(Message):
+    # field 2 (ext_commit) is reserved for vote-extension heights
+    fields = [Field(1, "message", "block", msg_cls=Block)]
+
+
+class BlocksyncStatusRequest(Message):
+    fields = []
+
+
+class BlocksyncStatusResponse(Message):
+    fields = [Field(1, "int64", "height"), Field(2, "int64", "base")]
+
+
+class BlocksyncMessage(Message):
+    """Message oneof (blocksync/types.proto:34-42)."""
+
+    fields = [
+        Field(1, "message", "block_request", msg_cls=BlocksyncBlockRequest),
+        Field(2, "message", "no_block_response", msg_cls=BlocksyncNoBlockResponse),
+        Field(3, "message", "block_response", msg_cls=BlocksyncBlockResponse),
+        Field(4, "message", "status_request", msg_cls=BlocksyncStatusRequest),
+        Field(5, "message", "status_response", msg_cls=BlocksyncStatusResponse),
+    ]
+
+
+
+# ------------------------------------------------------------- statesync wire
+# ref: proto/tendermint/statesync/types.proto
+
+
+class SnapshotsRequestProto(Message):
+    fields = []
+
+
+class SnapshotsResponseProto(Message):
+    fields = [
+        Field(1, "uint64", "height"),
+        Field(2, "uint32", "format"),
+        Field(3, "uint32", "chunks"),
+        Field(4, "bytes", "hash"),
+        Field(5, "bytes", "metadata"),
+    ]
+
+
+class ChunkRequestProto(Message):
+    fields = [
+        Field(1, "uint64", "height"),
+        Field(2, "uint32", "format"),
+        Field(3, "uint32", "index"),
+    ]
+
+
+class ChunkResponseProto(Message):
+    fields = [
+        Field(1, "uint64", "height"),
+        Field(2, "uint32", "format"),
+        Field(3, "uint32", "index"),
+        Field(4, "bytes", "chunk"),
+        Field(5, "bool", "missing"),
+    ]
+
+
+class LightBlockRequestProto(Message):
+    fields = [Field(1, "uint64", "height")]
+
+
+class LightBlockResponseProto(Message):
+    fields = [Field(1, "message", "light_block", msg_cls=LightBlock)]
+
+
+class ParamsRequestProto(Message):
+    fields = [Field(1, "uint64", "height")]
+
+
+class ParamsResponseProto(Message):
+    fields = [
+        Field(1, "uint64", "height"),
+        Field(2, "message", "consensus_params", msg_cls=ConsensusParamsUpdate, always_emit=True),
+    ]
+
+
+class StatesyncMessage(Message):
+    """Message oneof (statesync/types.proto:8-17)."""
+
+    fields = [
+        Field(1, "message", "snapshots_request", msg_cls=SnapshotsRequestProto),
+        Field(2, "message", "snapshots_response", msg_cls=SnapshotsResponseProto),
+        Field(3, "message", "chunk_request", msg_cls=ChunkRequestProto),
+        Field(4, "message", "chunk_response", msg_cls=ChunkResponseProto),
+        Field(5, "message", "light_block_request", msg_cls=LightBlockRequestProto),
+        Field(6, "message", "light_block_response", msg_cls=LightBlockResponseProto),
+        Field(7, "message", "params_request", msg_cls=ParamsRequestProto),
+        Field(8, "message", "params_response", msg_cls=ParamsResponseProto),
+    ]
+

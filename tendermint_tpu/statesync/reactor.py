@@ -10,7 +10,6 @@ blocks, and consensus params over 4 channels
 
 from __future__ import annotations
 
-import json
 import threading
 
 from ..abci import types as abci
@@ -65,71 +64,101 @@ class ParamsRequest:
 
 
 class ParamsResponse:
-    def __init__(self, height: int, params_doc: dict):
-        self.height, self.params_doc = height, params_doc
+    def __init__(self, height: int, params):
+        self.height, self.params = height, params
+
+
+def _env(**kw) -> bytes:
+    return pb.StatesyncMessage(**kw).encode()
 
 
 def _enc_snapshot_ch(msg) -> bytes:
+    """Wire bytes on every statesync channel = the reference's Message
+    oneof (proto/tendermint/statesync/types.proto:8-17)."""
     if isinstance(msg, SnapshotsRequest):
-        return b"\x01"
+        return _env(snapshots_request=pb.SnapshotsRequestProto())
     s = msg.snapshot
-    return b"\x02" + json.dumps(
-        {"h": s.height, "f": s.format, "c": s.chunks, "hash": s.hash.hex(), "meta": s.metadata.hex()}
-    ).encode()
+    return _env(snapshots_response=pb.SnapshotsResponseProto(
+        height=s.height, format=s.format, chunks=s.chunks,
+        hash=s.hash, metadata=s.metadata))
 
 
 def _dec_snapshot_ch(data: bytes):
-    if data[0] == 1:
+    env = pb.StatesyncMessage.decode(data)
+    if env.snapshots_request is not None:
         return SnapshotsRequest()
-    d = json.loads(data[1:])
+    r = env.snapshots_response
+    if r is None:
+        raise ValueError("unexpected message on snapshot channel")
     return SnapshotsResponse(
-        abci.Snapshot(height=d["h"], format=d["f"], chunks=d["c"], hash=bytes.fromhex(d["hash"]),
-                      metadata=bytes.fromhex(d["meta"]))
+        abci.Snapshot(height=r.height or 0, format=r.format or 0, chunks=r.chunks or 0,
+                      hash=r.hash or b"", metadata=r.metadata or b"")
     )
 
 
 def _enc_chunk_ch(msg) -> bytes:
     if isinstance(msg, ChunkRequest):
-        return b"\x01" + json.dumps({"h": msg.height, "f": msg.format, "i": msg.index}).encode()
-    hdr = json.dumps({"h": msg.height, "f": msg.format, "i": msg.index, "m": msg.missing}).encode()
-    return b"\x02" + len(hdr).to_bytes(4, "big") + hdr + msg.chunk
+        return _env(chunk_request=pb.ChunkRequestProto(
+            height=msg.height, format=msg.format, index=msg.index))
+    return _env(chunk_response=pb.ChunkResponseProto(
+        height=msg.height, format=msg.format, index=msg.index,
+        chunk=msg.chunk, missing=msg.missing))
 
 
 def _dec_chunk_ch(data: bytes):
-    if data[0] == 1:
-        d = json.loads(data[1:])
-        return ChunkRequest(d["h"], d["f"], d["i"])
-    n = int.from_bytes(data[1:5], "big")
-    d = json.loads(data[5 : 5 + n])
-    return ChunkResponse(d["h"], d["f"], d["i"], bytes(data[5 + n :]), d["m"])
+    env = pb.StatesyncMessage.decode(data)
+    if env.chunk_request is not None:
+        r = env.chunk_request
+        return ChunkRequest(r.height or 0, r.format or 0, r.index or 0)
+    r = env.chunk_response
+    if r is None:
+        raise ValueError("unexpected message on chunk channel")
+    return ChunkResponse(r.height or 0, r.format or 0, r.index or 0,
+                         r.chunk or b"", bool(r.missing))
 
 
 def _enc_lb_ch(msg) -> bytes:
     if isinstance(msg, LightBlockRequest):
-        return b"\x01" + msg.height.to_bytes(8, "big")
+        return _env(light_block_request=pb.LightBlockRequestProto(height=msg.height))
+    # a response with no light_block means "don't have it" (reference
+    # sends the empty LightBlockResponse the same way)
     if msg.light_block is None:
-        return b"\x02"
-    return b"\x02" + msg.light_block.to_proto().encode()
+        return _env(light_block_response=pb.LightBlockResponseProto())
+    return _env(light_block_response=pb.LightBlockResponseProto(
+        light_block=msg.light_block.to_proto()))
 
 
 def _dec_lb_ch(data: bytes):
-    if data[0] == 1:
-        return LightBlockRequest(int.from_bytes(data[1:9], "big"))
-    if len(data) == 1:
+    env = pb.StatesyncMessage.decode(data)
+    if env.light_block_request is not None:
+        return LightBlockRequest(env.light_block_request.height or 0)
+    r = env.light_block_response
+    if r is None:
+        raise ValueError("unexpected message on light-block channel")
+    if r.light_block is None:
         return LightBlockResponse(None)
-    return LightBlockResponse(LightBlock.from_proto(pb.LightBlock.decode(data[1:])))
+    return LightBlockResponse(LightBlock.from_proto(r.light_block))
 
 
 def _enc_params_ch(msg) -> bytes:
     if isinstance(msg, ParamsRequest):
-        return b"\x01" + msg.height.to_bytes(8, "big")
-    return b"\x02" + msg.height.to_bytes(8, "big") + json.dumps(msg.params_doc).encode()
+        return _env(params_request=pb.ParamsRequestProto(height=msg.height))
+    return _env(params_response=pb.ParamsResponseProto(
+        height=msg.height, consensus_params=msg.params.to_proto_update()))
 
 
 def _dec_params_ch(data: bytes):
-    if data[0] == 1:
-        return ParamsRequest(int.from_bytes(data[1:9], "big"))
-    return ParamsResponse(int.from_bytes(data[1:9], "big"), json.loads(data[9:]))
+    from ..types.params import ConsensusParams
+
+    env = pb.StatesyncMessage.decode(data)
+    if env.params_request is not None:
+        return ParamsRequest(env.params_request.height or 0)
+    r = env.params_response
+    if r is None:
+        raise ValueError("unexpected message on params channel")
+    return ParamsResponse(
+        r.height or 0, ConsensusParams().update_consensus_params(r.consensus_params)
+    )
 
 
 def statesync_channel_descriptors() -> list[ChannelDescriptor]:
@@ -277,9 +306,7 @@ class StateSyncReactor:
                         state = self.state_store.load()
                         params = state.consensus_params if state else None
                     if params is not None:
-                        from ..types.genesis import _params_to_json
-
-                        ch.send_to(nid, ParamsResponse(msg.height, _params_to_json(params)), timeout=1.0)
+                        ch.send_to(nid, ParamsResponse(msg.height, params), timeout=1.0)
                 elif isinstance(msg, ParamsResponse):
                     handler = getattr(self, "_params_waiter", None)
                     if handler is not None:

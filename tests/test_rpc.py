@@ -54,7 +54,6 @@ def live_node():
     node.start()
     assert wait_for_height([node], 2, timeout=60)
     host, port = server.address
-    node.rpc_env = env  # for the LocalClient parity test
     yield node, HTTPClient(f"http://{host}:{port}"), (host, port)
     node.stop()
     server.stop()
@@ -207,19 +206,52 @@ def test_light_client_over_http_provider(live_node):
     assert lc.latest_trusted().height == head.height
 
 
-def test_local_client_matches_http(live_node):
+def test_local_client_matches_http(tmp_path):
     """The in-process LocalClient returns the same results as the HTTP
-    path for the same routes (ref: rpc/client/local)."""
-    from tendermint_tpu.rpc.client import LocalClient
+    path for the same routes (ref: rpc/client/local) — driven over a
+    REAL Node's rpc_env so the node wiring is what's exercised."""
+    import os as _os
+    import sys as _sys
+    import time as _time
 
-    node, http, _ = live_node
-    local = LocalClient(node.rpc_env)
-    assert local.call("health") == http.call("health")
-    lb = local.call("block", height=1)
-    hb = http.call("block", height=1)
-    assert lb["block_id"] == hb["block_id"]
-    assert local.abci_info()["response"]["data"] == http.abci_info()["response"]["data"]
-    with pytest.raises(RPCClientError):
-        local.call("no_such_method")
-    with pytest.raises(RPCClientError):
-        local.call("block", height=10**9)
+    _sys.path.insert(0, _os.path.dirname(__file__))
+    from test_consensus import fast_params as _fp
+
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc.client import LocalClient
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    out = str(tmp_path / "net")
+    assert cli_main(["testnet", "--validators", "1", "--output", out,
+                     "--chain-id", "lc-chain", "--starting-port", "0"]) == 0
+    gp = _os.path.join(out, "node0", "config", "genesis.json")
+    gd = GenesisDoc.from_file(gp)
+    gd.consensus_params = _fp()
+    gd.save_as(gp)
+    cfg = load_config(_os.path.join(out, "node0"))
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.base.db_backend = "memdb"
+    real = Node(cfg)
+    real.start()
+    try:
+        assert real.rpc_env is not None
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline and real.block_store.height() < 2:
+            _time.sleep(0.05)
+        host, port = real.rpc_address
+        http = HTTPClient(f"http://{host}:{port}")
+        local = LocalClient(real.rpc_env)
+        assert local.call("health") == http.call("health")
+        lb = local.call("block", height=1)
+        hb = http.call("block", height=1)
+        assert lb["block_id"] == hb["block_id"]
+        assert local.abci_info()["response"]["data"] == http.abci_info()["response"]["data"]
+        with pytest.raises(RPCClientError):
+            local.call("no_such_method")
+        with pytest.raises(RPCClientError):
+            local.call("block", height=10**9)
+    finally:
+        real.stop()

@@ -235,3 +235,39 @@ def test_node_statesync_join(tmp_path):
             fresh.stop()
     finally:
         validator.stop()
+
+
+def test_statesync_wire_codec_roundtrip():
+    """All statesync channel messages round-trip through the reference's
+    proto Message oneof (statesync/types.proto:8-17)."""
+    from tendermint_tpu.statesync.reactor import (
+        ChunkRequest, ChunkResponse, LightBlockRequest, LightBlockResponse,
+        ParamsRequest, ParamsResponse, SnapshotsRequest, SnapshotsResponse,
+        _dec_chunk_ch, _dec_lb_ch, _dec_params_ch, _dec_snapshot_ch,
+        _enc_chunk_ch, _enc_lb_ch, _enc_params_ch, _enc_snapshot_ch,
+    )
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.types.params import ConsensusParams
+
+    snap = abci.Snapshot(height=12, format=1, chunks=3, hash=b"\x0a" * 32, metadata=b"md")
+    r = _dec_snapshot_ch(_enc_snapshot_ch(SnapshotsResponse(snap)))
+    assert r.snapshot == snap
+    assert isinstance(_dec_snapshot_ch(_enc_snapshot_ch(SnapshotsRequest())), SnapshotsRequest)
+
+    cr = _dec_chunk_ch(_enc_chunk_ch(ChunkRequest(12, 1, 2)))
+    assert (cr.height, cr.format, cr.index) == (12, 1, 2)
+    cresp = _dec_chunk_ch(_enc_chunk_ch(ChunkResponse(12, 1, 2, b"\x01\x02", False)))
+    assert cresp.chunk == b"\x01\x02" and cresp.missing is False
+    cm = _dec_chunk_ch(_enc_chunk_ch(ChunkResponse(12, 1, 2, b"", True)))
+    assert cm.missing is True
+
+    lbr = _dec_lb_ch(_enc_lb_ch(LightBlockRequest(9)))
+    assert lbr.height == 9
+    assert _dec_lb_ch(_enc_lb_ch(LightBlockResponse(None))).light_block is None
+
+    pr = _dec_params_ch(_enc_params_ch(ParamsRequest(7)))
+    assert pr.height == 7
+    params = ConsensusParams()
+    presp = _dec_params_ch(_enc_params_ch(ParamsResponse(7, params)))
+    assert presp.height == 7
+    assert presp.params == params
