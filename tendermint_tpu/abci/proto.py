@@ -279,11 +279,6 @@ class RequestPB(Message):
         Field(19, "message", "finalize_block", msg_cls=RequestFinalizeBlockPB),
     ]
 
-    def which(self) -> str | None:
-        for f in type(self).fields:
-            if getattr(self, f.name) is not None:
-                return f.name
-        return None
 
 
 # -------------------------------------------------------------- responses
@@ -416,11 +411,6 @@ class ResponsePB(Message):
         Field(20, "message", "finalize_block", msg_cls=ResponseFinalizeBlockPB),
     ]
 
-    def which(self) -> str | None:
-        for f in type(self).fields:
-            if getattr(self, f.name) is not None:
-                return f.name
-        return None
 
 
 # -------------------------------------------------- dataclass converters

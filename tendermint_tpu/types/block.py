@@ -15,7 +15,7 @@ from ..crypto.merkle import hash_from_byte_slices
 from ..proto import messages as pb
 from ..proto import wire
 from ..utils.tmtime import Time
-from .canonical import vote_sign_bytes
+from .canonical import vote_sign_bytes_template
 
 HASH_SIZE = 32
 ADDRESS_SIZE = 20
@@ -316,6 +316,9 @@ class Commit:
     block_id: BlockID = field(default_factory=BlockID)
     signatures: list[CommitSig] = field(default_factory=list)
     _hash: bytes | None = field(default=None, compare=False, repr=False)
+    # (chain_id, make_commit, make_nil) sign-bytes template cache —
+    # everything but the timestamp is commit-invariant
+    _sb_tmpl: tuple | None = field(default=None, compare=False, repr=False)
 
     def size(self) -> int:
         return len(self.signatures)
@@ -338,8 +341,24 @@ class Commit:
 
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """The canonical signed message for validator slot val_idx
-        (ref: Commit.VoteSignBytes, types/block.go:859)."""
-        return vote_sign_bytes(chain_id, self.get_vote(val_idx))
+        (ref: Commit.VoteSignBytes, types/block.go:859). Served from a
+        per-commit template (only the timestamp varies per validator) —
+        the host-side hot path of batched commit verification."""
+        cs = self.signatures[val_idx]
+        if self._sb_tmpl is None or self._sb_tmpl[0] != chain_id:
+            self._sb_tmpl = (
+                chain_id,
+                vote_sign_bytes_template(
+                    chain_id, pb.SIGNED_MSG_TYPE_PRECOMMIT,
+                    self.height, self.round, self.block_id.to_proto(),
+                ),
+                vote_sign_bytes_template(
+                    chain_id, pb.SIGNED_MSG_TYPE_PRECOMMIT,
+                    self.height, self.round, BlockID().to_proto(),
+                ),
+            )
+        make = self._sb_tmpl[1] if cs.block_id_flag == BLOCK_ID_FLAG_COMMIT else self._sb_tmpl[2]
+        return make(cs.timestamp.seconds, cs.timestamp.nanos)
 
     def hash(self) -> bytes:
         """Merkle root of CommitSig encodings (ref: types/block.go:900)."""

@@ -210,37 +210,28 @@ def test_local_client_matches_http(tmp_path):
     """The in-process LocalClient returns the same results as the HTTP
     path for the same routes (ref: rpc/client/local) — driven over a
     REAL Node's rpc_env so the node wiring is what's exercised."""
-    import os as _os
-    import sys as _sys
-    import time as _time
-
-    _sys.path.insert(0, _os.path.dirname(__file__))
-    from test_consensus import fast_params as _fp
-
-    from tendermint_tpu.cli import main as cli_main
     from tendermint_tpu.config import load_config
-    from tendermint_tpu.node import Node
+    from tendermint_tpu.node import Node, init_files_home
+    from tendermint_tpu.privval import FilePV
     from tendermint_tpu.rpc.client import LocalClient
-    from tendermint_tpu.types.genesis import GenesisDoc
 
-    out = str(tmp_path / "net")
-    assert cli_main(["testnet", "--validators", "1", "--output", out,
-                     "--chain-id", "lc-chain", "--starting-port", "0"]) == 0
-    gp = _os.path.join(out, "node0", "config", "genesis.json")
-    gd = GenesisDoc.from_file(gp)
-    gd.consensus_params = _fp()
-    gd.save_as(gp)
-    cfg = load_config(_os.path.join(out, "node0"))
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, "lc-chain")
+    gen_doc.consensus_params = fast_params()
+    home = str(tmp_path / "node")
+    init_files_home(home, gen_doc=gen_doc)
+    cfg = load_config(home)
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
     cfg.rpc.laddr = "tcp://127.0.0.1:0"
     cfg.base.db_backend = "memdb"
-    real = Node(cfg)
+    real = Node(cfg, gen_doc=gen_doc, priv_validator=FilePV(priv_key=keys[0]))
     real.start()
     try:
         assert real.rpc_env is not None
-        deadline = _time.monotonic() + 60
-        while _time.monotonic() < deadline and real.block_store.height() < 2:
-            _time.sleep(0.05)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and real.block_store.height() < 2:
+            time.sleep(0.05)
+        assert real.block_store.height() >= 2, "node never reached height 2"
         host, port = real.rpc_address
         http = HTTPClient(f"http://{host}:{port}")
         local = LocalClient(real.rpc_env)

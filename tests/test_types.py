@@ -287,3 +287,36 @@ def test_commit_vote_sign_bytes_matches_vote():
     for i in range(2):
         vote = commit.get_vote(i)
         assert commit.vote_sign_bytes(chain_id, i) == Vote.from_proto(vote).sign_bytes(chain_id)
+
+
+def test_commit_vote_sign_bytes_template_parity():
+    """Commit.vote_sign_bytes (template fast path) is byte-identical to
+    the direct canonical encoding of get_vote for every flag/timestamp
+    combination."""
+    from tendermint_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT,
+        BLOCK_ID_FLAG_NIL,
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+    )
+    from tendermint_tpu.types.canonical import vote_sign_bytes
+    from tendermint_tpu.utils.tmtime import Time
+
+    bid = BlockID(hash=b"\x42" * 32, part_set_header=PartSetHeader(total=5, hash=b"\x43" * 32))
+    sigs = [
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x01" * 20, Time(1_700_000_001, 7), b"s" * 64),
+        CommitSig(BLOCK_ID_FLAG_NIL, b"\x02" * 20, Time(1_700_000_002, 0), b"t" * 64),
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x03" * 20, Time(0, 0), b"u" * 64),
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x04" * 20, Time(2**35, 999_999_999), b"v" * 64),
+    ]
+    commit = Commit(height=77, round=3, block_id=bid, signatures=sigs)
+    for idx in range(len(sigs)):
+        fast = commit.vote_sign_bytes("tmpl-chain", idx)
+        slow = vote_sign_bytes("tmpl-chain", commit.get_vote(idx))
+        assert fast == slow, idx
+    # template invalidates when chain id changes
+    assert commit.vote_sign_bytes("other-chain", 0) == vote_sign_bytes(
+        "other-chain", commit.get_vote(0)
+    )
