@@ -123,7 +123,7 @@ def main():
         return
     import subprocess
 
-    for batch, budget in ((BATCH, 420), (1024, 240), (256, 150)):
+    for batch, budget in ((BATCH, 360), (2048, 240), (1024, 180), (256, 120)):
         env = dict(os.environ, BENCH_ONESHOT="1", BENCH_BATCH=str(batch))
         try:
             proc = subprocess.run(

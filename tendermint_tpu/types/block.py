@@ -357,7 +357,14 @@ class Commit:
                     self.height, self.round, BlockID().to_proto(),
                 ),
             )
-        make = self._sb_tmpl[1] if cs.block_id_flag == BLOCK_ID_FLAG_COMMIT else self._sb_tmpl[2]
+        if cs.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            make = self._sb_tmpl[1]
+        elif cs.block_id_flag in (BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_NIL):
+            make = self._sb_tmpl[2]
+        else:
+            # the flag byte is attacker-controlled and outside the
+            # signature — same guard CommitSig.block_id enforces
+            raise ValueError(f"unknown BlockIDFlag: {cs.block_id_flag}")
         return make(cs.timestamp.seconds, cs.timestamp.nanos)
 
     def hash(self) -> bytes:

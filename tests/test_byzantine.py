@@ -319,3 +319,69 @@ def test_kill_and_restart_validator(tmp_path):
                 n.stop()
             except Exception:
                 pass
+
+
+def test_invalid_precommits_do_not_stall_consensus():
+    """A byzantine validator floods garbage and malformed precommits —
+    bad signatures, wrong heights, unknown validators, corrupted
+    payloads — and the honest majority keeps committing blocks
+    (ref: internal/consensus/invalid_test.go TestReactorInvalidPrecommit)."""
+    from tendermint_tpu.proto.messages import SIGNED_MSG_TYPE_PRECOMMIT
+
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    nodes = [make_ev_node(keys, i, gen_doc) for i in range(4)]
+    _wire_fanout(nodes)
+
+    byz_key = keys[3]
+    byz_addr = byz_key.pub_key().address()
+    byz_idx, _ = nodes[0].state.validators.get_by_address(byz_addr)
+    stop = threading.Event()
+
+    def flood():
+        rng = 0
+        while not stop.is_set():
+            rs = nodes[0].rs
+            h, r = rs.height, rs.round
+            rng += 1
+            ts = Time.now()
+            bad = []
+            # wrong signature over a random block id
+            v = Vote(type=SIGNED_MSG_TYPE_PRECOMMIT, height=h, round=r,
+                     block_id=BlockID(hash=bytes([rng % 256]) * 32,
+                                      part_set_header=PartSetHeader(total=1, hash=b"\x01" * 32)),
+                     timestamp=ts, validator_address=byz_addr, validator_index=byz_idx)
+            v.signature = b"\x05" * 64
+            bad.append(v)
+            # valid signature but absurd height
+            v2 = Vote(type=SIGNED_MSG_TYPE_PRECOMMIT, height=h + 1000, round=0,
+                      block_id=BlockID(), timestamp=ts,
+                      validator_address=byz_addr, validator_index=byz_idx)
+            v2.signature = byz_key.sign(v2.sign_bytes(CHAIN))
+            bad.append(v2)
+            # unknown validator address/index
+            v3 = Vote(type=SIGNED_MSG_TYPE_PRECOMMIT, height=h, round=r,
+                      block_id=BlockID(), timestamp=ts,
+                      validator_address=b"\x99" * 20, validator_index=2)
+            v3.signature = b"\x07" * 64
+            bad.append(v3)
+            for n in nodes[:3]:
+                for v in bad:
+                    n.add_peer_message(VoteMessage(vote=v), peer_id="byzantine")
+            time.sleep(0.02)
+
+    for n in nodes:
+        n.start()
+    t = threading.Thread(target=flood, daemon=True)
+    t.start()
+    try:
+        # the honest net must still make progress under the flood
+        assert wait_for_height(nodes[:3], 5, timeout=90), (
+            f"heights: {[n.rs.height for n in nodes[:3]]}"
+        )
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        for n in nodes:
+            n.stop()

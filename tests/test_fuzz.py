@@ -137,3 +137,34 @@ def test_secret_connection_rejects_garbage_stream(data):
             assert not isinstance(e, (SystemExit, KeyboardInterrupt, AssertionError)), repr(e)
     finally:
         a.close()
+
+
+@given(st.binary(max_size=2048))
+@settings(max_examples=200, deadline=None)
+def test_wal_record_iterator_never_crashes(data):
+    """iter_wal_records on arbitrary bytes either yields valid frames or
+    stops cleanly — never raises (ref: internal/consensus/wal_fuzz.go)."""
+    from tendermint_tpu.consensus.wal import iter_wal_records
+
+    consumed = 0
+    for pos, payload in iter_wal_records(data):
+        assert pos >= consumed
+        consumed = pos + 8 + len(payload)
+    assert consumed <= len(data)
+
+
+@given(st.binary(min_size=1, max_size=256), st.integers(0, 32))
+@settings(max_examples=200, deadline=None)
+def test_wal_frame_roundtrip_with_tail_garbage(payload, garbage_len):
+    """A framed record followed by garbage decodes exactly the record and
+    stops at the garbage boundary."""
+    import json as _json
+
+    from tendermint_tpu.consensus.wal import frame_record, iter_wal_records
+
+    rec = frame_record(payload)
+    blob = rec + b"\xfe" * garbage_len
+    got = list(iter_wal_records(blob))
+    assert got and got[0] == (0, payload)
+    if garbage_len >= 8:
+        assert len(got) == 1  # garbage never parses as a second frame

@@ -320,3 +320,21 @@ def test_commit_vote_sign_bytes_template_parity():
     assert commit.vote_sign_bytes("other-chain", 0) == vote_sign_bytes(
         "other-chain", commit.get_vote(0)
     )
+
+
+def test_commit_vote_sign_bytes_rejects_unknown_flag():
+    """An attacker-controlled flag byte outside {absent, commit, nil}
+    aborts sign-bytes construction instead of silently mapping to the
+    nil template (parity with CommitSig.block_id's guard)."""
+    import pytest
+
+    from tendermint_tpu.types.block import BlockID, Commit, CommitSig, PartSetHeader
+    from tendermint_tpu.utils.tmtime import Time
+
+    commit = Commit(
+        height=5, round=0,
+        block_id=BlockID(hash=b"\x01" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x02" * 32)),
+        signatures=[CommitSig(4, b"\x03" * 20, Time(1, 0), b"s" * 64)],
+    )
+    with pytest.raises(ValueError, match="unknown BlockIDFlag"):
+        commit.vote_sign_bytes("c", 0)
