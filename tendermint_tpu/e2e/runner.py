@@ -78,7 +78,12 @@ class Runner:
     # ----------------------------------------------------------------- setup
 
     def setup(self) -> None:
-        """Generate homes, keys, genesis, configs (ref: runner/setup.go)."""
+        """Validate the manifest, wipe any previous testnet at base_dir,
+        then generate homes, keys, genesis, configs (ref: runner/main.go
+        Cleanup before Setup — stale chain data from an earlier run
+        would otherwise be resumed against a freshly generated genesis).
+        Validation runs FIRST so a bad manifest never destroys the
+        previous run's logs/WALs."""
         ms = self.manifest.nodes
         for nm in ms:
             if nm.state_sync and nm.start_at <= 0:
@@ -92,6 +97,23 @@ class Runner:
                     "snapshot_interval > 0 so some node produces snapshots"
                 )
 
+        if os.path.isdir(self.base_dir):
+            entries = os.listdir(self.base_dir)
+            # a previous testnet is recognized by its layout (every
+            # entry is a node home with config/), independent of THIS
+            # manifest's node names — refuse anything else (protects
+            # against pointing the runner at an unrelated directory)
+            looks_like_testnet = all(
+                os.path.isdir(os.path.join(self.base_dir, e, "config")) for e in entries
+            )
+            if entries and not looks_like_testnet:
+                raise ValueError(
+                    f"refusing to wipe {self.base_dir!r}: does not look "
+                    "like a previous testnet (entries without config/ subdirs)"
+                )
+            import shutil
+
+            shutil.rmtree(self.base_dir)
         ports = _free_ports(3 * len(ms))
         pvs = {}
         for i, nm in enumerate(ms):
