@@ -15,10 +15,46 @@ from ..abci.kvstore import KVStoreApplication
 from ..abci.socket import SocketServer
 
 
+class DelayedKVStore(KVStoreApplication):
+    """kvstore with artificial per-call delays mimicking app computation
+    time (ref: manifest.go:80-86 *DelayMS; test/e2e/app applies them the
+    same way). delays_ms keys: prepare_proposal, process_proposal,
+    check_tx, finalize_block."""
+
+    def __init__(self, delays_ms: dict | None = None, **kw):
+        super().__init__(**kw)
+        self._delays = {k: v / 1000.0 for k, v in (delays_ms or {}).items() if v}
+
+    def _dally(self, call: str) -> None:
+        d = self._delays.get(call)
+        if d:
+            time.sleep(d)
+
+    def prepare_proposal(self, req):
+        self._dally("prepare_proposal")
+        return super().prepare_proposal(req)
+
+    def process_proposal(self, req):
+        self._dally("process_proposal")
+        return super().process_proposal(req)
+
+    def check_tx(self, req):
+        self._dally("check_tx")
+        return super().check_tx(req)
+
+    def finalize_block(self, req):
+        self._dally("finalize_block")
+        return super().finalize_block(req)
+
+
 def main() -> int:
+    import json
+    import os
+
     addr = sys.argv[1] if len(sys.argv) > 1 else "tcp://127.0.0.1:26658"
     snapshot_interval = int(sys.argv[2]) if len(sys.argv) > 2 else 0
-    app = KVStoreApplication(snapshot_interval=snapshot_interval)
+    delays = json.loads(os.environ.get("TM_E2E_DELAYS_MS", "{}"))
+    app = DelayedKVStore(delays_ms=delays, snapshot_interval=snapshot_interval)
     if addr.startswith("grpc://"):
         from ..abci.grpc import GRPCServer
 

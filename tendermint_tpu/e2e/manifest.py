@@ -51,6 +51,13 @@ class Manifest:
     # builtin kvstore app snapshot cadence, 0 = no snapshots
     # (ref: manifest.go SnapshotInterval)
     snapshot_interval: int = 0
+    # artificial per-call ABCI delays mimicking app computation time,
+    # applied by the external e2e app process
+    # (ref: manifest.go:80-86 *DelayMS fields)
+    prepare_proposal_delay_ms: int = 0
+    process_proposal_delay_ms: int = 0
+    check_tx_delay_ms: int = 0
+    finalize_block_delay_ms: int = 0
 
     @classmethod
     def parse(cls, text: str) -> "Manifest":
@@ -60,6 +67,10 @@ class Manifest:
             load_tx_rate=int(doc.get("load_tx_rate", 10)),
             initial_height=int(doc.get("initial_height", 1)),
             snapshot_interval=int(doc.get("snapshot_interval", 0)),
+            prepare_proposal_delay_ms=int(doc.get("prepare_proposal_delay_ms", 0)),
+            process_proposal_delay_ms=int(doc.get("process_proposal_delay_ms", 0)),
+            check_tx_delay_ms=int(doc.get("check_tx_delay_ms", 0)),
+            finalize_block_delay_ms=int(doc.get("finalize_block_delay_ms", 0)),
         )
         for h, updates in (doc.get("validator_update") or {}).items():
             m.validator_updates[int(h)] = {k: int(v) for k, v in updates.items()}

@@ -231,10 +231,19 @@ class Runner:
     def _start_node(self, node: E2ENode) -> None:
         if node.m.abci_protocol in ("tcp", "unix", "grpc"):
             cfg = load_config(node.home)
+            app_env = self._env()
+            delays = {
+                "prepare_proposal": self.manifest.prepare_proposal_delay_ms,
+                "process_proposal": self.manifest.process_proposal_delay_ms,
+                "check_tx": self.manifest.check_tx_delay_ms,
+                "finalize_block": self.manifest.finalize_block_delay_ms,
+            }
+            if any(delays.values()):
+                app_env["TM_E2E_DELAYS_MS"] = json.dumps(delays)
             node.app_proc = subprocess.Popen(
                 [sys.executable, "-m", "tendermint_tpu.e2e.app", cfg.base.proxy_app,
                  str(self.manifest.snapshot_interval)],
-                env=self._env(),
+                env=app_env,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
             )

@@ -155,3 +155,29 @@ def test_e2e_statesync_late_join(tmp_path):
         runner.check_consistency()
     finally:
         runner.cleanup()
+
+
+def test_delayed_app_and_manifest_delays():
+    """Manifest ABCI delay fields (ref: manifest.go:80-86) parse and the
+    delayed e2e app actually dallies the wrapped calls."""
+    import time as _time
+
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.e2e.app import DelayedKVStore
+
+    m = Manifest.parse("""
+chain_id = "d"
+check_tx_delay_ms = 40
+finalize_block_delay_ms = 25
+
+[node.validator01]
+""")
+    assert m.check_tx_delay_ms == 40 and m.finalize_block_delay_ms == 25
+
+    app = DelayedKVStore(delays_ms={"check_tx": 40})
+    t0 = _time.perf_counter()
+    app.check_tx(abci.RequestCheckTx(tx=b"a=1", type=0))
+    assert _time.perf_counter() - t0 >= 0.04
+    t0 = _time.perf_counter()
+    app.finalize_block(abci.RequestFinalizeBlock(txs=[], height=1, hash=b"\x01" * 32))
+    assert _time.perf_counter() - t0 < 0.02  # undelayed call stays fast
