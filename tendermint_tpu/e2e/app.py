@@ -23,7 +23,7 @@ class DelayedKVStore(KVStoreApplication):
 
     def __init__(self, delays_ms: dict | None = None, **kw):
         super().__init__(**kw)
-        self._delays = {k: v / 1000.0 for k, v in (delays_ms or {}).items() if v}
+        self._delays = {k: v / 1000.0 for k, v in (delays_ms or {}).items() if v > 0}
 
     def _dally(self, call: str) -> None:
         d = self._delays.get(call)

@@ -228,18 +228,26 @@ class Runner:
         env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
         return env
 
+    def _delays_env(self) -> str:
+        """JSON ABCI-delay schedule for app processes, '' when unset.
+        Negative manifest values are rejected up front — a bad sleep
+        would otherwise crash the app subprocess with stderr discarded."""
+        delays = {
+            "prepare_proposal": self.manifest.prepare_proposal_delay_ms,
+            "process_proposal": self.manifest.process_proposal_delay_ms,
+            "check_tx": self.manifest.check_tx_delay_ms,
+            "finalize_block": self.manifest.finalize_block_delay_ms,
+        }
+        if any(v < 0 for v in delays.values()):
+            raise ValueError(f"negative ABCI delay in manifest: {delays}")
+        return json.dumps(delays) if any(delays.values()) else ""
+
     def _start_node(self, node: E2ENode) -> None:
         if node.m.abci_protocol in ("tcp", "unix", "grpc"):
             cfg = load_config(node.home)
             app_env = self._env()
-            delays = {
-                "prepare_proposal": self.manifest.prepare_proposal_delay_ms,
-                "process_proposal": self.manifest.process_proposal_delay_ms,
-                "check_tx": self.manifest.check_tx_delay_ms,
-                "finalize_block": self.manifest.finalize_block_delay_ms,
-            }
-            if any(delays.values()):
-                app_env["TM_E2E_DELAYS_MS"] = json.dumps(delays)
+            if self._delays_env():
+                app_env["TM_E2E_DELAYS_MS"] = self._delays_env()
             node.app_proc = subprocess.Popen(
                 [sys.executable, "-m", "tendermint_tpu.e2e.app", cfg.base.proxy_app,
                  str(self.manifest.snapshot_interval)],
@@ -264,9 +272,15 @@ class Runner:
             else:
                 raise TimeoutError(f"{node.m.name}: ABCI app never came up")
         log_f = open(os.path.join(node.home, "node.log"), "ab")
+        node_env = self._env()
+        if node.m.abci_protocol == "builtin" and self._delays_env():
+            # builtin apps are constructed inside the node process
+            # (node/node.py _make_app) — same env contract as the
+            # external app runner
+            node_env["TM_E2E_DELAYS_MS"] = self._delays_env()
         node.proc = subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu", "--home", node.home, "start"],
-            env=self._env(),
+            env=node_env,
             stdout=log_f,
             stderr=subprocess.STDOUT,
         )

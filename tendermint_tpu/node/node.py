@@ -74,11 +74,24 @@ def _make_app(proxy_app: str):
     kvstore accepts a snapshot-interval suffix:
     builtin:kvstore:snapshot=N. tcp:// and unix:// addresses dial an
     external app over the socket ABCI transport (abci/socket.py)."""
+    def _kvstore(**kw):
+        # the e2e harness's artificial ABCI-delay schedule applies to
+        # builtin apps too (ref: manifest.go:80-86 — the reference test
+        # app delays regardless of transport)
+        delays = os.environ.get("TM_E2E_DELAYS_MS")
+        if delays:
+            import json as _json
+
+            from ..e2e.app import DelayedKVStore
+
+            return DelayedKVStore(delays_ms=_json.loads(delays), **kw)
+        return KVStoreApplication(**kw)
+
     if proxy_app.startswith("builtin:kvstore:snapshot="):
         interval = int(proxy_app.rsplit("=", 1)[1])
-        return LocalClient(KVStoreApplication(snapshot_interval=interval))
+        return LocalClient(_kvstore(snapshot_interval=interval))
     if proxy_app in ("builtin:kvstore", "kvstore", "builtin"):
-        return LocalClient(KVStoreApplication())
+        return LocalClient(_kvstore())
     if proxy_app in ("noop", "builtin:noop"):
         from ..abci.types import BaseApplication
 
