@@ -474,6 +474,33 @@ def cmd_json2wal(args) -> int:
     return 0
 
 
+def cmd_config_migrate(args) -> int:
+    """`config-migrate` — normalize a node's config.toml to the current
+    schema (ref: scripts/confix): unknown/stale keys are dropped (and
+    reported), recognized values preserved, defaults filled in. The old
+    file is kept as config.toml.bak."""
+    from .config import Config
+    from .config.config import DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE
+
+    path = os.path.join(args.home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE)
+    if not os.path.exists(path):
+        print(f"no config at {path}")
+        return 1
+    with open(path) as f:
+        raw = f.read()
+    cfg = Config.from_toml(raw, home=args.home)
+    if cfg.unknown_keys:
+        print("dropping unrecognized keys:")
+        for k in cfg.unknown_keys:
+            print(f"  - {k}")
+    else:
+        print("no unrecognized keys; normalizing formatting/defaults only")
+    shutil.copyfile(path, path + ".bak")
+    cfg.save(path)
+    print(f"rewrote {path} (backup at {path}.bak)")
+    return 0
+
+
 def cmd_key_migrate(args) -> int:
     """`key-migrate` — upgrade legacy ASCII-decimal store keys to the
     current fixed-width binary layout (ref: cmd/tendermint/main.go:28-48
@@ -605,6 +632,11 @@ def build_parser() -> argparse.ArgumentParser:
         "key-migrate",
         help="upgrade legacy DB key layouts to the current format",
     ).set_defaults(fn=cmd_key_migrate)
+
+    sub.add_parser(
+        "config-migrate",
+        help="normalize config.toml to the current schema (drops stale keys)",
+    ).set_defaults(fn=cmd_config_migrate)
 
     sp = sub.add_parser("wal2json", help="decode a consensus WAL file to JSON lines")
     sp.add_argument("file")

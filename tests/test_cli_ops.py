@@ -194,3 +194,30 @@ def test_wal2json_reports_corruption(tmp_path, capsys):
     assert cli_main(["wal2json", cfg.wal_file]) == 1
     err = capsys.readouterr().err
     assert "corrupt or torn" in err
+
+
+def test_config_migrate_drops_stale_keys(tmp_path, capsys):
+    """config-migrate rewrites a stale config.toml to the current
+    schema, preserving recognized values and dropping unknown keys
+    (ref: scripts/confix)."""
+    home = str(tmp_path / "node")
+    assert cli_main(["--home", home, "init", "validator", "--chain-id", "cm-chain"]) == 0
+    path = os.path.join(home, "config", "config.toml")
+    with open(path) as f:
+        raw = f.read()
+    # stale key inside an existing section + a whole unknown section
+    raw = raw.replace("[consensus]\n", '[consensus]\ntimeout_propose = "3s"\n', 1)
+    raw += "\n[fastsync]\nversion = \"v0\"\n"
+    with open(path, "w") as f:
+        f.write(raw)
+
+    assert cli_main(["--home", home, "config-migrate"]) == 0
+    out = capsys.readouterr().out
+    assert "timeout_propose" in out  # reported as dropped
+
+    from tendermint_tpu.config import Config
+
+    with open(path) as f:
+        migrated = Config.from_toml(f.read(), home=home)
+    assert migrated.unknown_keys == []
+    assert os.path.exists(path + ".bak")
