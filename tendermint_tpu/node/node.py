@@ -481,21 +481,45 @@ class Node:
         cfg = self.config.statesync
         try:
             servers = [s.strip() for s in cfg.rpc_servers.split(",") if s.strip()]
-            if not servers or not cfg.trust_hash:
-                raise ValueError("statesync requires rpc_servers and trust_hash")
-            primary = HTTPProvider(self.gen_doc.chain_id, servers[0])
-            witnesses = [HTTPProvider(self.gen_doc.chain_id, s) for s in servers[1:]]
-            lc = LightClient(
-                self.gen_doc.chain_id,
-                TrustOptions(
-                    period_ns=int(cfg.trust_period * 1e9),
-                    height=cfg.trust_height,
-                    hash=bytes.fromhex(cfg.trust_hash),
-                ),
-                primary,
-                witnesses=witnesses,
+            if not cfg.trust_hash:
+                raise ValueError("statesync requires trust_hash")
+            trust = TrustOptions(
+                period_ns=int(cfg.trust_period * 1e9),
+                height=cfg.trust_height,
+                hash=bytes.fromhex(cfg.trust_hash),
             )
-            sp = LightClientStateProvider(lc, self.gen_doc)
+            params_fetcher = None
+            if servers:
+                primary = HTTPProvider(self.gen_doc.chain_id, servers[0])
+                witnesses = [HTTPProvider(self.gen_doc.chain_id, s) for s in servers[1:]]
+            else:
+                # p2p mode (ref: config statesync.use-p2p + the p2p state
+                # provider, stateprovider.go): light blocks and consensus
+                # params come from peers over the statesync channels
+                from ..statesync.dispatcher import Dispatcher, P2PLightProvider
+
+                dispatcher = Dispatcher(self.statesync_reactor)
+                primary = P2PLightProvider(
+                    self.gen_doc.chain_id, dispatcher, self.peer_manager.peers
+                )
+                witnesses = []
+                # the light client fetches its trust root eagerly — wait
+                # for at least one peer to be up first (bounded by the
+                # same discovery window the snapshot search uses)
+                deadline = time.monotonic() + cfg.discovery_time
+                while time.monotonic() < deadline and not self.peer_manager.peers():
+                    if self._halted.is_set():
+                        return
+                    time.sleep(0.1)
+
+                def params_fetcher(height, _d=dispatcher):
+                    # failure must ABORT the sync (-> blocksync-from-
+                    # genesis fallback), not silently restore with
+                    # genesis params: on-chain updates (e.g. raised
+                    # block.max_bytes) would otherwise fork this node
+                    return _d.consensus_params(height, self.peer_manager.peers())
+            lc = LightClient(self.gen_doc.chain_id, trust, primary, witnesses=witnesses)
+            sp = LightClientStateProvider(lc, self.gen_doc, params_fetcher=params_fetcher)
             state, _commit = self.statesync_reactor.sync(sp, self.gen_doc, discovery_time=cfg.discovery_time)
             self.statesync_reactor.backfill(state, lambda h: self._fetch_lb_quiet(primary, h))
             self.consensus.update_to_state(state)

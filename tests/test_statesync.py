@@ -271,3 +271,126 @@ def test_statesync_wire_codec_roundtrip():
     presp = _dec_params_ch(_enc_params_ch(ParamsResponse(7, params)))
     assert presp.height == 7
     assert presp.params == params
+
+
+def test_statesync_p2p_state_provider():
+    """Full p2p statesync: NO RPC anywhere — the light blocks and
+    consensus params for the trust chain come from peers over the
+    statesync LightBlock/Params channels via the dispatcher
+    (ref: statesync/dispatcher.go + the p2p state provider)."""
+    from tendermint_tpu.statesync.dispatcher import Dispatcher, P2PLightProvider
+
+    keys, gen_doc, cs, app, client, state_store, block_store = _source_chain()
+
+    net = MemoryNetwork()
+    provider = LocalProvider(CHAIN, block_store, state_store)
+    server = SSNode(net, 0x91, client, state_store, block_store, local_provider=provider)
+
+    fresh_app = KVStoreApplication()
+    fresh_client = LocalClient(fresh_app)
+    fresh_state_store = StateStore(MemDB())
+    fresh_block_store = BlockStore(MemDB())
+    client_node = SSNode(net, 0x92, fresh_client, fresh_state_store, fresh_block_store)
+
+    server.start()
+    client_node.start()
+    try:
+        client_node.pm.add(Endpoint(protocol="memory", host=server.node_id, node_id=server.node_id))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not client_node.pm.peers():
+            time.sleep(0.05)
+        assert client_node.pm.peers(), "peer never connected"
+
+        dispatcher = Dispatcher(client_node.reactor)
+        p2p_provider = P2PLightProvider(CHAIN, dispatcher, client_node.pm.peers)
+
+        # trust root ALSO fetched over p2p
+        lb1 = dispatcher.light_block(1, client_node.pm.peers())
+        lc = LightClient(
+            CHAIN,
+            TrustOptions(period_ns=24 * 3600 * 10**9, height=1, hash=lb1.signed_header.hash()),
+            p2p_provider,
+            clock=lambda: Time.from_unix_ns(
+                provider.light_block(0).signed_header.header.time.unix_ns() + 10**9
+            ),
+        )
+
+        def params_fetcher(height):
+            return dispatcher.consensus_params(height, client_node.pm.peers())
+
+        sp = LightClientStateProvider(lc, gen_doc, params_fetcher=params_fetcher)
+        state, commit = client_node.reactor.sync(sp, gen_doc, discovery_time=20.0)
+        snap_height = state.last_block_height
+        assert snap_height % SNAPSHOT_INTERVAL == 0 and snap_height >= SNAPSHOT_INTERVAL
+        assert fresh_app.height == snap_height
+        assert state.consensus_params == gen_doc.consensus_params
+
+        # backfill over the p2p dispatcher as well
+        def fetch(h):
+            try:
+                return dispatcher.light_block(h, client_node.pm.peers())
+            except Exception:
+                return None
+
+        stored = client_node.reactor.backfill(state, fetch, stop_height=1)
+        assert stored == snap_height - 1
+    finally:
+        client_node.stop()
+        server.stop()
+
+
+def test_node_statesync_join_p2p_only(tmp_path):
+    """Node-level p2p statesync: statesync.enable with NO rpc_servers —
+    the trust chain is fetched from peers over the statesync channels
+    (ref: config statesync use-p2p mode)."""
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node, init_files_home
+    from tendermint_tpu.privval import FilePV
+
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-p2p")
+    gen_doc.consensus_params = fast_params()
+
+    vhome = str(tmp_path / "validator")
+    init_files_home(vhome, gen_doc=gen_doc)
+    vcfg = load_config(vhome)
+    vcfg.base.proxy_app = f"builtin:kvstore:snapshot={SNAPSHOT_INTERVAL}"
+    vcfg.p2p.laddr = "tcp://127.0.0.1:0"
+    vcfg.rpc.laddr = "tcp://127.0.0.1:0"
+    validator = Node(vcfg, gen_doc=gen_doc, priv_validator=FilePV(priv_key=keys[0]))
+    validator.start()
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and validator.block_store.height() < 2 * SNAPSHOT_INTERVAL + 3:
+            time.sleep(0.05)
+        assert validator.block_store.height() >= 2 * SNAPSHOT_INTERVAL + 3
+
+        trust_lb = validator.block_store.load_block_meta(1)
+        fhome = str(tmp_path / "fresh")
+        init_files_home(fhome, mode="full", gen_doc=gen_doc)
+        fcfg = load_config(fhome)
+        fcfg.base.mode = "full"
+        fcfg.p2p.laddr = "tcp://127.0.0.1:0"
+        fcfg.rpc.laddr = "tcp://127.0.0.1:0"
+        fcfg.statesync.enable = True
+        fcfg.statesync.rpc_servers = ""  # p2p only
+        fcfg.statesync.trust_height = 1
+        fcfg.statesync.trust_hash = trust_lb.block_id.hash.hex()
+        fresh = Node(fcfg, gen_doc=gen_doc)
+        fresh.start()
+        try:
+            fresh.dial(validator)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = fresh.state_store.load()
+                if st is not None and st.last_block_height >= SNAPSHOT_INTERVAL:
+                    if fresh.block_store.height() >= st.last_block_height:
+                        break
+                time.sleep(0.1)
+            restored = fresh.state_store.load().last_block_height
+            assert restored >= SNAPSHOT_INTERVAL, f"p2p statesync never restored (state at {restored})"
+            assert fresh.app_client._app.height >= SNAPSHOT_INTERVAL
+        finally:
+            fresh.stop()
+    finally:
+        validator.stop()
