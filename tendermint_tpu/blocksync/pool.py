@@ -44,7 +44,8 @@ class BlockPool:
         self.send_error = send_error or (lambda err, peer_id: None)
         self.peers: dict[str, _BpPeer] = {}
         self.requesters: dict[int, str] = {}  # height → assigned peer
-        self.blocks: dict[int, tuple] = {}  # height → (block, extended_commit, peer_id)
+        self.blocks: dict[int, tuple] = {}  # height → (block, peer_id)
+        self._ext_commits: dict[int, object] = {}  # height → pb.ExtendedCommit
         self.max_peer_height = 0
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -89,11 +90,17 @@ class BlockPool:
             # second block must not be used to verify the first
             for h in [h for h, (_, p) in self.blocks.items() if p == peer_id and h >= self.height]:
                 del self.blocks[h]
+                self._ext_commits.pop(h, None)
             self.max_peer_height = max((p.height for p in self.peers.values()), default=0)
 
     # ----------------------------------------------------------- blocks
 
-    def add_block(self, peer_id: str, block) -> bool:
+    def take_ext_commit(self, height: int):
+        """ExtendedCommit delivered with the block at `height`, if any."""
+        with self._lock:
+            return self._ext_commits.pop(height, None)
+
+    def add_block(self, peer_id: str, block, ext_commit=None) -> bool:
         """A BlockResponse arrived (ref: pool.go:244 AddBlock). Only the
         peer the height was assigned to may deliver it — unsolicited
         blocks are rejected (the reference errors the sender), which
@@ -103,6 +110,8 @@ class BlockPool:
             if self.requesters.get(height) != peer_id:
                 self.send_error(ValueError(f"unsolicited block for height {height}"), peer_id)
                 return False
+            if ext_commit is not None:
+                self._ext_commits[height] = ext_commit
             if height in self.blocks:
                 return False
             self.blocks[height] = (block, peer_id)
@@ -165,6 +174,7 @@ class BlockPool:
         (ref: pool.go:274 RedoRequest)."""
         with self._lock:
             entry = self.blocks.pop(height, None)
+            self._ext_commits.pop(height, None)
             self.requesters.pop(height, None)
             peer_id = entry[1] if entry else None
             if peer_id is not None:

@@ -36,8 +36,12 @@ class NoBlockResponse:
 
 
 class BlockResponse:
-    def __init__(self, block: Block):
+    def __init__(self, block: Block, ext_commit=None):
         self.block = block
+        # pb.ExtendedCommit for vote-extension heights
+        # (blocksync/types.proto:23) — lets the syncing node later serve
+        # extension-aware catch-up gossip itself
+        self.ext_commit = ext_commit
 
 
 class StatusRequest:
@@ -58,7 +62,8 @@ def encode_blocksync_msg(msg) -> bytes:
     elif isinstance(msg, NoBlockResponse):
         env = pb.BlocksyncMessage(no_block_response=pb.BlocksyncNoBlockResponse(height=msg.height))
     elif isinstance(msg, BlockResponse):
-        env = pb.BlocksyncMessage(block_response=pb.BlocksyncBlockResponse(block=msg.block.to_proto()))
+        env = pb.BlocksyncMessage(block_response=pb.BlocksyncBlockResponse(
+            block=msg.block.to_proto(), ext_commit=msg.ext_commit))
     elif isinstance(msg, StatusRequest):
         env = pb.BlocksyncMessage(status_request=pb.BlocksyncStatusRequest())
     elif isinstance(msg, StatusResponse):
@@ -80,7 +85,10 @@ def decode_blocksync_msg(data: bytes):
     if kind == "block_response":
         if env.block_response.block is None:
             raise ValueError("block_response without a block")
-        return BlockResponse(Block.from_proto(env.block_response.block))
+        return BlockResponse(
+            Block.from_proto(env.block_response.block),
+            ext_commit=env.block_response.ext_commit,
+        )
     if kind == "status_request":
         return StatusRequest()
     if kind == "status_response":
@@ -201,7 +209,7 @@ class BlockSyncReactor:
                 if isinstance(msg, BlockRequest):
                     self._respond_to_peer(msg, nid)
                 elif isinstance(msg, BlockResponse):
-                    self.pool.add_block(nid, msg.block)
+                    self.pool.add_block(nid, msg.block, ext_commit=msg.ext_commit)
                 elif isinstance(msg, StatusRequest):
                     self.channel.send_to(
                         nid, StatusResponse(self.block_store.base(), self.block_store.height()), timeout=1.0
@@ -214,10 +222,12 @@ class BlockSyncReactor:
                 self.channel.send_error(PeerError(node_id=nid, err=e))
 
     def _respond_to_peer(self, msg: BlockRequest, peer_id: str) -> None:
-        """ref: reactor.go:186 respondToPeer."""
+        """ref: reactor.go:186 respondToPeer — the extended commit rides
+        along for vote-extension heights."""
         block = self.block_store.load_block(msg.height)
         if block is not None:
-            self.channel.send_to(peer_id, BlockResponse(block), timeout=1.0)
+            ec = self.block_store.load_extended_commit_proto(msg.height)
+            self.channel.send_to(peer_id, BlockResponse(block, ext_commit=ec), timeout=1.0)
         else:
             self.channel.send_to(peer_id, NoBlockResponse(msg.height), timeout=1.0)
 
@@ -304,7 +314,10 @@ class BlockSyncReactor:
             return False
 
         self.pool.pop_request()
+        ec = self.pool.take_ext_commit(first.header.height)
         self.block_store.save_block(first, first_parts, second.last_commit)
+        if ec is not None:
+            self.block_store.save_extended_commit_proto(first.header.height, ec)
         self.state = self.block_exec.apply_block(self.state, first_id, first)
         self.blocks_synced += 1
         return True

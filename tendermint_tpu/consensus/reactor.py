@@ -471,11 +471,19 @@ class ConsensusReactor:
                     if self._pick_send_vote(ps, rs.last_commit):
                         continue
                 # peer is further behind: send precommits from the stored
-                # commit at their height (reactor.go:789)
+                # commit at their height (reactor.go:789). When vote
+                # extensions were enabled at that height the peer's
+                # extended vote set rejects commit-derived votes, so
+                # serve the stored EXTENDED commit instead.
                 if prs.height != 0 and rs.height >= prs.height + 2 and self.block_store.base() <= prs.height:
-                    commit = self.block_store.load_block_commit(prs.height)
-                    if commit is not None and self._pick_send_commit_sig(ps, prs, commit):
-                        continue
+                    if self.cs.state.consensus_params.abci.vote_extensions_enabled(prs.height):
+                        votes = self.block_store.load_extended_commit(prs.height)
+                        if votes and self._pick_send_extended(ps, prs, votes):
+                            continue
+                    else:
+                        commit = self.block_store.load_block_commit(prs.height)
+                        if commit is not None and self._pick_send_commit_sig(ps, prs, commit):
+                            continue
             except Exception:
                 pass
             time.sleep(self.GOSSIP_SLEEP)
@@ -545,6 +553,28 @@ class ConsensusReactor:
                 signature=cs_sig.signature,
             )
             vote_set.add_vote(vote)
+        return self._pick_send_vote(ps, vote_set)
+
+    def _pick_send_extended(self, ps: PeerState, prs, votes) -> bool:
+        """Serve one stored EXTENDED precommit to a lagging peer whose
+        vote set verifies extension signatures (ref: the extended-commit
+        path of catch-up gossip)."""
+        vals = self.cs.block_exec.store.load_validators(prs.height)
+        if vals is None or not votes:
+            return False
+        round_ = votes[0].round
+        ps.ensure_catchup_commit_round(prs.height, round_, vals.size())
+        ps.ensure_vote_bit_arrays(prs.height, vals.size())
+        from ..types.vote_set import VoteSet
+
+        vote_set = VoteSet.extended(
+            self.cs.state.chain_id, prs.height, round_, PRECOMMIT, vals
+        )
+        for vote in votes:
+            try:
+                vote_set.add_vote(vote)
+            except Exception:
+                continue  # skip any vote that fails re-verification
         return self._pick_send_vote(ps, vote_set)
 
     # ---------------------------------------------------------- maj23 query

@@ -40,10 +40,15 @@ class HeightVoteSet:
     created lazily up to round+1, plus peer-triggered catchup rounds
     (ref: internal/consensus/types/height_vote_set.go:29)."""
 
-    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet,
+                 extensions_enabled: bool = False):
+        """extensions_enabled: vote extensions active at this height —
+        precommit sets are then extended (verify extension signatures,
+        ref: height_vote_set.go + NewExtendedVoteSet)."""
         self.chain_id = chain_id
         self.height = height
         self.val_set = val_set
+        self.extensions_enabled = extensions_enabled
         self.round = 0
         self._round_vote_sets: dict[int, tuple[VoteSet, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}
@@ -59,7 +64,12 @@ class HeightVoteSet:
 
     def _add_round(self, round_: int) -> None:
         prevotes = VoteSet(self.chain_id, self.height, round_, PREVOTE, self.val_set)
-        precommits = VoteSet(self.chain_id, self.height, round_, PRECOMMIT, self.val_set)
+        if self.extensions_enabled:
+            precommits = VoteSet.extended(
+                self.chain_id, self.height, round_, PRECOMMIT, self.val_set
+            )
+        else:
+            precommits = VoteSet(self.chain_id, self.height, round_, PRECOMMIT, self.val_set)
         self._round_vote_sets[round_] = (prevotes, precommits)
 
     def _get(self, round_: int, vote_type: int) -> VoteSet | None:

@@ -359,7 +359,10 @@ class ConsensusState:
         rs.valid_round = -1
         rs.valid_block = None
         rs.valid_block_parts = None
-        rs.votes = HeightVoteSet(state.chain_id, height, state.validators)
+        rs.votes = HeightVoteSet(
+            state.chain_id, height, state.validators,
+            extensions_enabled=state.consensus_params.abci.vote_extensions_enabled(height),
+        )
         rs.commit_round = -1
         rs.last_commit = last_commit
         rs.last_validators = state.last_validators
@@ -739,8 +742,18 @@ class ConsensusState:
         self.block_exec.validate_block(self.state, block)
 
         if self.block_store.height() < block.header.height:
-            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-            self.block_store.save_block(block, block_parts, seen_commit)
+            precommits = rs.votes.precommits(rs.commit_round)
+            seen_commit = precommits.make_commit()
+            # extended votes ride in the same batch as the block: catch-up
+            # gossip must serve votes an EXTENDED vote set accepts
+            # (commit-derived votes lack extension signatures) — ref:
+            # SaveBlockWithExtendedCommit
+            ext = (
+                precommits.votes
+                if self.state.consensus_params.abci.vote_extensions_enabled(height)
+                else None
+            )
+            self.block_store.save_block(block, block_parts, seen_commit, extended_votes=ext)
 
         # EndHeight implies the block store saved the block; crash before
         # this replays from the WAL, crash after replays via ApplyBlock in

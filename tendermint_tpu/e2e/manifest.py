@@ -51,6 +51,9 @@ class Manifest:
     # builtin kvstore app snapshot cadence, 0 = no snapshots
     # (ref: manifest.go SnapshotInterval)
     snapshot_interval: int = 0
+    # height at which vote extensions activate on-chain, 0 = disabled
+    # (ref: manifest.go VoteExtensionsEnableHeight / ABCIParams)
+    vote_extensions_enable_height: int = 0
     # artificial per-call ABCI delays mimicking app computation time,
     # applied by the external e2e app process
     # (ref: manifest.go:80-86 *DelayMS fields)
@@ -67,6 +70,7 @@ class Manifest:
             load_tx_rate=int(doc.get("load_tx_rate", 10)),
             initial_height=int(doc.get("initial_height", 1)),
             snapshot_interval=int(doc.get("snapshot_interval", 0)),
+            vote_extensions_enable_height=int(doc.get("vote_extensions_enable_height", 0)),
             prepare_proposal_delay_ms=int(doc.get("prepare_proposal_delay_ms", 0)),
             process_proposal_delay_ms=int(doc.get("process_proposal_delay_ms", 0)),
             check_tx_delay_ms=int(doc.get("check_tx_delay_ms", 0)),

@@ -144,10 +144,13 @@ class Runner:
         # manifests shorten timeouts the same way)
         import dataclasses
 
-        from ..types.params import ConsensusParams, TimeoutParams
+        from ..types.params import ABCIParams, ConsensusParams, TimeoutParams
 
         gen_doc.consensus_params = dataclasses.replace(
             ConsensusParams(),
+            abci=ABCIParams(
+                vote_extensions_enable_height=self.manifest.vote_extensions_enable_height
+            ),
             timeout=TimeoutParams(
                 propose=600_000_000,
                 propose_delta=200_000_000,

@@ -627,6 +627,32 @@ class NodeInfoProto(Message):
     ]
 
 
+class ExtendedCommitSig(Message):
+    """CommitSig + vote extension data (types.proto:155-165)."""
+
+    fields = [
+        Field(1, "enum", "block_id_flag"),
+        Field(2, "bytes", "validator_address"),
+        Field(3, "message", "timestamp", always_emit=True, msg_cls=Timestamp),
+        Field(4, "bytes", "signature"),
+        Field(5, "bytes", "extension"),
+        Field(6, "bytes", "extension_signature"),
+    ]
+
+
+class ExtendedCommit(Message):
+    """Commit whose signatures retain vote extensions
+    (types.proto:145-151) — persisted and gossiped so extended vote
+    sets can be reconstructed after the fact."""
+
+    fields = [
+        Field(1, "int64", "height"),
+        Field(2, "int32", "round"),
+        Field(3, "message", "block_id", always_emit=True, msg_cls=BlockID),
+        Field(4, "message", "extended_signatures", repeated=True, msg_cls=ExtendedCommitSig),
+    ]
+
+
 # ------------------------------------------------------------- blocksync wire
 # ref: proto/tendermint/blocksync/types.proto
 
@@ -640,8 +666,11 @@ class BlocksyncNoBlockResponse(Message):
 
 
 class BlocksyncBlockResponse(Message):
-    # field 2 (ext_commit) is reserved for vote-extension heights
-    fields = [Field(1, "message", "block", msg_cls=Block)]
+    fields = [
+        Field(1, "message", "block", msg_cls=Block),
+        # populated for vote-extension heights (blocksync/types.proto:23)
+        Field(2, "message", "ext_commit", msg_cls=ExtendedCommit),
+    ]
 
 
 class BlocksyncStatusRequest(Message):

@@ -42,7 +42,10 @@ class Dispatcher:
             return  # unsolicited
         want_height, q = entry
         if payload is not None and height_of(payload) != want_height:
-            payload = None  # wrong height = untrustworthy peer; treat as miss
+            # a late reply to an earlier timed-out request: drop it and
+            # keep waiting — turning it into a miss would let one slow
+            # response poison every subsequent request to this peer
+            return
         q.put(payload)
 
     def _on_light_block(self, peer_id: str, lb) -> None:

@@ -301,10 +301,18 @@ class StateSyncReactor:
             msg, nid = env.message, env.from_
             try:
                 if isinstance(msg, ParamsRequest):
+                    # serve ONLY params actually recorded for that height —
+                    # labeling our latest params with the requested height
+                    # would hand a statesyncing peer wrong params and fork
+                    # it at the first divergence (the requester treats the
+                    # label as authoritative)
                     params = self.state_store.load_consensus_params(msg.height)
                     if params is None:
                         state = self.state_store.load()
-                        params = state.consensus_params if state else None
+                        if state is not None and state.last_block_height <= msg.height:
+                            # at/above our tip the current params ARE the
+                            # params for that height
+                            params = state.consensus_params
                     if params is not None:
                         ch.send_to(nid, ParamsResponse(msg.height, params), timeout=1.0)
                 elif isinstance(msg, ParamsResponse):

@@ -90,9 +90,13 @@ class BlockStore:
 
     # ------------------------------------------------------------- writes
 
-    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit) -> None:
-        """ref: store.go SaveBlock. Parts are stored individually so the
-        consensus reactor can serve part-gossip straight from disk."""
+    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit,
+                   extended_votes=None) -> None:
+        """ref: store.go SaveBlock / SaveBlockWithExtendedCommit. Parts
+        are stored individually so the consensus reactor can serve
+        part-gossip straight from disk. extended_votes (precommit Vote
+        list incl. extensions) is written in the SAME batch so a crash
+        cannot separate the block from its extended commit."""
         if block is None:
             raise ValueError("BlockStore can only save a non-nil block")
         height = block.header.height
@@ -116,11 +120,40 @@ class BlockStore:
                 batch.set(_h(KEY_PART, height) + b":" + i.to_bytes(4, "big"), part.to_proto().encode())
             batch.set(_h(KEY_COMMIT, height - 1), block.last_commit.to_proto().encode() if block.last_commit else b"")
             batch.set(_h(KEY_SEEN_COMMIT, height), seen_commit.to_proto().encode())
+            if extended_votes is not None:
+                from ..types.vote import extended_commit_from_votes
+
+                ec = extended_commit_from_votes(extended_votes)
+                if ec is not None:
+                    batch.set(_h(KEY_EXT_COMMIT, height), ec.encode())
             batch.write()
             if self._base == 0:
                 self._base = height
             self._height = height
             self._save_state()
+
+    def save_extended_commit_proto(self, height: int, ec) -> None:
+        """Store an ExtendedCommit received over the wire (blocksync's
+        BlockResponse.ext_commit) so this node can itself serve
+        extension-aware catch-up gossip for heights it never committed
+        through consensus."""
+        self._db.set(_h(KEY_EXT_COMMIT, height), ec.encode())
+
+    def load_extended_commit(self, height: int):
+        """Precommit votes WITH extensions, or None
+        (ref: store.go LoadBlockExtendedCommit)."""
+        from ..types.vote import votes_from_extended_commit
+
+        raw = self._db.get(_h(KEY_EXT_COMMIT, height))
+        if raw is None:
+            return None
+        return votes_from_extended_commit(pb.ExtendedCommit.decode(raw))
+
+    def load_extended_commit_proto(self, height: int):
+        raw = self._db.get(_h(KEY_EXT_COMMIT, height))
+        if raw is None:
+            return None
+        return pb.ExtendedCommit.decode(raw)
 
     def save_seen_commit(self, height: int, seen_commit: Commit) -> None:
         with self._mu:
@@ -190,6 +223,7 @@ class BlockStore:
                 if meta is None:
                     continue
                 batch.delete(_h(KEY_META, h))
+                batch.delete(_h(KEY_EXT_COMMIT, h))
                 batch.delete(KEY_BY_HASH + meta.block_id.hash)
                 for i in range(meta.block_id.part_set_header.total):
                     batch.delete(_h(KEY_PART, h) + b":" + i.to_bytes(4, "big"))

@@ -322,3 +322,48 @@ def test_blocksync_verify_ahead_detects_tampering():
     assert reactor._verify_ahead is not None
     assert reactor._try_sync_one() is False  # ahead completion raises
     assert errors and errors[0].node_id == peer
+
+
+def test_blocksync_carries_extended_commits():
+    """Blocks synced through extension-enabled heights arrive with their
+    ExtendedCommit and the syncing node persists it, so it can itself
+    serve extension-aware catch-up gossip later (ref: blocksync
+    BlockResponse.ext_commit, store SaveBlockWithExtendedCommit)."""
+    import dataclasses
+
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), abci=ABCIParams(vote_extensions_enable_height=2)
+    )
+    source = make_node(keys, 0, gen_doc)
+    source.start()
+    try:
+        assert wait_for_height([source], 5, timeout=60)
+    finally:
+        source.stop()
+    src_height = source.block_store.height()
+    assert source.block_store.load_extended_commit(3), "source has no ext commit"
+
+    fresh = make_node(keys, 0, gen_doc)
+    errors = []
+    reactor = _stub_reactor(fresh, errors)
+    peer = "cc" * 20
+    reactor.pool.set_peer_range(peer, 1, src_height)
+    reactor.pool._fill_requests()
+    for h in range(1, src_height + 1):
+        reactor.pool.add_block(
+            peer,
+            source.block_store.load_block(h),
+            ext_commit=source.block_store.load_extended_commit_proto(h),
+        )
+    for _ in range(src_height - 1):
+        assert reactor._try_sync_one() is True
+    assert not errors
+    # the synced node persisted the extended commits for served heights
+    for h in range(2, src_height - 1):
+        votes = fresh.block_store.load_extended_commit(h)
+        assert votes, f"no extended commit persisted at {h}"
+        assert any(v is not None and v.extension_signature for v in votes)

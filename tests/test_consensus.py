@@ -281,3 +281,49 @@ def test_wal_legacy_suffix_migration(tmp_path):
     assert heights == list(range(1, 15)), heights
     assert not os.path.exists(path + ".000")
     w2.close()
+
+
+def test_chain_advances_with_vote_extensions_enabled():
+    """Vote extensions activating at height 2 must not halt the chain:
+    precommits carry extensions + extension signatures, extended vote
+    sets verify them, and the extended commit is persisted for catch-up
+    gossip (regression: extended precommits were rejected by plain vote
+    sets — 'unexpected vote extension data' — halting every chain at
+    the activation height)."""
+    import dataclasses
+
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-vx")
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), abci=ABCIParams(vote_extensions_enable_height=2)
+    )
+    nodes = [make_node(keys, i, gen_doc) for i in range(4)]
+
+    def wire(sender_idx):
+        def fan_out(msg):
+            for j, other in enumerate(nodes):
+                if j != sender_idx:
+                    other.add_peer_message(msg, peer_id=f"node{sender_idx}")
+        return fan_out
+
+    for i, n in enumerate(nodes):
+        n.broadcast = wire(i)
+    for n in nodes:
+        n.start()
+    try:
+        assert wait_for_height(nodes, 5, timeout=60), (
+            f"stalled at {[n.rs.height for n in nodes]}"
+        )
+        n0 = nodes[0]
+        # precommits at an extension height carried extension signatures
+        ext_votes = n0.block_store.load_extended_commit(3)
+        assert ext_votes, "extended commit was not persisted"
+        assert any(v.extension_signature for v in ext_votes if v is not None)
+        # plain commits are stored extension-free as always
+        commit = n0.block_store.load_block_commit(3)
+        assert commit is not None
+    finally:
+        for n in nodes:
+            n.stop()

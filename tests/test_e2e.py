@@ -16,6 +16,7 @@ from tendermint_tpu.e2e import Manifest, Runner
 MANIFEST = """
 chain_id = "e2e-test"
 load_tx_rate = 15
+vote_extensions_enable_height = 2
 
 [node.validator01]
 perturb = ["kill"]
@@ -38,6 +39,7 @@ def test_manifest_parse():
     m = Manifest.parse(MANIFEST)
     assert m.chain_id == "e2e-test"
     assert len(m.nodes) == 4 and len(m.validators) == 4
+    assert m.vote_extensions_enable_height == 2
     assert m.nodes[0].perturb == ["kill"]
     assert m.nodes[2].abci_protocol == "grpc"
     assert m.nodes[3].abci_protocol == "tcp"
