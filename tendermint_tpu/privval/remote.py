@@ -343,7 +343,12 @@ class SignerServer:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=2)
+            # a signer thread outliving stop() can redial an ephemeral
+            # port later reused by an unrelated validator (observed as a
+            # rare cross-test flake) — wait for real thread death
+            self._thread.join(timeout=2 * DEFAULT_TIMEOUT_READ_WRITE + 2)
+            if self._thread.is_alive():
+                self.logger.error("signer thread did not exit cleanly")
 
     def _dial(self):
         family, sockaddr, is_tcp = _parse_addr(self.addr)
@@ -361,6 +366,9 @@ class SignerServer:
                 retries += 1
                 time.sleep(self.retry_wait)
                 continue
+            if self._stop.is_set():
+                conn.close()
+                return
             retries = 0
             self.logger.info("connected to validator", addr=self.addr)
             try:
