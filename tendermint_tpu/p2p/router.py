@@ -18,7 +18,7 @@ from __future__ import annotations
 import queue
 import threading
 import traceback
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .channel import Channel
 from .conn_tracker import ConnTracker

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import queue
 import socket
-import struct
+
 import threading
 import time
 from typing import Any

@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..crypto.merkle import Proof, proofs_from_byte_slices
 from ..proto import messages as pb
