@@ -1,78 +1,111 @@
 """North-star benchmark: ed25519 batch-verify sigs/sec on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints JSON lines {"metric", "value", "unit", "vs_baseline"}; the LAST
+line is the result (the driver parses the final JSON line, so the bench
+banks a small-batch number early and overwrites it as larger batches
+succeed).
+
+Failure-mode design (BENCH_r02/r03 postmortem — the tunnel to the chip
+is flaky and a killed mid-claim process wedges the device grant):
+  - ONE process, ONE device claim. No subprocess cascade: each child
+    re-claimed the tunnel and was timeout-killed, wedging the grant for
+    every later attempt.
+  - Smallest batch FIRST. Batch 256's kernel compile is in .jax_cache
+    from a prior chip session, so the first number lands within seconds
+    of a successful claim; larger batches only ever improve the banked
+    line.
+  - In-process deadlines (SIGALRM -> exception), never SIGKILL. If a
+    stage overruns we stop attempting bigger batches and exit 0 with
+    whatever is banked; the JAX client shuts down cleanly and releases
+    the grant.
 
 The measured path is the full device pipeline (ops/verify.py):
 decompression + [s]B - [k]A - R + cofactor clear for every signature,
-with host-side SHA-512 challenge prep excluded from neither side — both
-the TPU path and the CPU baseline verify the same (pubkey, msg, sig)
-triples end to end.
+pipelined (host prep + uint8 H2D of batch i+1 overlap compute of batch
+i) — the production mode, where blocksync feeds the chip a stream of
+per-height commit batches.
 
 The CPU baseline is a native single-signature verifier loop: the
-`cryptography` package's Ed25519 (OpenSSL) if available — the closest
-stand-in for the reference's Go curve25519-voi serial path
+`cryptography` package's Ed25519 (OpenSSL) — the closest stand-in for
+the reference's Go curve25519-voi serial path
 (crypto/ed25519/ed25519.go Verify) — else the pure-Python oracle.
 """
 
 import json
 import os
+import signal
 import sys
 import time
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _ROOT)
 
+BATCHES = (256, 1024, 2048, 8192)
+BUDGET = float(os.environ.get("BENCH_BUDGET", "840"))
+PIPELINE_ITERS = int(os.environ.get("BENCH_ITERS", "8"))
+_T0 = time.monotonic()
 
-def _enable_compile_cache():
+
+def _remaining():
+    return BUDGET - (time.monotonic() - _T0)
+
+
+def _log(msg):
+    print(f"# [{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+class StageTimeout(Exception):
+    pass
+
+
+def _alarm_handler(signum, frame):
+    raise StageTimeout()
+
+
+class stage_deadline:
+    """Best-effort in-process deadline: SIGALRM raises StageTimeout in
+    the main thread. Cannot interrupt a C call that never returns to the
+    interpreter, but never SIGKILLs the process — the device grant is
+    released by normal JAX client shutdown on exit."""
+
+    def __init__(self, seconds):
+        self.seconds = max(1.0, seconds)
+
+    def __enter__(self):
+        signal.signal(signal.SIGALRM, _alarm_handler)
+        signal.setitimer(signal.ITIMER_REAL, self.seconds)
+
+    def __exit__(self, *exc):
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        return False
+
+
+def _enable_compile_cache(jax):
     """Persistent XLA compile cache: repeat driver runs skip the heavy
     curve-kernel compile entirely (same setup as __graft_entry__.py)."""
-    import jax
-
     jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
-_enable_compile_cache()
-
-BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
-CPU_SAMPLE = 256
-
-
-def make_jobs(n):
+def make_jobs(jobs, n):
+    """Extend (pks, msgs, sigs) lists in place up to n entries."""
     from tendermint_tpu.crypto import ed25519_ref as ref
 
-    pks, msgs, sigs = [], [], []
+    pks, msgs, sigs = jobs
     sk = ref.gen_privkey(b"\x42" * 32)
     pk = sk[32:]
-    for i in range(n):
+    for i in range(len(sigs), n):
         msg = b"bench-commit-vote-%d" % i
         pks.append(pk)
         msgs.append(msg)
         sigs.append(ref.sign(sk, msg))
-    return pks, msgs, sigs
+    return jobs
 
 
-def bench_device(pks, msgs, sigs):
-    from tendermint_tpu.ops import verify as V
-
-    # Warm-up launch compiles the program; measure steady state.
-    V.verify_batch(pks, msgs, sigs)
-    # Throughput is measured pipelined: every iteration pays full host
-    # prep + uint8 H2D + kernel, but iterations are dispatched async so
-    # transfers overlap compute (the production mode: blocksync feeds
-    # the chip a stream of per-height commit batches). Sync once at end.
-    iters = int(os.environ.get("BENCH_ITERS", "8"))
-    t0 = time.perf_counter()
-    inflight = [V.verify_batch_async(pks, msgs, sigs) for _ in range(iters)]
-    bitmaps = [V.collect(d) for d in inflight]
-    dt = (time.perf_counter() - t0) / iters
-    assert all(bool(b.all()) for b in bitmaps), "device rejected valid signatures"
-    return len(sigs) / dt
-
-
-def bench_cpu(pks, msgs, sigs):
-    n = min(CPU_SAMPLE, len(sigs))
+def bench_cpu(jobs):
+    pks, msgs, sigs = jobs
+    n = len(sigs)
     try:
         from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
         from cryptography.exceptions import InvalidSignature
@@ -96,17 +129,33 @@ def bench_cpu(pks, msgs, sigs):
     return n / dt
 
 
-def run_once():
-    pks, msgs, sigs = make_jobs(BATCH)
-    device_rate = bench_device(pks, msgs, sigs)
-    cpu_rate = bench_cpu(pks, msgs, sigs)
+def bench_device(jobs, batch):
+    from tendermint_tpu.ops import verify as V
+
+    pks, msgs, sigs = jobs
+    pks, msgs, sigs = pks[:batch], msgs[:batch], sigs[:batch]
+    # Warm-up launch compiles the program (cached across runs); measure
+    # steady-state pipelined throughput: every iteration pays full host
+    # prep + uint8 H2D + kernel, iterations dispatched async so
+    # transfers overlap compute. Sync once at end.
+    bitmap = V.verify_batch(pks, msgs, sigs)
+    assert bool(bitmap.all()), "device rejected valid signatures (warm-up)"
+    t0 = time.perf_counter()
+    inflight = [V.verify_batch_async(pks, msgs, sigs) for _ in range(PIPELINE_ITERS)]
+    bitmaps = [V.collect(d) for d in inflight]
+    dt = (time.perf_counter() - t0) / PIPELINE_ITERS
+    assert all(bool(b.all()) for b in bitmaps), "device rejected valid signatures"
+    return batch / dt
+
+
+def emit(rate, cpu_rate):
     print(
         json.dumps(
             {
                 "metric": "ed25519_batch_verify_throughput",
-                "value": round(device_rate, 1),
+                "value": round(rate, 1),
                 "unit": "sigs/sec/chip",
-                "vs_baseline": round(device_rate / cpu_rate, 3),
+                "vs_baseline": round(rate / cpu_rate, 3),
             }
         ),
         flush=True,
@@ -114,34 +163,55 @@ def run_once():
 
 
 def main():
-    """Cascade batch sizes in subprocesses with individual time budgets:
-    if the big-batch compile goes pathological on the chip, a smaller
-    batch still produces an honest device measurement instead of a hang
-    (BENCH_r02 lesson). BENCH_ONESHOT short-circuits to a single run."""
-    if os.environ.get("BENCH_ONESHOT"):
-        run_once()
-        return
-    import subprocess
+    jobs = ([], [], [])
 
-    for batch, budget in ((BATCH, 360), (2048, 240), (1024, 180), (256, 120)):
-        env = dict(os.environ, BENCH_ONESHOT="1", BENCH_BATCH=str(batch))
+    # Stage 1 (no device): ALL job generation (pure-Python signing,
+    # ~2.4ms/sig) happens before the claim — window seconds are scarce
+    # and must be spent on device work only. CPU baseline likewise.
+    make_jobs(jobs, BATCHES[-1])
+    cpu_rate = bench_cpu(jobs)
+    _log(f"cpu baseline (n={len(jobs[2])}): {cpu_rate:,.0f} sigs/s")
+
+    # Stage 2: claim the device ONCE. jax backend init may hang in C if
+    # the tunnel is wedged; nothing can cleanly interrupt that, so no
+    # point arming an alarm we can't honor — but if it returns we know
+    # immediately whether we are on a real accelerator.
+    import jax
+
+    _enable_compile_cache(jax)
+    _log("claiming device (jax.devices())...")
+    dev = jax.devices()[0]
+    _log(f"claimed: {dev.platform}:{dev.device_kind}")
+
+    # Stage 3: bank batches smallest-first; each success re-emits the
+    # best rate so far. A stage timeout or error stops escalation but
+    # keeps everything already banked.
+    best = 0.0
+    for batch in BATCHES:
+        rem = _remaining()
+        if best and rem < 60:
+            _log(f"budget exhausted ({rem:.0f}s left); stopping at banked result")
+            break
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, timeout=budget, capture_output=True, text=True,
-            )
-        except subprocess.TimeoutExpired:
-            print(f"# batch {batch} exceeded {budget}s; retrying smaller", file=sys.stderr)
-            continue
-        line = next(
-            (ln for ln in (proc.stdout or "").splitlines() if ln.startswith("{")), None
-        )
-        if proc.returncode == 0 and line:
-            print(line, flush=True)
-            return
-        print(f"# batch {batch} failed rc={proc.returncode}: {(proc.stderr or '')[-400:]}",
-              file=sys.stderr)
-    sys.exit(1)
+            with stage_deadline(rem - 15 if best else rem):
+                make_jobs(jobs, batch)
+                rate = bench_device(jobs, batch)
+        except StageTimeout:
+            _log(f"batch {batch} hit stage deadline; stopping escalation")
+            break
+        except Exception as e:  # noqa: BLE001 - bank what we have
+            _log(f"batch {batch} failed: {type(e).__name__}: {e}")
+            break
+        _log(f"batch {batch}: {rate:,.0f} sigs/s pipelined")
+        if rate > best:
+            best = rate
+            emit(best, cpu_rate)
+    if best:
+        # Re-emit so the final stdout line is the best banked number
+        # regardless of any later stderr interleaving in the driver's
+        # captured tail.
+        emit(best, cpu_rate)
+    sys.exit(0 if best else 1)
 
 
 if __name__ == "__main__":

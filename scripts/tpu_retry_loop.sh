@@ -1,0 +1,23 @@
+#!/bin/bash
+# Gentle TPU claim loop: attempts scripts/tpu_window.py with NO external
+# timeout (a killed mid-claim process wedges the device grant; a failed
+# claim errors naturally after ~25-27 min). Stop it by touching
+# /tmp/tpu_stop — checked between attempts only, so an in-flight claim
+# always completes or fails on its own.
+LOG=${TPU_WINDOW_LOG:-/tmp/tpu_window_log.txt}
+ATTEMPTS=${TPU_ATTEMPTS:-24}
+cd "$(dirname "$0")/.."
+for i in $(seq 1 "$ATTEMPTS"); do
+    if [ -e /tmp/tpu_stop ]; then
+        echo "=== stopfile present; exiting ===" >> "$LOG"
+        exit 0
+    fi
+    echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+    if python scripts/tpu_window.py >> "$LOG" 2>&1; then
+        echo "=== SUCCESS attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+        exit 0
+    fi
+    echo "=== attempt $i failed $(date -u +%H:%M:%S) ===" >> "$LOG"
+    sleep 60
+done
+echo "=== attempts exhausted ===" >> "$LOG"

@@ -1,0 +1,186 @@
+"""Everything to measure in ONE tunnel window, ONE device claim.
+
+The axon tunnel works in short windows (r3: ~3 minutes over 12 hours),
+so this script banks results in strictly decreasing value-per-second
+order and flushes after every line:
+
+  A. dot-mode sweep (compile cached from prior windows): device-only
+     rates at 256..8192, H2D bandwidth, pipelined end-to-end at max
+     batch — the numbers bench.py needs to be believed.
+  B. small-batch launch latency (end-to-end verify_batch at n=4..128)
+     -> derives DEVICE_BATCH_CUTOVER from real chip data.
+  C. slice-mode A/B at batch 256 (uncached compile, riskiest, last):
+     settles dot-vs-slice on the MXU.
+
+Stages use SIGALRM deadlines (best-effort: cannot interrupt a hung C
+call) and never kill the process — a wedged stage just stops escalation
+so the banked lines survive.
+
+Usage: python scripts/tpu_window.py   (claims the device; run via
+scripts/tpu_retry_loop.sh which never timeout-kills a claim).
+"""
+
+import os
+import signal
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+class StageTimeout(Exception):
+    pass
+
+
+def _alarm(signum, frame):
+    raise StageTimeout()
+
+
+signal.signal(signal.SIGALRM, _alarm)
+
+
+class deadline:
+    def __init__(self, seconds):
+        self.seconds = max(1.0, seconds)
+
+    def __enter__(self):
+        signal.setitimer(signal.ITIMER_REAL, self.seconds)
+
+    def __exit__(self, *exc):
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        return False
+
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import field as F
+from tendermint_tpu.ops import verify as V
+
+# All host-side work BEFORE the device claim: window seconds are scarce.
+MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
+sk = ref.gen_privkey(b"\x42" * 32)
+pk = sk[32:]
+pks, msgs, sigs = [], [], []
+for i in range(MAX_B):
+    m = b"bench-commit-vote-%d" % i
+    pks.append(pk)
+    msgs.append(m)
+    sigs.append(ref.sign(sk, m))
+
+t0 = time.time()
+a, r, s, k, pre = V.prepare_batch(pks, msgs, sigs)
+log(f"host prep {MAX_B}: {time.time()-t0:.3f}s ({MAX_B/(time.time()-t0):,.0f} sigs/s)")
+
+log("claiming device (jax.devices())...")
+dev = jax.devices()[0]
+log(f"claimed: {dev.platform}:{dev.device_kind}")
+
+
+def device_only(kernel, B, iters=10):
+    da = jnp.asarray(a[:B]); dr = jnp.asarray(r[:B])
+    ds = jnp.asarray(s[:B]); dk = jnp.asarray(k[:B])
+    t0 = time.time()
+    out = kernel(da, dr, ds, dk)
+    jax.block_until_ready(out)
+    t_compile = time.time() - t0
+    assert bool(np.asarray(out).all()), f"kernel rejected valid sigs at B={B}"
+    t0 = time.time()
+    for _ in range(iters):
+        out = kernel(da, dr, ds, dk)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / iters
+    return t_compile, dt
+
+
+# ---- Phase A: dot-mode sweep (cached compiles; the must-bank data) ----
+try:
+    with deadline(600):
+        for B in (256, 1024, 2048, 4096, 8192):
+            if B > MAX_B:
+                break
+            t_c, dt = device_only(V.verify_kernel, B)
+            log(f"A dot B={B:5d}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+                f"device-only {B/dt:12,.0f} sigs/s")
+        for mb in (1, 4):
+            buf = np.zeros((mb << 20,), np.uint8)
+            jax.block_until_ready(jnp.asarray(buf))
+            t0 = time.time()
+            outs = [jnp.asarray(buf) for _ in range(4)]
+            jax.block_until_ready(outs)
+            dt = (time.time() - t0) / 4
+            log(f"A H2D {mb}MB: {dt*1000:7.1f}ms = {mb/dt:8.1f} MB/s")
+        B = MAX_B
+        t0 = time.time()
+        for _ in range(3):
+            ok = V.verify_batch(pks, msgs, sigs)
+        dt = (time.time() - t0) / 3
+        log(f"A end-to-end sync      B={B}: {dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+        iters = 8
+        t0 = time.time()
+        inflight = [V.verify_batch_async(pks, msgs, sigs) for _ in range(iters)]
+        outs = [V.collect(d) for d in inflight]
+        dt = (time.time() - t0) / iters
+        assert all(bool(o.all()) for o in outs)
+        log(f"A end-to-end pipelined B={B}: {dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+except StageTimeout:
+    log("A TIMED OUT mid-phase; continuing to B with what we have")
+except Exception as e:  # noqa: BLE001
+    log(f"A failed: {type(e).__name__}: {e}")
+
+# ---- Phase B: small-batch end-to-end latency -> cutover derivation ----
+try:
+    with deadline(420):
+        for n in (4, 64, 8, 16, 32, 128):  # current-cutover shapes first
+            sub = (pks[:n], msgs[:n], sigs[:n])
+            t0 = time.time()
+            ok = V.verify_batch(*sub)
+            t_first = time.time() - t0
+            assert bool(ok.all())
+            t0 = time.time()
+            for _ in range(20):
+                ok = V.verify_batch(*sub)
+            dt = (time.time() - t0) / 20
+            log(f"B n={n:4d}  first {t_first:7.2f}s  steady {dt*1000:8.3f}ms/call  "
+                f"({n/dt:10,.0f} sigs/s)")
+except StageTimeout:
+    log("B TIMED OUT mid-phase")
+except Exception as e:  # noqa: BLE001
+    log(f"B failed: {type(e).__name__}: {e}")
+
+# ---- Phase C: slice-mode A/B at 256 (uncached compile risk; last) ----
+try:
+    with deadline(420):
+        F._FE_MUL_MODE = "slice"
+        slice_kernel = jax.jit(V.verify_kernel_impl)
+        t_c, dt = device_only(slice_kernel, 256)
+        log(f"C slice B=256  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+            f"device-only {256/dt:12,.0f} sigs/s")
+        for B in (1024, 8192):
+            if B > MAX_B:
+                break
+            t_c, dt = device_only(slice_kernel, B)
+            log(f"C slice B={B:5d}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+                f"device-only {B/dt:12,.0f} sigs/s")
+except StageTimeout:
+    log("C TIMED OUT (slice compile too slow on chip — dot stays default)")
+except Exception as e:  # noqa: BLE001
+    log(f"C failed: {type(e).__name__}: {e}")
+finally:
+    F._FE_MUL_MODE = os.environ.get("TM_TPU_FE_MUL", "dot")
+
+log("window complete")
