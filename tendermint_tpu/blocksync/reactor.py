@@ -313,14 +313,133 @@ class BlockSyncReactor:
                 self.channel.send_error(PeerError(node_id=first_peer, err=e))
             return False
 
+        height = first.header.height
+        ec = self.pool.take_ext_commit(height)
+        if self.state.consensus_params.abci.vote_extensions_enabled(height):
+            err = self._validate_ext_commit(
+                ec, height, first_id, self.state.validators, self.state.chain_id
+            )
+            if err is not None:
+                # A missing or malformed extended commit at a
+                # vote-extension height is a peer fault: without it the
+                # synced node could never serve extension-aware catch-up
+                # gossip. Re-request the height from another peer
+                # (ref: reactor.go:549-553, 590).
+                peer = self.pool.redo_request(height)
+                if peer is not None:
+                    self.channel.send_error(PeerError(node_id=peer, err=err))
+                return False
+        else:
+            ec = None  # extensions disabled at this height: nothing to persist
+
         self.pool.pop_request()
-        ec = self.pool.take_ext_commit(first.header.height)
-        self.block_store.save_block(first, first_parts, second.last_commit)
-        if ec is not None:
-            self.block_store.save_extended_commit_proto(first.header.height, ec)
+        # Block and extended commit ride one DB batch: a crash between
+        # separate writes would leave a block whose restart
+        # reconstruction (consensus/state.py) requires an EC that is
+        # not there — a permanent halt.
+        self.block_store.save_block(
+            first, first_parts, second.last_commit, extended_commit=ec
+        )
         self.state = self.block_exec.apply_block(self.state, first_id, first)
         self.blocks_synced += 1
         return True
+
+    def _validate_ext_commit(self, ec, height: int, first_id, vals=None,
+                             chain_id: str = "") -> Exception | None:
+        """A block at a vote-extension height MUST carry an
+        ExtendedCommit whose height/block_id match the verified block
+        and whose COMMIT signatures all carry extension signatures
+        (ref: reactor.go:549-553 refuses a missing one; EnsureExtensions
+        at reactor.go:590 before SaveBlockWithExtendedCommit).
+
+        When the validator set is supplied, the commit is then verified
+        CRYPTOGRAPHICALLY by replaying it through an extensions-checking
+        VoteSet requiring +2/3 for the block — an unverified EC on disk
+        is a poison pill: the next restart rebuilds last_commit from it
+        and halts forever if it was forged."""
+        from ..types.block import BLOCK_ID_FLAG_COMMIT, BlockID
+
+        if ec is None:
+            return ValueError(
+                f"block {height} at vote-extension height arrived without extended commit"
+            )
+        if (ec.height or 0) != height:
+            return ValueError(f"extended commit height {ec.height or 0} != block height {height}")
+        if BlockID.from_proto(ec.block_id) != first_id:
+            return ValueError("extended commit block_id does not match verified block")
+        for i, sig in enumerate(ec.extended_signatures or []):
+            flag = sig.block_id_flag or 0
+            if flag == BLOCK_ID_FLAG_COMMIT:
+                if not (sig.extension_signature or b""):
+                    return ValueError(f"extended commit signature {i} missing extension signature")
+            elif (sig.extension or b"") or (sig.extension_signature or b""):
+                return ValueError(f"extended commit signature {i} has unexpected extension data")
+        if vals is None:
+            return None
+        from ..crypto import batch as crypto_batch
+        from ..types.block import Commit, CommitSig
+        from ..types.validation import verify_commit
+        from ..types.vote import votes_from_extended_commit
+        from ..utils.tmtime import Time
+
+        sigs = ec.extended_signatures or []
+        if len(sigs) != vals.size():
+            return ValueError(
+                f"extended commit has {len(sigs)} signature slots, validator set has {vals.size()}"
+            )
+        # Vote signatures: check ALL of them (not just a 2/3 prefix —
+        # restart reconstruction re-verifies every persisted vote, so an
+        # unverified tail would be an on-disk poison) through the same
+        # batch/device plane the sync pipeline already uses.
+        commit = Commit(
+            height=ec.height or 0,
+            round=ec.round or 0,
+            block_id=BlockID.from_proto(ec.block_id),
+            signatures=[
+                CommitSig(
+                    block_id_flag=s.block_id_flag or 0,
+                    validator_address=s.validator_address or b"",
+                    timestamp=Time((s.timestamp or pb.Timestamp()).seconds or 0,
+                                   (s.timestamp or pb.Timestamp()).nanos or 0),
+                    signature=s.signature or b"",
+                )
+                for s in sigs
+            ],
+        )
+        try:
+            verify_commit(chain_id, vals, first_id, height, commit)
+        except Exception as e:
+            return ValueError(f"extended commit votes failed verification: {e}")
+        # Extension signatures (COMMIT slots only), batched likewise.
+        votes = votes_from_extended_commit(ec)
+        ext_jobs = []
+        for idx, v in enumerate(votes):
+            if v is None:
+                continue
+            # Address must match the slot for NIL votes too — restart
+            # reconstruction (VoteSet.add_vote) rejects mismatches, so
+            # letting one through here would poison the store.
+            addr, val = vals.get_by_index(idx)
+            if val is None or v.validator_address != addr:
+                return ValueError(f"extended commit signature {idx} has wrong validator address")
+            if v.block_id.is_nil():
+                continue
+            ext_jobs.append((val.pub_key, v.extension_sign_bytes(chain_id), v.extension_signature))
+        if ext_jobs:
+            proposer_pk = ext_jobs[0][0]
+            if crypto_batch.supports_batch_verifier(proposer_pk):
+                bv = crypto_batch.create_batch_verifier(proposer_pk)
+                try:
+                    for pk, msg, sig in ext_jobs:
+                        bv.add(pk, msg, sig)
+                    ok, bits = bv.verify()
+                except ValueError:
+                    ok = all(pk.verify_signature(msg, sig) for pk, msg, sig in ext_jobs)
+            else:
+                ok = all(pk.verify_signature(msg, sig) for pk, msg, sig in ext_jobs)
+            if not ok:
+                return ValueError("extended commit has an invalid extension signature")
+        return None
 
     def _dispatch_verify_ahead(self, second) -> None:
         """Launch the device verification of height h+1's commit while

@@ -562,7 +562,11 @@ class ConsensusReactor:
         vals = self.cs.block_exec.store.load_validators(prs.height)
         if vals is None or not votes:
             return False
-        round_ = votes[0].round
+        # Absent validator slots are None entries; the round must come
+        # from the first PRESENT vote (slot 0 may legitimately be absent).
+        round_ = next((v.round for v in votes if v is not None), None)
+        if round_ is None:
+            return False
         ps.ensure_catchup_commit_round(prs.height, round_, vals.size())
         ps.ensure_vote_bit_arrays(prs.height, vals.size())
         from ..types.vote_set import VoteSet
@@ -571,6 +575,8 @@ class ConsensusReactor:
             self.cs.state.chain_id, prs.height, round_, PRECOMMIT, vals
         )
         for vote in votes:
+            if vote is None:
+                continue
             try:
                 vote_set.add_vote(vote)
             except Exception:

@@ -374,14 +374,42 @@ class ConsensusState:
         self._new_step()
 
     def _reconstruct_last_commit_if_needed(self, state: State) -> None:
-        """Rebuild LastCommit VoteSet from the stored seen commit
-        (ref: reconstructLastCommit state.go:723)."""
+        """Rebuild LastCommit VoteSet from storage (ref:
+        reconstructLastCommit state.go:704-745). When vote extensions
+        were enabled at last_block_height the set MUST be rebuilt from
+        the stored ExtendedCommit via an extensions-verifying vote set —
+        a plain set rebuilt from the seen commit lacks extension
+        signatures, so 1-behind peers' extended precommit sets would
+        reject every gossiped vote."""
         if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        last_vals = self.block_exec.store.load_validators(state.last_block_height)
+        if state.consensus_params.abci.vote_extensions_enabled(state.last_block_height):
+            votes = (
+                self.block_store.load_extended_commit(state.last_block_height)
+                if self.block_store else None
+            )
+            if votes is None:
+                raise ConsensusError(
+                    f"failed to reconstruct last extended commit; extended commit for "
+                    f"height {state.last_block_height} not found"
+                )
+            round_ = next((v.round for v in votes if v is not None), None)
+            if round_ is None:
+                raise ConsensusError("failed to reconstruct last extended commit; all slots absent")
+            vote_set = VoteSet.extended(
+                state.chain_id, state.last_block_height, round_, PRECOMMIT, last_vals
+            )
+            for vote in votes:
+                if vote is not None:
+                    vote_set.add_vote(vote)
+            if not vote_set.has_two_thirds_majority():
+                raise ConsensusError("failed to reconstruct last extended commit; does not have +2/3 maj")
+            self.rs.last_commit = vote_set
             return
         seen = self.block_store.load_seen_commit(state.last_block_height) if self.block_store else None
         if seen is None:
             raise ConsensusError(f"failed to reconstruct last commit; seen commit for height {state.last_block_height} not found")
-        last_vals = self.block_exec.store.load_validators(state.last_block_height)
         vote_set = VoteSet(state.chain_id, seen.height, seen.round, PRECOMMIT, last_vals)
         for idx, cs_sig in enumerate(seen.signatures):
             if cs_sig.absent():
@@ -744,16 +772,16 @@ class ConsensusState:
         if self.block_store.height() < block.header.height:
             precommits = rs.votes.precommits(rs.commit_round)
             seen_commit = precommits.make_commit()
-            # extended votes ride in the same batch as the block: catch-up
-            # gossip must serve votes an EXTENDED vote set accepts
-            # (commit-derived votes lack extension signatures) — ref:
-            # SaveBlockWithExtendedCommit
+            # The extended commit rides in the same batch as the block:
+            # catch-up gossip must serve votes an EXTENDED vote set
+            # accepts (commit-derived votes lack extension signatures) —
+            # ref: SaveBlockWithExtendedCommit
             ext = (
-                precommits.votes
+                precommits.make_extended_commit()
                 if self.state.consensus_params.abci.vote_extensions_enabled(height)
                 else None
             )
-            self.block_store.save_block(block, block_parts, seen_commit, extended_votes=ext)
+            self.block_store.save_block(block, block_parts, seen_commit, extended_commit=ext)
 
         # EndHeight implies the block store saved the block; crash before
         # this replays from the WAL, crash after replays via ApplyBlock in
@@ -850,6 +878,14 @@ class ConsensusState:
         consensus failure and must propagate to halt the node, as the
         reference's panics do."""
         try:
+            # Stateless checks first (ref: msgs.go VoteMessage.ValidateBasic
+            # on the reactor boundary): among other things this rejects
+            # extension data smuggled onto prevotes and nil precommits —
+            # such bytes are outside the vote's sign bytes, so signature
+            # verification alone would accept the tampered vote and the
+            # garbage would end up in our extended commit, which syncing
+            # peers then refuse.
+            vote.validate_basic()
             return self._add_vote(vote, peer_id)
         except ConflictingVoteError as e:
             if self.priv_pub_key is not None and vote.validator_address == self.priv_pub_key.address():

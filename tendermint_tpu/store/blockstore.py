@@ -91,12 +91,13 @@ class BlockStore:
     # ------------------------------------------------------------- writes
 
     def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit,
-                   extended_votes=None) -> None:
+                   extended_commit=None) -> None:
         """ref: store.go SaveBlock / SaveBlockWithExtendedCommit. Parts
         are stored individually so the consensus reactor can serve
-        part-gossip straight from disk. extended_votes (precommit Vote
-        list incl. extensions) is written in the SAME batch so a crash
-        cannot separate the block from its extended commit."""
+        part-gossip straight from disk. extended_commit (pb.ExtendedCommit,
+        from VoteSet.make_extended_commit so its block_id is the maj23
+        block) is written in the SAME batch so a crash cannot separate
+        the block from its extended commit."""
         if block is None:
             raise ValueError("BlockStore can only save a non-nil block")
         height = block.header.height
@@ -120,12 +121,8 @@ class BlockStore:
                 batch.set(_h(KEY_PART, height) + b":" + i.to_bytes(4, "big"), part.to_proto().encode())
             batch.set(_h(KEY_COMMIT, height - 1), block.last_commit.to_proto().encode() if block.last_commit else b"")
             batch.set(_h(KEY_SEEN_COMMIT, height), seen_commit.to_proto().encode())
-            if extended_votes is not None:
-                from ..types.vote import extended_commit_from_votes
-
-                ec = extended_commit_from_votes(extended_votes)
-                if ec is not None:
-                    batch.set(_h(KEY_EXT_COMMIT, height), ec.encode())
+            if extended_commit is not None:
+                batch.set(_h(KEY_EXT_COMMIT, height), extended_commit.encode())
             batch.write()
             if self._base == 0:
                 self._base = height

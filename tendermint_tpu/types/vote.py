@@ -136,40 +136,6 @@ class Vote:
         )
 
 
-def extended_commit_from_votes(votes) -> "pb.ExtendedCommit | None":
-    """Pack a precommit vote list (indexed by validator slot, None =
-    absent) into the ExtendedCommit proto (ref: VoteSet.MakeExtendedCommit,
-    types.proto:145)."""
-    from .block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL
-
-    present = [v for v in votes if v is not None]
-    if not present:
-        return None
-    commit_bid = next((v.block_id for v in present if not v.block_id.is_nil()), None)
-    sigs = []
-    for v in votes:
-        if v is None:
-            sigs.append(pb.ExtendedCommitSig(block_id_flag=BLOCK_ID_FLAG_ABSENT,
-                                             timestamp=pb.Timestamp()))
-            continue
-        flag = BLOCK_ID_FLAG_NIL if v.block_id.is_nil() else BLOCK_ID_FLAG_COMMIT
-        sigs.append(pb.ExtendedCommitSig(
-            block_id_flag=flag,
-            validator_address=v.validator_address,
-            timestamp=pb.Timestamp(seconds=v.timestamp.seconds, nanos=v.timestamp.nanos),
-            signature=v.signature,
-            extension=v.extension,
-            extension_signature=v.extension_signature,
-        ))
-    first = present[0]
-    return pb.ExtendedCommit(
-        height=first.height,
-        round=first.round,
-        block_id=(commit_bid.to_proto() if commit_bid is not None else pb.BlockID()),
-        extended_signatures=sigs,
-    )
-
-
 def votes_from_extended_commit(ec: "pb.ExtendedCommit"):
     """Reconstruct the precommit Vote list an ExtendedCommit encodes
     (ref: ExtendedCommit.ToExtendedVoteSet). Absent slots become None."""

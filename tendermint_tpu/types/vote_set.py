@@ -241,3 +241,51 @@ class VoteSet:
                 sig = CommitSig.new_absent()
             sigs.append(sig)
         return Commit(height=self.height, round=self.round, block_id=self.maj23, signatures=sigs)
+
+    def make_extended_commit(self):
+        """Build the ExtendedCommit proto from 2/3-majority precommits
+        (ref: MakeExtendedCommit, vote_set.go:629-648). Like make_commit,
+        the commit block_id is the +2/3 maj23 block — NOT whatever the
+        first non-nil vote says — and a COMMIT vote for any other block
+        (a conflicting/Byzantine precommit) is demoted to absent, so
+        every persisted signature re-verifies against the commit's
+        block_id on reload and catch-up gossip."""
+        from ..proto import messages as pb
+        from .block import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_NIL
+
+        if self.signed_msg_type != PRECOMMIT:
+            raise ValueError("cannot make_extended_commit() unless VoteSet.Type is Precommit")
+        if self.maj23 is None:
+            raise ValueError("cannot make_extended_commit() unless a blockhash has +2/3")
+        absent = pb.ExtendedCommitSig(block_id_flag=BLOCK_ID_FLAG_ABSENT, timestamp=pb.Timestamp())
+        sigs = []
+        for v in self.votes:
+            if v is None:
+                sigs.append(absent)
+                continue
+            if v.block_id.is_nil():
+                flag = BLOCK_ID_FLAG_NIL
+            elif v.block_id == self.maj23:
+                flag = BLOCK_ID_FLAG_COMMIT
+            else:
+                sigs.append(absent)
+                continue
+            sigs.append(pb.ExtendedCommitSig(
+                block_id_flag=flag,
+                validator_address=v.validator_address,
+                timestamp=pb.Timestamp(seconds=v.timestamp.seconds, nanos=v.timestamp.nanos),
+                signature=v.signature,
+                # Extensions exist only on non-nil precommits; never copy
+                # extension bytes onto a NIL signature (they are outside
+                # the vote's sign bytes, so nothing vouches for them).
+                extension=v.extension if flag == BLOCK_ID_FLAG_COMMIT else b"",
+                extension_signature=(
+                    v.extension_signature if flag == BLOCK_ID_FLAG_COMMIT else b""
+                ),
+            ))
+        return pb.ExtendedCommit(
+            height=self.height,
+            round=self.round,
+            block_id=self.maj23.to_proto(),
+            extended_signatures=sigs,
+        )

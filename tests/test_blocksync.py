@@ -367,3 +367,128 @@ def test_blocksync_carries_extended_commits():
         votes = fresh.block_store.load_extended_commit(h)
         assert votes, f"no extended commit persisted at {h}"
         assert any(v is not None and v.extension_signature for v in votes)
+
+
+def test_validate_ext_commit_rules():
+    """Vote-extension heights refuse blocks whose ExtendedCommit is
+    missing, height-mismatched, block-mismatched, or lacking extension
+    signatures on COMMIT entries (ref: reactor.go:549-553, EnsureExtensions
+    at reactor.go:590)."""
+    from tendermint_tpu.blocksync.reactor import BlockSyncReactor
+    from tendermint_tpu.proto import messages as pb
+    from tendermint_tpu.types import BlockID, PartSetHeader
+    from tendermint_tpu.types.block import (
+        BLOCK_ID_FLAG_ABSENT,
+        BLOCK_ID_FLAG_COMMIT,
+        BLOCK_ID_FLAG_NIL,
+    )
+
+    height = 5
+    first_id = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    check = lambda ec: BlockSyncReactor._validate_ext_commit(object(), ec, height, first_id)
+
+    def make_ec(height=height, block_id=first_id, sigs=None):
+        if sigs is None:
+            sigs = [
+                pb.ExtendedCommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                    validator_address=b"\x01" * 20,
+                    timestamp=pb.Timestamp(),
+                    signature=b"s" * 64,
+                    extension=b"ext",
+                    extension_signature=b"e" * 64,
+                )
+            ]
+        return pb.ExtendedCommit(
+            height=height, round=0, block_id=block_id.to_proto(), extended_signatures=sigs
+        )
+
+    assert check(make_ec()) is None
+    assert check(None) is not None  # missing entirely
+    assert check(make_ec(height=height + 1)) is not None  # wrong height
+    wrong_bid = BlockID(hash=b"\xcc" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    assert check(make_ec(block_id=wrong_bid)) is not None  # wrong block
+    no_ext = pb.ExtendedCommitSig(
+        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+        validator_address=b"\x01" * 20,
+        timestamp=pb.Timestamp(),
+        signature=b"s" * 64,
+    )
+    assert check(make_ec(sigs=[no_ext])) is not None  # COMMIT without ext sig
+    sneaky_nil = pb.ExtendedCommitSig(
+        block_id_flag=BLOCK_ID_FLAG_NIL,
+        validator_address=b"\x01" * 20,
+        timestamp=pb.Timestamp(),
+        signature=b"s" * 64,
+        extension=b"bogus",
+    )
+    assert check(make_ec(sigs=[sneaky_nil])) is not None  # NIL with ext data
+    absent = pb.ExtendedCommitSig(block_id_flag=BLOCK_ID_FLAG_ABSENT, timestamp=pb.Timestamp())
+    assert check(make_ec(sigs=[make_ec().extended_signatures[0], absent])) is None
+
+
+def test_validate_ext_commit_cryptographic():
+    """Shape-valid but forged extended commits must be rejected before
+    persisting: an unverified EC on disk is a poison pill — the next
+    restart rebuilds last_commit from it and halts forever."""
+    from test_types import _make_validators
+
+    from tendermint_tpu.blocksync.reactor import BlockSyncReactor
+    from tendermint_tpu.types import PRECOMMIT, BlockID, PartSetHeader, Vote, VoteSet
+    from tendermint_tpu.utils.tmtime import Time
+
+    chain_id = "vec-chain"
+    vset, privs = _make_validators(4)
+    height, round_ = 5, 0
+    block_id = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    vote_set = VoteSet.extended(chain_id, height, round_, PRECOMMIT, vset)
+    for i in range(4):
+        vote = Vote(
+            type=PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=Time.parse_rfc3339("2024-01-02T03:04:05Z"),
+            validator_address=vset.validators[i].address,
+            validator_index=i,
+            extension=b"ext-%d" % i,
+        )
+        vote.signature = privs[i].sign(vote.sign_bytes(chain_id))
+        vote.extension_signature = privs[i].sign(vote.extension_sign_bytes(chain_id))
+        vote_set.add_vote(vote)
+    ec = vote_set.make_extended_commit()
+
+    check = lambda e: BlockSyncReactor._validate_ext_commit(
+        object(), e, height, block_id, vset, chain_id
+    )
+    assert check(ec) is None  # honest EC verifies
+
+    import copy
+
+    forged = copy.deepcopy(ec)
+    sig = bytearray(forged.extended_signatures[1].extension_signature)
+    sig[0] ^= 0xFF
+    forged.extended_signatures[1].extension_signature = bytes(sig)
+    assert check(forged) is not None  # tampered extension signature
+
+    forged = copy.deepcopy(ec)
+    sig = bytearray(forged.extended_signatures[2].signature)
+    sig[0] ^= 0xFF
+    forged.extended_signatures[2].signature = bytes(sig)
+    assert check(forged) is not None  # tampered vote signature
+
+    from tendermint_tpu.proto import messages as pb
+    from tendermint_tpu.types.block import BLOCK_ID_FLAG_ABSENT
+
+    empty = pb.ExtendedCommit(
+        height=height, round=round_, block_id=block_id.to_proto(), extended_signatures=[]
+    )
+    assert check(empty) is not None  # no power at all
+
+    only_absent = pb.ExtendedCommit(
+        height=height, round=round_, block_id=block_id.to_proto(),
+        extended_signatures=[
+            pb.ExtendedCommitSig(block_id_flag=BLOCK_ID_FLAG_ABSENT, timestamp=pb.Timestamp())
+        ] * 4,
+    )
+    assert check(only_absent) is not None  # slots present, zero power

@@ -327,3 +327,56 @@ def test_chain_advances_with_vote_extensions_enabled():
     finally:
         for n in nodes:
             n.stop()
+
+
+def test_restart_reconstructs_extended_last_commit():
+    """Restarting on a live vote-extension chain must rebuild
+    rs.last_commit from the stored ExtendedCommit via an
+    extensions-verifying vote set (ref: state.go:704-720). A plain set
+    rebuilt from the seen commit lacks extension signatures, so
+    1-behind peers' extended precommit sets would reject every vote we
+    gossip them after the restart."""
+    import dataclasses
+
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-vx-restart")
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), abci=ABCIParams(vote_extensions_enable_height=2)
+    )
+    nodes = [make_node(keys, i, gen_doc) for i in range(4)]
+
+    def wire(sender_idx):
+        def fan_out(msg):
+            for j, other in enumerate(nodes):
+                if j != sender_idx:
+                    other.add_peer_message(msg, peer_id=f"node{sender_idx}")
+        return fan_out
+
+    for i, n in enumerate(nodes):
+        n.broadcast = wire(i)
+    for n in nodes:
+        n.start()
+    try:
+        assert wait_for_height(nodes, 4, timeout=60), (
+            f"stalled at {[n.rs.height for n in nodes]}"
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+    n0 = nodes[0]
+    restarted = ConsensusState(
+        n0.state,
+        n0.block_exec,
+        n0.block_store,
+        priv_validator=FilePV(priv_key=keys[0]),
+    )
+    lc = restarted.rs.last_commit
+    assert lc is not None
+    assert lc.extensions_enabled, "last commit must verify extensions after restart"
+    assert lc.has_two_thirds_majority()
+    assert any(v is not None and v.extension_signature for v in lc.votes), (
+        "reconstructed votes lack extension signatures"
+    )

@@ -134,3 +134,73 @@ def test_late_joiner_catches_up_via_gossip():
             n.stop()
         if late is not None:
             late.stop()
+
+
+def test_pick_send_extended_with_absent_slot_zero():
+    """load_extended_commit returns None entries for absent validator
+    slots; _pick_send_extended must take the round from the first
+    PRESENT vote and skip None slots (regression: votes[0].round raised
+    AttributeError, silently swallowed by the gossip loop, so extended
+    catch-up gossip for that height never ran)."""
+    from types import SimpleNamespace
+
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+    from tendermint_tpu.types import PRECOMMIT, BlockID, PartSetHeader, Vote
+    from tendermint_tpu.utils.tmtime import Time
+
+    chain_id = "pse-chain"
+    vset, privs = _make_validators(4)
+    height, round_ = 7, 2
+    block_id = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    votes = [None]  # slot 0 absent
+    for i in range(1, 4):
+        vote = Vote(
+            type=PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=Time.parse_rfc3339("2024-01-02T03:04:05Z"),
+            validator_address=vset.validators[i].address,
+            validator_index=i,
+            extension=b"ext",
+        )
+        vote.signature = privs[i].sign(vote.sign_bytes(chain_id))
+        vote.extension_signature = privs[i].sign(vote.extension_sign_bytes(chain_id))
+        votes.append(vote)
+
+    picked = {}
+    stub = SimpleNamespace(
+        cs=SimpleNamespace(
+            state=SimpleNamespace(chain_id=chain_id),
+            block_exec=SimpleNamespace(
+                store=SimpleNamespace(load_validators=lambda h: vset)
+            ),
+        ),
+        _pick_send_vote=lambda ps, vs: picked.setdefault("vs", vs) is None or True,
+    )
+    ps = SimpleNamespace(
+        ensure_catchup_commit_round=lambda h, r, n: None,
+        ensure_vote_bit_arrays=lambda h, n: None,
+    )
+    prs = SimpleNamespace(height=height)
+
+    assert ConsensusReactor._pick_send_extended(stub, ps, prs, votes) is True
+    vs = picked["vs"]
+    assert vs.extensions_enabled
+    assert vs.round == round_
+    assert len(vs.list()) == 3  # the three present votes re-verified
+
+    # All-absent slots: no round to take, so nothing to send — not a crash.
+    assert ConsensusReactor._pick_send_extended(stub, ps, prs, [None] * 4) is False
+
+
+def _make_validators(n, power=100):
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.types import Validator, ValidatorSet
+
+    privs = [Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    vals = [Validator.new(p.pub_key(), power) for p in privs]
+    vset = ValidatorSet.new(vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs_sorted = [by_addr[v.address] for v in vset.validators]
+    return vset, privs_sorted

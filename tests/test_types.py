@@ -338,3 +338,56 @@ def test_commit_vote_sign_bytes_rejects_unknown_flag():
     )
     with pytest.raises(ValueError, match="unknown BlockIDFlag"):
         commit.vote_sign_bytes("c", 0)
+
+
+def test_make_extended_commit_uses_maj23_and_demotes_conflicting():
+    """A COMMIT precommit for a block other than the +2/3 maj23 block
+    (e.g. from a Byzantine validator at a low index) must be demoted to
+    absent, and the ExtendedCommit's block_id must be the maj23 block —
+    NOT the first non-nil vote's block (ref: MakeExtendedCommit,
+    vote_set.go:629-648). Otherwise every honest vote fails
+    re-verification on reload and catch-up gossip serves nothing."""
+    from tendermint_tpu.types.block import (
+        BLOCK_ID_FLAG_ABSENT,
+        BLOCK_ID_FLAG_COMMIT,
+    )
+    from tendermint_tpu.types.vote import votes_from_extended_commit
+
+    chain_id = "test-chain"
+    vset, privs = _make_validators(4)
+    height, round_ = 10, 1
+    block_y = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    block_x = BlockID(hash=b"\xcc" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xdd" * 32))
+    vote_set = VoteSet(chain_id, height, round_, PRECOMMIT, vset)
+    ts = Time.parse_rfc3339("2024-01-02T03:04:05Z")
+    for i, (val, priv) in enumerate(zip(vset.validators, privs)):
+        vote = Vote(
+            type=PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=block_x if i == 0 else block_y,  # index 0 defects
+            timestamp=ts,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        vote.signature = priv.sign(vote.sign_bytes(chain_id))
+        assert vote_set.add_vote(vote)
+    assert vote_set.has_two_thirds_majority()
+    assert vote_set.maj23 == block_y
+
+    ec = vote_set.make_extended_commit()
+    assert BlockID.from_proto(ec.block_id) == block_y
+    flags = [sig.block_id_flag for sig in ec.extended_signatures]
+    assert flags[0] == BLOCK_ID_FLAG_ABSENT  # demoted, not mislabeled COMMIT
+    assert flags[1:] == [BLOCK_ID_FLAG_COMMIT] * 3
+
+    # Every persisted vote re-verifies against the commit's block_id.
+    votes = votes_from_extended_commit(ec)
+    assert votes[0] is None
+    for i, v in enumerate(votes[1:], start=1):
+        v.verify(chain_id, vset.validators[i].pub_key)
+
+    # A set with no +2/3 must refuse to build an extended commit.
+    partial = VoteSet(chain_id, height, round_, PRECOMMIT, vset)
+    with pytest.raises(ValueError, match=r"\+2/3"):
+        partial.make_extended_commit()
