@@ -10,12 +10,17 @@ from __future__ import annotations
 from . import BatchVerifier, PubKey
 from .ed25519 import KEY_TYPE as ED25519_TYPE
 from .ed25519 import Ed25519BatchVerifier
+from .sr25519 import KEY_TYPE as SR25519_TYPE
 
 
 def create_batch_verifier(pk: PubKey) -> BatchVerifier:
     """ref: CreateBatchVerifier crypto/batch/batch.go:12."""
     if pk.type_name == ED25519_TYPE:
         return Ed25519BatchVerifier()
+    if pk.type_name == SR25519_TYPE:
+        from .sr25519 import Sr25519BatchVerifier
+
+        return Sr25519BatchVerifier()
     raise ValueError(f"key type {pk.type_name} does not support batch verification")
 
 
@@ -23,4 +28,4 @@ def supports_batch_verifier(pk: PubKey | None) -> bool:
     """ref: SupportsBatchVerifier crypto/batch/batch.go:26."""
     if pk is None:
         return False
-    return pk.type_name == ED25519_TYPE
+    return pk.type_name in (ED25519_TYPE, SR25519_TYPE)

@@ -176,6 +176,10 @@ class Ed25519BatchVerifier(BatchVerifier):
         return len(self._sigs)
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type_name != KEY_TYPE:
+            # ref: ErrNotEd25519Key (crypto/ed25519/ed25519.go:209) — an
+            # sr25519 key is also 32 bytes, so size alone cannot tell.
+            raise ValueError("pubkey is not ed25519")
         pk = pub_key.bytes()
         if len(pk) != PUBKEY_SIZE:
             raise ValueError("invalid pubkey size")

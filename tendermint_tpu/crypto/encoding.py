@@ -13,6 +13,8 @@ def pubkey_to_proto(pk: PubKey) -> pb.PublicKey:
         return pb.PublicKey(ed25519=pk.bytes())
     if pk.type_name == "secp256k1":
         return pb.PublicKey(secp256k1=pk.bytes())
+    if pk.type_name == "sr25519":
+        return pb.PublicKey(sr25519=pk.bytes())
     raise ValueError(f"unsupported key type {pk.type_name}")
 
 
@@ -22,4 +24,8 @@ def pubkey_from_proto(p: pb.PublicKey) -> PubKey:
         return Ed25519PubKey(data)
     if name == "secp256k1":
         return Secp256k1PubKey(data)
+    if name == "sr25519":
+        from .sr25519 import Sr25519PubKey
+
+        return Sr25519PubKey(data)
     raise ValueError(f"unsupported proto pubkey arm {name!r}")

@@ -44,6 +44,9 @@ class Manifest:
     nodes: list[NodeManifest] = field(default_factory=list)
     load_tx_rate: int = 10  # txs/sec injected during the run
     initial_height: int = 1
+    # validator key type for the whole testnet: ed25519 | sr25519 |
+    # secp256k1 (ref: manifest.go KeyType)
+    key_type: str = "ed25519"
     # height -> {node name: power} validator-set changes applied via
     # the kvstore's val: txs once the chain passes that height
     # (ref: manifest.go ValidatorUpdates)
@@ -69,6 +72,7 @@ class Manifest:
             chain_id=doc.get("chain_id", "e2e-chain"),
             load_tx_rate=int(doc.get("load_tx_rate", 10)),
             initial_height=int(doc.get("initial_height", 1)),
+            key_type=doc.get("key_type", "ed25519"),
             snapshot_interval=int(doc.get("snapshot_interval", 0)),
             vote_extensions_enable_height=int(doc.get("vote_extensions_enable_height", 0)),
             prepare_proposal_delay_ms=int(doc.get("prepare_proposal_delay_ms", 0)),

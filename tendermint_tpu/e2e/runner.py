@@ -122,7 +122,10 @@ class Runner:
             os.makedirs(os.path.join(home, "config"), exist_ok=True)
             os.makedirs(os.path.join(home, "data"), exist_ok=True)
             cfg = default_config(home)
-            pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+            pv = FilePV.load_or_generate(
+                cfg.priv_validator_key_file, cfg.priv_validator_state_file,
+                key_type=self.manifest.key_type,
+            )
             node.node_id = NodeKey.load_or_gen(cfg.node_key_file).node_id
             if nm.mode == "validator":
                 pvs[nm.name] = pv
@@ -144,10 +147,16 @@ class Runner:
         # manifests shorten timeouts the same way)
         import dataclasses
 
-        from ..types.params import ABCIParams, ConsensusParams, TimeoutParams
+        from ..types.params import (
+            ABCIParams,
+            ConsensusParams,
+            TimeoutParams,
+            ValidatorParams,
+        )
 
         gen_doc.consensus_params = dataclasses.replace(
             ConsensusParams(),
+            validator=ValidatorParams(pub_key_types=(self.manifest.key_type,)),
             abci=ABCIParams(
                 vote_extensions_enable_height=self.manifest.vote_extensions_enable_height
             ),

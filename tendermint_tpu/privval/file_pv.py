@@ -126,9 +126,23 @@ class FilePV:
     # ------------------------------------------------------ construction
 
     @classmethod
-    def generate(cls, key_file_path: str = "", state_file_path: str = "", seed: bytes | None = None) -> "FilePV":
+    def generate(cls, key_file_path: str = "", state_file_path: str = "", seed: bytes | None = None,
+                 key_type: str = "ed25519") -> "FilePV":
+        """ref: privval.GenFilePV with a key type (file.go:200)."""
+        if key_type == "ed25519":
+            priv = Ed25519PrivKey.generate(seed)
+        elif key_type == "sr25519":
+            from ..crypto.sr25519 import Sr25519PrivKey
+
+            priv = Sr25519PrivKey.generate(seed)
+        elif key_type == "secp256k1":
+            from ..crypto.secp256k1 import Secp256k1PrivKey
+
+            priv = Secp256k1PrivKey.generate(seed)
+        else:
+            raise ValueError(f"unsupported key type {key_type!r}")
         pv = cls(
-            priv_key=Ed25519PrivKey.generate(seed),
+            priv_key=priv,
             key_file_path=key_file_path,
             last_sign_state=LastSignState(file_path=state_file_path),
         )
@@ -142,11 +156,22 @@ class FilePV:
     def load(cls, key_file_path: str, state_file_path: str) -> "FilePV":
         with open(key_file_path, "rb") as f:
             doc = json.loads(f.read())
-        if doc.get("priv_key", {}).get("type") != "tendermint/PrivKeyEd25519":
-            raise ValueError(f"unsupported priv key type {doc.get('priv_key', {}).get('type')}")
         import base64
 
-        priv = Ed25519PrivKey(base64.b64decode(doc["priv_key"]["value"]))
+        ktype = doc.get("priv_key", {}).get("type")
+        raw = base64.b64decode(doc["priv_key"]["value"])
+        if ktype == "tendermint/PrivKeyEd25519":
+            priv = Ed25519PrivKey(raw)
+        elif ktype == "tendermint/PrivKeySr25519":
+            from ..crypto.sr25519 import Sr25519PrivKey
+
+            priv = Sr25519PrivKey(raw)
+        elif ktype == "tendermint/PrivKeySecp256k1":
+            from ..crypto.secp256k1 import Secp256k1PrivKey
+
+            priv = Secp256k1PrivKey(raw)
+        else:
+            raise ValueError(f"unsupported priv key type {ktype}")
         return cls(
             priv_key=priv,
             key_file_path=key_file_path,
@@ -154,20 +179,28 @@ class FilePV:
         )
 
     @classmethod
-    def load_or_generate(cls, key_file_path: str, state_file_path: str, seed: bytes | None = None) -> "FilePV":
+    def load_or_generate(cls, key_file_path: str, state_file_path: str, seed: bytes | None = None,
+                         key_type: str = "ed25519") -> "FilePV":
         if os.path.exists(key_file_path):
             return cls.load(key_file_path, state_file_path)
-        return cls.generate(key_file_path, state_file_path, seed)
+        return cls.generate(key_file_path, state_file_path, seed, key_type=key_type)
+
+    _JSON_KEY_TAGS = {
+        "ed25519": ("tendermint/PubKeyEd25519", "tendermint/PrivKeyEd25519"),
+        "sr25519": ("tendermint/PubKeySr25519", "tendermint/PrivKeySr25519"),
+        "secp256k1": ("tendermint/PubKeySecp256k1", "tendermint/PrivKeySecp256k1"),
+    }
 
     def save_key(self) -> None:
         import base64
 
         pub = self.priv_key.pub_key()
+        pub_tag, priv_tag = self._JSON_KEY_TAGS[self.priv_key.type_name]
         doc = {
             "address": pub.address().hex().upper(),
-            "pub_key": {"type": "tendermint/PubKeyEd25519", "value": base64.b64encode(pub.bytes()).decode()},
+            "pub_key": {"type": pub_tag, "value": base64.b64encode(pub.bytes()).decode()},
             "priv_key": {
-                "type": "tendermint/PrivKeyEd25519",
+                "type": priv_tag,
                 "value": base64.b64encode(self.priv_key.bytes()).decode(),
             },
         }

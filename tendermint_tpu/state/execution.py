@@ -54,6 +54,10 @@ def validator_updates_from_abci(updates: list[abci.ValidatorUpdate]) -> list[Val
             pk = Ed25519PubKey(u.pub_key_bytes)
         elif u.pub_key_type in ("secp256k1", "tendermint/PubKeySecp256k1"):
             pk = Secp256k1PubKey(u.pub_key_bytes)
+        elif u.pub_key_type in ("sr25519", "tendermint/PubKeySr25519"):
+            from ..crypto.sr25519 import Sr25519PubKey
+
+            pk = Sr25519PubKey(u.pub_key_bytes)
         else:
             raise ValueError(f"unsupported pubkey type {u.pub_key_type}")
         out.append(Validator(address=pk.address(), pub_key=pk, voting_power=u.power))

@@ -93,6 +93,10 @@ class GenesisDoc:
                 from ..crypto.secp256k1 import Secp256k1PubKey
 
                 pk = Secp256k1PubKey(_unb64(v["pub_key"]["value"]))
+            elif ktype == "tendermint/PubKeySr25519":
+                from ..crypto.sr25519 import Sr25519PubKey
+
+                pk = Sr25519PubKey(_unb64(v["pub_key"]["value"]))
             elif ktype == "tendermint/PubKeyEd25519":
                 pk = Ed25519PubKey(_unb64(v["pub_key"]["value"]))
             else:
@@ -138,6 +142,7 @@ class GenesisDoc:
 _PUBKEY_JSON_TYPES = {
     "ed25519": "tendermint/PubKeyEd25519",
     "secp256k1": "tendermint/PubKeySecp256k1",
+    "sr25519": "tendermint/PubKeySr25519",
 }
 
 

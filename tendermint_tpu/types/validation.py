@@ -148,7 +148,22 @@ def _verify_commit_batch(
                 raise ValueError(f"double vote from {val} ({seen_vals[val_idx]} and {idx})")
             seen_vals[val_idx] = idx
         vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        try:
+            bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        except ValueError:
+            # Mixed key types: this key cannot join the proposer-typed
+            # batch. The reference returns the Add error outright
+            # (validation.go:211), rejecting commits that are in fact
+            # valid; we deliberately fall back to serial verification
+            # instead — acceptance still requires every signature to
+            # verify, so no invalid commit is admitted.
+            single = _verify_commit_single(
+                chain_id, vals, commit, voting_power_needed,
+                ignore_sig, count_sig, count_all_signatures, look_up_by_index,
+            )
+            if defer:
+                return lambda: single
+            return single
         batch_sig_idxs.append(idx)
         if count_sig(commit_sig):
             tallied += val.voting_power

@@ -1,0 +1,267 @@
+"""sr25519 conformance (ref: crypto/sr25519/sr25519_test.go, batch.go).
+
+Bit-level anchors, since no schnorrkel runtime exists in-container:
+keccak-f[1600] is validated against hashlib's SHA-3, the Merlin
+transcript against the published merlin-crate test vector, and
+ristretto255 against RFC 9496 vectors — the three layers whose bytes
+determine cross-implementation signature compatibility.
+"""
+
+import hashlib
+import struct
+
+import pytest
+
+from tendermint_tpu.crypto import sr25519 as sr
+from tendermint_tpu.crypto.ed25519_ref import BASE, IDENTITY, scalar_mult
+from tendermint_tpu.crypto.merlin import Transcript, keccak_f1600
+
+
+def test_keccak_matches_hashlib_sha3():
+    def sha3_256(data: bytes) -> bytes:
+        rate = 136
+        st = bytearray(200)
+        padded = bytearray(data)
+        padded.append(0x06)
+        while len(padded) % rate != 0:
+            padded.append(0)
+        padded[-1] |= 0x80
+        for off in range(0, len(padded), rate):
+            for i in range(rate):
+                st[i] ^= padded[off + i]
+            lanes = keccak_f1600(list(struct.unpack("<25Q", bytes(st))))
+            st = bytearray(struct.pack("<25Q", *lanes))
+        return bytes(st[:32])
+
+    for msg in (b"", b"abc", b"x" * 135, b"y" * 136, b"z" * 500, bytes(range(256))):
+        assert sha3_256(msg) == hashlib.sha3_256(msg).digest()
+
+
+def test_merlin_published_vector():
+    """The equivalence vector from the merlin crate's test suite."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    assert t.challenge_bytes(b"challenge", 32).hex() == (
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+    )
+
+
+def test_merlin_clone_independent():
+    t = Transcript(b"proto")
+    t.append_message(b"a", b"b")
+    u = t.clone()
+    u.append_message(b"c", b"d")
+    assert t.challenge_bytes(b"x", 16) != u.challenge_bytes(b"x", 16)
+
+
+def test_ristretto_rfc9496_vectors():
+    # identity and the first small multiples of the basepoint (RFC 9496 §A.1)
+    assert sr.ristretto_encode(IDENTITY) == b"\x00" * 32
+    assert sr.ristretto_encode(BASE).hex() == (
+        "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76"
+    )
+    assert sr.ristretto_encode(scalar_mult(2, BASE)).hex() == (
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919"
+    )
+
+
+def test_ristretto_roundtrip_and_rejections():
+    for k in range(1, 32):
+        enc = sr.ristretto_encode(scalar_mult(k, BASE))
+        dec = sr.ristretto_decode(enc)
+        assert dec is not None
+        assert sr.ristretto_encode(dec) == enc
+    # non-canonical: s >= p
+    assert sr.ristretto_decode(b"\xff" * 32) is None
+    # negative: odd s
+    assert sr.ristretto_decode(b"\x01" + b"\x00" * 31) is None
+    # wrong length
+    assert sr.ristretto_decode(b"\x00" * 31) is None
+
+
+def test_sign_verify_tamper():
+    priv = sr.Sr25519PrivKey.generate(b"conformance secret")
+    pub = priv.pub_key()
+    assert len(pub.bytes()) == sr.PUBKEY_SIZE
+    assert len(pub.address()) == 20
+    # ref: privkey.go:156 GenPrivKeyFromSecret = sha256(secret)
+    assert priv.bytes() == hashlib.sha256(b"conformance secret").digest()
+
+    msg = b"sr25519 message"
+    sig = priv.sign(msg)
+    assert len(sig) == sr.SIG_SIZE
+    assert sig[63] & 0x80  # schnorrkel v1 marker
+    assert pub.verify_signature(msg, sig)
+
+    for i in (0, 7, 32, 63):
+        bad = bytearray(sig)
+        bad[i] ^= 0x01
+        assert not pub.verify_signature(msg, bytes(bad))
+    assert not pub.verify_signature(msg + b"!", sig)
+    # marker bit cleared -> "not marked" rejection
+    nomark = bytearray(sig)
+    nomark[63] &= 0x7F
+    assert not pub.verify_signature(msg, bytes(nomark))
+    # non-canonical scalar rejected
+    big_s = bytearray(sig)
+    big_s[32:64] = (sr.L + 1).to_bytes(32, "little")
+    big_s[63] |= 0x80
+    assert not pub.verify_signature(msg, bytes(big_s))
+
+
+def test_batch_verifier_bitmap():
+    bv = sr.Sr25519BatchVerifier()
+    expected = []
+    for i in range(8):
+        priv = sr.Sr25519PrivKey.generate(b"batch-%d" % i)
+        msg = b"easter" if i % 2 == 0 else b"egg"
+        sig = priv.sign(msg)
+        if i in (2, 5):
+            mutated = bytearray(sig)
+            mutated[3] ^= 0xFF
+            sig = bytes(mutated)
+        bv.add(priv.pub_key(), msg, sig)
+        expected.append(i not in (2, 5))
+    ok, bits = bv.verify()
+    assert not ok
+    assert bits == expected
+
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    with pytest.raises(ValueError, match="not sr25519"):
+        bv.add(Ed25519PrivKey.generate(b"\x01" * 32).pub_key(), b"m", b"\x00" * 64)
+
+
+def test_batch_dispatch():
+    from tendermint_tpu.crypto import batch as crypto_batch
+
+    pk = sr.Sr25519PrivKey.generate(b"d").pub_key()
+    assert crypto_batch.supports_batch_verifier(pk)
+    assert isinstance(crypto_batch.create_batch_verifier(pk), sr.Sr25519BatchVerifier)
+
+
+def test_proto_and_genesis_roundtrip():
+    from tendermint_tpu.crypto.encoding import pubkey_from_proto, pubkey_to_proto
+
+    pk = sr.Sr25519PrivKey.generate(b"proto").pub_key()
+    rt = pubkey_from_proto(pb_roundtrip(pubkey_to_proto(pk)))
+    assert rt == pk and rt.type_name == "sr25519"
+
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.utils.tmtime import Time
+
+    gd = GenesisDoc(
+        chain_id="sr-chain",
+        genesis_time=Time.from_unix_ns(1_700_000_000 * 10**9),
+        validators=[GenesisValidator(address=pk.address(), pub_key=pk, power=5, name="v")],
+    )
+    rt_doc = GenesisDoc.from_json(gd.to_json())
+    assert rt_doc.validators[0].pub_key == pk
+
+
+def pb_roundtrip(msg):
+    return type(msg).decode(msg.encode())
+
+
+def _commit_over(chain_id, vset, privs_by_addr, height=10, round_=1):
+    from tendermint_tpu.types import PRECOMMIT, BlockID, PartSetHeader, Vote, VoteSet
+    from tendermint_tpu.utils.tmtime import Time
+
+    block_id = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32))
+    vote_set = VoteSet(chain_id, height, round_, PRECOMMIT, vset)
+    for i, val in enumerate(vset.validators):
+        vote = Vote(
+            type=PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=Time.parse_rfc3339("2024-01-02T03:04:05Z"),
+            validator_address=val.address,
+            validator_index=i,
+        )
+        vote.signature = privs_by_addr[val.address].sign(vote.sign_bytes(chain_id))
+        assert vote_set.add_vote(vote)
+    return block_id, vote_set.make_commit()
+
+
+def test_sr25519_commit_batch_verified(monkeypatch):
+    """A homogeneous sr25519 validator set batch-verifies a commit
+    (ref: batch.go:15-47 — the second batch-capable key type)."""
+    monkeypatch.setenv("TM_TPU_CRYPTO", "off")
+    from tendermint_tpu.types import ValidatorSet, Validator, verify_commit
+
+    privs = [sr.Sr25519PrivKey.generate(b"val-%d" % i) for i in range(4)]
+    vset = ValidatorSet.new([Validator.new(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    block_id, commit = _commit_over("sr-chain", vset, by_addr)
+    verify_commit("sr-chain", vset, block_id, 10, commit)
+
+    commit.signatures[1].signature = bytes(64)
+    with pytest.raises(ValueError, match=r"wrong signature \(#1\)"):
+        verify_commit("sr-chain", vset, block_id, 10, commit)
+
+
+def test_mixed_ed25519_sr25519_commit(monkeypatch):
+    """Mixed key types verify end-to-end. The reference would return
+    bv.Add's error here (validation.go:211), rejecting a valid commit;
+    we fall back to serial verification instead (documented divergence,
+    types/validation.py)."""
+    monkeypatch.setenv("TM_TPU_CRYPTO", "off")
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.types import ValidatorSet, Validator, verify_commit
+
+    ed_privs = [Ed25519PrivKey.generate(bytes([i + 1]) * 32) for i in range(3)]
+    sr_priv = sr.Sr25519PrivKey.generate(b"mixed")
+    privs = ed_privs + [sr_priv]
+    vset = ValidatorSet.new(
+        [Validator.new(ed_privs[0].pub_key(), 100)]  # batch-capable proposer
+        + [Validator.new(p.pub_key(), 10) for p in privs[1:]]
+    )
+    by_addr = {p.pub_key().address(): p for p in privs}
+    block_id, commit = _commit_over("mixed-chain", vset, by_addr)
+    assert vset.get_proposer().pub_key.type_name == "ed25519"
+    verify_commit("mixed-chain", vset, block_id, 10, commit)
+
+    # a bad signature must still fail through the fallback
+    commit.signatures[2].signature = bytes(64)
+    with pytest.raises(ValueError):
+        verify_commit("mixed-chain", vset, block_id, 10, commit)
+
+
+def test_sr25519_validators_produce_blocks(monkeypatch):
+    """A chain whose validators all use sr25519 keys advances: votes
+    sign/verify through schnorrkel transcripts and every LastCommit
+    goes through the sr25519 batch verifier (the e2e key-type matrix's
+    sr25519 column, in-process)."""
+    monkeypatch.setenv("TM_TPU_CRYPTO", "off")
+    import dataclasses
+
+    from helpers import make_genesis_doc
+    from test_consensus import CHAIN, fast_params, make_node, wait_for_height
+    from tendermint_tpu.types.params import ValidatorParams
+
+    keys = [sr.Sr25519PrivKey.generate(b"chain-%d" % i) for i in range(2)]
+    gen_doc = make_genesis_doc(keys, CHAIN + "-sr")
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), validator=ValidatorParams(pub_key_types=("sr25519",))
+    )
+    nodes = [make_node(keys, i, gen_doc) for i in range(2)]
+
+    def wire(sender_idx):
+        def fan_out(msg):
+            for j, other in enumerate(nodes):
+                if j != sender_idx:
+                    other.add_peer_message(msg, peer_id=f"node{sender_idx}")
+        return fan_out
+
+    for i, n in enumerate(nodes):
+        n.broadcast = wire(i)
+    for n in nodes:
+        n.start()
+    try:
+        assert wait_for_height(nodes, 3, timeout=90), (
+            f"sr25519 chain stalled at {[n.rs.height for n in nodes]}"
+        )
+    finally:
+        for n in nodes:
+            n.stop()
