@@ -18,6 +18,14 @@ REQUEST_INTERVAL = 0.01  # pool.go requestIntervalMS = 2ms
 MAX_PENDING_REQUESTS_PER_PEER = 20  # pool.go maxPendingRequestsPerPeer
 MAX_TOTAL_REQUESTERS = 600  # pool.go maxTotalRequesters
 PEER_TIMEOUT = 15.0  # pool.go peerTimeout
+# Minimum observation window after start before is_caught_up may fire:
+# at restart the first status to arrive can be from a peer that is
+# itself behind (or a seed at height 0), and switching to consensus on
+# that stale view leaves a node hundreds of blocks behind crawling to
+# the tip via vote gossip instead of blocksync. The reference gets the
+# same settling time from its 1 s switchToConsensusTicker
+# (reactor.go:35,444); here the window is explicit.
+STATUS_SETTLE_SECONDS = 1.0
 
 
 @dataclass
@@ -53,10 +61,13 @@ class BlockPool:
         self.last_advance = time.monotonic()
         self.last_hundred_start = time.monotonic()
         self.last_sync_rate = 0.0
+        self.settle_seconds = STATUS_SETTLE_SECONDS
+        self._started_at = time.monotonic()
 
     # ----------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        self._started_at = time.monotonic()
         self._stop.clear()
         self._thread = threading.Thread(target=self._make_requests_routine, daemon=True, name="blockpool")
         self._thread.start()
@@ -182,9 +193,17 @@ class BlockPool:
             return peer_id
 
     def is_caught_up(self) -> bool:
-        """ref: pool.go:183 IsCaughtUp."""
+        """ref: pool.go:189 IsCaughtUp + the reactor's 1 s switch ticker
+        (reactor.go:466). Peers only enter `self.peers` via status
+        responses, so non-empty peers implies at least one post-start
+        status round; the settle window additionally keeps the first —
+        possibly stale or height-0 — response from deciding the switch
+        alone (the restart race: a node 100+ blocks behind must rejoin
+        via blocksync, not vote gossip)."""
         with self._lock:
             if not self.peers:
+                return False
+            if time.monotonic() - self._started_at < self.settle_seconds:
                 return False
             return self.height >= self.max_peer_height
 

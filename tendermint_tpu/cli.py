@@ -69,6 +69,14 @@ def cmd_start(args) -> int:
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    # Fault-injection hooks: SIGUSR1 severs all p2p connections and
+    # refuses new ones, SIGUSR2 reconnects — a real network partition
+    # for the e2e runner's `disconnect` perturbation (the reference
+    # detaches the docker network, test/e2e/runner/perturb.go:43).
+    router = getattr(node, "router", None)
+    if router is not None:
+        signal.signal(signal.SIGUSR1, lambda *a: router.set_network_enabled(False))
+        signal.signal(signal.SIGUSR2, lambda *a: router.set_network_enabled(True))
     try:
         while not stop:
             time.sleep(0.2)

@@ -491,12 +491,14 @@ class Runner:
             time.sleep(5.0)
             node.proc.send_signal(signal.SIGCONT)
         elif kind == "disconnect":
-            # closest host-level analog of docker network disconnect:
-            # long pause — peers drop the unresponsive connection, then
-            # the node reconnects on resume
-            node.proc.send_signal(signal.SIGSTOP)
+            # a REAL partition (ref: perturb.go:43 docker network
+            # disconnect): SIGUSR1 makes the node's router close every
+            # p2p connection and refuse new ones — peers see immediate
+            # EOF/reset (not a silent stall as under SIGSTOP) — then
+            # SIGUSR2 reconnects and the node must re-dial and recover
+            node.proc.send_signal(signal.SIGUSR1)
             time.sleep(8.0)
-            node.proc.send_signal(signal.SIGCONT)
+            node.proc.send_signal(signal.SIGUSR2)
         else:
             raise ValueError(f"unknown perturbation {kind!r}")
 

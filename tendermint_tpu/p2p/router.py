@@ -106,6 +106,8 @@ class Router:
         self._threads: list[threading.Thread] = []  # long-lived loop threads only
         self._threads_lock = threading.Lock()
         self._stop = threading.Event()
+        self._network_enabled = threading.Event()
+        self._network_enabled.set()
         self._conn_tracker = (
             ConnTracker(self.options.max_incoming_per_ip, self.options.incoming_conn_window)
             if self.options.max_incoming_per_ip > 0
@@ -131,6 +133,30 @@ class Router:
             return set(self._channels)
 
     # ------------------------------------------------------------ lifecycle
+
+    def set_network_enabled(self, enabled: bool) -> None:
+        """Hard partition switch for fault injection (the host-level
+        equivalent of the reference e2e runner's docker network
+        disconnect, test/e2e/runner/perturb.go:43): disabling closes
+        every live peer connection NOW and refuses new inbound and
+        outbound connections until re-enabled. Unlike a SIGSTOP pause,
+        peers observe immediate EOF/reset and run their real
+        disconnect/eviction/reconnect paths."""
+        if enabled:
+            self._network_enabled.set()
+            return
+        self._network_enabled.clear()
+        with self._peer_lock:
+            conns = list(self._peer_conns.values())
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    @property
+    def network_enabled(self) -> bool:
+        return self._network_enabled.is_set()
 
     def start(self) -> None:
         self._stop.clear()
@@ -230,6 +256,9 @@ class Router:
                 continue
             except (ConnectionClosed, OSError):
                 return
+            if not self._network_enabled.is_set():
+                conn.close()
+                continue
             ip = ""
             if self._conn_tracker is not None:
                 try:
@@ -256,6 +285,9 @@ class Router:
     def _open_connection(self, conn: Connection, outgoing: bool, endpoint: Endpoint | None) -> None:
         """Handshake + register + run send/recv (ref: router.go:481
         openConnection / :675 handshakePeer + :745 routePeer)."""
+        if not self._network_enabled.is_set():
+            conn.close()
+            return
         peer_id = None
         try:
             peer_info, peer_key = conn.handshake(
@@ -302,6 +334,14 @@ class Router:
 
             conn.on_traffic = on_traffic
         with self._peer_lock:
+            # Re-check under the lock set_network_enabled snapshots with:
+            # a connection that finished its handshake while the switch
+            # flipped would otherwise register AFTER the close sweep and
+            # survive the "partition".
+            if not self._network_enabled.is_set():
+                conn.close()
+                self.peer_manager.disconnected(peer_id)
+                return
             old = self._peer_conns.pop(peer_id, None)
             self._peer_queues[peer_id] = pq
             self._peer_conns[peer_id] = conn
@@ -358,6 +398,9 @@ class Router:
         while not self._stop.is_set():
             endpoint = self.peer_manager.dial_next(timeout=0.2)
             if endpoint is None:
+                continue
+            if not self._network_enabled.is_set():
+                self.peer_manager.dial_failed(endpoint)  # retry after backoff
                 continue
             transport = self._transport_for(endpoint.protocol)
             if transport is None:

@@ -129,13 +129,6 @@ class BlockStore:
             self._height = height
             self._save_state()
 
-    def save_extended_commit_proto(self, height: int, ec) -> None:
-        """Store an ExtendedCommit received over the wire (blocksync's
-        BlockResponse.ext_commit) so this node can itself serve
-        extension-aware catch-up gossip for heights it never committed
-        through consensus."""
-        self._db.set(_h(KEY_EXT_COMMIT, height), ec.encode())
-
     def load_extended_commit(self, height: int):
         """Precommit votes WITH extensions, or None
         (ref: store.go LoadBlockExtendedCommit)."""

@@ -492,3 +492,62 @@ def test_validate_ext_commit_cryptographic():
         ] * 4,
     )
     assert check(only_absent) is not None  # slots present, zero power
+
+
+def test_restart_behind_rejoins_via_blocksync_not_gossip():
+    """The restart race (ref: pool.go:189 + the reference's 1s switch
+    ticker, reactor.go:466): a node far behind the tip whose FIRST
+    status response comes from a stale/height-0 peer must not switch to
+    consensus on that view — it must keep blocksyncing once the tip
+    peer's status lands. Before the settle-window fix, is_caught_up
+    fired on the first check (height 1 >= max_peer_height 0 with one
+    stale peer present) and the node crawled to the tip via vote gossip
+    instead."""
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-race")
+    gen_doc.consensus_params = fast_params()
+
+    source = make_node(keys, 0, gen_doc)
+    source.start()
+    try:
+        assert wait_for_height([source], 100, timeout=90)
+    finally:
+        source.stop()
+    tip = source.block_store.height()
+    assert tip >= 100
+
+    fresh = make_node(keys, 0, gen_doc)  # the restarted/behind node
+    stale = make_node(keys, 0, gen_doc)  # a peer with an empty chain
+
+    caught = {}
+    done = threading.Event()
+
+    def on_caught_up(state, n):
+        caught["n"] = n
+        done.set()
+
+    net = MemoryNetwork()
+    tip_server = BSNode(net, 0x61, source, block_sync=False)
+    stale_server = BSNode(net, 0x62, stale, block_sync=False)
+    client = BSNode(net, 0x63, fresh, on_caught_up=on_caught_up)
+    for n in (tip_server, stale_server, client):
+        n.start()
+    try:
+        # stale peer's status (height 0) arrives first...
+        client.pm.add(Endpoint(protocol="memory", host=stale_server.node_id,
+                               node_id=stale_server.node_id))
+        time.sleep(0.5)
+        assert not done.is_set(), "switched to consensus off a stale height-0 status"
+        # ...then the tip peer reports; the node must blocksync to the tip
+        client.pm.add(Endpoint(protocol="memory", host=tip_server.node_id,
+                               node_id=tip_server.node_id))
+        assert done.wait(timeout=120), (
+            f"client stuck at {client.reactor.pool.height}, tip {tip}"
+        )
+    finally:
+        for n in (client, tip_server, stale_server):
+            n.stop()
+    assert caught["n"] >= tip - 2, (
+        f"rejoined with only {caught['n']} synced blocks — vote-gossip crawl, not blocksync"
+    )
+    assert fresh.block_store.height() >= tip - 2

@@ -111,6 +111,9 @@ def test_key_migrate_legacy_layout(tmp_path):
     reads it (ref: scripts/keymigrate/migrate.go semantics)."""
     n, home, rpc, height = _mini_chain(tmp_path, "km-chain", txs=2)
     n.stop()
+    # _mini_chain samples the height while the node is still committing;
+    # the store is only stable now
+    height = n.block_store.height()
     cfg = load_config(home)
     from tendermint_tpu.store.kv import FileDB
     from tendermint_tpu.store.migrate import migrate_db

@@ -29,6 +29,7 @@ abci_protocol = "grpc"
 
 [node.validator04]
 abci_protocol = "tcp"
+perturb = ["disconnect"]
 
 [validator_update.3]
 validator03 = 250

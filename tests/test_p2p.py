@@ -264,3 +264,57 @@ def test_conn_tracker_limits_per_ip():
     t2.add_conn("10.0.0.3")
     with _pytest.raises(ConnectionRefusedError, match="rate-limited"):
         t2.add_conn("10.0.0.3")
+
+
+def test_network_disconnect_is_a_real_partition():
+    """router.set_network_enabled(False) must behave like pulling the
+    cable (ref: the e2e `disconnect` perturbation, perturb.go:43), NOT
+    like a SIGSTOP pause: the peer observes an immediate close and runs
+    its disconnect path, new connections are refused while disabled,
+    and re-enabling lets the dial-retry path reconnect."""
+    import json
+
+    def mk(seed):
+        desc = ChannelDescriptor(
+            id=0x77, name="test",
+            encode=lambda m: json.dumps(m).encode(),
+            decode=lambda b: json.loads(b.decode()),
+        )
+        key = Ed25519PrivKey.generate(bytes([seed]) * 32)
+        nid = node_id_from_pubkey(key.pub_key())
+        t = TcpTransport([desc])
+        pm = PeerManager(nid, PeerManagerOptions(max_connected=8))
+        router = Router(NodeInfo(node_id=nid, network="part-test"), key, pm, [t])
+        router.open_channel(desc)
+        return nid, t, pm, router
+
+    nid_a, t_a, pm_a, router_a = mk(0x31)
+    nid_b, t_b, pm_b, router_b = mk(0x32)
+    router_a.start()
+    router_b.start()
+    try:
+        ep_b = t_b.endpoint()
+        pm_a.add(Endpoint(protocol="mconn", host=ep_b.host, port=ep_b.port, node_id=nid_b))
+        assert wait_until(lambda: nid_b in pm_a.peers(), timeout=10)
+
+        # control: an IDLE but healthy link stays up — so the DOWN below
+        # can only come from the active close, not from an idle timeout
+        time.sleep(2.0)
+        assert nid_b in pm_a.peers()
+
+        router_b.set_network_enabled(False)
+        assert wait_until(lambda: nid_b not in pm_a.peers(), timeout=5), (
+            "peer never observed the partition — disconnect behaved like a pause"
+        )
+        # while partitioned, reconnection attempts must be refused
+        time.sleep(1.0)
+        assert nid_b not in pm_a.peers()
+        assert not router_b.network_enabled
+
+        router_b.set_network_enabled(True)
+        assert wait_until(lambda: nid_b in pm_a.peers(), timeout=30), (
+            "peers did not reconnect after the partition healed"
+        )
+    finally:
+        router_a.stop()
+        router_b.stop()

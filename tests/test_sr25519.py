@@ -5,6 +5,13 @@ keccak-f[1600] is validated against hashlib's SHA-3, the Merlin
 transcript against the published merlin-crate test vector, and
 ristretto255 against RFC 9496 vectors — the three layers whose bytes
 determine cross-implementation signature compatibility.
+
+KNOWN GAP: the signature layer itself (transcript labels, marker bit,
+challenge reduction) has no external known-answer vector — none can be
+generated in this container (no Rust/Go runtime) and fabricating one
+from memory would pin the wrong bytes. First action in an environment
+with schnorrkel or curve25519-voi available: produce one fixed
+(mini-key, msg, signature) triple and assert verify() accepts it.
 """
 
 import hashlib
