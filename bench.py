@@ -33,12 +33,14 @@ the reference's Go curve25519-voi serial path
 
 import json
 import os
-import signal
 import sys
 import time
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+
+from _bench_util import StageTimeout, enable_compile_cache, stage_deadline  # noqa: E402
 
 BATCHES = (256, 1024, 2048, 8192)
 BUDGET = float(os.environ.get("BENCH_BUDGET", "840"))
@@ -52,40 +54,6 @@ def _remaining():
 
 def _log(msg):
     print(f"# [{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
-
-
-class StageTimeout(Exception):
-    pass
-
-
-def _alarm_handler(signum, frame):
-    raise StageTimeout()
-
-
-class stage_deadline:
-    """Best-effort in-process deadline: SIGALRM raises StageTimeout in
-    the main thread. Cannot interrupt a C call that never returns to the
-    interpreter, but never SIGKILLs the process — the device grant is
-    released by normal JAX client shutdown on exit."""
-
-    def __init__(self, seconds):
-        self.seconds = max(1.0, seconds)
-
-    def __enter__(self):
-        signal.signal(signal.SIGALRM, _alarm_handler)
-        signal.setitimer(signal.ITIMER_REAL, self.seconds)
-
-    def __exit__(self, *exc):
-        signal.setitimer(signal.ITIMER_REAL, 0)
-        return False
-
-
-def _enable_compile_cache(jax):
-    """Persistent XLA compile cache: repeat driver runs skip the heavy
-    curve-kernel compile entirely (same setup as __graft_entry__.py)."""
-    jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def make_jobs(jobs, n):
@@ -180,7 +148,7 @@ def main():
     # immediately whether we are on a real accelerator.
     import jax
 
-    _enable_compile_cache(jax)
+    enable_compile_cache(jax)
     _log("claiming device (jax.devices())...")
     dev = jax.devices()[0]
     _log(f"claimed: {dev.platform}:{dev.device_kind}")

@@ -1,0 +1,48 @@
+"""Shared bench/probe plumbing for the flaky-tunnel environment.
+
+Used by bench.py (repo root) and scripts/tpu_window.py — the SIGALRM
+deadline policy and compile-cache setup must stay identical in both, or
+the wedge-avoidance behavior drifts between the driver's bench run and
+the manual window runs.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class StageTimeout(Exception):
+    pass
+
+
+def _alarm_handler(signum, frame):
+    raise StageTimeout()
+
+
+class stage_deadline:
+    """Best-effort in-process deadline: SIGALRM raises StageTimeout in
+    the main thread. Cannot interrupt a C call that never returns to the
+    interpreter, but never SIGKILLs the process — the device grant is
+    released by normal JAX client shutdown on exit."""
+
+    def __init__(self, seconds: float):
+        self.seconds = max(1.0, seconds)
+
+    def __enter__(self):
+        signal.signal(signal.SIGALRM, _alarm_handler)
+        signal.setitimer(signal.ITIMER_REAL, self.seconds)
+
+    def __exit__(self, *exc):
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        return False
+
+
+def enable_compile_cache(jax) -> None:
+    """Persistent XLA compile cache: repeat runs skip the heavy
+    curve-kernel compile entirely (same setup as __graft_entry__.py)."""
+    jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -21,50 +21,27 @@ scripts/tpu_retry_loop.sh which never timeout-kills a claim).
 """
 
 import os
-import signal
 import sys
 import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-jax.config.update("jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from _bench_util import StageTimeout, enable_compile_cache, stage_deadline as deadline
+
+enable_compile_cache(jax)
 
 _T0 = time.time()
 
 
 def log(msg):
     print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
-
-
-class StageTimeout(Exception):
-    pass
-
-
-def _alarm(signum, frame):
-    raise StageTimeout()
-
-
-signal.signal(signal.SIGALRM, _alarm)
-
-
-class deadline:
-    def __init__(self, seconds):
-        self.seconds = max(1.0, seconds)
-
-    def __enter__(self):
-        signal.setitimer(signal.ITIMER_REAL, self.seconds)
-
-    def __exit__(self, *exc):
-        signal.setitimer(signal.ITIMER_REAL, 0)
-        return False
 
 
 from tendermint_tpu.crypto import ed25519_ref as ref

@@ -127,6 +127,7 @@ class BlockSyncReactor:
         on_caught_up=None,
         block_sync: bool = True,
         on_fatal=None,
+        metrics=None,
     ):
         """on_caught_up(state, blocks_synced) fires when the pool reaches
         the network head — the node switches to consensus
@@ -149,6 +150,7 @@ class BlockSyncReactor:
         )
         self.blocks_synced = 0
         self.sync_error = False
+        self.metrics = metrics  # BlockSyncMetrics (ref: blocksync/metrics.go)
         # verify-ahead pipeline state: (height, block obj, commit-source
         # block obj, valset hash, completion callable). Object identity
         # guards against the pool refetching either block; the valset
@@ -236,6 +238,11 @@ class BlockSyncReactor:
             self.channel.broadcast(
                 StatusResponse(self.block_store.base(), self.block_store.height()), timeout=1.0
             )
+            if self.metrics is not None:
+                height, _, rate = self.pool.status()
+                self.metrics.latest_height.set(height)
+                self.metrics.sync_rate.set(rate)
+                self.metrics.syncing.set(0 if self._switched else int(self.block_sync))
             self._stop.wait(self.STATUS_UPDATE_INTERVAL)
 
     def _pool_routine(self) -> None:
@@ -342,6 +349,8 @@ class BlockSyncReactor:
         )
         self.state = self.block_exec.apply_block(self.state, first_id, first)
         self.blocks_synced += 1
+        if self.metrics is not None:
+            self.metrics.num_blocks.add(1)
         return True
 
     def _validate_ext_commit(self, ec, height: int, first_id, vals=None,

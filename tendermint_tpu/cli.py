@@ -545,6 +545,24 @@ def cmd_e2e(args) -> int:
     return 0
 
 
+def cmd_e2e_generate(args) -> int:
+    """Generate randomized e2e manifests for CI sweeps
+    (ref: test/e2e/generator/main.go)."""
+    from .e2e.generator import generate, validate_generated
+
+    os.makedirs(args.output, exist_ok=True)
+    written = 0
+    for seed in range(args.seed, args.seed + args.seeds):
+        for name, text in generate(seed):
+            validate_generated(text)
+            path = os.path.join(args.output, f"{name}.toml")
+            with open(path, "w") as f:
+                f.write(text)
+            written += 1
+    print(f"wrote {written} manifests to {args.output}")
+    return 0
+
+
 def cmd_remote_signer(args) -> int:
     """Run a standalone remote signer that dials a validator's privval
     listen address (ref: the reference ships this as the external
@@ -615,6 +633,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", default="", help="testnet working directory")
     sp.add_argument("--duration", type=float, default=15.0, help="load duration seconds")
     sp.set_defaults(fn=cmd_e2e)
+
+    sp = sub.add_parser("e2e-generate", help="generate randomized e2e manifests for CI")
+    sp.add_argument("--seed", type=int, default=0, help="first RNG seed")
+    sp.add_argument("--seeds", type=int, default=1, help="number of seeds to sweep")
+    sp.add_argument("--output", required=True, help="directory for generated manifests")
+    sp.set_defaults(fn=cmd_e2e_generate)
 
     sp = sub.add_parser("debug", help="capture a running node's state (kill|dump)")
     sp.add_argument("debug_command", choices=["kill", "dump"])

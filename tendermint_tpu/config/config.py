@@ -72,6 +72,9 @@ class P2PConfig:
     # per-connection flow control, bytes/sec (ref: conn/connection.go:45-46)
     send_rate: int = 512000
     recv_rate: int = 512000
+    # per-peer outbound queue discipline: fifo | priority |
+    # simple-priority (ref: config.go P2PConfig.QueueType)
+    queue_type: str = "fifo"
 
 
 @dataclass
@@ -124,7 +127,10 @@ class ConsensusConfig:
 class TxIndexConfig:
     """ref: config.TxIndexConfig (config/config.go:1100)."""
 
-    indexer: str = "kv"  # kv | "null"
+    indexer: str = "kv"  # kv | sqlite | psql | "null", comma-separated
+    # DSN for the psql sink, e.g. postgresql://user:pw@host:5432/db
+    # (ref: config.go TxIndexConfig.PsqlConn)
+    psql_conn: str = ""
 
 
 @dataclass

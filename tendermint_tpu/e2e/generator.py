@@ -1,0 +1,160 @@
+"""Randomized e2e manifest generator (ref: test/e2e/generator/generate.go).
+
+Produces combinatorial testnet manifests over the dimensions the runner
+supports — topology x ABCI transport x key type x sync mode x
+perturbations x vote-extension height x ABCI delays — from a seeded RNG
+so CI can sweep `--seed N` reproducibly. Every emitted manifest
+satisfies the runner's own validation invariants (a state_sync node
+starts late AND some node produces snapshots, a BFT quorum starts at
+genesis, late joiners get a validator_update).
+"""
+
+from __future__ import annotations
+
+import random
+
+from .manifest import Manifest
+
+
+def _weighted(r: random.Random, table: dict[str, int]) -> str:
+    total = sum(table.values())
+    pick = r.randrange(total)
+    for value, weight in table.items():
+        pick -= weight
+        if pick < 0:
+            return value
+    raise AssertionError("unreachable")
+
+
+# ref: generate.go testnetCombinations — the Cartesian axes; the rest is
+# randomly chosen per testnet/node.
+TOPOLOGIES = ("single", "duo", "quad", "large")
+ABCI_MODES = ("builtin", "outofprocess")
+
+ABCI_PROTOCOLS = {"tcp": 20, "grpc": 20, "unix": 10}  # generate.go:36-40
+KEY_TYPES = {"ed25519": 60, "secp256k1": 20, "sr25519": 20}
+PERTURBATIONS = {"disconnect": 0.1, "pause": 0.1, "kill": 0.1, "restart": 0.1}
+# ref: generate.go:134-147 abciDelays none/small/large
+DELAY_PROFILES = {
+    "none": {},
+    "small": {"prepare_proposal_delay_ms": 50, "process_proposal_delay_ms": 50,
+              "finalize_block_delay_ms": 100},
+    "large": {"prepare_proposal_delay_ms": 100, "process_proposal_delay_ms": 100,
+              "check_tx_delay_ms": 10, "finalize_block_delay_ms": 250},
+}
+
+
+def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: int) -> str:
+    """One testnet manifest as TOML text."""
+    lines: list[str] = []
+    key_type = _weighted(r, KEY_TYPES)
+    lines.append(f'chain_id = "gen-{index:03d}-{topology}"')
+    lines.append(f"load_tx_rate = {r.choice((5, 10, 20))}")
+    lines.append(f'key_type = "{key_type}"')
+
+    if topology == "single":
+        n_validators, n_fulls, n_seeds = 1, 0, 0
+    elif topology == "duo":
+        n_validators, n_fulls, n_seeds = 2, 0, 0
+    elif topology == "quad":
+        n_validators, n_fulls, n_seeds = 4, 0, 0
+    else:  # large
+        n_validators = 4 + r.randrange(3)
+        n_fulls = r.randrange(2)
+        n_seeds = r.randrange(2)
+
+    # Vote extensions activate a few heights in, half the time
+    # (ref: generate.go:124-126).
+    if r.random() < 0.5:
+        lines.append(f"vote_extensions_enable_height = {r.choice((2, 3, 10))}")
+
+    for field, value in DELAY_PROFILES[r.choice(tuple(DELAY_PROFILES))].items():
+        lines.append(f"{field} = {value}")
+
+    # Late joiners: only meaningful with >= 4 validators (a BFT quorum
+    # must remain at genesis). Half are statesync restores, half plain
+    # blocksync (ref: generate.go:178-186 startAt + nodeStateSyncs).
+    late: dict[str, tuple[int, bool]] = {}
+    snapshot_interval = 0
+    if n_validators >= 4 and r.random() < 0.5:
+        start_at = 3 + r.randrange(3)
+        use_statesync = r.random() < 0.5
+        late[f"validator{n_validators:02d}"] = (start_at, use_statesync)
+        if use_statesync:
+            snapshot_interval = r.choice((2, 3))
+    if snapshot_interval or (r.random() < 0.25):
+        lines.append(f"snapshot_interval = {snapshot_interval or r.choice((2, 3))}")
+
+    # A validator update accompanies every late joiner so it gains power
+    # once synced (ref: generate.go:192-196); occasionally also a power
+    # change for an existing validator.
+    updates: dict[int, dict[str, int]] = {}
+    for name, (start_at, _) in late.items():
+        updates.setdefault(start_at + 2, {})[name] = 30 + r.randrange(71)
+    if n_validators >= 2 and r.random() < 0.3:
+        updates.setdefault(3, {})["validator01"] = 30 + r.randrange(71)
+    for height, upd in sorted(updates.items()):
+        lines.append(f"[validator_update.{height}]")
+        for name, power in sorted(upd.items()):
+            lines.append(f"{name} = {power}")
+
+    def node_lines(name: str, mode: str) -> None:
+        lines.append(f"[node.{name}]")
+        if mode != "validator":
+            lines.append(f'mode = "{mode}"')
+        if mode != "seed":
+            if abci_mode == "outofprocess":
+                lines.append(f'abci_protocol = "{_weighted(r, ABCI_PROTOCOLS)}"')
+            start = late.get(name)
+            if start is not None:
+                lines.append(f"start_at = {start[0]}")
+                if start[1]:
+                    lines.append("state_sync = true")
+            else:
+                perturbs = [p for p, prob in PERTURBATIONS.items() if r.random() < prob]
+                if perturbs and mode == "validator" and n_validators >= 2:
+                    lines.append(f"perturb = {perturbs!r}".replace("'", '"'))
+
+    for i in range(1, n_seeds + 1):
+        node_lines(f"seed{i:02d}", "seed")
+    for i in range(1, n_validators + 1):
+        node_lines(f"validator{i:02d}", "validator")
+    for i in range(1, n_fulls + 1):
+        node_lines(f"full{i:02d}", "full")
+    return "\n".join(lines) + "\n"
+
+
+def generate(seed: int, topologies=TOPOLOGIES, abci_modes=ABCI_MODES) -> list[tuple[str, str]]:
+    """The Cartesian product of the global axes, one manifest each
+    (ref: generate.go:79 Generate). Returns [(name, toml_text)]."""
+    r = random.Random(seed)
+    out = []
+    index = 0
+    for topology in topologies:
+        for abci_mode in abci_modes:
+            name = f"gen-{seed:04d}-{index:03d}-{topology}-{abci_mode}"
+            out.append((name, generate_manifest(r, topology, abci_mode, index)))
+            index += 1
+    return out
+
+
+def validate_generated(text: str) -> Manifest:
+    """Parse + check the runner's invariants; raises on violation."""
+    m = Manifest.parse(text)
+    names = {n.name for n in m.nodes}
+    # Every manifest validator is in the genesis set (runner.setup), so
+    # the ones whose processes start at genesis must alone exceed 2/3:
+    # at most floor((n-1)/3) validators may join late.
+    late_vals = [n for n in m.validators if n.start_at > 0]
+    if len(late_vals) > max(0, (len(m.validators) - 1) // 3):
+        raise ValueError("too many late validators for a genesis quorum")
+    for n in m.nodes:
+        if n.state_sync and n.start_at <= 0:
+            raise ValueError(f"{n.name}: state_sync without start_at")
+        if n.state_sync and m.snapshot_interval <= 0:
+            raise ValueError(f"{n.name}: state_sync without snapshots")
+    for height, upd in m.validator_updates.items():
+        for name in upd:
+            if name not in names:
+                raise ValueError(f"validator_update.{height} references unknown node {name}")
+    return m

@@ -34,11 +34,12 @@ def _key(prefix: bytes, ev) -> bytes:
 class EvidencePool:
     """ref: evidence.Pool (pool.go:42)."""
 
-    def __init__(self, db, state_store, block_store, logger=None):
+    def __init__(self, db, state_store, block_store, logger=None, metrics=None):
         self.db = db
         self.state_store = state_store
         self.block_store = block_store
         self.logger = logger
+        self.metrics = metrics  # EvidenceMetrics (ref: evidence/metrics.go)
         self._lock = threading.RLock()
         self._pending: dict[bytes, object] = {}  # hash → evidence
         self._consensus_buffer: list[tuple] = []  # (vote_a, vote_b)
@@ -123,8 +124,12 @@ class EvidencePool:
             self._state = state
             for ev in ev_list:
                 self._mark_committed(ev)
+            if ev_list and self.metrics is not None:
+                self.metrics.committed.add(len(ev_list))
             self._process_consensus_buffer(state)
             self._prune_expired()
+            if self.metrics is not None:
+                self.metrics.num_evidence.set(len(self._pending))
 
     # ------------------------------------------------------------ internals
 
@@ -138,6 +143,8 @@ class EvidencePool:
     def _add_pending(self, ev) -> None:
         self._pending[ev.hash()] = ev
         self.db.set(_key(_PENDING_PREFIX, ev), evidence_to_proto(ev).encode())
+        if self.metrics is not None:
+            self.metrics.num_evidence.set(len(self._pending))
 
     def _mark_committed(self, ev) -> None:
         h = ev.hash()

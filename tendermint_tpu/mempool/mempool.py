@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
@@ -294,9 +295,11 @@ class TxMempool:
             if key in self._txs:
                 self._remove(key)
         if recheck and self._txs:
+            t0 = time.monotonic()
             self._recheck_txs()
             if self._metrics is not None:
                 self._metrics.recheck_times.add(1)
+                self._metrics.recheck_duration.observe(time.monotonic() - t0)
         if self._metrics is not None:
             self._metrics.size.set(self.size())
         self._notify_txs_available()

@@ -209,6 +209,11 @@ class MempoolMetrics:
         self.failed_txs = reg.counter(f"{ns}_failed_txs", "Rejected transactions")
         self.evicted_txs = reg.counter(f"{ns}_evicted_txs", "Evicted transactions")
         self.recheck_times = reg.counter(f"{ns}_recheck_times", "Recheck runs")
+        self.recheck_duration = reg.histogram(
+            f"{ns}_recheck_duration_seconds",
+            "Wall time of one post-commit recheck sweep",
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
 
 
 class P2PMetrics:
@@ -223,6 +228,49 @@ class P2PMetrics:
         self.message_receive_bytes_total = reg.counter(
             f"{ns}_message_receive_bytes_total", "Bytes received", labels=("chID",)
         )
+        self.peer_queue_dropped_msgs = reg.counter(
+            f"{ns}_peer_queue_dropped_msgs",
+            "Envelopes dropped from full per-peer send queues",
+            labels=("chID",),
+        )
+
+
+class BlockSyncMetrics:
+    """ref: internal/blocksync/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_blocksync"
+        self.syncing = reg.gauge(f"{ns}_syncing", "1 while block-syncing")
+        self.num_blocks = reg.counter(f"{ns}_num_blocks", "Blocks synced and applied")
+        self.latest_height = reg.gauge(f"{ns}_latest_block_height", "Pool verify height")
+        self.sync_rate = reg.gauge(f"{ns}_sync_rate", "Recent blocks/sec estimate")
+
+
+class StateSyncMetrics:
+    """ref: internal/statesync/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_statesync"
+        self.snapshots_discovered = reg.counter(
+            f"{ns}_total_snapshots", "Snapshots discovered from peers"
+        )
+        self.chunks_applied = reg.counter(f"{ns}_chunks_applied", "Snapshot chunks applied")
+        self.chunk_process_time = reg.histogram(
+            f"{ns}_chunk_process_seconds", "Fetch-to-apply time per chunk",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+        )
+        self.backfilled_blocks = reg.counter(
+            f"{ns}_backfilled_blocks", "Light blocks backfilled after restore"
+        )
+
+
+class EvidenceMetrics:
+    """ref: internal/evidence/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_evidence"
+        self.num_evidence = reg.gauge(f"{ns}_pool_num_evidence", "Pending evidence")
+        self.committed = reg.counter(f"{ns}_committed", "Evidence committed in blocks")
 
 
 class StateMetrics:

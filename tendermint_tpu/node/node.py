@@ -123,12 +123,15 @@ class Node:
         node_key: NodeKey | None = None,
     ):
         from ..metrics import (
+            BlockSyncMetrics,
             ConsensusMetrics,
+            EvidenceMetrics,
             MempoolMetrics,
             P2PMetrics,
             PrometheusServer,
             Registry,
             StateMetrics,
+            StateSyncMetrics,
         )
         from ..utils.log import Logger, parse_level
 
@@ -141,6 +144,9 @@ class Node:
         self.mempool_metrics = MempoolMetrics(self.metrics_registry)
         self.p2p_metrics = P2PMetrics(self.metrics_registry)
         self.state_metrics = StateMetrics(self.metrics_registry)
+        self.blocksync_metrics = BlockSyncMetrics(self.metrics_registry)
+        self.statesync_metrics = StateSyncMetrics(self.metrics_registry)
+        self.evidence_metrics = EvidenceMetrics(self.metrics_registry)
         self.prometheus_server = (
             PrometheusServer(self.metrics_registry, config.instrumentation.prometheus_listen_addr)
             if config.instrumentation.prometheus
@@ -166,27 +172,41 @@ class Node:
         from ..eventbus.eventlog import EventLog
 
         self.event_bus = EventBus(event_log=EventLog())
-        # Event sinks (ref: EventSinksFromConfig, node/setup.go): "kv"
-        # and/or "sqlite" (the psql-sink analog), comma-separated.
+        # Event sinks (ref: EventSinksFromConfig, node/setup.go): "kv",
+        # "sqlite" (in-process SQL), and/or "psql" (a real Postgres via
+        # config.tx_index.psql_conn, ref: config.go TxIndexConfig.PsqlConn),
+        # comma-separated.
         self.indexer = None
-        self.sql_sink = None
+        self.sql_sinks = []  # every SQL-backed sink, closed on stop
         sinks = []
         for name in filter(None, (s.strip() for s in config.tx_index.indexer.split(","))):
             if name == "kv":
                 self.indexer = KVIndexer(_make_db(config, "tx_index"))
                 sinks.append(self.indexer)
-            elif name in ("sqlite", "psql"):
+            elif name == "sqlite":
                 from ..indexer.sink_sql import SQLSink
 
                 os.makedirs(config.db_dir, exist_ok=True)
-                self.sql_sink = SQLSink(
+                self.sql_sinks.append(SQLSink(
                     os.path.join(config.db_dir, "events.sqlite"), self.gen_doc.chain_id
-                )
-                sinks.append(self.sql_sink)
+                ))
+                sinks.append(self.sql_sinks[-1])
+            elif name == "psql":
+                from ..indexer.sink_psql import PsqlSink
+
+                dsn = getattr(config.tx_index, "psql_conn", "")
+                if not dsn:
+                    raise ValueError(
+                        "tx_index.indexer 'psql' requires `psql-conn` in the "
+                        "[tx-index] section (ref: config.go TxIndexConfig.PsqlConn)"
+                    )
+                self.sql_sinks.append(PsqlSink(dsn, self.gen_doc.chain_id))
+                sinks.append(self.sql_sinks[-1])
             elif name in ("null", "none"):
                 continue
             else:
                 raise ValueError(f"unsupported tx_index.indexer {name!r}")
+        self.sql_sink = self.sql_sinks[0] if self.sql_sinks else None
         self.indexer_service = IndexerService(sinks, self.event_bus) if sinks else None
 
         # ---- privval (node/setup.go:489: file | socket | grpc remote signer)
@@ -274,7 +294,7 @@ class Node:
         )
         self.router = Router(
             self.node_info, self.node_key.priv_key, self.peer_manager, [self.transport],
-            options=RouterOptions(),
+            options=RouterOptions(queue_type=config.p2p.queue_type),
             metrics=self.p2p_metrics,
         )
         cs_chs = [self.router.open_channel(d) for d in consensus_channel_descriptors()]
@@ -302,7 +322,8 @@ class Node:
             metrics=self.mempool_metrics,
         )
         self.evidence_pool = EvidencePool(
-            _make_db(config, "evidence"), self.state_store, self.block_store
+            _make_db(config, "evidence"), self.state_store, self.block_store,
+            metrics=self.evidence_metrics,
         )
         self.block_executor = BlockExecutor(
             self.state_store,
@@ -354,6 +375,7 @@ class Node:
             on_caught_up=self._on_blocksync_done,
             block_sync=self._should_blocksync(state),
             on_fatal=self._on_fatal,
+            metrics=self.blocksync_metrics,
         )
 
         # ---- statesync (node/node.go:352-377): always serves snapshots/
@@ -368,6 +390,7 @@ class Node:
             ss_chs[0], ss_chs[1], ss_chs[2], ss_chs[3],
             self.peer_manager,
             local_provider=self.local_provider,
+            metrics=self.statesync_metrics,
         )
 
         # ---- RPC (node/node.go:509)
@@ -587,8 +610,8 @@ class Node:
             self.indexer_service.stop()
         if self.prometheus_server is not None:
             self.prometheus_server.stop()
-        if self.sql_sink is not None:
-            self.sql_sink.close()
+        for sink in self.sql_sinks:
+            sink.close()
         self.consensus.wal.close()
 
     # -------------------------------------------------------------- helpers

@@ -15,6 +15,7 @@ Channel.in_queue → reactor.
 
 from __future__ import annotations
 
+import heapq
 import queue
 import threading
 import traceback
@@ -39,6 +40,9 @@ class RouterOptions:
     # per-IP inbound limits (ref: conn_tracker.go; 0 disables)
     max_incoming_per_ip: int = 8
     incoming_conn_window: float = 0.1
+    # per-peer outbound queue discipline (ref: config `queue-type`,
+    # router.go queueFactory): fifo | priority | simple-priority
+    queue_type: str = "fifo"
 
 
 class _PeerQueue:
@@ -75,6 +79,83 @@ class _PeerQueue:
             pass
 
 
+class _PriorityPeerQueue:
+    """Per-peer outbound queue scheduling by channel priority
+    (ref: pqueue.go:289 priorityQueueScheduler): dequeue order is
+    strictly highest-priority-first (FIFO within a priority); when full,
+    the lowest-priority entry — possibly the incoming one — is dropped,
+    so consensus traffic survives a flood of low-priority gossip."""
+
+    __slots__ = ("_heap", "_size", "_cv", "closed", "_seq", "_priorities", "dropped",
+                 "on_drop")
+
+    def __init__(self, size: int, priorities: dict[int, int], on_drop=None):
+        self._heap: list[tuple[int, int, Envelope]] = []  # (-prio, seq, env)
+        self._size = size
+        self._cv = threading.Condition()
+        self.closed = threading.Event()  # same surface as _PeerQueue
+        self._seq = 0
+        self._priorities = priorities
+        self.dropped = 0
+        self.on_drop = on_drop  # callable(channel_id) for evicted envelopes
+
+    def _priority(self, envelope: Envelope) -> int:
+        return self._priorities.get(envelope.channel_id, 0)
+
+    def put(self, envelope: Envelope, timeout: float = 1.0) -> bool:
+        prio = self._priority(envelope)
+        with self._cv:
+            if self.closed.is_set():
+                return False
+            if len(self._heap) >= self._size:
+                worst = max(self._heap)  # lowest priority, newest within it
+                if (-prio, self._seq) >= worst[:2]:
+                    self.dropped += 1
+                    return False  # incoming ranks lowest: drop it (caller counts)
+                self._heap.remove(worst)
+                heapq.heapify(self._heap)
+                self.dropped += 1
+                if self.on_drop is not None:
+                    # an eviction is invisible to the caller (put returns
+                    # True), so it must be metered here
+                    self.on_drop(worst[2].channel_id)
+            heapq.heappush(self._heap, (-prio, self._seq, envelope))
+            self._seq += 1
+            self._cv.notify()
+            return True
+
+    def get(self, timeout: float = 0.2):
+        with self._cv:
+            if not self._heap:
+                self._cv.wait(timeout)
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def close(self) -> None:
+        with self._cv:
+            self.closed.set()
+            self._cv.notify_all()
+
+
+class _SimplePriorityPeerQueue(_PriorityPeerQueue):
+    """ref: rqueue.go newSimplePriorityQueue: arrival-order delivery,
+    priorities consulted only under pressure (overflow drops the
+    lowest-priority queued entry, or the incoming one if it ranks
+    lowest)."""
+
+    def get(self, timeout: float = 0.2):
+        with self._cv:
+            if not self._heap:
+                self._cv.wait(timeout)
+            if not self._heap:
+                return None
+            entry = min(self._heap, key=lambda e: e[1])  # oldest first
+            self._heap.remove(entry)
+            heapq.heapify(self._heap)
+            return entry[2]
+
+
 class Router:
     """ref: internal/p2p/router.go Router."""
 
@@ -108,6 +189,10 @@ class Router:
         self._stop = threading.Event()
         self._network_enabled = threading.Event()
         self._network_enabled.set()
+        if self.options.queue_type not in ("fifo", "priority", "simple-priority", "", None):
+            # fail at construction: a per-connection failure after the
+            # handshake would leave peers wedged in connected state
+            raise ValueError(f"unsupported queue-type {self.options.queue_type!r}")
         self._conn_tracker = (
             ConnTracker(self.options.max_incoming_per_ip, self.options.incoming_conn_window)
             if self.options.max_incoming_per_ip > 0
@@ -157,6 +242,27 @@ class Router:
     @property
     def network_enabled(self) -> bool:
         return self._network_enabled.is_set()
+
+    def _make_peer_queue(self):
+        """ref: router.go createQueueFactory, selectable via config
+        `queue-type`."""
+        qt = self.options.queue_type
+        if qt in ("fifo", "", None):
+            return _PeerQueue(self.options.queue_size)
+        with self._channel_lock:
+            priorities = {cid: ch.desc.priority for cid, ch in self._channels.items()}
+        on_drop = None
+        if self.metrics is not None:
+            metrics = self.metrics
+
+            def on_drop(channel_id: int) -> None:
+                metrics.peer_queue_dropped_msgs.add(1, f"{channel_id:#x}")
+
+        if qt == "priority":
+            return _PriorityPeerQueue(self.options.queue_size, priorities, on_drop=on_drop)
+        if qt == "simple-priority":
+            return _SimplePriorityPeerQueue(self.options.queue_size, priorities, on_drop=on_drop)
+        raise ValueError(f"unsupported queue-type {qt!r}")
 
     def start(self) -> None:
         self._stop.clear()
@@ -242,7 +348,9 @@ class Router:
                     to=nid,
                     channel_id=ch.id,
                 )
-                pq.put(env)
+                if not pq.put(env) and self.metrics is not None:
+                    # ref: p2p/metrics.go PeerQueueDroppedMsgs
+                    self.metrics.peer_queue_dropped_msgs.add(1, f"{ch.id:#x}")
 
     # ------------------------------------------------------------- accept
 
@@ -322,7 +430,7 @@ class Router:
             return
 
         peer_channels = set(peer_info.channels)
-        pq = _PeerQueue(self.options.queue_size)
+        pq = self._make_peer_queue()
         if self.metrics is not None:
             metrics = self.metrics
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import queue
 import socket
 import struct
 import threading
@@ -50,33 +51,62 @@ def _rpc_response(id_, result=None, error: RPCError | None = None) -> dict:
 
 class _WebSocketConnection:
     """Minimal RFC-6455 server-side connection (ref: gorilla/websocket
-    usage in rpc/jsonrpc/server/ws_handler.go)."""
+    usage in rpc/jsonrpc/server/ws_handler.go).
+
+    Writes go through a bounded per-connection queue drained by one
+    writer thread — subscription pushers never block on a slow client's
+    socket. When the queue overflows, the connection is terminated, the
+    reference's slow-consumer policy (ws_handler.go writeChan: a client
+    that cannot keep up with its subscriptions is disconnected rather
+    than allowed to stall the event pipeline)."""
+
+    SEND_QUEUE_SIZE = 512
+    _SENTINEL = object()
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self._send_lock = threading.Lock()
         self.closed = threading.Event()
+        self._out: queue.Queue = queue.Queue(maxsize=self.SEND_QUEUE_SIZE)
+        self.dropped_for_backpressure = False
+        self._writer = threading.Thread(target=self._write_pump, daemon=True, name="ws-writer")
+        self._writer.start()
 
     def send_json(self, obj) -> None:
         self.send_text(json.dumps(obj))
 
     def send_text(self, text: str) -> None:
-        payload = text.encode()
-        header = bytearray([0x81])  # FIN + text
-        n = len(payload)
-        if n < 126:
-            header.append(n)
-        elif n < 1 << 16:
-            header.append(126)
-            header += struct.pack(">H", n)
-        else:
-            header.append(127)
-            header += struct.pack(">Q", n)
-        with self._send_lock:
-            try:
-                self.sock.sendall(bytes(header) + payload)
-            except OSError:
-                self.closed.set()
+        if self.closed.is_set():
+            return
+        try:
+            self._out.put_nowait(text.encode())
+        except queue.Full:
+            # Slow consumer: terminate instead of stalling the pushers.
+            self.dropped_for_backpressure = True
+            self.close()
+
+    def _write_pump(self) -> None:
+        while True:
+            item = self._out.get()
+            if item is self._SENTINEL or self.closed.is_set():
+                return
+            payload = item
+            header = bytearray([0x81])  # FIN + text
+            n = len(payload)
+            if n < 126:
+                header.append(n)
+            elif n < 1 << 16:
+                header.append(126)
+                header += struct.pack(">H", n)
+            else:
+                header.append(127)
+                header += struct.pack(">Q", n)
+            with self._send_lock:
+                try:
+                    self.sock.sendall(bytes(header) + payload)
+                except OSError:
+                    self.closed.set()
+                    return
 
     def recv_text(self) -> str | None:
         """One text message (handles ping/close); None when closed."""
@@ -131,6 +161,15 @@ class _WebSocketConnection:
 
     def close(self) -> None:
         self.closed.set()
+        try:
+            self._out.put_nowait(self._SENTINEL)  # release the writer
+        except queue.Full:
+            pass
+        try:
+            # unblock a mid-sendall writer and the reader thread
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:

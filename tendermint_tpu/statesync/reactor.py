@@ -189,6 +189,7 @@ class StateSyncReactor:
         params_ch,
         peer_manager,
         local_provider=None,
+        metrics=None,
     ):
         self.app = app_client
         self.state_store = state_store
@@ -198,6 +199,7 @@ class StateSyncReactor:
         self.lb_ch = lb_ch
         self.params_ch = params_ch
         self.peer_manager = peer_manager
+        self.metrics = metrics  # StateSyncMetrics
         self.local_provider = local_provider
         self.syncer = None  # set by sync()
         self._stop = threading.Event()
@@ -340,7 +342,8 @@ class StateSyncReactor:
                 peer, ChunkRequest(snapshot.height, snapshot.format, index), timeout=1.0
             )
 
-        self.syncer = Syncer(self.app, state_provider, request_snapshots, request_chunk)
+        self.syncer = Syncer(self.app, state_provider, request_snapshots, request_chunk,
+                             metrics=self.metrics)
         state, commit = self.syncer.sync_any(discovery_time=discovery_time, stop_event=self._stop)
 
         # persist: bootstrap state + seen commit so consensus/blocksync
@@ -390,5 +393,7 @@ class StateSyncReactor:
             self.state_store.save_validator_sets(prev.height, prev.height, prev.validator_set)
             self.block_store.save_seen_commit(prev.height, prev.signed_header.commit)
             stored += 1
+            if self.metrics is not None:
+                self.metrics.backfilled_blocks.add(1)
             cur = prev
         return stored

@@ -127,7 +127,8 @@ class Syncer:
     CHUNK_TIMEOUT = 5.0
     FETCH_STALL = 15.0
 
-    def __init__(self, app_client, state_provider, request_snapshots, request_chunk, logger=None):
+    def __init__(self, app_client, state_provider, request_snapshots, request_chunk, logger=None,
+                 metrics=None):
         """request_snapshots() broadcasts a GetSnapshots query;
         request_chunk(snapshot, index, peers) asks a peer for a chunk.
         state_provider: .app_hash(height), .state(height), .commit(height)."""
@@ -135,6 +136,7 @@ class Syncer:
         self.state_provider = state_provider
         self.request_snapshots = request_snapshots
         self.request_chunk = request_chunk
+        self.metrics = metrics  # StateSyncMetrics (ref: statesync/metrics.go)
         self.snapshots = _SnapshotPool()
         self.chunks: _ChunkQueue | None = None
         self._current: abci.Snapshot | None = None
@@ -144,7 +146,10 @@ class Syncer:
     # ------------------------------------------------------------ inbound
 
     def add_snapshot(self, peer_id: str, snapshot: abci.Snapshot) -> bool:
-        return self.snapshots.add(peer_id, snapshot)
+        added = self.snapshots.add(peer_id, snapshot)
+        if added and self.metrics is not None:
+            self.metrics.snapshots_discovered.add(1)
+        return added
 
     def add_chunk(self, index: int, chunk: bytes, sender: str) -> bool:
         with self._lock:
@@ -232,12 +237,16 @@ class Syncer:
                 stop_event.wait(0.05)
                 continue
             index, chunk, sender = entry
-            last_progress = time.monotonic()
+            chunk_t0 = time.monotonic()
+            last_progress = chunk_t0
             resp = self.app.apply_snapshot_chunk(
                 abci.RequestApplySnapshotChunk(index=index, chunk=chunk, sender=sender)
             )
             if resp.result == abci.CHUNK_ACCEPT:
                 applied += 1
+                if self.metrics is not None:
+                    self.metrics.chunks_applied.add(1)
+                    self.metrics.chunk_process_time.observe(time.monotonic() - chunk_t0)
                 continue
             if resp.result == abci.CHUNK_RETRY:
                 self.chunks.refetch([index])

@@ -318,3 +318,77 @@ def test_network_disconnect_is_a_real_partition():
     finally:
         router_a.stop()
         router_b.stop()
+
+
+def test_priority_queue_discipline():
+    """ref: pqueue.go:289 — strict priority dequeue, FIFO within a
+    priority, lowest-priority dropped on overflow."""
+    from tendermint_tpu.p2p.router import _PriorityPeerQueue
+
+    priorities = {0x20: 8, 0x30: 5, 0x00: 1}
+    q = _PriorityPeerQueue(4, priorities)
+    mk = lambda ch, n: Envelope(channel_id=ch, message=n)
+    assert q.put(mk(0x00, "pex1"))
+    assert q.put(mk(0x30, "mp1"))
+    assert q.put(mk(0x30, "mp2"))
+    assert q.put(mk(0x00, "pex2"))
+    # full: high-priority consensus traffic evicts low-priority pex
+    assert q.put(mk(0x20, "cs1"))
+    assert q.dropped == 1
+    # full again: incoming pex ranks lowest -> dropped, queue unchanged
+    assert not q.put(mk(0x00, "pex3"))
+    got = [q.get(timeout=0.1).message for _ in range(4)]
+    assert got == ["cs1", "mp1", "mp2", "pex1"]  # priority order, FIFO within
+    assert q.get(timeout=0.05) is None
+    q.close()
+    assert not q.put(mk(0x20, "after-close"))
+
+
+def test_simple_priority_queue_discipline():
+    """ref: rqueue.go — arrival-order delivery; priority only decides
+    what to drop under pressure."""
+    from tendermint_tpu.p2p.router import _SimplePriorityPeerQueue
+
+    priorities = {0x20: 8, 0x00: 1}
+    q = _SimplePriorityPeerQueue(3, priorities)
+    mk = lambda ch, n: Envelope(channel_id=ch, message=n)
+    q.put(mk(0x20, "a"))
+    q.put(mk(0x00, "pex"))
+    q.put(mk(0x20, "b"))
+    q.put(mk(0x20, "c"))  # overflow: the pex entry is sacrificed
+    got = [q.get(timeout=0.1).message for _ in range(3)]
+    assert got == ["a", "b", "c"]  # arrival order, not priority order
+
+
+def test_router_priority_queue_roundtrip():
+    """The selectable discipline works end to end over the memory
+    network (config queue-type=priority)."""
+    from tendermint_tpu.p2p.router import RouterOptions
+
+    net = MemoryNetwork()
+
+    def mk(seed):
+        key = Ed25519PrivKey.generate(bytes([seed]) * 32)
+        nid = node_id_from_pubkey(key.pub_key())
+        t = net.create_transport(nid)
+        pm = PeerManager(nid, PeerManagerOptions(max_connected=4))
+        router = Router(
+            NodeInfo(node_id=nid, network="pq-test"), key, pm, [t],
+            options=RouterOptions(queue_type="priority"),
+        )
+        ch = router.open_channel(CH_TEST)
+        return nid, pm, router, ch
+
+    nid_a, pm_a, router_a, ch_a = mk(0x41)
+    nid_b, pm_b, router_b, ch_b = mk(0x42)
+    router_a.start()
+    router_b.start()
+    try:
+        pm_a.add(Endpoint(protocol="memory", host=nid_b, node_id=nid_b))
+        assert wait_until(lambda: nid_b in pm_a.peers(), timeout=10)
+        ch_a.send_to(nid_b, b"ping")
+        env = ch_b.receive_one(timeout=10)
+        assert env is not None and env.message == b"ping"
+    finally:
+        router_a.stop()
+        router_b.stop()

@@ -246,3 +246,44 @@ def test_local_client_matches_http(tmp_path):
             local.call("block", height=10**9)
     finally:
         real.stop()
+
+
+def test_websocket_slow_consumer_is_disconnected(monkeypatch):
+    """ref: ws_handler.go writeChan — a client that cannot drain its
+    subscription pushes is terminated instead of stalling the pushers;
+    the send path never blocks the caller."""
+    import threading
+    import time as _time
+
+    from tendermint_tpu.rpc.server import _WebSocketConnection
+
+    class WedgedSock:
+        """A socket whose send never completes until shutdown."""
+
+        def __init__(self):
+            self.unblock = threading.Event()
+            self.shutdown_called = threading.Event()
+
+        def sendall(self, data):
+            if not self.unblock.wait(timeout=5):
+                raise OSError("send timed out")
+            raise OSError("connection reset")
+
+        def shutdown(self, how):
+            self.shutdown_called.set()
+            self.unblock.set()
+
+        def close(self):
+            self.unblock.set()
+
+    monkeypatch.setattr(_WebSocketConnection, "SEND_QUEUE_SIZE", 4)
+    sock = WedgedSock()
+    conn = _WebSocketConnection(sock)
+    t0 = _time.monotonic()
+    for i in range(8):  # first blocks in sendall, 4 fill the queue, next closes
+        conn.send_text(f"event-{i}")
+    elapsed = _time.monotonic() - t0
+    assert elapsed < 1.0, "send path blocked on the slow client"
+    assert conn.closed.is_set()
+    assert conn.dropped_for_backpressure
+    assert sock.shutdown_called.wait(timeout=2), "wedged writer was not unblocked"
