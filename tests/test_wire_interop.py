@@ -1,0 +1,124 @@
+"""Cross-implementation interop fixtures replayed from the reference
+tree IN PLACE (the same pattern as the light-client MBT traces): the
+reference's own recorded bytes exercising our wire stack.
+
+1. SecretConnection key schedule: the reference's
+   TestDeriveSecretsAndChallengeGolden vectors
+   (internal/p2p/conn/testdata/) — 32 recorded (dh_secret,
+   loc_is_least) -> (recv, send, challenge) triples. A hand-rolled
+   HKDF/key-split that drifted would fail every encrypted byte of the
+   transport.
+2. The reference's go-fuzz seed corpora (test/fuzz/tests/testdata/):
+   inputs that were interesting against the Go stack, replayed against
+   our jsonrpc parser, secret-connection handshake, and mempool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+REF = "/root/reference"
+GOLDEN = os.path.join(REF, "internal/p2p/conn/testdata/TestDeriveSecretsAndChallengeGolden.golden")
+CORPUS = os.path.join(REF, "test/fuzz/tests/testdata/fuzz")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference tree not present"
+)
+
+
+def test_derive_secrets_golden_vectors():
+    """ref: secret_connection_test.go:227 — byte-exact HKDF key schedule."""
+    from tendermint_tpu.p2p.secret_connection import derive_secrets
+
+    n = 0
+    for line in open(GOLDEN):
+        parts = line.strip().split(",")
+        if len(parts) < 4:
+            continue
+        dh = bytes.fromhex(parts[0])
+        loc_is_least = parts[1] == "true"
+        recv, send, chal = derive_secrets(dh, loc_is_least)
+        assert recv.hex() == parts[2], f"recv secret mismatch at vector {n}"
+        assert send.hex() == parts[3], f"send secret mismatch at vector {n}"
+        if len(parts) > 4 and parts[4]:
+            assert chal.hex() == parts[4], f"challenge mismatch at vector {n}"
+        n += 1
+    assert n == 32
+
+
+def _corpus_inputs(name: str) -> list[bytes]:
+    """Parse Go fuzz seed files: 'go test fuzz v1' + []byte(\"...\")."""
+    out = []
+    d = os.path.join(CORPUS, name)
+    for fn in sorted(os.listdir(d)):
+        lines = open(os.path.join(d, fn), "rb").read().split(b"\n")
+        for line in lines[1:]:
+            line = line.strip()
+            if not line.startswith(b"[]byte("):
+                continue
+            literal = line[len(b"[]byte(") : line.rfind(b")")]
+            if len(literal) >= 2 and literal[:1] == b'"':
+                raw = literal[1:-1].decode("utf-8", "surrogateescape")
+                out.append(raw.encode().decode("unicode_escape").encode("latin1"))
+    return out
+
+
+def test_reference_fuzz_corpus_jsonrpc():
+    """ref: test/fuzz/tests/rpc_jsonrpc_server_test.go seeds."""
+    from tendermint_tpu.rpc.server import JSONRPCServer
+
+    srv = JSONRPCServer({"echo": lambda **kw: kw})
+    inputs = _corpus_inputs("FuzzRPCJSONRPCServer")
+    assert inputs
+    for data in inputs:
+        try:
+            req = json.loads(data)
+        except Exception:
+            continue  # the HTTP layer answers parse errors before dispatch
+        resp = srv._dispatch(req if isinstance(req, dict) else {"id": 0})
+        assert isinstance(resp, dict) and ("error" in resp or "result" in resp)
+
+
+def test_reference_fuzz_corpus_mempool():
+    """ref: test/fuzz/tests/mempool_test.go seeds."""
+    from tendermint_tpu.abci import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool.mempool import TxMempool
+
+    mp = TxMempool(LocalClient(KVStoreApplication()), size=100, max_tx_bytes=1 << 20)
+    inputs = _corpus_inputs("FuzzMempool")
+    assert inputs
+    for tx in inputs:
+        try:
+            mp.check_tx(tx)
+        except Exception as e:
+            assert type(e).__name__ in ("MempoolError", "RuntimeError", "ValueError",
+                                        "TxInCacheError"), repr(e)
+
+
+def test_reference_fuzz_corpus_secret_connection():
+    """ref: test/fuzz/tests/p2p_secretconnection_test.go seeds fed as a
+    hostile handshake stream."""
+    import socket as _socket
+
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.p2p.secret_connection import SecretConnection
+
+    inputs = _corpus_inputs("FuzzP2PSecretConnection")
+    assert inputs
+    key = Ed25519PrivKey.generate(b"\x07" * 32)
+    for data in inputs:
+        a, b = _socket.socketpair()
+        try:
+            a.settimeout(1.0)
+            b.sendall(data)
+            b.close()
+            try:
+                SecretConnection(a, key)
+            except Exception as e:
+                assert not isinstance(e, (SystemExit, KeyboardInterrupt, AssertionError)), repr(e)
+        finally:
+            a.close()
