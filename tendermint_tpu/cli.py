@@ -35,7 +35,8 @@ def cmd_init(args) -> int:
     """ref: commands/init.go — init validator|full|seed."""
     from .node import init_files_home
 
-    cfg = init_files_home(args.home, chain_id=args.chain_id or "", mode=args.mode)
+    cfg = init_files_home(args.home, chain_id=args.chain_id or "", mode=args.mode,
+                          key_type=args.key)
     print(f"initialized {args.mode} node in {args.home}")
     print(f"  config:  {os.path.join(args.home, 'config', 'config.toml')}")
     print(f"  genesis: {cfg.genesis_file}")
@@ -102,13 +103,19 @@ def cmd_testnet(args) -> int:
         cfg = default_config(home)
         os.makedirs(os.path.join(home, "config"), exist_ok=True)
         os.makedirs(os.path.join(home, "data"), exist_ok=True)
-        pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+        pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file,
+                                     key_type=args.key)
         NodeKey.load_or_gen(cfg.node_key_file)
         pvs.append(pv)
+
+    from .types.params import ConsensusParams, ValidatorParams
 
     gen_doc = GenesisDoc(
         chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
         genesis_time=Time.now(),
+        consensus_params=ConsensusParams(
+            validator=ValidatorParams(pub_key_types=(args.key,))
+        ),
         validators=[
             GenesisValidator(address=pv.get_pub_key().address(), pub_key=pv.get_pub_key(), power=10, name=f"node{i}")
             for i, pv in enumerate(pvs)
@@ -159,15 +166,17 @@ def cmd_show_validator(args) -> int:
 
 
 def cmd_gen_validator(args) -> int:
-    from .crypto.ed25519 import Ed25519PrivKey
+    """ref: commands/gen_validator.go (--key flag)."""
+    from .privval import FilePV
 
-    key = Ed25519PrivKey.generate()
+    kt = args.key
+    key = FilePV.generate(key_type=kt).priv_key  # one dispatch table (file_pv.py)
     print(
         json.dumps(
             {
                 "address": key.pub_key().address().hex().upper(),
-                "pub_key": {"type": "ed25519", "value": key.pub_key().bytes().hex()},
-                "priv_key": {"type": "ed25519", "value": key.bytes().hex()},
+                "pub_key": {"type": kt, "value": key.pub_key().bytes().hex()},
+                "priv_key": {"type": kt, "value": key.bytes().hex()},
             },
             indent=2,
         )
@@ -607,6 +616,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("init", help="initialize a node home directory")
     sp.add_argument("mode", nargs="?", default="validator", choices=["validator", "full", "seed"])
     sp.add_argument("--chain-id", default="")
+    sp.add_argument("--key", default="ed25519", choices=["ed25519", "sr25519", "secp256k1"],
+                    help="validator key type (ref: init.go:37)")
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("start", help="run the node")
@@ -618,11 +629,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", default="./testnet")
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", type=int, default=26656)
+    sp.add_argument("--key", default="ed25519", choices=["ed25519", "sr25519", "secp256k1"],
+                    help="validator key type")
     sp.set_defaults(fn=cmd_testnet)
 
     sub.add_parser("show-node-id", help="print the p2p node id").set_defaults(fn=cmd_show_node_id)
     sub.add_parser("show-validator", help="print the validator pubkey").set_defaults(fn=cmd_show_validator)
-    sub.add_parser("gen-validator", help="generate a validator keypair").set_defaults(fn=cmd_gen_validator)
+    sp = sub.add_parser("gen-validator", help="generate a validator keypair")
+    sp.add_argument("--key", default="ed25519", choices=["ed25519", "sr25519", "secp256k1"],
+                    help="key type (ref: gen_validator.go)")
+    sp.set_defaults(fn=cmd_gen_validator)
     sub.add_parser("gen-node-key", help="generate a node key").set_defaults(fn=cmd_gen_node_key)
     sub.add_parser("unsafe-reset-all", help="wipe the data directory").set_defaults(fn=cmd_reset)
     sub.add_parser("rollback", help="rewind state one height").set_defaults(fn=cmd_rollback)

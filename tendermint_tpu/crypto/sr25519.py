@@ -35,12 +35,12 @@ import os
 from . import BatchVerifier, PrivKey, PubKey, address_hash
 from .ed25519_ref import (
     BASE,
+    IDENTITY,
     L,
     P,
     D,
     point_add,
     point_neg,
-    scalar_mult,
 )
 from .merlin import Transcript
 
@@ -157,18 +157,59 @@ def _challenge(t: Transcript, pk_enc: bytes, r_enc: bytes) -> int:
 
 def sign(mini: bytes, msg: bytes) -> bytes:
     key, nonce = _expand_ed25519(mini)
-    pk_enc = ristretto_encode(scalar_mult(key % L, BASE))
+    pk_enc = ristretto_encode(_base_mult(key % L))
     t = _signing_transcript(msg)
     # Deterministic witness bound to (nonce, transcript state).
     wt = t.clone()
     wt.append_message(b"witness-nonce", nonce)
     r = int.from_bytes(wt.challenge_bytes(b"witness-scalar", 64), "little") % L
-    r_enc = ristretto_encode(scalar_mult(r, BASE))
+    r_enc = ristretto_encode(_base_mult(r))
     k = _challenge(t, pk_enc, r_enc)
     s = (k * key + r) % L
     sig = bytearray(r_enc + s.to_bytes(32, "little"))
     sig[63] |= 0x80  # schnorrkel v1 marker
     return bytes(sig)
+
+
+def _window_table(p) -> list:
+    """[identity, p, 2p, ..., 15p] for 4-bit Straus windows."""
+    table = [IDENTITY, p]
+    for _ in range(14):
+        table.append(point_add(table[-1], p))
+    return table
+
+
+_BASE_WINDOW = _window_table(BASE)
+
+
+def _base_mult(a: int) -> tuple:
+    """a*B through the precomputed 4-bit window (sign/pubkey path)."""
+    acc = IDENTITY
+    for shift in range(252, -1, -4):
+        for _ in range(4):
+            acc = point_add(acc, acc)
+        da = (a >> shift) & 0xF
+        if da:
+            acc = point_add(acc, _BASE_WINDOW[da])
+    return acc
+
+
+def _double_scalar_mult(a: int, b: int, q) -> tuple:
+    """a*B + b*q via Straus simultaneous 4-bit windows: one shared
+    ladder (256 doublings + <=128 table adds) instead of two full
+    double-and-add ladders — the verify hot path."""
+    tq = _window_table(q)
+    acc = IDENTITY
+    for shift in range(252, -1, -4):
+        for _ in range(4):
+            acc = point_add(acc, acc)
+        da = (a >> shift) & 0xF
+        if da:
+            acc = point_add(acc, _BASE_WINDOW[da])
+        db = (b >> shift) & 0xF
+        if db:
+            acc = point_add(acc, tq[db])
+    return acc
 
 
 def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
@@ -190,7 +231,7 @@ def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     # R =? s*B - k*A, compared as canonical ristretto encodings —
     # Edwards-coordinate equality is wrong here (ristretto points are
     # torsion cosets; voi likewise compares compressed bytes).
-    expect = point_add(scalar_mult(s, BASE), scalar_mult(k, point_neg(a_pt)))
+    expect = _double_scalar_mult(s, k, point_neg(a_pt))
     return ristretto_encode(expect) == sig[:32]
 
 
@@ -249,7 +290,7 @@ class Sr25519PrivKey(PrivKey):
 
     def pub_key(self) -> Sr25519PubKey:
         key, _ = _expand_ed25519(self._mini)
-        return Sr25519PubKey(ristretto_encode(scalar_mult(key % L, BASE)))
+        return Sr25519PubKey(ristretto_encode(_base_mult(key % L)))
 
     @property
     def type_name(self) -> str:

@@ -16,9 +16,10 @@ def init_files_home(
     chain_id: str = "",
     mode: str = "validator",
     gen_doc: GenesisDoc | None = None,
+    key_type: str = "ed25519",
 ) -> Config:
     """Create config.toml, genesis.json, privval + node keys
-    (ref: init.go initFilesWithConfig)."""
+    (ref: init.go initFilesWithConfig; --key flag at init.go:37)."""
     cfg = default_config(home)
     cfg.base.mode = mode
     os.makedirs(os.path.join(home, "config"), exist_ok=True)
@@ -26,7 +27,8 @@ def init_files_home(
 
     pv = None
     if mode == "validator":
-        pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+        pv = FilePV.load_or_generate(cfg.priv_validator_key_file, cfg.priv_validator_state_file,
+                                     key_type=key_type)
 
     NodeKey.load_or_gen(cfg.node_key_file)
 
@@ -34,9 +36,14 @@ def init_files_home(
         if gen_doc is None:
             import secrets
 
+            from ..types.params import ConsensusParams, ValidatorParams
+
             gen_doc = GenesisDoc(
                 chain_id=chain_id or f"test-chain-{secrets.token_hex(3)}",
                 genesis_time=Time.now(),
+                consensus_params=ConsensusParams(
+                    validator=ValidatorParams(pub_key_types=(key_type,)),
+                ),
                 validators=(
                     [
                         GenesisValidator(

@@ -224,3 +224,36 @@ def test_config_migrate_drops_stale_keys(tmp_path, capsys):
         migrated = Config.from_toml(f.read(), home=home)
     assert migrated.unknown_keys == []
     assert os.path.exists(path + ".bak")
+
+
+def test_cli_key_type_flags(tmp_path):
+    """init/testnet/gen-validator accept --key for all three key types
+    (ref: init.go:37, gen_validator.go)."""
+    import json as _json
+
+    # gen-validator
+    import contextlib
+    import io
+
+    for kt in ("ed25519", "sr25519", "secp256k1"):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli_main(["gen-validator", "--key", kt]) == 0
+        doc = _json.loads(buf.getvalue())
+        assert doc["pub_key"]["type"] == kt
+
+    # init with sr25519: privval file + genesis carry the type
+    home = str(tmp_path / "sr-home")
+    assert cli_main(["--home", home, "init", "validator", "--key", "sr25519"]) == 0
+    pv = _json.load(open(os.path.join(home, "config", "priv_validator_key.json")))
+    assert pv["priv_key"]["type"] == "tendermint/PrivKeySr25519"
+    gen = _json.load(open(os.path.join(home, "config", "genesis.json")))
+    assert gen["validators"][0]["pub_key"]["type"] == "tendermint/PubKeySr25519"
+    assert gen["consensus_params"]["validator"]["pub_key_types"] == ["sr25519"]
+
+    # testnet with secp256k1
+    out = str(tmp_path / "secp-net")
+    assert cli_main(["testnet", "--validators", "2", "--output", out,
+                     "--key", "secp256k1", "--starting-port", "0"]) == 0
+    pv = _json.load(open(os.path.join(out, "node0", "config", "priv_validator_key.json")))
+    assert pv["priv_key"]["type"] == "tendermint/PrivKeySecp256k1"
