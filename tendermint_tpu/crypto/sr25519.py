@@ -301,10 +301,12 @@ class Sr25519BatchVerifier(BatchVerifier):
     """Batch verifier with the reference's semantics (batch.go:15-47):
     Add validates/queues, Verify returns (all_ok, per-signature bools).
 
-    Verification is per-signature host-side for now; the random-linear-
-    combination batch equation (one MSM like the ed25519 device plane)
-    is a future TPU offload — sr25519 validator sets are rare compared
-    to ed25519."""
+    Device path: the schnorrkel equation R == encode([s]B - [k]A) runs
+    batched on the SAME TPU curve kernels as ed25519 (ops/verify_sr.py,
+    ristretto codec in ops/ristretto.py) — both of the reference's
+    batch-capable key types ride one device plane. Host path: Straus
+    ladders per signature. Gating mirrors ed25519 (TM_TPU_CRYPTO +
+    launch-latency cutover)."""
 
     def __init__(self):
         self._jobs: list[tuple[bytes, bytes, bytes]] = []
@@ -317,5 +319,30 @@ class Sr25519BatchVerifier(BatchVerifier):
         self._jobs.append((pub.bytes(), msg, sig))
 
     def verify(self) -> tuple[bool, list[bool]]:
+        return self.verify_async()()
+
+    def verify_async(self):
+        """Device path: launch prep + H2D + kernel now, return a
+        completion callable so callers overlap the kernel with host
+        work (same contract as Ed25519BatchVerifier.verify_async)."""
+        from .ed25519 import DEVICE_BATCH_CUTOVER, _use_device
+
+        n = len(self._jobs)
+        if n == 0:
+            return lambda: (False, [])
+        if _use_device() and n >= DEVICE_BATCH_CUTOVER:
+            from ..ops import verify_sr as dev
+
+            pks = [j[0] for j in self._jobs]
+            msgs = [j[1] for j in self._jobs]
+            sigs = [j[2] for j in self._jobs]
+            dispatched = dev.verify_batch_async(pks, msgs, sigs)
+
+            def complete():
+                bools = [bool(b) for b in dev.collect(dispatched)]
+                return all(bools), bools
+
+            return complete
         oks = [verify(pk, msg, sig) for pk, msg, sig in self._jobs]
-        return all(oks) and bool(oks), oks
+        result = (all(oks), oks)
+        return lambda: result

@@ -1,0 +1,103 @@
+"""Ristretto255 group encoding on device (RFC 9496 §4.3).
+
+Puts sr25519 (schnorrkel) batch verification on the same TPU curve
+kernels as ed25519: both of the reference's batch-capable key types
+(crypto/batch/batch.go:12-33) then ride one device plane. The curve is
+the same Edwards25519 as ops/curve.py — only the point codec differs
+(ristretto encodes cosets of the 4-torsion subgroup, so equality is
+encoding equality, not Edwards-coordinate equality).
+
+Validated element-for-element against the host implementation
+(crypto/sr25519.py, itself pinned by the RFC 9496 appendix vectors) in
+tests/test_sr25519.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import curve as C
+from . import field as F
+
+# INVSQRT_A_MINUS_D = invsqrt(-1 - d) (RFC 9496 §4.1), computed once by
+# the host module's sqrt_ratio (pinned there by the RFC vectors).
+from ..crypto.sr25519 import INVSQRT_A_MINUS_D as _INV_SQRT_A_MINUS_D_INT  # noqa: E402
+
+INVSQRT_A_MINUS_D = F._int_to_limbs(_INV_SQRT_A_MINUS_D_INT)
+
+
+def fe_parity(z):
+    """IS_NEGATIVE (RFC 9496 §4.1): canonical value odd -> 1."""
+    return F.fe_canonical(z)[0] & 1
+
+
+def fe_abs(z):
+    """CT_ABS: the non-negative (even) representative, canonical limbs."""
+    c = F.fe_canonical(z)
+    neg = F.fe_canonical(jnp.asarray(F.P_LIMBS) - c)
+    return F.fe_select((c[0] & 1) == 1, neg, c)
+
+
+def sqrt_ratio_m1(u, v):
+    """RFC 9496 §4.2: (was_square, non-negative sqrt(u/v) or
+    sqrt(i*u/v)). Mirrors the decompression sqrt chain in
+    ops/curve.py:111 with the ristretto sign fixups."""
+    v3 = F.fe_mul(F.fe_square(v), v)
+    v7 = F.fe_mul(F.fe_square(v3), v)
+    r = F.fe_mul(F.fe_mul(u, v3), F.fe_pow_p58(F.fe_mul(u, v7)))
+    check = F.fe_mul(v, F.fe_square(r))
+    u_neg = F.fe_neg(u)
+    correct = F.fe_eq(check, u)
+    flipped = F.fe_eq(check, u_neg)
+    flipped_i = F.fe_eq(check, F.fe_mul(u_neg, jnp.asarray(F.SQRT_M1_LIMBS)))
+    r = F.fe_select(flipped | flipped_i, F.fe_mul(r, jnp.asarray(F.SQRT_M1_LIMBS)), r)
+    return correct | flipped, fe_abs(r)
+
+
+def decode(s_enc):
+    """(32, B) int32 byte values -> (extended point, ok mask)
+    (RFC 9496 §4.3.1). Rejections: non-canonical, negative (odd),
+    non-square, t negative, y zero."""
+    one = jnp.asarray(F.ONE_LIMBS)
+    s = s_enc.astype(jnp.int32)
+    canonical = jnp.all(F.fe_canonical(s) == s, axis=0)
+    even = (s[0] & 1) == 0
+    ss = F.fe_square(s)
+    u1 = F.fe_sub(one, ss)
+    u2 = F.fe_add(one, ss)
+    u2_sqr = F.fe_square(u2)
+    d_u1 = F.fe_mul(jnp.asarray(F.D_LIMBS), u1)
+    v = F.fe_sub(F.fe_neg(F.fe_mul(d_u1, u1)), u2_sqr)
+    was_square, invsqrt = sqrt_ratio_m1(one, F.fe_mul(v, u2_sqr))
+    den_x = F.fe_mul(invsqrt, u2)
+    den_y = F.fe_mul(F.fe_mul(invsqrt, den_x), v)
+    x = fe_abs(F.fe_mul(F.fe_add(s, s), den_x))
+    y = F.fe_canonical(F.fe_mul(u1, den_y))
+    t = F.fe_mul(x, y)
+    ok = canonical & even & was_square & (fe_parity(t) == 0) & ~F.fe_is_zero(y)
+    pt = C.make_point(x, y, jnp.broadcast_to(one, x.shape), t)
+    return pt, ok
+
+
+def encode(pt):
+    """Extended point -> (32, B) canonical byte values (RFC 9496 §4.3.2).
+    Encoding equality IS ristretto equality, so callers compare these
+    bytes directly against wire encodings."""
+    x0, y0, z0, t0 = pt[0], pt[1], pt[2], pt[3]
+    one = jnp.asarray(F.ONE_LIMBS)
+    sqrt_m1 = jnp.asarray(F.SQRT_M1_LIMBS)
+    u1 = F.fe_mul(F.fe_add(z0, y0), F.fe_sub(z0, y0))
+    u2 = F.fe_mul(x0, y0)
+    _, invsqrt = sqrt_ratio_m1(one, F.fe_mul(u1, F.fe_square(u2)))
+    den1 = F.fe_mul(invsqrt, u1)
+    den2 = F.fe_mul(invsqrt, u2)
+    z_inv = F.fe_mul(F.fe_mul(den1, den2), t0)
+    rotate = fe_parity(F.fe_mul(t0, z_inv)) == 1
+    ix = F.fe_mul(x0, sqrt_m1)
+    iy = F.fe_mul(y0, sqrt_m1)
+    enchanted = F.fe_mul(den1, jnp.asarray(INVSQRT_A_MINUS_D))
+    x = F.fe_select(rotate, iy, x0)
+    y = F.fe_select(rotate, ix, y0)
+    den_inv = F.fe_select(rotate, enchanted, den2)
+    y = F.fe_select(fe_parity(F.fe_mul(x, z_inv)) == 1, F.fe_neg(y), y)
+    return fe_abs(F.fe_mul(den_inv, F.fe_sub(z0, y)))

@@ -71,6 +71,17 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return size
 
 
+def pad_pow2_rows(arrays, n: int):
+    """Pad (n, 32) uint8 arrays up to the next power-of-two row count so
+    jit caches a small set of program shapes (shared by the ed25519 and
+    sr25519 planes)."""
+    size = _pad_pow2(n)
+    if size == n:
+        return arrays
+    pad = size - n
+    return [np.pad(a, ((0, pad), (0, 0))) for a in arrays]
+
+
 def _prepare_batch_py(pubkeys, msgs, sigs):
     """Pure-Python prep (fallback + oracle for the native path)."""
     n = len(sigs)
@@ -158,13 +169,7 @@ def verify_batch_async(pubkeys, msgs, sigs):
     if n == 0:
         return None, np.zeros((0,), bool), 0
     a_enc, r_enc, s_bytes, k_bytes, precheck = prepare_batch(pubkeys, msgs, sigs)
-    size = _pad_pow2(n)
-    if size != n:
-        pad = size - n
-        a_enc = np.pad(a_enc, ((0, pad), (0, 0)))
-        r_enc = np.pad(r_enc, ((0, pad), (0, 0)))
-        s_bytes = np.pad(s_bytes, ((0, pad), (0, 0)))
-        k_bytes = np.pad(k_bytes, ((0, pad), (0, 0)))
+    a_enc, r_enc, s_bytes, k_bytes = pad_pow2_rows([a_enc, r_enc, s_bytes, k_bytes], n)
     ok_dev = verify_kernel(
         jnp.asarray(a_enc), jnp.asarray(r_enc),
         jnp.asarray(s_bytes), jnp.asarray(k_bytes),

@@ -1,0 +1,100 @@
+"""Batched sr25519 (schnorrkel) verification on device.
+
+Same split of labor as the ed25519 plane (ops/verify.py):
+  host   — Merlin transcript challenges k = H(proto, pk, R) mod L,
+           s < L range check, marker-bit check, input shaping
+  device — ristretto decode of A, the joint [s]B - [k]A Straus ladder
+           (shared with ed25519 — ops/curve.py:242), ristretto
+           re-encoding, byte comparison against the wire R
+
+The equation is R == encode([s]B - [k]A): schnorrkel compares compressed
+encodings (no cofactor clearing — the ristretto group has prime order),
+so a valid signature is exactly one whose R bytes re-emerge from the
+ladder. ref: crypto/sr25519/batch.go:15-47 (the semantics this plane
+implements); the batch RLC equation the voi backend uses is replaced by
+the per-signature bitmap, which the callers need anyway
+(types/validation.go:245-255 first-bad-index semantics).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import curve as C
+from . import ristretto as R
+
+L = 2**252 + 27742317777372353535851937790883648493
+
+
+def verify_sr_kernel_impl(a_enc, r_enc, s_bytes, k_bytes):
+    """(B, 32) uint8 arrays -> (B,) bool validity. a_enc/r_enc are
+    ristretto encodings; s_bytes pre-checked < L with the marker bit
+    cleared; k_bytes the Merlin challenge mod L."""
+    a = a_enc.T.astype(jnp.int32)  # (32, B) limb-major
+    r = r_enc.T.astype(jnp.int32)
+    s = s_bytes.T.astype(jnp.int32)
+    k = k_bytes.T.astype(jnp.int32)
+    a_pt, a_ok = R.decode(a)
+    q = C.double_scalar_mul_base(s, k, C.point_neg(a_pt))  # [s]B - [k]A
+    enc = R.encode(q)
+    return a_ok & jnp.all(enc == r, axis=0)
+
+
+verify_sr_kernel = jax.jit(verify_sr_kernel_impl)
+
+
+def prepare_batch(pubkeys, msgs, sigs):
+    """Host prep: (a_enc, r_enc, s_bytes, k_bytes, precheck) uint8/bool
+    arrays of shape (B, 32)/(B,). Malformed inputs fail precheck."""
+    from ..crypto.sr25519 import SIG_SIZE, _challenge, _signing_transcript
+
+    n = len(sigs)
+    raw = np.zeros((4, n, 32), np.uint8)
+    precheck = np.zeros((n,), bool)
+    for i in range(n):
+        pk, sig = pubkeys[i], sigs[i]
+        if len(pk) != 32 or len(sig) != SIG_SIZE or not sig[63] & 0x80:
+            continue
+        s_buf = bytearray(sig[32:64])
+        s_buf[31] &= 0x7F
+        if int.from_bytes(bytes(s_buf), "little") >= L:
+            continue
+        t = _signing_transcript(msgs[i])
+        k = _challenge(t, pk, sig[:32])
+        raw[0, i] = np.frombuffer(pk, np.uint8)
+        raw[1, i] = np.frombuffer(sig, np.uint8, count=32)
+        raw[2, i] = np.frombuffer(bytes(s_buf), np.uint8)
+        raw[3, i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+        precheck[i] = True
+    return raw[0], raw[1], raw[2], raw[3], precheck
+
+
+def verify_batch_async(pubkeys, msgs, sigs):
+    """Dispatch one batch without blocking (host prep + H2D + launch),
+    returning (device_bitmap, precheck, n) — same pipelining contract
+    as the ed25519 plane (ops/verify.py verify_batch_async)."""
+    from .verify import pad_pow2_rows
+
+    n = len(sigs)
+    if n == 0:
+        return None, np.zeros((0,), bool), 0
+    a, r, s, k, precheck = prepare_batch(pubkeys, msgs, sigs)
+    a, r, s, k = pad_pow2_rows([a, r, s, k], n)
+    ok_dev = verify_sr_kernel(jnp.asarray(a), jnp.asarray(r), jnp.asarray(s), jnp.asarray(k))
+    return ok_dev, precheck, n
+
+
+def collect(dispatched) -> np.ndarray:
+    """Block on a verify_batch_async result and fold in the precheck."""
+    ok_dev, precheck, n = dispatched
+    if n == 0:
+        return np.zeros((0,), bool)
+    return np.asarray(ok_dev)[:n] & precheck
+
+
+def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
+    """End-to-end batched sr25519 verification -> (n,) bool bitmap."""
+    return collect(verify_batch_async(pubkeys, msgs, sigs))

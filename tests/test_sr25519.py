@@ -272,3 +272,53 @@ def test_sr25519_validators_produce_blocks(monkeypatch):
     finally:
         for n in nodes:
             n.stop()
+
+
+def test_device_ristretto_codec_matches_host():
+    """ops/ristretto decode/encode agree with the host codec (itself
+    pinned by the RFC 9496 vectors) and reject what it rejects."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import ristretto as R
+
+    encs = [sr.ristretto_encode(scalar_mult(k, BASE)) for k in range(1, 9)]
+    arr = np.stack([np.frombuffer(e, np.uint8) for e in encs]).T.astype(np.int32)
+    pt, ok = R.decode(jnp.asarray(arr))
+    assert bool(np.asarray(ok).all())
+    assert (np.asarray(R.encode(pt)) == arr).all()
+
+    bad = np.zeros((32, 8), np.int32)
+    bad[0, 0] = 1  # negative (odd)
+    bad[:, 1] = 255  # non-canonical
+    bad[0, 2] = 4  # non-square candidate
+    _, ok = R.decode(jnp.asarray(bad))
+    ok = np.asarray(ok)
+    assert not ok[0] and not ok[1]
+    # host agreement on every lane (incl. the zero/identity lanes)
+    for lane in range(8):
+        host = sr.ristretto_decode(bytes(bad[:, lane].astype(np.uint8)))
+        assert (host is not None) == bool(ok[lane]), lane
+
+
+def test_sr25519_device_batch_matches_host(monkeypatch):
+    """The device plane (ops/verify_sr.py) accepts exactly what the host
+    Straus path accepts, bitmap positions included."""
+    monkeypatch.setenv("TM_TPU_CRYPTO", "on")
+    monkeypatch.setattr("tendermint_tpu.crypto.ed25519.DEVICE_BATCH_CUTOVER", 1)
+
+    privs = [sr.Sr25519PrivKey.generate(b"dev-%d" % i) for i in range(12)]
+    msgs = [b"device-batch-%d" % i for i in range(12)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    sigs[3] = bytes(64)  # garbage
+    bad7 = bytearray(sigs[7]); bad7[1] ^= 0xFF; sigs[7] = bytes(bad7)
+    nomark = bytearray(sigs[10]); nomark[63] &= 0x7F; sigs[10] = bytes(nomark)
+
+    bv = sr.Sr25519BatchVerifier()
+    for p, m, s in zip(privs, msgs, sigs):
+        bv.add(p.pub_key(), m, s)
+    ok, bits = bv.verify()
+    host_bits = [sr.verify(p.pub_key().bytes(), m, s) for p, m, s in zip(privs, msgs, sigs)]
+    assert bits == host_bits
+    assert not ok and bits == [i not in (3, 7, 10) for i in range(12)]
