@@ -48,8 +48,10 @@ verify_sr_kernel = jax.jit(verify_sr_kernel_impl)
 
 def prepare_batch(pubkeys, msgs, sigs):
     """Host prep: (a_enc, r_enc, s_bytes, k_bytes, precheck) uint8/bool
-    arrays of shape (B, 32)/(B,). Malformed inputs fail precheck."""
-    from ..crypto.sr25519 import SIG_SIZE, _challenge, _signing_transcript
+    arrays of shape (B, 32)/(B,). Malformed inputs fail precheck.
+    Merlin challenges run through the vectorized batch transcript
+    (crypto/merlin_batch.py) so host prep keeps pace with the chip."""
+    from ..crypto.sr25519 import SIG_SIZE, challenges_batch
 
     n = len(sigs)
     raw = np.zeros((4, n, 32), np.uint8)
@@ -62,13 +64,19 @@ def prepare_batch(pubkeys, msgs, sigs):
         s_buf[31] &= 0x7F
         if int.from_bytes(bytes(s_buf), "little") >= L:
             continue
-        t = _signing_transcript(msgs[i])
-        k = _challenge(t, pk, sig[:32])
         raw[0, i] = np.frombuffer(pk, np.uint8)
         raw[1, i] = np.frombuffer(sig, np.uint8, count=32)
         raw[2, i] = np.frombuffer(bytes(s_buf), np.uint8)
-        raw[3, i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
         precheck[i] = True
+    valid = np.flatnonzero(precheck)
+    if len(valid):
+        ks = challenges_batch(
+            [pubkeys[i] for i in valid],
+            [msgs[i] for i in valid],
+            [sigs[i][:32] for i in valid],
+        )
+        for i, k in zip(valid, ks):
+            raw[3, i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
     return raw[0], raw[1], raw[2], raw[3], precheck
 
 
