@@ -572,6 +572,47 @@ def cmd_e2e_generate(args) -> int:
     return 0
 
 
+def cmd_replay_console(args) -> int:
+    """Interactive WAL playback (ref: `tendermint replay-console`,
+    internal/consensus/replay_file.go)."""
+    from .config import load_config
+    from .consensus import WAL, ConsensusState, Handshaker
+    from .consensus.replay_console import Playback, console_loop
+    from .node.node import _make_app, _make_db
+    from .state import BlockExecutor, StateStore, make_genesis_state
+    from .store.blockstore import BlockStore
+    from .types.genesis import GenesisDoc
+
+    import tempfile
+
+    # Play back a COPY of the whole node home: stepping the tail across
+    # a commit boundary writes blocks/state through the executor, and a
+    # post-mortem console must never mutate the original evidence
+    # (WAL, blockstore, state db alike).
+    tmp_home = tempfile.mkdtemp(prefix="replay-console-")
+    try:
+        for sub in ("config", "data"):
+            src = os.path.join(args.home, sub)
+            if os.path.isdir(src):
+                shutil.copytree(src, os.path.join(tmp_home, sub))
+        cfg = load_config(tmp_home)
+        gen_doc = GenesisDoc.from_file(cfg.genesis_file)
+
+        def make_cs():
+            state_store = StateStore(_make_db(cfg, "state"))
+            block_store = BlockStore(_make_db(cfg, "blockstore"))
+            state = state_store.load() or make_genesis_state(gen_doc)
+            app = _make_app(args.app or cfg.base.proxy_app)
+            state = Handshaker(state_store, state, block_store, gen_doc).handshake(app)
+            executor = BlockExecutor(state_store, app, block_store=block_store)
+            return ConsensusState(state, executor, block_store, wal=WAL(cfg.wal_file))
+
+        console_loop(Playback(make_cs))
+    finally:
+        shutil.rmtree(tmp_home, ignore_errors=True)
+    return 0
+
+
 def cmd_remote_signer(args) -> int:
     """Run a standalone remote signer that dials a validator's privval
     listen address (ref: the reference ships this as the external
@@ -668,6 +709,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("replay", help="re-sync the app by replaying stored blocks over ABCI")
     sp.add_argument("--app", default="", help="override proxy_app (e.g. builtin:kvstore)")
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("replay-console",
+                        help="interactive WAL playback (next/back/rs/locate)")
+    sp.add_argument("--app", default="", help="override proxy_app (e.g. builtin:kvstore)")
+    sp.set_defaults(fn=cmd_replay_console)
 
     sp = sub.add_parser("reindex-event", help="rebuild the tx/block event index from stored blocks")
     sp.add_argument("--start-height", type=int, default=0)

@@ -1,0 +1,137 @@
+"""Interactive WAL playback (ref: internal/consensus/replay_file.go).
+
+`tendermint-tpu replay-console` steps the WAL tail (everything after
+the last EndHeight, i.e. what crash recovery would replay) through a
+fresh consensus state one record at a time:
+
+    next [N]   apply the next record (or N records)
+    back [N]   rewind N records — the state machine cannot step
+               backwards, so the state is rebuilt and the prefix
+               re-applied (ref: replayReset, replay_file.go:144)
+    rs         print the current RoundState
+    locate     print position in the WAL tail
+    quit       exit
+
+The consensus state is an observer (no privval, replay_mode set), so
+stepping never signs or gossips anything.
+"""
+
+from __future__ import annotations
+
+from .round_state import STEP_NAMES
+
+
+class Playback:
+    """ref: replay_file.go:121 playback."""
+
+    def __init__(self, make_cs):
+        """make_cs() -> a FRESH, unstarted ConsensusState whose WAL is
+        open on the file under replay. Called again on every rewind."""
+        self.make_cs = make_cs
+        self.cs = make_cs()
+        records = self.cs.wal.search_for_end_height(self.cs.rs.height - 1)
+        if records is None:
+            raise ValueError(
+                f"WAL has no EndHeight({self.cs.rs.height - 1}) record — "
+                "truncated or corrupt (a debugging console must not present "
+                "this as an empty tail)"
+            )
+        self.records = list(records)
+        self.pos = 0  # records[:pos] have been applied
+
+    # ------------------------------------------------------------- stepping
+
+    def _apply(self, record) -> None:
+        self.cs.replay_record(record)  # same dispatch as crash recovery
+
+    def step(self, n: int = 1) -> int:
+        """Apply up to n records; returns how many were applied."""
+        applied = 0
+        while applied < n and self.pos < len(self.records):
+            self._apply(self.records[self.pos])
+            self.pos += 1
+            applied += 1
+        return applied
+
+    def rewind(self, n: int = 1) -> None:
+        """ref: replayReset (replay_file.go:144): rebuild and re-apply
+        the shorter prefix."""
+        target = max(0, self.pos - n)
+        self.cs = self.make_cs()
+        self.pos = 0
+        self.step(target)
+
+    # ------------------------------------------------------------- display
+
+    def round_state_lines(self) -> list[str]:
+        rs = self.cs.rs
+        lines = [
+            f"height/round/step: {rs.height}/{rs.round}/"
+            f"{STEP_NAMES.get(rs.step, rs.step)}",
+            f"proposal: {'set' if rs.proposal is not None else 'nil'}",
+            f"proposal block: "
+            f"{rs.proposal_block.hash().hex().upper()[:16] if rs.proposal_block is not None else 'nil'}",
+            f"locked round/block: {rs.locked_round}/"
+            f"{rs.locked_block.hash().hex().upper()[:16] if rs.locked_block is not None else 'nil'}",
+            f"valid round: {rs.valid_round}",
+        ]
+        try:
+            prevotes = rs.votes.prevotes(rs.round)
+            precommits = rs.votes.precommits(rs.round)
+            lines.append(f"prevotes:   {prevotes.bit_array()}  ({prevotes.sum} power)")
+            lines.append(f"precommits: {precommits.bit_array()}  ({precommits.sum} power)")
+        except Exception:
+            pass
+        return lines
+
+    def locate_line(self) -> str:
+        return (
+            f"record {self.pos}/{len(self.records)} of the WAL tail "
+            f"(height {self.cs.rs.height})"
+        )
+
+
+def console_loop(pb: Playback, input_fn=None, print_fn=print) -> None:
+    """ref: replayConsoleLoop (replay_file.go:190). input_fn resolves at
+    call time (tests monkeypatch builtins.input)."""
+    if input_fn is None:
+        input_fn = input
+    print_fn(f"WAL playback: {len(pb.records)} records "
+             f"(starting height {pb.cs.rs.height}). Commands: next [N], "
+             "back [N], rs, locate, quit")
+    while True:
+        try:
+            line = input_fn("> ")
+        except EOFError:
+            return
+        tokens = line.strip().split()
+        if not tokens:
+            continue
+        cmd, rest = tokens[0], tokens[1:]
+        if cmd == "next":
+            try:
+                n = int(rest[0]) if rest else 1
+            except ValueError:
+                print_fn("next takes an integer argument")
+                continue
+            applied = pb.step(n)
+            print_fn(f"applied {applied} record(s); {pb.locate_line()}")
+            if applied < n:
+                print_fn("end of WAL tail")
+        elif cmd == "back":
+            try:
+                n = int(rest[0]) if rest else 1
+            except ValueError:
+                print_fn("back takes an integer argument")
+                continue
+            pb.rewind(n)
+            print_fn(pb.locate_line())
+        elif cmd == "rs":
+            for line_ in pb.round_state_lines():
+                print_fn(line_)
+        elif cmd == "locate":
+            print_fn(pb.locate_line())
+        elif cmd in ("quit", "exit", "q"):
+            return
+        else:
+            print_fn(f"unknown command {cmd!r} (next/back/rs/locate/quit)")

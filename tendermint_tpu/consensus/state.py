@@ -1031,23 +1031,26 @@ class ConsensusState:
 
     # -------------------------------------------------------------- replay
 
+    def replay_record(self, record) -> None:
+        """Apply ONE WAL record in replay mode — the single dispatch
+        shared by crash recovery and the replay console (EndHeight and
+        round-step markers are informational, not state transitions)."""
+        if isinstance(record, (EndHeightMessage, EventRoundStep)):
+            return
+        self.replay_mode = True
+        try:
+            if isinstance(record, TimeoutInfo):
+                self._handle_timeout(record)
+            elif isinstance(record, MsgInfo):
+                self._handle_msg(record)
+        finally:
+            self.replay_mode = False
+
     def _catchup_replay(self) -> None:
         """Replay WAL messages since the last EndHeight
         (ref: catchupReplay replay.go:97)."""
         msgs = self.wal.search_for_end_height(self.rs.height - 1)
         if msgs is None:
             return
-        self.replay_mode = True
-        try:
-            for m in msgs:
-                if isinstance(m, EndHeightMessage):
-                    continue
-                if isinstance(m, EventRoundStep):
-                    # fast-forward round/step markers are informational
-                    continue
-                if isinstance(m, TimeoutInfo):
-                    self._handle_timeout(m)
-                elif isinstance(m, MsgInfo):
-                    self._handle_msg(m)
-        finally:
-            self.replay_mode = False
+        for m in msgs:
+            self.replay_record(m)
