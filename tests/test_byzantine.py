@@ -385,3 +385,93 @@ def test_invalid_precommits_do_not_stall_consensus():
         t.join(timeout=5)
         for n in nodes:
             n.stop()
+
+
+def test_tampered_vote_extensions_rejected_chain_advances():
+    """Relay-tampered extension bytes (outside the vote's sign bytes,
+    so the VOTE signature still verifies) must be rejected at ingress
+    and never reach a persisted ExtendedCommit, while the chain keeps
+    advancing (regression for the r4 ingress validate_basic +
+    extension-verification hardening)."""
+    import dataclasses
+
+    from tendermint_tpu.proto.messages import SIGNED_MSG_TYPE_PRECOMMIT
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-vxt")
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), abci=ABCIParams(vote_extensions_enable_height=2)
+    )
+    nodes = [make_ev_node(keys, i, gen_doc) for i in range(4)]
+    _wire_fanout(nodes)
+
+    byz_key = keys[3]
+    byz_addr = byz_key.pub_key().address()
+    byz_idx, _ = nodes[0].state.validators.get_by_address(byz_addr)
+
+    stop = threading.Event()
+
+    def tamper():
+        """Continuously inject precommits whose VOTE signature is valid
+        but whose extension payload is forged: (a) garbage extension
+        with the real extension signature shape, (b) extension data
+        with no extension signature at all."""
+        while not stop.is_set():
+            rs = nodes[0].rs
+            h, r = rs.height, rs.round
+            blk = rs.proposal_block
+            if h < 2 or blk is None:
+                time.sleep(0.01)
+                continue
+            bid = BlockID(hash=blk.hash(), part_set_header=PartSetHeader(total=1, hash=b"\xcd" * 32))
+            ts = Time.now()
+            v = Vote(
+                type=SIGNED_MSG_TYPE_PRECOMMIT, height=h, round=r, block_id=bid,
+                timestamp=ts, validator_address=byz_addr, validator_index=byz_idx,
+                extension=b"FORGED-EXTENSION",
+            )
+            v.signature = byz_key.sign(v.sign_bytes(CHAIN + "-vxt"))
+            v.extension_signature = b"\x01" * 64  # garbage ext sig
+            naked = Vote(
+                type=SIGNED_MSG_TYPE_PRECOMMIT, height=h, round=r, block_id=bid,
+                timestamp=ts, validator_address=byz_addr, validator_index=byz_idx,
+                extension=b"NO-SIG-EXTENSION",
+            )
+            naked.signature = byz_key.sign(naked.sign_bytes(CHAIN + "-vxt"))
+            for n in nodes[:3]:
+                n.add_peer_message(VoteMessage(vote=v), peer_id="tamperer")
+                n.add_peer_message(VoteMessage(vote=naked), peer_id="tamperer")
+            time.sleep(0.05)
+
+    for n in nodes:
+        n.start()
+    t = threading.Thread(target=tamper, daemon=True)
+    t.start()
+    try:
+        assert wait_for_height(nodes, 5, timeout=60), (
+            f"chain stalled under tampered extensions: {[n.rs.height for n in nodes]}"
+        )
+    finally:
+        stop.set()
+        for n in nodes:
+            n.stop()
+    t.join(timeout=5)
+
+    # no forged extension bytes ever reached a persisted extended commit
+    for n in nodes:
+        for h in range(2, n.block_store.height()):
+            votes = n.block_store.load_extended_commit(h)
+            if votes is None:
+                continue
+            for vt in votes:
+                if vt is None:
+                    continue
+                assert b"FORGED" not in vt.extension and b"NO-SIG" not in vt.extension
+                if vt.block_id.is_nil():
+                    continue
+                # every persisted extension re-verifies
+                _, val = nodes[0].state.validators.get_by_index(vt.validator_index)
+                assert val.pub_key.verify_signature(
+                    vt.extension_sign_bytes(CHAIN + "-vxt"), vt.extension_signature
+                )
