@@ -160,4 +160,35 @@ except Exception as e:  # noqa: BLE001
 finally:
     F._FE_MUL_MODE = os.environ.get("TM_TPU_FE_MUL", "dot")
 
+# ---- Phase D: sr25519 kernel (new in r4): compile + device-only rate ----
+try:
+    with deadline(300):
+        from tendermint_tpu.crypto import sr25519 as srh
+        from tendermint_tpu.ops import verify_sr as VS
+
+        B = 256
+        spriv = srh.Sr25519PrivKey.generate(b"window-sr")
+        spk = spriv.pub_key().bytes()
+        smsgs = [b"sr-window-%03d" % i for i in range(B)]
+        ssigs = [spriv.sign(m) for m in smsgs]
+        sa, srr, ss, sk2, _ = VS.prepare_batch([spk] * B, smsgs, ssigs)
+        da = jnp.asarray(sa); dr = jnp.asarray(srr)
+        ds = jnp.asarray(ss); dk = jnp.asarray(sk2)
+        t0 = time.time()
+        out = VS.verify_sr_kernel(da, dr, ds, dk)
+        jax.block_until_ready(out)
+        t_c = time.time() - t0
+        assert bool(np.asarray(out).all()), "sr25519 kernel rejected valid sigs"
+        t0 = time.time()
+        for _ in range(10):
+            out = VS.verify_sr_kernel(da, dr, ds, dk)
+        jax.block_until_ready(out)
+        dt = (time.time() - t0) / 10
+        log(f"D sr25519 B={B}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+            f"device-only {B/dt:12,.0f} sigs/s")
+except StageTimeout:
+    log("D TIMED OUT (sr25519 kernel compile)")
+except Exception as e:  # noqa: BLE001
+    log(f"D failed: {type(e).__name__}: {e}")
+
 log("window complete")
