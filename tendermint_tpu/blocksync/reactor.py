@@ -252,10 +252,23 @@ class BlockSyncReactor:
             now = time.monotonic()
             if now - last_switch_check > self.SWITCH_CHECK_INTERVAL:
                 last_switch_check = now
-                if not self._switched and self.pool.is_caught_up():
+                if (
+                    not self._switched
+                    and self.pool.is_caught_up()
+                    and self._can_switch_to_consensus()
+                ):
                     self._switched = True
                     self.pool.stop()
-                    self.on_caught_up(self.state, self.blocks_synced)
+                    try:
+                        self.on_caught_up(self.state, self.blocks_synced)
+                    except Exception as exc:
+                        # A failed switch (e.g. reconstruction cannot
+                        # find its data) must HALT the node, not leave
+                        # it half-alive with consensus never started.
+                        import traceback
+
+                        traceback.print_exc()
+                        self.on_fatal(exc)
                     return
             try:
                 advanced = self._try_sync_one()
@@ -273,6 +286,20 @@ class BlockSyncReactor:
                 return
             if not advanced:
                 time.sleep(0.01)
+
+    def _can_switch_to_consensus(self) -> bool:
+        """ref: reactor.go:485-507: when vote extensions were enabled at
+        last_block_height, consensus cannot start without that height's
+        ExtendedCommit (restart reconstruction requires it). Every
+        synced extension-height block carries one, so a node that
+        synced >= 1 block is safe; a statesync-landed node that synced
+        none must wait for the chain to extend by one block."""
+        h = self.state.last_block_height
+        if h == 0 or not self.state.consensus_params.abci.vote_extensions_enabled(h):
+            return True
+        if self.blocks_synced > 0:
+            return True
+        return self.block_store.load_extended_commit_proto(h) is not None
 
     def _try_sync_one(self) -> bool:
         """ref: reactor.go:536-616 (the trySync block)."""

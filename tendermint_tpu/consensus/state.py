@@ -312,6 +312,17 @@ class ConsensusState:
 
     # ------------------------------------------------------ state updates
 
+    def switch_to_state(self, state: State) -> None:
+        """Blocksync/statesync -> consensus transition (ref:
+        SwitchToConsensus, consensus/reactor.go:256): rebuild the last
+        commit from the SYNCED chain — any set reconstructed at boot
+        predates the sync, and on a vote-extension chain the stored
+        ExtendedCommit is the only valid source — then reset RoundState."""
+        if state.last_block_height > 0:
+            self.rs.last_commit = None
+            self._reconstruct_last_commit_if_needed(state)
+        self.update_to_state(state)
+
     def update_to_state(self, state: State) -> None:
         """Reset RoundState for the next height (ref: updateToState
         state.go:752)."""

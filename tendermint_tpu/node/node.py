@@ -565,8 +565,13 @@ class Node:
             return None
 
     def _on_blocksync_done(self, state, blocks_synced: int) -> None:
-        """ref: node/node.go:360-377 (statesync/blocksync → consensus)."""
-        self.consensus.update_to_state(state)
+        """ref: node/node.go:360-377 + SwitchToConsensus
+        (consensus/reactor.go:256): the last commit must be rebuilt from
+        the SYNCED chain before updateToState — any set reconstructed at
+        boot predates the sync (and on a vote-extension chain the
+        extended commit blocksync just persisted is the only valid
+        source)."""
+        self.consensus.switch_to_state(state)
         self._start_consensus()
 
     def _start_consensus(self) -> None:

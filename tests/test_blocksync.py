@@ -551,3 +551,102 @@ def test_restart_behind_rejoins_via_blocksync_not_gossip():
         f"rejoined with only {caught['n']} synced blocks — vote-gossip crawl, not blocksync"
     )
     assert fresh.block_store.height() >= tip - 2
+
+
+def test_switch_gate_requires_extended_commit():
+    """ref: reactor.go:485-507 — a node at a vote-extension height may
+    not switch to consensus without the ExtendedCommit its restart
+    reconstruction would need: either >= 1 synced block carried one, or
+    the store already holds it."""
+    import dataclasses
+
+    from test_consensus import make_node
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-gate")
+    gen_doc.consensus_params = fast_params()
+    cs = make_node(keys, 0, gen_doc)
+
+    net = MemoryNetwork()
+    bs = BSNode(net, 0x71, cs, block_sync=True)
+    r = bs.reactor
+
+    # non-extension chains switch freely
+    assert r._can_switch_to_consensus()
+
+    # pretend the synced state sits at an extension height
+    r.state = dataclasses.replace(
+        r.state,
+        last_block_height=7,
+        consensus_params=dataclasses.replace(
+            r.state.consensus_params, abci=ABCIParams(vote_extensions_enable_height=2)
+        ),
+    )
+    assert not r._can_switch_to_consensus(), "switched without an extended commit"
+
+    # a synced block (which blocksync validates to carry an EC) unblocks
+    r.blocks_synced = 1
+    assert r._can_switch_to_consensus()
+
+    # ...as does an EC already in the store (initial-height case)
+    r.blocks_synced = 0
+    from tendermint_tpu.proto import messages as pb
+
+    cs.block_store._db.set(b"EC:" + (7).to_bytes(8, "big"),
+                           pb.ExtendedCommit(height=7, round=0).encode())
+    assert r._can_switch_to_consensus()
+
+
+def test_blocksync_then_reconstruct_extended_last_commit():
+    """After blocksyncing an extension chain, the node-level switch path
+    (rs.last_commit reset + reconstruction, ref SwitchToConsensus
+    consensus/reactor.go:256) yields an extensions-verifying last commit
+    built from the EC the sync persisted."""
+    import dataclasses
+
+    from test_consensus import make_node
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-rle")
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), abci=ABCIParams(vote_extensions_enable_height=2)
+    )
+    source = make_node(keys, 0, gen_doc)
+    source.start()
+    try:
+        assert wait_for_height([source], 4, timeout=60)
+    finally:
+        source.stop()
+    src_height = source.block_store.height()
+
+    fresh = make_node(keys, 0, gen_doc)
+    done = threading.Event()
+    result = {}
+
+    def on_caught_up(state, n):
+        result["state"], result["n"] = state, n
+        done.set()
+
+    net = MemoryNetwork()
+    server = BSNode(net, 0x72, source, block_sync=False)
+    client = BSNode(net, 0x73, fresh, on_caught_up=on_caught_up)
+    server.start()
+    client.start()
+    try:
+        client.pm.add(Endpoint(protocol="memory", host=server.node_id, node_id=server.node_id))
+        assert done.wait(timeout=60)
+    finally:
+        client.stop()
+        server.stop()
+    assert result["n"] >= src_height - 1  # synced the chain => ECs persisted
+
+    # the node-level switch: rebuild last commit from the synced chain
+    state = result["state"]
+    fresh.rs.last_commit = None
+    fresh._reconstruct_last_commit_if_needed(state)
+    lc = fresh.rs.last_commit
+    assert lc is not None and lc.extensions_enabled
+    assert lc.has_two_thirds_majority()
+    assert any(v is not None and v.extension_signature for v in lc.votes)
