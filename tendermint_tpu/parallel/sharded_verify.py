@@ -17,8 +17,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from ..ops import verify as V
+from ..ops import verify_sr as VS
 
 AXIS = "batch"
+
+# the batch-capable planes (secp256k1 has no batch equation — callers
+# fall back to serial host verification, as in the reference)
+_PLANES = {
+    "ed25519": (V, V.verify_kernel_impl),
+    "sr25519": (VS, VS.verify_sr_kernel_impl),
+}
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -28,43 +36,57 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devices), (AXIS,))
 
 
-def _local_verify(a_enc, r_enc, s_bytes, k_bytes):
-    ok = V.verify_kernel_impl(a_enc, r_enc, s_bytes, k_bytes)
-    fails = jnp.sum(jnp.where(ok, 0, 1))
-    total_fails = jax.lax.psum(fails, AXIS)  # ICI AND-reduce
-    return ok, total_fails == 0
+def _local_verify_with(kernel_impl):
+    def _local_verify(a_enc, r_enc, s_bytes, k_bytes):
+        ok = kernel_impl(a_enc, r_enc, s_bytes, k_bytes)
+        fails = jnp.sum(jnp.where(ok, 0, 1))
+        total_fails = jax.lax.psum(fails, AXIS)  # ICI AND-reduce
+        return ok, total_fails == 0
+
+    return _local_verify
 
 
-_FN_CACHE: dict[Mesh, object] = {}
+_FN_CACHE: dict[tuple, object] = {}
 
 
-def sharded_verify_fn(mesh: Mesh):
+def sharded_verify_fn(mesh: Mesh, kernel_impl=V.verify_kernel_impl):
     """Returns a jitted fn: (B,32)x4 uint8 -> ((B,) bool bitmap sharded
     over the mesh, scalar all-valid replicated). B must divide evenly by
-    the mesh size (pad on host). Memoized per mesh so jit's trace cache
-    is effective across calls."""
-    fn = _FN_CACHE.get(mesh)
+    the mesh size (pad on host). Memoized per (mesh, kernel) so jit's
+    trace cache is effective across calls. kernel_impl selects the
+    plane: ed25519 (default) or sr25519 (ops/verify_sr.py) — both
+    kernels verify their zero-padded rows true by construction."""
+    key = (mesh, kernel_impl)
+    fn = _FN_CACHE.get(key)
     if fn is None:
         spec = P(AXIS)
         fn = jax.jit(
             shard_map(
-                _local_verify,
+                _local_verify_with(kernel_impl),
                 mesh=mesh,
                 in_specs=(spec, spec, spec, spec),
                 out_specs=(spec, P()),
             )
         )
-        _FN_CACHE[mesh] = fn
+        _FN_CACHE[key] = fn
     return fn
 
 
-def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs):
+def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs, key_type: str = "ed25519"):
     """Host glue mirroring ops.verify.verify_batch but sharded. Returns
-    (bitmap numpy (n,), all_valid bool)."""
+    (bitmap numpy (n,), all_valid bool). key_type selects the plane:
+    both of the batch-capable key types shard the same way."""
     n = len(sigs)
     if n == 0:
         return np.zeros((0,), bool), False
-    a_enc, r_enc, s_bytes, k_bytes, precheck = V.prepare_batch(pubkeys, msgs, sigs)
+    try:
+        plane, kernel_impl = _PLANES[key_type]
+    except KeyError:
+        raise ValueError(
+            f"unsupported key_type {key_type!r} for sharded verification "
+            f"(batch-capable: {sorted(_PLANES)})"
+        ) from None
+    a_enc, r_enc, s_bytes, k_bytes, precheck = plane.prepare_batch(pubkeys, msgs, sigs)
     n_dev = mesh.devices.size
     # Shard-size schedule: powers of two up to 256 per device, then
     # 256-multiples — a bounded jit-shape zoo with at most ~2.5% padding
@@ -82,7 +104,7 @@ def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs):
         r_enc = np.pad(r_enc, ((0, pad), (0, 0)))
         s_bytes = np.pad(s_bytes, ((0, pad), (0, 0)))
         k_bytes = np.pad(k_bytes, ((0, pad), (0, 0)))
-    fn = sharded_verify_fn(mesh)
+    fn = sharded_verify_fn(mesh, kernel_impl)
     sharding = NamedSharding(mesh, P(AXIS))
     args = [jax.device_put(jnp.asarray(x), sharding) for x in (a_enc, r_enc, s_bytes, k_bytes)]
     bitmap, device_all_valid = fn(*args)

@@ -96,3 +96,24 @@ def test_sharded_verify_8_devices():
     assert [bool(b) for b in bitmap] == [i != 3 for i in range(19)]
     bitmap2, all_valid2 = S.verify_batch_sharded(mesh, *make_jobs(8))
     assert all_valid2 and bitmap2.all()
+
+
+def test_sharded_verify_sr25519_8_devices():
+    """The sr25519 plane shards over the mesh exactly like ed25519:
+    per-shard kernels, psum AND-reduce, fault localization."""
+    from tendermint_tpu.crypto import sr25519 as sr
+    from tendermint_tpu.parallel import sharded_verify as SV
+
+    mesh = SV.make_mesh(8)
+    priv = sr.Sr25519PrivKey.generate(b"shard-sr")
+    pk = priv.pub_key().bytes()
+    n = 64
+    msgs = [b"sharded-sr-%02d" % i for i in range(n)]
+    sigs = [priv.sign(m) for m in msgs]
+    bitmap, all_ok = SV.verify_batch_sharded(mesh, [pk] * n, msgs, sigs, key_type="sr25519")
+    assert all_ok and bitmap.all()
+
+    bad = bytearray(sigs[37]); bad[2] ^= 1; sigs[37] = bytes(bad)
+    bitmap, all_ok = SV.verify_batch_sharded(mesh, [pk] * n, msgs, sigs, key_type="sr25519")
+    assert not all_ok
+    assert not bitmap[37] and bitmap.sum() == n - 1  # fault localized

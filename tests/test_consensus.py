@@ -405,6 +405,7 @@ def test_pbts_untimely_proposer_rejected_chain_advances():
     )
     SKEW_NS = 30_000_000_000  # 30s ahead: far outside precision+delay
 
+    run_started_ns = Time.now().unix_ns()
     nodes = []
     for i in range(4):
         n = make_node(keys, i, gen_doc)
@@ -433,14 +434,25 @@ def test_pbts_untimely_proposer_rejected_chain_advances():
 
     n1 = nodes[1]
     saw_late_round = False
+    times = {}
     for h in range(1, n1.block_store.height() + 1):
         commit = n1.block_store.load_block_commit(h) or n1.block_store.load_seen_commit(h)
         block = n1.block_store.load_block(h)
         if commit is not None and commit.round > 0:
             saw_late_round = True
-        # no committed block carries a far-future timestamp
         if block is not None:
-            assert block.header.time.unix_ns() < Time.now().unix_ns() + 5_000_000_000, (
+            times[h] = block.header.time.unix_ns()
+            # coarse absolute bound: nothing outruns run start + budget
+            assert times[h] < run_started_ns + 95_000_000_000, (
                 f"untimely timestamp committed at height {h}"
+            )
+    # A committed +30s-skewed timestamp would tower over its honest
+    # successor no matter WHEN it landed: no block may lead the next
+    # one by more than a generous honest-cadence margin.
+    for h in sorted(times):
+        if h + 1 in times:
+            assert times[h] - times[h + 1] < 20_000_000_000, (
+                f"height {h} timestamp is ~{(times[h]-times[h+1])/1e9:.0f}s "
+                f"ahead of height {h+1}: an untimely block was committed"
             )
     assert saw_late_round, "skewed proposer was never forced into a round > 0"
