@@ -287,3 +287,18 @@ def test_websocket_slow_consumer_is_disconnected(monkeypatch):
     assert conn.closed.is_set()
     assert conn.dropped_for_backpressure
     assert sock.shutdown_called.wait(timeout=2), "wedged writer was not unblocked"
+
+
+def test_rpc_route_docs_in_sync():
+    """docs/rpc-routes.md is generated from the live route table and
+    must match it (the reference documents its API in rpc/openapi/)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import gen_rpc_docs
+
+    with open(gen_rpc_docs.OUT) as f:
+        assert f.read() == gen_rpc_docs.generate(), (
+            "docs/rpc-routes.md is stale: run python scripts/gen_rpc_docs.py --write"
+        )
