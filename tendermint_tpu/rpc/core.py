@@ -222,6 +222,7 @@ def build_routes(env: RPCEnvironment) -> dict:
     # ---------------------------------------------------------------- info
 
     def health():
+        """Liveness probe: empty result while the node serves RPC."""
         return {}
 
     def status():
@@ -274,6 +275,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def net_info():
+        """Connected peer listing."""
         peers = env.peer_manager.peers() if env.peer_manager else []
         return {
             "listening": True,
@@ -282,6 +284,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def genesis():
+        """The full genesis document."""
         import json as _json
 
         if env.gen_doc is None:
@@ -289,6 +292,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         return {"genesis": _json.loads(env.gen_doc.to_json())}
 
     def genesis_chunked(chunk=0):
+        """Genesis in base64 chunks for large documents."""
         if env.gen_doc is None:
             raise RPCError(-32603, "genesis doc unavailable")
         data = env.gen_doc.to_json().encode()
@@ -337,6 +341,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def block_by_hash(hash=None):
+        """Block ID + block for a block hash."""
         h = _as_bytes_hex(hash, "hash")
         blk = env.block_store.load_block_by_hash(h)
         if blk is None:
@@ -413,6 +418,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         return {"count": len(out), "threads": out}
 
     def block_results(height=None):
+        """FinalizeBlock results (tx results, events, updates) at a height."""
         h = _height_or_latest(height)
         f_res = env.state_store.load_finalize_block_responses(h)
         if f_res is None:
@@ -452,6 +458,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         return {"last_height": str(head), "block_metas": metas}
 
     def commit(height=None):
+        """Signed header + canonical commit at a height."""
         h = _height_or_latest(height)
         meta = env.block_store.load_block_meta(h)
         if meta is None:
@@ -467,6 +474,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def validators(height=None, page=1, per_page=30):
+        """Paginated validator set at a height."""
         h = _height_or_latest(height)
         vals = env.state_store.load_validators(h)
         if vals is None:
@@ -483,6 +491,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def consensus_params(height=None):
+        """On-chain consensus parameters at a height."""
         h = _height_or_latest(height)
         params = env.state_store.load_consensus_params(h)
         if params is None:
@@ -502,6 +511,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def consensus_state():
+        """Compact live round-state summary."""
         cs = env.consensus_state
         if cs is None:
             raise RPCError(-32603, "consensus state unavailable")
@@ -517,6 +527,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def dump_consensus_state():
+        """Full round state plus peer round states."""
         base = consensus_state()
         base["peers"] = [{"node_id": p} for p in (env.peer_manager.peers() if env.peer_manager else [])]
         return base
@@ -524,6 +535,7 @@ def build_routes(env: RPCEnvironment) -> dict:
     # ------------------------------------------------------------- txs
 
     def broadcast_tx_async(tx=None):
+        """Fire-and-forget CheckTx; returns immediately."""
         raw = _as_bytes_hex(tx, "tx")
         threading.Thread(target=lambda: _check_tx_quiet(raw), daemon=True).start()
         return {"code": 0, "data": "", "log": "", "hash": _hex(tx_hash(raw))}
@@ -535,6 +547,7 @@ def build_routes(env: RPCEnvironment) -> dict:
             pass
 
     def broadcast_tx_sync(tx=None):
+        """Run CheckTx, return its result (alias: broadcast_tx)."""
         raw = _as_bytes_hex(tx, "tx")
         try:
             res = env.mempool.check_tx(raw, sender="")
@@ -596,11 +609,13 @@ def build_routes(env: RPCEnvironment) -> dict:
             env.event_bus.unsubscribe_all(subscriber)
 
     def check_tx(tx=None):
+        """Run CheckTx without inserting into the mempool."""
         raw = _as_bytes_hex(tx, "tx")
         res = env.app_client.check_tx(abci.RequestCheckTx(tx=raw, type=0))
         return tx_result_to_json(res)
 
     def unconfirmed_txs(page=1, per_page=30):
+        """Paginated mempool contents."""
         txs = [w.tx for w in env.mempool.all_txs()]
         page_i = max(1, _as_int(page, "page") or 1)
         per = min(100, max(1, _as_int(per_page, "per_page") or 30))
@@ -613,6 +628,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def num_unconfirmed_txs():
+        """Mempool size and byte totals."""
         return {
             "count": str(env.mempool.size()),
             "total": str(env.mempool.size()),
@@ -620,6 +636,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def tx(hash=None, prove=False):
+        """Indexed transaction by hash, optional inclusion proof."""
         if env.tx_indexer is None:
             raise RPCError(-32603, "transaction indexing is disabled")
         h = _as_bytes_hex(hash, "hash")
@@ -641,6 +658,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def tx_search(query=None, prove=False, page=1, per_page=30, order_by="asc"):
+        """Query the tx index (events query language), paginated."""
         if env.tx_indexer is None:
             raise RPCError(-32603, "transaction indexing is disabled")
         q = parse_query(query or "")
@@ -665,6 +683,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def block_search(query=None, page=1, per_page=30, order_by="asc"):
+        """Query the block index (events query language), paginated."""
         if env.tx_indexer is None:
             raise RPCError(-32603, "block indexing is disabled")
         q = parse_query(query or "")
@@ -685,6 +704,7 @@ def build_routes(env: RPCEnvironment) -> dict:
     # ------------------------------------------------------------ evidence
 
     def broadcast_evidence(evidence=None):
+        """Submit verified misbehavior evidence."""
         from ..proto import messages as pb
         from ..types.evidence import evidence_from_proto
 
@@ -698,6 +718,7 @@ def build_routes(env: RPCEnvironment) -> dict:
     # ----------------------------------------------------------------- abci
 
     def abci_query(path="", data="", height=0, prove=False):
+        """App-level query through ABCI Query."""
         raw = _as_bytes_hex(data, "data") if data else b""
         res = env.app_client.query(
             abci.RequestQuery(data=raw, path=path, height=_as_int(height, "height") or 0, prove=bool(prove))
@@ -716,6 +737,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         }
 
     def abci_info():
+        """App name/version/height via ABCI Info."""
         res = env.app_client.info(abci.RequestInfo())
         return {
             "response": {
