@@ -367,3 +367,43 @@ def test_batch_merlin_throughput_sanity():
         _challenge(t, pk, r)
     t_scalar = (time.perf_counter() - t0) / 16 * n
     assert t_batch < t_scalar / 3, (t_batch, t_scalar)
+
+
+def test_device_ristretto_decode_parity_fuzz():
+    """Host and device ristretto decode must agree accept/reject on
+    arbitrary 32-byte strings (valid encodings, torsion-ish bytes,
+    sign/canonicality edges), and re-encode identically on accepts."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import ristretto as R
+
+    rng = np.random.default_rng(0x715)
+    cases = []
+    for k in range(1, 17):
+        cases.append(np.frombuffer(sr.ristretto_encode(scalar_mult(k, BASE)), np.uint8))
+    for _ in range(48):
+        cases.append(rng.integers(0, 256, 32, dtype=np.uint8))
+    # targeted edges: high-bit/canonicality and low-bit/sign flips of a
+    # valid encoding, all-zero (identity), p-1, p, p+small
+    base_enc = np.frombuffer(sr.ristretto_encode(BASE), np.uint8).copy()
+    for flip in (0, 31):
+        for bit in (0x01, 0x80):
+            e = base_enc.copy()
+            e[flip] ^= bit
+            cases.append(e)
+    P = 2**255 - 19
+    for v in (0, P - 19, P - 1, P, P + 18, 2**256 - 1):
+        cases.append(np.frombuffer((v % 2**256).to_bytes(32, "little"), np.uint8))
+    arr = np.stack(cases).T.astype(np.int32)  # (32, N)
+    pt, ok_dev = R.decode(jnp.asarray(arr))
+    ok_dev = np.asarray(ok_dev)
+    enc_dev = np.asarray(R.encode(pt))
+    for i, case in enumerate(cases):
+        host_pt = sr.ristretto_decode(bytes(case.astype(np.uint8)))
+        assert (host_pt is not None) == bool(ok_dev[i]), f"case {i} acceptance diverged"
+        if host_pt is not None:
+            assert bytes(enc_dev[:, i].astype(np.uint8)) == sr.ristretto_encode(host_pt), (
+                f"case {i} re-encode diverged"
+            )
