@@ -425,7 +425,11 @@ def test_pbts_untimely_proposer_rejected_chain_advances():
     for n in nodes:
         n.start()
     try:
-        assert wait_for_height(nodes, 6, timeout=90), (
+        # PBTS cuts both ways: the skewed node also judges every HONEST
+        # proposal untimely (they sit 30s in its past), so it may stall —
+        # correct behavior. The chain must advance on the 3 honest
+        # validators (> 2/3 power) regardless.
+        assert wait_for_height(nodes[1:], 6, timeout=90), (
             f"stalled: {[n.rs.height for n in nodes]}"
         )
     finally:
