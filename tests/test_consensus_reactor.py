@@ -204,3 +204,63 @@ def _make_validators(n, power=100):
     by_addr = {p.pub_key().address(): p for p in privs}
     privs_sorted = [by_addr[v.address] for v in vset.validators]
     return vset, privs_sorted
+
+
+def test_pbts_untimely_proposer_rejected_chain_advances():
+    """Proposer-based timestamps over the REAL reactor stack (ref:
+    internal/consensus/pbts_test.go): a validator with a 30s-fast clock
+    proposes untimely blocks; unlocked honest validators prevote nil,
+    the round fails, and the next proposer commits. Catch-up part
+    gossip (reactor.go:437) keeps the skewed node itself live — it
+    judges honest proposals untimely and prevotes nil, but commits via
+    +2/3 precommits — so ALL nodes must advance, rounds > 0 must appear,
+    and no committed timestamp may lead its successor by ~the skew."""
+    import dataclasses
+
+    from tendermint_tpu.types.params import SynchronyParams
+    from tendermint_tpu.utils.tmtime import Time
+
+    keys = make_keys(4)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-pbts")
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(),
+        synchrony=SynchronyParams(precision=200_000_000, message_delay=300_000_000),
+    )
+    SKEW_NS = 30_000_000_000
+
+    net = MemoryNetwork()
+    nodes = [P2PNode(net, keys, i, gen_doc) for i in range(4)]
+    nodes[0].cs.now = lambda: Time.from_unix_ns(Time.now().unix_ns() + SKEW_NS)
+    for n in nodes:
+        n.start()
+    try:
+        for i, a in enumerate(nodes):
+            for j, b in enumerate(nodes):
+                if i < j:
+                    a.pm.add(Endpoint(protocol="memory", host=b.node_id, node_id=b.node_id))
+        assert wait_for_height([n.cs for n in nodes], 6, timeout=120), (
+            f"stalled: {[n.cs.block_store.height() for n in nodes]}"
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+    n1 = nodes[1].cs
+    saw_late_round = False
+    times = {}
+    for h in range(1, n1.block_store.height() + 1):
+        commit = n1.block_store.load_block_commit(h) or n1.block_store.load_seen_commit(h)
+        block = n1.block_store.load_block(h)
+        if commit is not None and commit.round > 0:
+            saw_late_round = True
+        if block is not None:
+            times[h] = block.header.time.unix_ns()
+    # A committed +30s-skewed timestamp would tower over its honest
+    # successor no matter when it landed.
+    for h in sorted(times):
+        if h + 1 in times:
+            assert times[h] - times[h + 1] < 20_000_000_000, (
+                f"height {h} timestamp ~{(times[h]-times[h+1])/1e9:.0f}s ahead of "
+                f"height {h+1}: an untimely block was committed"
+            )
+    assert saw_late_round, "skewed proposer was never forced into a round > 0"
