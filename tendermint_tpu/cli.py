@@ -49,6 +49,15 @@ def cmd_start(args) -> int:
     from .config import load_config
     from .node import Node
 
+    # Install fault-injection handlers BEFORE construction: the e2e
+    # runner may deliver a `disconnect` SIGUSR1 while the node is still
+    # replaying its WAL, and the default disposition would kill it.
+    _router_cell = []
+    signal.signal(signal.SIGUSR1,
+                  lambda *a: _router_cell and _router_cell[0].set_network_enabled(False))
+    signal.signal(signal.SIGUSR2,
+                  lambda *a: _router_cell and _router_cell[0].set_network_enabled(True))
+
     cfg = load_config(args.home)
     if args.proxy_app:
         cfg.base.proxy_app = args.proxy_app
@@ -70,14 +79,13 @@ def cmd_start(args) -> int:
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    # Fault-injection hooks: SIGUSR1 severs all p2p connections and
+    # Arm the partition switch: SIGUSR1 severs all p2p connections and
     # refuses new ones, SIGUSR2 reconnects — a real network partition
     # for the e2e runner's `disconnect` perturbation (the reference
     # detaches the docker network, test/e2e/runner/perturb.go:43).
     router = getattr(node, "router", None)
     if router is not None:
-        signal.signal(signal.SIGUSR1, lambda *a: router.set_network_enabled(False))
-        signal.signal(signal.SIGUSR2, lambda *a: router.set_network_enabled(True))
+        _router_cell.append(router)
     try:
         while not stop:
             time.sleep(0.2)

@@ -128,7 +128,13 @@ class ConsensusState:
         self._n_steps = 0
 
         self.update_to_state(state)
-        self._reconstruct_last_commit_if_needed(state)
+        # Boot-time reconstruction is best-effort: a statesync-restored
+        # node on a vote-extension chain has NO ExtendedCommit until
+        # blocksync applies its first block, and must still be able to
+        # construct (it boots into statesync/blocksync, not consensus).
+        # The blocksync->consensus switch re-runs this strictly
+        # (switch_to_state) where the data is guaranteed.
+        self._reconstruct_last_commit_if_needed(state, strict=False)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -320,7 +326,7 @@ class ConsensusState:
         ExtendedCommit is the only valid source — then reset RoundState."""
         if state.last_block_height > 0:
             self.rs.last_commit = None
-            self._reconstruct_last_commit_if_needed(state)
+            self._reconstruct_last_commit_if_needed(state)  # strict
         self.update_to_state(state)
 
     def update_to_state(self, state: State) -> None:
@@ -384,7 +390,7 @@ class ConsensusState:
             self.metrics.validators_power.set(state.validators.total_voting_power())
         self._new_step()
 
-    def _reconstruct_last_commit_if_needed(self, state: State) -> None:
+    def _reconstruct_last_commit_if_needed(self, state: State, strict: bool = True) -> None:
         """Rebuild LastCommit VoteSet from storage (ref:
         reconstructLastCommit state.go:704-745). When vote extensions
         were enabled at last_block_height the set MUST be rebuilt from
@@ -401,6 +407,13 @@ class ConsensusState:
                 if self.block_store else None
             )
             if votes is None:
+                if not strict:
+                    self.logger.info(
+                        "no extended commit yet for last height; deferring "
+                        "last-commit reconstruction to the sync switch",
+                        height=state.last_block_height,
+                    )
+                    return
                 raise ConsensusError(
                     f"failed to reconstruct last extended commit; extended commit for "
                     f"height {state.last_block_height} not found"

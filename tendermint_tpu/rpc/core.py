@@ -326,7 +326,9 @@ def build_routes(env: RPCEnvironment) -> dict:
         return h
 
     def block(height=None):
-        """Mirrors the reference exactly (blocks.go:90-102): a missing
+        """Block ID + full block at a height (latest by default).
+
+        Mirrors the reference exactly (blocks.go:90-102): a missing
         META yields the empty result; a present meta with a missing full
         block (e.g. a backfilled light block on a state-synced node)
         yields the REAL BlockID with a null block."""

@@ -380,3 +380,54 @@ def test_restart_reconstructs_extended_last_commit():
     assert any(v is not None and v.extension_signature for v in lc.votes), (
         "reconstructed votes lack extension signatures"
     )
+
+
+def test_boot_without_extended_commit_is_nonfatal_switch_is_strict():
+    """A statesync-restored node on a vote-extension chain has no
+    ExtendedCommit until blocksync applies a block. Boot-time
+    construction must succeed (deferring reconstruction), or the node
+    crash-loops before it can ever run the sync that fetches the EC;
+    the post-sync switch (switch_to_state) stays strict."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    from tendermint_tpu.consensus.state import ConsensusError
+    from tendermint_tpu.store.blockstore import BlockStore
+    from tendermint_tpu.store.kv import MemDB
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN + "-ssvx")
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), abci=ABCIParams(vote_extensions_enable_height=2)
+    )
+    n = make_node(keys, 0, gen_doc)
+    n.start()
+    try:
+        assert wait_for_height([n], 3, timeout=30)
+    finally:
+        n.stop()
+    state = n.state
+    assert state.consensus_params.abci.vote_extensions_enabled(state.last_block_height)
+
+    # statesync-like store: seen commit present, NO extended commit
+    bare_store = BlockStore(MemDB())
+    seen = n.block_store.load_seen_commit(state.last_block_height)
+    bare_store.save_seen_commit(state.last_block_height, seen)
+
+    cs = ConsensusState(state, n.block_exec, bare_store,
+                        priv_validator=FilePV(priv_key=keys[0]))  # must not raise
+    assert cs.rs.last_commit is None  # deferred
+
+    with _pytest.raises(ConsensusError, match="extended commit"):
+        cs.switch_to_state(state)
+
+    # once the EC exists (blocksync fetched a block), the switch succeeds
+    ec = n.block_store.load_extended_commit_proto(state.last_block_height)
+    bare_store._db.set(b"EC:" + state.last_block_height.to_bytes(8, "big"), ec.encode())
+    cs2 = ConsensusState(state, n.block_exec, bare_store,
+                         priv_validator=FilePV(priv_key=keys[0]))
+    cs2.rs.last_commit = None
+    cs2.switch_to_state(state)
+    assert cs2.rs.last_commit is not None and cs2.rs.last_commit.extensions_enabled
