@@ -407,3 +407,31 @@ def test_device_ristretto_decode_parity_fuzz():
             assert bytes(enc_dev[:, i].astype(np.uint8)) == sr.ristretto_encode(host_pt), (
                 f"case {i} re-encode diverged"
             )
+
+
+def test_sign_self_regression_vectors():
+    """Our signing is deterministic: frozen (seed, msg) -> (pubkey, sig)
+    vectors pin the whole stack (expand/merlin/ristretto/ladder) so a
+    refactor cannot silently change the bytes we produce. These are
+    SELF-vectors (see the module docstring's KNOWN GAP about external
+    schnorrkel KATs)."""
+    vectors = [
+        (b"vector-one", b"",
+         "3ea084fe4653e2a1517dab8b0f173e250fd5b6a96aa80a3b36dc12a21472354c",
+         "24bf02929e7d20eeebf1b08579c5cca18bc9f9900172d9bc6fe6e08e333bed24"
+         "f94925954152db2b376ce3ac960abdac7d819856a9443b135dd0b262050f2d8b"),
+        (b"vector-two", b"abc",
+         "fe89afe38863763ff57b4134db18975231cb63ecbd24b0592210488411782a00",
+         "92573c9799e9efcebefcbd7d2de418edede52d271980bd7d1fef0dd53edc7d65"
+         "9aee5a63b482083736bbb0bbf747bfec6966312e6e9aada85d561d8f53d70b89"),
+        (b"vector-three", b"x" * 300,
+         "32bd816196f7598966e2bce086fc1cbd181bf960802a286203e3857fcfe60705",
+         "30e31d04c4f9d3df5a193d013aa4c112e160556ad726b573f3be3146c7f16f1d"
+         "a10631a866d0e8f467fb3cd6cf90934e47b11ec5d11219e87ecaff155da3b883"),
+    ]
+    for seed, msg, pub_hex, sig_hex in vectors:
+        priv = sr.Sr25519PrivKey.generate(seed)
+        assert priv.pub_key().bytes().hex() == pub_hex
+        sig = priv.sign(msg)
+        assert sig.hex() == sig_hex
+        assert priv.pub_key().verify_signature(msg, sig)
