@@ -201,18 +201,47 @@ def cmd_gen_node_key(args) -> int:
     return 0
 
 
+def _zero_privval_state(data_dir: str) -> None:
+    with open(os.path.join(data_dir, "priv_validator_state.json"), "w") as f:
+        json.dump({"height": 0, "round": 0, "step": 0}, f)
+
+
 def cmd_reset(args) -> int:
-    """ref: commands/reset.go — unsafe-reset-all keeps keys/genesis,
-    wipes data."""
+    """ref: commands/reset.go — the reset family:
+      blockchain     wipe blocks/state/evidence/indexes/WAL, KEEP the
+                     signer state (safe on a live chain)
+      peers          drop the peer address store only
+      unsafe-signer  zero the privval sign state (double-sign hazard)
+      unsafe-all     everything above including signer state
+    Bare `unsafe-reset-all` remains an alias of `reset unsafe-all`."""
+    what = getattr(args, "what", "unsafe-all")
     data_dir = os.path.join(args.home, "data")
-    if os.path.isdir(data_dir):
-        keep = {}
-        pv_state = os.path.join(data_dir, "priv_validator_state.json")
-        shutil.rmtree(data_dir)
-        os.makedirs(data_dir, exist_ok=True)
-        with open(pv_state, "w") as f:
-            json.dump({"height": 0, "round": 0, "step": 0}, f)
-        print(f"reset {data_dir} (privval sign-state zeroed — DANGEROUS on a live chain)")
+    if not os.path.isdir(data_dir):
+        return 0
+    if what == "peers":
+        for name in ("peerstore.db",):
+            path = os.path.join(data_dir, name)
+            if os.path.exists(path):
+                shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+        print(f"reset peer store in {data_dir}")
+        return 0
+    if what == "unsafe-signer":
+        _zero_privval_state(data_dir)
+        print(f"zeroed privval sign state in {data_dir} (DANGEROUS on a live chain)")
+        return 0
+    if what == "blockchain":
+        for entry in os.listdir(data_dir):
+            if entry == "priv_validator_state.json" or entry == "peerstore.db":
+                continue
+            path = os.path.join(data_dir, entry)
+            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+        print(f"reset chain data in {data_dir} (signer state and peers kept)")
+        return 0
+    # unsafe-all
+    shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    _zero_privval_state(data_dir)
+    print(f"reset {data_dir} (privval sign-state zeroed — DANGEROUS on a live chain)")
     return 0
 
 
@@ -690,6 +719,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_gen_validator)
     sub.add_parser("gen-node-key", help="generate a node key").set_defaults(fn=cmd_gen_node_key)
     sub.add_parser("unsafe-reset-all", help="wipe the data directory").set_defaults(fn=cmd_reset)
+
+    sp = sub.add_parser("reset", help="reset subsets of node data (ref: commands/reset.go)")
+    sp.add_argument("what", nargs="?", default="unsafe-all",
+                    choices=["blockchain", "peers", "unsafe-signer", "unsafe-all"])
+    sp.set_defaults(fn=cmd_reset)
     sub.add_parser("rollback", help="rewind state one height").set_defaults(fn=cmd_rollback)
     sub.add_parser("inspect", help="read-only RPC over node data").set_defaults(fn=cmd_inspect)
 

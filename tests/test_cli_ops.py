@@ -289,3 +289,34 @@ def test_replay_console_steps_and_rewinds(tmp_path, monkeypatch, capsys):
     assert int(locs[3][0]) == int(locs[4][0]) == max(0, total - 1)  # back 1
     # the original WAL is untouched
     assert hashlib.sha256(open(cfg.wal_file, "rb").read()).hexdigest() == wal_digest
+
+
+def test_reset_family(tmp_path):
+    """ref: commands/reset.go — blockchain keeps signer state + peers,
+    peers drops only the peer store, unsafe-signer zeroes sign state,
+    unsafe-all wipes everything."""
+    import json as _json
+
+    n, home, rpc, height = _mini_chain(tmp_path, "reset-chain", txs=1)
+    n.stop()
+    data = os.path.join(home, "data")
+    # give the node a peer store + a sign state with progress
+    open(os.path.join(data, "peerstore.db"), "ab").close()
+    pv_path = os.path.join(data, "priv_validator_state.json")
+    pv_before = _json.load(open(pv_path))
+    assert int(pv_before["height"]) > 0
+
+    assert cli_main(["--home", home, "reset", "blockchain"]) == 0
+    left = set(os.listdir(data))
+    assert "priv_validator_state.json" in left and "peerstore.db" in left
+    assert not any(e.endswith(".db") and e != "peerstore.db" for e in left), left
+    assert _json.load(open(pv_path)) == pv_before  # signer state untouched
+
+    assert cli_main(["--home", home, "reset", "peers"]) == 0
+    assert "peerstore.db" not in set(os.listdir(data))
+
+    assert cli_main(["--home", home, "reset", "unsafe-signer"]) == 0
+    assert int(_json.load(open(pv_path))["height"]) == 0
+
+    assert cli_main(["--home", home, "reset", "unsafe-all"]) == 0
+    assert set(os.listdir(data)) == {"priv_validator_state.json"}
