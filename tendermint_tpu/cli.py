@@ -201,11 +201,6 @@ def cmd_gen_node_key(args) -> int:
     return 0
 
 
-def _zero_privval_state(data_dir: str) -> None:
-    with open(os.path.join(data_dir, "priv_validator_state.json"), "w") as f:
-        json.dump({"height": 0, "round": 0, "step": 0}, f)
-
-
 def cmd_reset(args) -> int:
     """ref: commands/reset.go — the reset family:
       blockchain     wipe blocks/state/evidence/indexes/WAL, KEEP the
@@ -214,34 +209,54 @@ def cmd_reset(args) -> int:
       unsafe-signer  zero the privval sign state (double-sign hazard)
       unsafe-all     everything above including signer state
     Bare `unsafe-reset-all` remains an alias of `reset unsafe-all`."""
+    from .config import load_config
+
+    # Resolve every path from the loaded config — db-dir, the WAL and
+    # the privval state file are all configurable, and a partial reset
+    # against hardcoded defaults would split state (reference reset.go
+    # likewise resolves from config).
+    cfg = load_config(args.home)
     what = getattr(args, "what", "unsafe-all")
-    data_dir = os.path.join(args.home, "data")
-    if not os.path.isdir(data_dir):
-        return 0
+    db_dir = cfg.db_dir
+    pv_state = cfg.priv_validator_state_file
+    wal_dir = os.path.dirname(cfg.wal_file)
+
+    def _rm(path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def _zero_pv():
+        os.makedirs(os.path.dirname(pv_state), exist_ok=True)
+        with open(pv_state, "w") as f:
+            json.dump({"height": 0, "round": 0, "step": 0}, f)
+
     if what == "peers":
-        for name in ("peerstore.db",):
-            path = os.path.join(data_dir, name)
-            if os.path.exists(path):
-                shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
-        print(f"reset peer store in {data_dir}")
+        _rm(os.path.join(db_dir, "peerstore.db"))
+        print(f"reset peer store in {db_dir}")
         return 0
     if what == "unsafe-signer":
-        _zero_privval_state(data_dir)
-        print(f"zeroed privval sign state in {data_dir} (DANGEROUS on a live chain)")
+        _zero_pv()
+        print(f"zeroed privval sign state at {pv_state} (DANGEROUS on a live chain)")
         return 0
     if what == "blockchain":
-        for entry in os.listdir(data_dir):
-            if entry == "priv_validator_state.json" or entry == "peerstore.db":
-                continue
-            path = os.path.join(data_dir, entry)
-            shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
-        print(f"reset chain data in {data_dir} (signer state and peers kept)")
+        if os.path.isdir(db_dir):
+            for entry in os.listdir(db_dir):
+                path = os.path.join(db_dir, entry)
+                if path in (pv_state, os.path.join(db_dir, "peerstore.db")) or path == wal_dir:
+                    continue
+                _rm(path)
+        _rm(wal_dir)
+        print(f"reset chain data in {db_dir} (signer state and peers kept)")
         return 0
     # unsafe-all
-    shutil.rmtree(data_dir)
-    os.makedirs(data_dir, exist_ok=True)
-    _zero_privval_state(data_dir)
-    print(f"reset {data_dir} (privval sign-state zeroed — DANGEROUS on a live chain)")
+    if os.path.isdir(db_dir):
+        shutil.rmtree(db_dir)
+    os.makedirs(db_dir, exist_ok=True)
+    _rm(wal_dir)
+    _zero_pv()
+    print(f"reset {db_dir} (privval sign-state zeroed — DANGEROUS on a live chain)")
     return 0
 
 

@@ -107,7 +107,7 @@ class StateSyncConfig:
     trust_hash: str = ""
     trust_period: float = 168 * 3600.0  # seconds
     discovery_time: float = 15.0
-    chunk_fetchers: int = 4
+    fetchers: int = 4  # 0.35 spelling (0.34: chunk-fetchers)
 
 
 @dataclass
