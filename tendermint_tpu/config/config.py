@@ -38,6 +38,9 @@ class BaseConfig:
     db_backend: str = "filedb"
     db_dir: str = "data"
     log_level: str = "info"
+    # "plain" (human console lines) | "json" (one object per line,
+    # zerolog-style) — ref: config.go BaseConfig.LogFormat
+    log_format: str = "plain"
     genesis_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_GENESIS_FILE)
     priv_validator_key_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_PRIVVAL_KEY)
     priv_validator_state_file: str = os.path.join(DEFAULT_DATA_DIR, DEFAULT_PRIVVAL_STATE)

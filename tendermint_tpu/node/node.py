@@ -152,7 +152,8 @@ class Node:
             if config.instrumentation.prometheus
             else None
         )
-        self.logger = Logger(level=parse_level(config.base.log_level)).with_fields(
+        self.logger = Logger(level=parse_level(config.base.log_level),
+                             fmt=config.base.log_format).with_fields(
             module="node"
         )
         self._halted = threading.Event()

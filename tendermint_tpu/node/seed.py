@@ -32,7 +32,8 @@ class SeedNode:
             raise ValueError("cannot run seed nodes with PEX disabled")
         self.config = config
         self.gen_doc = gen_doc if gen_doc is not None else GenesisDoc.from_file(config.genesis_file)
-        self.logger = Logger(level=parse_level(config.base.log_level)).with_fields(module="seed")
+        self.logger = Logger(level=parse_level(config.base.log_level),
+                             fmt=config.base.log_format).with_fields(module="seed")
 
         self.node_key = node_key if node_key is not None else NodeKey.load_or_gen(config.node_key_file)
         self.node_id = self.node_key.node_id

@@ -48,6 +48,8 @@ class Logger:
             level = parse_level(os.environ.get("TM_LOG_LEVEL", "info"))
         if fmt is None:
             fmt = os.environ.get("TM_LOG_FORMAT", "console")
+        if fmt == "plain":  # the reference config's name for console
+            fmt = "console"
         self.level = level
         self.fmt = fmt
         self.writer = writer or sys.stderr
