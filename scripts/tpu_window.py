@@ -1,23 +1,31 @@
-"""Everything to measure in ONE tunnel window, ONE device claim.
+"""Bank on-chip measurements across SHORT tunnel windows, statefully.
 
-The axon tunnel works in short windows (r3: ~3 minutes over 12 hours),
-so this script banks results in strictly decreasing value-per-second
-order and flushes after every line:
+The axon tunnel works in short windows (r3: ~3 min; r4 first window:
+~4.3 min from claim to wedge), so this script is designed to be re-run
+by scripts/tpu_retry_loop.sh across many windows: each phase writes a
+marker file under .tpu_runs/banked/ on success and is SKIPPED on later
+runs, so every new window spends its seconds on the most valuable
+measurement still missing. Exit code is 0 only when every phase is
+banked (the retry loop keeps attempting otherwise).
 
-  A. dot-mode sweep (compile cached from prior windows): device-only
-     rates at 256..8192, H2D bandwidth, pipelined end-to-end at max
-     batch — the numbers bench.py needs to be believed.
-  B. small-batch launch latency (end-to-end verify_batch at n=4..128)
-     -> derives DEVICE_BATCH_CUTOVER from real chip data.
-  C. slice-mode A/B at batch 256 (uncached compile, riskiest, last):
-     settles dot-vs-slice on the MXU.
+Phase order (value-per-second, given what's already banked):
+  slice256  — slice-mode kernel compile + steady @256: the decisive
+              dot-vs-slice A/B on the MXU/VPU. Dot is measured at
+              ~34k sigs/s device-only (window 1, 2026-07-31); slice is
+              ~11x faster than dot on XLA-CPU and its VPU cost model
+              predicts ~500k+ sigs/s on chip.
+  slice_big — slice @1024/@8192 scaling points.
+  pipe      — end-to-end sync + pipelined verify_batch @8192 (host prep
+              + uint8 H2D + kernel) in the default mode.
+  cutover   — small-batch end-to-end latency (n=64, 16, 128) to derive
+              DEVICE_BATCH_CUTOVER from real launch latency.
+  sr        — sr25519 kernel compile + steady @256.
+  dot       — dot-mode device-only sweep 256..8192 (banked window 1;
+              marker pre-seeded, re-run only if marker removed).
 
 Stages use SIGALRM deadlines (best-effort: cannot interrupt a hung C
-call) and never kill the process — a wedged stage just stops escalation
-so the banked lines survive.
-
-Usage: python scripts/tpu_window.py   (claims the device; run via
-scripts/tpu_retry_loop.sh which never timeout-kills a claim).
+call) and never kill the process — a wedged stage stops escalation but
+the banked lines and markers survive.
 """
 
 import os
@@ -38,30 +46,56 @@ from _bench_util import StageTimeout, enable_compile_cache, stage_deadline as de
 enable_compile_cache(jax)
 
 _T0 = time.time()
+_BANK_DIR = os.path.join(_ROOT, ".tpu_runs", "banked")
+os.makedirs(_BANK_DIR, exist_ok=True)
+_RESULTS = os.path.join(_ROOT, ".tpu_runs", "results.txt")
 
 
 def log(msg):
-    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+    line = f"[{time.time() - _T0:7.1f}s] {msg}"
+    print(line, flush=True)
+    with open(_RESULTS, "a") as f:
+        f.write(line + "\n")
+
+
+def banked(phase):
+    return os.path.exists(os.path.join(_BANK_DIR, phase))
+
+
+def mark(phase):
+    with open(os.path.join(_BANK_DIR, phase), "w") as f:
+        f.write(f"{time.time()}\n")
 
 
 from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.ops import field as F
 from tendermint_tpu.ops import verify as V
 
-# All host-side work BEFORE the device claim: window seconds are scarce.
-MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
-sk = ref.gen_privkey(b"\x42" * 32)
-pk = sk[32:]
-pks, msgs, sigs = [], [], []
-for i in range(MAX_B):
-    m = b"bench-commit-vote-%d" % i
-    pks.append(pk)
-    msgs.append(m)
-    sigs.append(ref.sign(sk, m))
+PHASES = ("slice256", "slice_big", "pipe", "cutover", "sr", "dot")
+todo = [p for p in PHASES if not banked(p)]
+if not todo:
+    log("all phases banked; nothing to do")
+    sys.exit(0)
+log(f"phases to bank: {todo}")
 
-t0 = time.time()
-a, r, s, k, pre = V.prepare_batch(pks, msgs, sigs)
-log(f"host prep {MAX_B}: {time.time()-t0:.3f}s ({MAX_B/(time.time()-t0):,.0f} sigs/s)")
+# All host-side work BEFORE the device claim: window seconds are scarce.
+# Skipped entirely when no remaining phase consumes ed25519 jobs (e.g.
+# only "sr" is left): retry attempts then go straight to the claim.
+MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
+pks, msgs, sigs = [], [], []
+a = r = s = k = None
+if any(p != "sr" for p in todo):
+    sk = ref.gen_privkey(b"\x42" * 32)
+    pk = sk[32:]
+    for i in range(MAX_B):
+        m = b"bench-commit-vote-%d" % i
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(ref.sign(sk, m))
+
+    t0 = time.time()
+    a, r, s, k, pre = V.prepare_batch(pks, msgs, sigs)
+    log(f"host prep {MAX_B}: {time.time()-t0:.3f}s ({MAX_B/(time.time()-t0):,.0f} sigs/s)")
 
 log("claiming device (jax.devices())...")
 dev = jax.devices()[0]
@@ -84,111 +118,132 @@ def device_only(kernel, B, iters=10):
     return t_compile, dt
 
 
-# ---- Phase A: dot-mode sweep (cached compiles; the must-bank data) ----
-try:
-    with deadline(600):
-        for B in (256, 1024, 2048, 4096, 8192):
-            if B > MAX_B:
-                break
-            t_c, dt = device_only(V.verify_kernel, B)
-            log(f"A dot B={B:5d}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
-                f"device-only {B/dt:12,.0f} sigs/s")
-        for mb in (1, 4):
-            buf = np.zeros((mb << 20,), np.uint8)
-            jax.block_until_ready(jnp.asarray(buf))
-            t0 = time.time()
-            outs = [jnp.asarray(buf) for _ in range(4)]
-            jax.block_until_ready(outs)
-            dt = (time.time() - t0) / 4
-            log(f"A H2D {mb}MB: {dt*1000:7.1f}ms = {mb/dt:8.1f} MB/s")
-        B = MAX_B
-        t0 = time.time()
-        for _ in range(3):
-            ok = V.verify_batch(pks, msgs, sigs)
-        dt = (time.time() - t0) / 3
-        log(f"A end-to-end sync      B={B}: {dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
-        iters = 8
-        t0 = time.time()
-        inflight = [V.verify_batch_async(pks, msgs, sigs) for _ in range(iters)]
-        outs = [V.collect(d) for d in inflight]
-        dt = (time.time() - t0) / iters
-        assert all(bool(o.all()) for o in outs)
-        log(f"A end-to-end pipelined B={B}: {dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
-except StageTimeout:
-    log("A TIMED OUT mid-phase; continuing to B with what we have")
-except Exception as e:  # noqa: BLE001
-    log(f"A failed: {type(e).__name__}: {e}")
+import contextlib
 
-# ---- Phase B: small-batch end-to-end latency -> cutover derivation ----
-try:
-    with deadline(420):
-        for n in (4, 64, 8, 16, 32, 128):  # current-cutover shapes first
-            sub = (pks[:n], msgs[:n], sigs[:n])
-            t0 = time.time()
-            ok = V.verify_batch(*sub)
-            t_first = time.time() - t0
-            assert bool(ok.all())
-            t0 = time.time()
-            for _ in range(20):
-                ok = V.verify_batch(*sub)
-            dt = (time.time() - t0) / 20
-            log(f"B n={n:4d}  first {t_first:7.2f}s  steady {dt*1000:8.3f}ms/call  "
-                f"({n/dt:10,.0f} sigs/s)")
-except StageTimeout:
-    log("B TIMED OUT mid-phase")
-except Exception as e:  # noqa: BLE001
-    log(f"B failed: {type(e).__name__}: {e}")
 
-# ---- Phase C: slice-mode A/B at 256 (uncached compile risk; last) ----
-try:
-    with deadline(420):
-        F._FE_MUL_MODE = "slice"
-        slice_kernel = jax.jit(V.verify_kernel_impl)
-        t_c, dt = device_only(slice_kernel, 256)
-        log(f"C slice B=256  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+@contextlib.contextmanager
+def slice_mode():
+    """Trace V.verify_kernel_impl in slice mode; always restore whatever
+    mode was active so later phases (module-level V.verify_kernel,
+    sr25519) keep their default-mode traces."""
+    prev = F._FE_MUL_MODE
+    F._FE_MUL_MODE = "slice"
+    try:
+        yield jax.jit(V.verify_kernel_impl)
+    finally:
+        F._FE_MUL_MODE = prev
+
+
+def run_phase(name, seconds, fn, gate=True):
+    """Run one bankable phase under a SIGALRM deadline. Success writes
+    the marker; timeout/failure logs and falls through to later phases
+    (the banked lines always survive)."""
+    if name not in todo:
+        return
+    if not gate:
+        log(f"{name} skipped (gate not met)")
+        return
+    try:
+        with deadline(seconds):
+            fn()
+            mark(name)
+    except StageTimeout:
+        log(f"{name} TIMED OUT")
+    except Exception as e:  # noqa: BLE001
+        log(f"{name} failed: {type(e).__name__}: {e}")
+
+
+def _phase_slice256():
+    with slice_mode() as kern:
+        t_c, dt = device_only(kern, 256)
+        log(f"SLICE B=  256  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
             f"device-only {256/dt:12,.0f} sigs/s")
-        for B in (1024, 8192):
-            if B > MAX_B:
-                break
-            t_c, dt = device_only(slice_kernel, B)
-            log(f"C slice B={B:5d}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+
+
+def _phase_slice_big():
+    with slice_mode() as kern:
+        for B in sorted({b for b in (1024, MAX_B) if b <= MAX_B}):
+            t_c, dt = device_only(kern, B)
+            log(f"SLICE B={B:5d}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
                 f"device-only {B/dt:12,.0f} sigs/s")
-except StageTimeout:
-    log("C TIMED OUT (slice compile too slow on chip — dot stays default)")
-except Exception as e:  # noqa: BLE001
-    log(f"C failed: {type(e).__name__}: {e}")
-finally:
-    F._FE_MUL_MODE = os.environ.get("TM_TPU_FE_MUL", "dot")
 
-# ---- Phase D: sr25519 kernel (new in r4): compile + device-only rate ----
-try:
-    with deadline(300):
-        from tendermint_tpu.crypto import sr25519 as srh
-        from tendermint_tpu.ops import verify_sr as VS
 
-        B = 256
-        spriv = srh.Sr25519PrivKey.generate(b"window-sr")
-        spk = spriv.pub_key().bytes()
-        smsgs = [b"sr-window-%03d" % i for i in range(B)]
-        ssigs = [spriv.sign(m) for m in smsgs]
-        sa, srr, ss, sk2, _ = VS.prepare_batch([spk] * B, smsgs, ssigs)
-        da = jnp.asarray(sa); dr = jnp.asarray(srr)
-        ds = jnp.asarray(ss); dk = jnp.asarray(sk2)
+def _phase_pipe():
+    B = MAX_B
+    # Warm-up: compiles the @MAX_B shape unless .jax_cache already holds
+    # it (it does after window 1 on this machine; a fresh cache pays the
+    # full ~66 s compile out of this phase's deadline).
+    ok = V.verify_batch(pks, msgs, sigs)
+    assert bool(ok.all())
+    t0 = time.time()
+    for _ in range(3):
+        ok = V.verify_batch(pks, msgs, sigs)
+    dt = (time.time() - t0) / 3
+    log(f"PIPE end-to-end sync      B={B}: {dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+    iters = 8
+    t0 = time.time()
+    inflight = [V.verify_batch_async(pks, msgs, sigs) for _ in range(iters)]
+    outs = [V.collect(d) for d in inflight]
+    dt = (time.time() - t0) / iters
+    assert all(bool(o.all()) for o in outs)
+    log(f"PIPE end-to-end pipelined B={B}: {dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+
+
+def _phase_cutover():
+    for n in (64, 16, 128):  # one compile per padded shape
+        sub = (pks[:n], msgs[:n], sigs[:n])
         t0 = time.time()
+        ok = V.verify_batch(*sub)
+        t_first = time.time() - t0
+        assert bool(ok.all())
+        t0 = time.time()
+        for _ in range(20):
+            ok = V.verify_batch(*sub)
+        dt = (time.time() - t0) / 20
+        log(f"CUTOVER n={n:4d}  first {t_first:7.2f}s  steady {dt*1000:8.3f}ms/call  "
+            f"({n/dt:10,.0f} sigs/s)")
+
+
+def _phase_sr():
+    from tendermint_tpu.crypto import sr25519 as srh
+    from tendermint_tpu.ops import verify_sr as VS
+
+    B = 256
+    spriv = srh.Sr25519PrivKey.generate(b"window-sr")
+    spk = spriv.pub_key().bytes()
+    smsgs = [b"sr-window-%03d" % i for i in range(B)]
+    ssigs = [spriv.sign(m) for m in smsgs]
+    sa, srr, ss, sk2, _ = VS.prepare_batch([spk] * B, smsgs, ssigs)
+    da = jnp.asarray(sa); dr = jnp.asarray(srr)
+    ds = jnp.asarray(ss); dk = jnp.asarray(sk2)
+    t0 = time.time()
+    out = VS.verify_sr_kernel(da, dr, ds, dk)
+    jax.block_until_ready(out)
+    t_c = time.time() - t0
+    assert bool(np.asarray(out).all()), "sr25519 kernel rejected valid sigs"
+    t0 = time.time()
+    for _ in range(10):
         out = VS.verify_sr_kernel(da, dr, ds, dk)
-        jax.block_until_ready(out)
-        t_c = time.time() - t0
-        assert bool(np.asarray(out).all()), "sr25519 kernel rejected valid sigs"
-        t0 = time.time()
-        for _ in range(10):
-            out = VS.verify_sr_kernel(da, dr, ds, dk)
-        jax.block_until_ready(out)
-        dt = (time.time() - t0) / 10
-        log(f"D sr25519 B={B}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
-            f"device-only {B/dt:12,.0f} sigs/s")
-except StageTimeout:
-    log("D TIMED OUT (sr25519 kernel compile)")
-except Exception as e:  # noqa: BLE001
-    log(f"D failed: {type(e).__name__}: {e}")
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / 10
+    log(f"SR25519 B={B}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+        f"device-only {B/dt:12,.0f} sigs/s")
 
-log("window complete")
+
+def _phase_dot():
+    for B in sorted({b for b in (256, 1024, 2048, 4096, 8192) if b <= MAX_B}):
+        t_c, dt = device_only(V.verify_kernel, B)
+        log(f"DOT B={B:5d}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
+            f"device-only {B/dt:12,.0f} sigs/s")
+
+
+run_phase("slice256", 480, _phase_slice256)
+run_phase("slice_big", 360, _phase_slice_big, gate=banked("slice256"))
+run_phase("pipe", 360, _phase_pipe)
+run_phase("cutover", 360, _phase_cutover)
+run_phase("sr", 300, _phase_sr)
+run_phase("dot", 600, _phase_dot)
+
+remaining = [p for p in PHASES if not banked(p)]
+log(f"window complete; still missing: {remaining or 'nothing'}")
+sys.exit(0 if not remaining else 1)

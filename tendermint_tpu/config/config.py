@@ -194,6 +194,12 @@ class Config:
     def validate_basic(self) -> None:
         if self.base.mode not in ("validator", "full", "seed"):
             raise ValueError(f"unknown mode {self.base.mode!r}")
+        if self.base.log_format not in ("plain", "json"):
+            # ref: config/config.go BaseConfig.ValidateBasic (unknown
+            # log_format must error, not silently fall back to console)
+            raise ValueError(
+                f"unknown log_format {self.base.log_format!r} (must be 'plain' or 'json')"
+            )
         if self.mempool.size <= 0:
             raise ValueError("mempool.size must be positive")
 

@@ -28,6 +28,7 @@ class SeedNode:
         gen_doc: GenesisDoc | None = None,
         node_key: NodeKey | None = None,
     ):
+        config.validate_basic()  # same gate as Node (node/node.py)
         if not config.p2p.pex:
             raise ValueError("cannot run seed nodes with PEX disabled")
         self.config = config

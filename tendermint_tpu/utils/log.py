@@ -81,7 +81,7 @@ class Logger:
                 record["level"] = _NAMES[level].lower()
                 record["time"] = round(ts, 3)
                 record["message"] = msg
-                line = json.dumps(record, default=str)
+                line = json.dumps(record, default=_json_val)
             else:
                 t = time.strftime("%H:%M:%S", time.localtime(ts))
                 pairs = " ".join(f"{k}={_fmt_val(v)}" for k, v in record.items())
@@ -91,6 +91,14 @@ class Logger:
                 self.writer.flush()
         except Exception:
             pass  # logging must never take the node down
+
+
+def _json_val(v: Any) -> str:
+    """json.dumps fallback: hex for bytes (zerolog emits hex, not a
+    Python repr), str for everything else."""
+    if isinstance(v, (bytes, bytearray)):
+        return v.hex()
+    return str(v)
 
 
 def _fmt_val(v: Any) -> str:

@@ -72,6 +72,18 @@ def test_config_toml_roundtrip(tmp_path):
     assert back.mempool.size == 1234
 
 
+def test_config_rejects_unknown_log_format(tmp_path):
+    # ref: config/config.go BaseConfig.ValidateBasic
+    from tendermint_tpu.config import default_config
+
+    cfg = default_config(str(tmp_path))
+    cfg.base.log_format = "jsn"
+    with pytest.raises(ValueError, match="log_format"):
+        cfg.validate_basic()
+    cfg.base.log_format = "json"
+    cfg.validate_basic()
+
+
 @pytest.fixture(scope="module")
 def testnet(tmp_path_factory):
     """A running 3-validator testnet over real TCP, built via the CLI."""
