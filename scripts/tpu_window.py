@@ -58,13 +58,27 @@ def log(msg):
         f.write(line + "\n")
 
 
+MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
+
+
 def banked(phase):
-    return os.path.exists(os.path.join(_BANK_DIR, phase))
+    """A phase counts as banked only if its marker was written at a
+    sweep size >= the current one — a reduced smoke run (SWEEP_MAX=256)
+    must not permanently suppress the full @8192 measurement. Markers
+    with no metadata (window 1's hand-seeded 'dot') predate this and
+    were full-size TPU runs."""
+    path = os.path.join(_BANK_DIR, phase)
+    if not os.path.exists(path):
+        return False
+    text = open(path).read()
+    if "max=" not in text:
+        return True
+    return int(text.split("max=")[1].split()[0]) >= MAX_B
 
 
 def mark(phase):
     with open(os.path.join(_BANK_DIR, phase), "w") as f:
-        f.write(f"{time.time()}\n")
+        f.write(f"{time.time()} platform={dev.platform} max={MAX_B}\n")
 
 
 from tendermint_tpu.crypto import ed25519_ref as ref
@@ -79,9 +93,7 @@ if not todo:
 log(f"phases to bank: {todo}")
 
 # All host-side work BEFORE the device claim: window seconds are scarce.
-# Skipped entirely when no remaining phase consumes ed25519 jobs (e.g.
-# only "sr" is left): retry attempts then go straight to the claim.
-MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
+# Each prep block is gated on whether a remaining phase consumes it.
 pks, msgs, sigs = [], [], []
 a = r = s = k = None
 if any(p != "sr" for p in todo):
@@ -97,9 +109,24 @@ if any(p != "sr" for p in todo):
     a, r, s, k, pre = V.prepare_batch(pks, msgs, sigs)
     log(f"host prep {MAX_B}: {time.time()-t0:.3f}s ({MAX_B/(time.time()-t0):,.0f} sigs/s)")
 
+sr_inputs = None
+if "sr" in todo:
+    from tendermint_tpu.crypto import sr25519 as srh
+    from tendermint_tpu.ops import verify_sr as VS
+
+    SR_B = 256
+    spriv = srh.Sr25519PrivKey.generate(b"window-sr")
+    spk = spriv.pub_key().bytes()
+    smsgs = [b"sr-window-%03d" % i for i in range(SR_B)]
+    ssigs = [spriv.sign(m) for m in smsgs]
+    sr_inputs = VS.prepare_batch([spk] * SR_B, smsgs, ssigs)[:4]
+
 log("claiming device (jax.devices())...")
 dev = jax.devices()[0]
 log(f"claimed: {dev.platform}:{dev.device_kind}")
+if dev.platform != "tpu":
+    log(f"not a TPU backend ({dev.platform}); refusing to bank anything")
+    sys.exit(1)
 
 
 def device_only(kernel, B, iters=10):
@@ -205,15 +232,10 @@ def _phase_cutover():
 
 
 def _phase_sr():
-    from tendermint_tpu.crypto import sr25519 as srh
     from tendermint_tpu.ops import verify_sr as VS
 
-    B = 256
-    spriv = srh.Sr25519PrivKey.generate(b"window-sr")
-    spk = spriv.pub_key().bytes()
-    smsgs = [b"sr-window-%03d" % i for i in range(B)]
-    ssigs = [spriv.sign(m) for m in smsgs]
-    sa, srr, ss, sk2, _ = VS.prepare_batch([spk] * B, smsgs, ssigs)
+    B = SR_B
+    sa, srr, ss, sk2 = sr_inputs  # prepped before the claim
     da = jnp.asarray(sa); dr = jnp.asarray(srr)
     ds = jnp.asarray(ss); dk = jnp.asarray(sk2)
     t0 = time.time()
