@@ -61,15 +61,22 @@ def log(msg):
 MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
 
 
+# Phases whose measurements scale with SWEEP_MAX; the rest run at
+# fixed batch sizes and a marker from any sweep size stands.
+_MAXB_PHASES = ("slice_big", "pipe", "dot")
+
+
 def banked(phase):
-    """A phase counts as banked only if its marker was written at a
-    sweep size >= the current one — a reduced smoke run (SWEEP_MAX=256)
-    must not permanently suppress the full @8192 measurement. Markers
-    with no metadata (window 1's hand-seeded 'dot') predate this and
-    were full-size TPU runs."""
+    """A MAX_B-dependent phase counts as banked only if its marker was
+    written at a sweep size >= the current one — a reduced smoke run
+    (SWEEP_MAX=256) must not permanently suppress the full @8192
+    measurement. Markers with no metadata (window 1's hand-seeded
+    'dot') predate this and were full-size TPU runs."""
     path = os.path.join(_BANK_DIR, phase)
     if not os.path.exists(path):
         return False
+    if phase not in _MAXB_PHASES:
+        return True
     text = open(path).read()
     if "max=" not in text:
         return True

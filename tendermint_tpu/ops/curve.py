@@ -246,10 +246,14 @@ def fixed_base_table() -> np.ndarray:
     return _FIXED_TABLE
 
 
-def double_scalar_mul_base(s_bytes, k_bytes, a_pt):
+def double_scalar_mul_base(s_bytes, k_bytes, a_pt, final_t: bool = True):
     """[s]B + [k]A' in one interleaved Straus ladder (A' = a_pt, usually
     the negated pubkey). s_bytes/k_bytes: (32, B); a_pt: (4, 32, B) with
-    T. Output carries a valid T (the final addition produces it).
+    T. With final_t the output carries a valid T (the last addition
+    produces it; the ristretto encoder needs it). final_t=False keeps
+    every window identical, so the whole ladder is the fori_loop and no
+    unrolled final window bloats the graph — callers that only double
+    and compare the result (the ed25519 identity check) take this path.
 
     Per 4-bit window: 4 shared doublings (3 without T) + one addition per
     scalar (only the first produces T) + two 16-way one-hot selects."""
@@ -275,6 +279,8 @@ def double_scalar_mul_base(s_bytes, k_bytes, a_pt):
         _select16(a_table, nibs_k[_NIBBLES - 1]),
         out_t=False,
     )
+    if not final_t:
+        return lax.fori_loop(1, _NIBBLES, lambda i, v: window(v, 63 - i, False), acc0)
     acc = lax.fori_loop(1, _NIBBLES - 1, lambda i, v: window(v, 63 - i, False), acc0)
     return window(acc, 0, True)  # final window produces T for the R add
 

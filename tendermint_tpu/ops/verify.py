@@ -15,9 +15,11 @@ Split of labor:
   host   — SHA-512 challenges (cheap vs curve math), s < L range check,
            input shaping/padding
   device — point decompression (A and R in one stacked pass), the joint
-           [s]B + [k](-A) Straus ladder with shared doublings, the R
-           subtraction, cofactor clearing, identity test: one fused XLA
-           program with the batch on the VPU lane axis throughout
+           [s]B + [k](-A) Straus ladder with shared doublings, then the
+           cofactored equation as a projective equality
+           [8]([s]B - [k]A) == [8]R (both sides doubled in one stacked
+           scanned loop, compared by cross-multiplication): one fused
+           XLA program with the batch on the VPU lane axis throughout
 """
 
 from __future__ import annotations
@@ -53,12 +55,17 @@ def verify_kernel_impl(a_enc, r_enc, s_bytes, k_bytes):
     pts, oks = C.decompress(jnp.concatenate([a, r], axis=1), zip215=True)
     a_pt, r_pt = pts[..., :n], pts[..., n:]
     a_ok, r_ok = oks[:n], oks[n:]
-    q = C.double_scalar_mul_base(s, k, C.point_neg(a_pt))  # [s]B - [k]A
-    q = C.point_add(q, C.point_neg(r_pt), out_t=False)
-    q = C.point_double(q, out_t=False)  # clear cofactor: x8
-    q = C.point_double(q, out_t=False)
-    q = C.point_double(q, out_t=False)
-    return a_ok & r_ok & C.point_is_identity(q)
+    # ZIP-215 equation [8]([s]B - [k]A - R) == identity, restated as
+    # [8]([s]B - [k]A) == [8]R: the subtraction (which needs the
+    # ladder's T and forced an unrolled final window into the graph)
+    # becomes a projective cross-multiplied equality, and the cofactor
+    # doublings of both sides run stacked in one scanned loop.
+    q = C.double_scalar_mul_base(s, k, C.point_neg(a_pt), final_t=False)
+    both = jnp.concatenate([q, r_pt], axis=-1)  # (4, 32, 2B)
+    both = jax.lax.fori_loop(
+        0, 3, lambda _, v: C.point_double(v, out_t=False), both
+    )
+    return a_ok & r_ok & C.point_equal(both[..., :n], both[..., n:])
 
 
 verify_kernel = jax.jit(verify_kernel_impl)
