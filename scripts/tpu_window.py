@@ -63,7 +63,7 @@ MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
 
 # Phases whose measurements scale with SWEEP_MAX; the rest run at
 # fixed batch sizes and a marker from any sweep size stands.
-_MAXB_PHASES = ("slice_big", "pipe", "dot")
+_MAXB_PHASES = ("slice_big", "pipe", "dot", "cache")
 
 
 def banked(phase):
@@ -92,7 +92,7 @@ from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.ops import field as F
 from tendermint_tpu.ops import verify as V
 
-PHASES = ("slice256", "slice_big", "pipe", "cutover", "sr", "dot")
+PHASES = ("slice256", "slice_big", "pipe", "cutover", "cache", "sr", "dot")
 todo = [p for p in PHASES if not banked(p)]
 if not todo:
     log("all phases banked; nothing to do")
@@ -195,8 +195,10 @@ def _phase_slice256():
 
 
 def _phase_slice_big():
+    # Batch set matches bench.py BATCHES so a slice-default flip finds
+    # every shape already in .jax_cache at the driver's bench run.
     with slice_mode() as kern:
-        for B in sorted({b for b in (1024, MAX_B) if b <= MAX_B}):
+        for B in sorted({b for b in (1024, 2048, MAX_B) if b <= MAX_B}):
             t_c, dt = device_only(kern, B)
             log(f"SLICE B={B:5d}  compile+1st {t_c:7.2f}s  steady {dt*1000:9.3f}ms  "
                 f"device-only {B/dt:12,.0f} sigs/s")
@@ -266,10 +268,30 @@ def _phase_dot():
             f"device-only {B/dt:12,.0f} sigs/s")
 
 
+def _phase_cache():
+    # HBM-pubkey-cache path, hit steady state: end-to-end pipelined at
+    # the largest batch (bench.py stage 4 runs exactly this).
+    B = min(2048, MAX_B)
+    sub = (pks[:B], msgs[:B], sigs[:B])
+    t0 = time.time()
+    ok = V.verify_batch_cached(*sub)  # insert + compile
+    t_first = time.time() - t0
+    assert bool(ok.all())
+    iters = 6
+    t0 = time.time()
+    inflight = [V.verify_batch_cached_async(*sub) for _ in range(iters)]
+    outs = [V.collect(d) for d in inflight]
+    dt = (time.time() - t0) / iters
+    assert all(bool(o.all()) for o in outs)
+    log(f"CACHE B={B}  compile+insert+1st {t_first:7.2f}s  pipelined "
+        f"{dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+
+
 run_phase("slice256", 480, _phase_slice256)
 run_phase("slice_big", 360, _phase_slice_big, gate=banked("slice256"))
 run_phase("pipe", 360, _phase_pipe)
 run_phase("cutover", 360, _phase_cutover)
+run_phase("cache", 300, _phase_cache)
 run_phase("sr", 300, _phase_sr)
 run_phase("dot", 600, _phase_dot)
 

@@ -203,7 +203,14 @@ class Ed25519BatchVerifier(BatchVerifier):
         if _use_device() and n >= DEVICE_BATCH_CUTOVER:
             from ..ops import verify as dev
 
-            dispatched = dev.verify_batch_async(self._pks, self._msgs, self._sigs)
+            # HBM pubkey cache (the reference's expanded-key LRU,
+            # ed25519.go:57, lifted to device memory): production
+            # commits reuse the same validator keys height after
+            # height. TM_TPU_PK_CACHE=off forces the uncached kernel.
+            if os.environ.get("TM_TPU_PK_CACHE", "on").strip().lower() in ("off", "0", "false", "no"):
+                dispatched = dev.verify_batch_async(self._pks, self._msgs, self._sigs)
+            else:
+                dispatched = dev.verify_batch_cached_async(self._pks, self._msgs, self._sigs)
 
             def complete():
                 bools = [bool(b) for b in dev.collect(dispatched)]

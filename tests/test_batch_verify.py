@@ -63,6 +63,52 @@ def test_verify_batch_matches_oracle_on_edges():
     assert want == [True, True, False, True, False]
 
 
+def test_cached_kernel_matches_uncached():
+    # Same batch through verify_batch and verify_batch_cached, including
+    # repeated keys, a tampered sig, and the ZIP-215 edge encodings.
+    pks, msgs, sigs = make_jobs(6, tamper_idx=(2,))
+    pks[4], msgs[4] = pks[0], msgs[4]  # repeated key, different msg
+    sigs[4] = ref.sign(ref.gen_privkey(secrets.token_bytes(32)), msgs[4])  # wrong key
+    so = ref.small_order_points()[1]
+    pks.append(so)
+    msgs.append(b"anything")
+    sigs.append(ref.compress(ref.IDENTITY) + b"\x00" * 32)
+    uncached = [bool(b) for b in V.verify_batch(pks, msgs, sigs)]
+    cached1 = [bool(b) for b in V.verify_batch_cached(pks, msgs, sigs)]
+    cached2 = [bool(b) for b in V.verify_batch_cached(pks, msgs, sigs)]  # all hits
+    assert uncached == cached1 == cached2
+    assert not cached1[2] and not cached1[4] and cached1[6]
+
+
+def test_pubkey_cache_eviction_and_overflow():
+    cache = V.PubkeyCache(capacity=4)
+    pks, msgs, sigs = make_jobs(3)
+    slots1 = cache.ensure(pks)
+    assert len(set(slots1.tolist())) == 3
+    # refresh pk0, insert two more -> pk1 (now coldest) evicted
+    cache.ensure([pks[0]])
+    pks2, _, _ = make_jobs(2)
+    cache.ensure(pks2)
+    assert pks[1] not in cache._lru and pks[0] in cache._lru
+    # eviction must never pop a key used by the same batch
+    extra_pks, _, _ = make_jobs(2)
+    slots = cache.ensure([pks[0]] + pks2 + extra_pks[:1])
+    assert slots is not None and len(slots) == 4
+    # more distinct keys than capacity -> fallback signal
+    many, _, _ = make_jobs(5)
+    assert cache.ensure(many) is None
+    # and the public path still verifies correctly via fallback
+    mpks, mmsgs, msigs = make_jobs(5, tamper_idx=(3,))
+    import tendermint_tpu.ops.verify as Vm
+    old = Vm._PK_CACHE
+    Vm._PK_CACHE = V.PubkeyCache(capacity=4)
+    try:
+        got = [bool(b) for b in V.verify_batch_cached(mpks, mmsgs, msigs)]
+    finally:
+        Vm._PK_CACHE = old
+    assert got == [True, True, True, False, True]
+
+
 def test_batch_verifier_interface():
     pks, msgs, sigs = make_jobs(4, tamper_idx={1})
     bv = create_batch_verifier(Ed25519PubKey(pks[0]))
