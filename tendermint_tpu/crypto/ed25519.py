@@ -126,6 +126,15 @@ def _use_device() -> bool:
     return _accelerator_present()
 
 
+def _pk_cache_enabled() -> bool:
+    """TM_TPU_PK_CACHE gate for the HBM pubkey cache, shared by both
+    signature planes (sr25519 imports this) so they always respond to
+    the env var identically. Default: on."""
+    return os.environ.get("TM_TPU_PK_CACHE", "on").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
 # Below this many signatures a device launch costs more than it saves
 # (dispatch + transfer latency vs ~125us/sig native host verify); the
 # batch verifier then runs serially on host. SURVEY "hard parts": a
@@ -207,10 +216,10 @@ class Ed25519BatchVerifier(BatchVerifier):
             # ed25519.go:57, lifted to device memory): production
             # commits reuse the same validator keys height after
             # height. TM_TPU_PK_CACHE=off forces the uncached kernel.
-            if os.environ.get("TM_TPU_PK_CACHE", "on").strip().lower() in ("off", "0", "false", "no"):
-                dispatched = dev.verify_batch_async(self._pks, self._msgs, self._sigs)
-            else:
+            if _pk_cache_enabled():
                 dispatched = dev.verify_batch_cached_async(self._pks, self._msgs, self._sigs)
+            else:
+                dispatched = dev.verify_batch_async(self._pks, self._msgs, self._sigs)
 
             def complete():
                 bools = [bool(b) for b in dev.collect(dispatched)]

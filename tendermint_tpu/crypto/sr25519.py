@@ -361,7 +361,7 @@ class Sr25519BatchVerifier(BatchVerifier):
         """Device path: launch prep + H2D + kernel now, return a
         completion callable so callers overlap the kernel with host
         work (same contract as Ed25519BatchVerifier.verify_async)."""
-        from .ed25519 import DEVICE_BATCH_CUTOVER, _use_device
+        from .ed25519 import DEVICE_BATCH_CUTOVER, _pk_cache_enabled, _use_device
 
         n = len(self._jobs)
         if n == 0:
@@ -372,7 +372,10 @@ class Sr25519BatchVerifier(BatchVerifier):
             pks = [j[0] for j in self._jobs]
             msgs = [j[1] for j in self._jobs]
             sigs = [j[2] for j in self._jobs]
-            dispatched = dev.verify_batch_async(pks, msgs, sigs)
+            if _pk_cache_enabled():
+                dispatched = dev.verify_batch_cached_async(pks, msgs, sigs)
+            else:
+                dispatched = dev.verify_batch_async(pks, msgs, sigs)
 
             def complete():
                 bools = [bool(b) for b in dev.collect(dispatched)]

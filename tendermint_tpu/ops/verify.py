@@ -118,11 +118,12 @@ class PubkeyCache:
     which creates a NEW device array — in-flight async batches keep
     referencing the buffers they were dispatched with."""
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096, build_fn=None):
         import collections
         import threading
 
         self.capacity = capacity
+        self._build = build_fn or build_pk_tables  # sr25519 plugs in its decoder
         self._lock = threading.Lock()  # reactors verify concurrently
         self._lru: "collections.OrderedDict[bytes, int]" = collections.OrderedDict()
         self.tables = jnp.zeros((capacity, 16, 4, 32), jnp.int16)
@@ -165,7 +166,7 @@ class PubkeyCache:
             idx = np.fromiter((next(free_slots) for _ in missing), np.int32)
             enc = np.frombuffer(b"".join(missing), np.uint8).reshape(-1, 32)
             (enc_p,) = pad_pow2_rows([enc], len(missing))
-            new_tables, new_oks = build_pk_tables(jnp.asarray(enc_p))
+            new_tables, new_oks = self._build(jnp.asarray(enc_p))
             m = len(missing)
             self.tables = self.tables.at[idx].set(new_tables[:m])
             self.oks = self.oks.at[idx].set(new_oks[:m])
@@ -314,29 +315,38 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     return collect(verify_batch_async(pubkeys, msgs, sigs))
 
 
-def verify_batch_cached_async(pubkeys, msgs, sigs):
-    """verify_batch_async through the HBM pubkey cache: repeated
-    validator sets (every production VerifyCommit after the first at a
-    given height range) skip A decompression + table build on device.
-    Falls back to the uncached kernel when the batch holds more
-    distinct keys than the cache."""
+def dispatch_cached(cache, prepare, cached_kernel, uncached_async, pubkeys, msgs, sigs):
+    """Shared cache-path orchestration for both signature planes:
+    slot lookup/insert (atomic snapshot), fallback when the batch has
+    more distinct keys than the cache, shape padding, kernel dispatch.
+    Malformed pubkeys are keyed as zeros — they already fail precheck,
+    which masks their lanes at collect; the cache just needs a 32-byte
+    key for them."""
     n = len(sigs)
     if n == 0:
         return None, np.zeros((0,), bool), 0
-    # Malformed pubkeys already fail precheck; key them as zeros so the
-    # cache stays 32-byte-keyed (their lanes are masked at collect).
     keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
-    slots, tables, oks = pubkey_cache().ensure_snapshot(keys)
+    slots, tables, oks = cache.ensure_snapshot(keys)
     if slots is None:
-        return verify_batch_async(pubkeys, msgs, sigs)
-    _, r_enc, s_bytes, k_bytes, precheck = prepare_batch(pubkeys, msgs, sigs)
+        return uncached_async(pubkeys, msgs, sigs)
+    _, r_enc, s_bytes, k_bytes, precheck = prepare(pubkeys, msgs, sigs)
     r_enc, s_bytes, k_bytes = pad_pow2_rows([r_enc, s_bytes, k_bytes], n)
     slots = np.pad(slots, (0, len(r_enc) - n))
-    ok_dev = verify_kernel_cached(
+    ok_dev = cached_kernel(
         tables, oks, jnp.asarray(slots),
         jnp.asarray(r_enc), jnp.asarray(s_bytes), jnp.asarray(k_bytes),
     )
     return ok_dev, precheck, n
+
+
+def verify_batch_cached_async(pubkeys, msgs, sigs):
+    """verify_batch_async through the HBM pubkey cache: repeated
+    validator sets (every production VerifyCommit after the first at a
+    given height range) skip A decompression + table build on device."""
+    return dispatch_cached(
+        pubkey_cache(), prepare_batch, verify_kernel_cached,
+        verify_batch_async, pubkeys, msgs, sigs,
+    )
 
 
 def verify_batch_cached(pubkeys, msgs, sigs) -> np.ndarray:

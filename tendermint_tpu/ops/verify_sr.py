@@ -46,6 +46,46 @@ def verify_sr_kernel_impl(a_enc, r_enc, s_bytes, k_bytes):
 verify_sr_kernel = jax.jit(verify_sr_kernel_impl)
 
 
+def build_sr_tables_impl(a_enc):
+    """Cache-fill kernel for the sr25519 plane: ristretto decode +
+    negate + Straus multiples table, (B, 16, 4, 32) int16 + ok bits
+    (same contract as ops/verify.py build_pk_tables_impl)."""
+    a = a_enc.T.astype(jnp.int32)
+    a_pt, ok = R.decode(a)
+    table = C._build_var_table(C.point_neg(a_pt))
+    return jnp.transpose(table, (3, 0, 1, 2)).astype(jnp.int16), ok
+
+
+build_sr_tables = jax.jit(build_sr_tables_impl)
+
+
+def verify_sr_kernel_cached_impl(tables, oks, slots, r_enc, s_bytes, k_bytes):
+    """Cache-hit kernel: A arrives as slots into the device-resident
+    ristretto table cache; only the result re-encoding remains."""
+    r = r_enc.T.astype(jnp.int32)
+    s = s_bytes.T.astype(jnp.int32)
+    k = k_bytes.T.astype(jnp.int32)
+    a_table = jnp.transpose(tables[slots].astype(jnp.int32), (1, 2, 3, 0))
+    a_ok = oks[slots]
+    q = C.double_scalar_mul_base(s, k, a_table=a_table)  # final_t for encode
+    enc = R.encode(q)
+    return a_ok & jnp.all(enc == r, axis=0)
+
+
+verify_sr_kernel_cached = jax.jit(verify_sr_kernel_cached_impl)
+
+_SR_CACHE = None
+
+
+def sr_pubkey_cache():
+    from .verify import PubkeyCache
+
+    global _SR_CACHE
+    if _SR_CACHE is None:
+        _SR_CACHE = PubkeyCache(build_fn=build_sr_tables)
+    return _SR_CACHE
+
+
 def prepare_batch(pubkeys, msgs, sigs):
     """Host prep: (a_enc, r_enc, s_bytes, k_bytes, precheck) uint8/bool
     arrays of shape (B, 32)/(B,). Malformed inputs fail precheck.
@@ -93,6 +133,22 @@ def verify_batch_async(pubkeys, msgs, sigs):
     a, r, s, k = pad_pow2_rows([a, r, s, k], n)
     ok_dev = verify_sr_kernel(jnp.asarray(a), jnp.asarray(r), jnp.asarray(s), jnp.asarray(k))
     return ok_dev, precheck, n
+
+
+def verify_batch_cached_async(pubkeys, msgs, sigs):
+    """verify_batch_async through the HBM ristretto-table cache (same
+    contract as the ed25519 plane's verify_batch_cached_async)."""
+    from .verify import dispatch_cached
+
+    return dispatch_cached(
+        sr_pubkey_cache(), prepare_batch, verify_sr_kernel_cached,
+        verify_batch_async, pubkeys, msgs, sigs,
+    )
+
+
+def verify_batch_cached(pubkeys, msgs, sigs) -> np.ndarray:
+    """End-to-end cached sr25519 verification -> (n,) bool bitmap."""
+    return collect(verify_batch_cached_async(pubkeys, msgs, sigs))
 
 
 def collect(dispatched) -> np.ndarray:

@@ -324,6 +324,23 @@ def test_sr25519_device_batch_matches_host(monkeypatch):
     assert not ok and bits == [i not in (3, 7, 10) for i in range(12)]
 
 
+def test_sr25519_cached_kernel_matches_uncached():
+    """Cached (HBM ristretto-table) and uncached device planes agree,
+    including repeated keys, garbage sigs, and cache hits on re-run."""
+    from tendermint_tpu.ops import verify_sr as VS
+
+    privs = [sr.Sr25519PrivKey.generate(b"ck-%d" % i) for i in range(5)]
+    privs.append(privs[0])  # repeated key
+    msgs = [b"cache-%d" % i for i in range(6)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    bad = bytearray(sigs[2]); bad[5] ^= 1; sigs[2] = bytes(bad)
+    pks = [p.pub_key().bytes() for p in privs]
+    uncached = [bool(b) for b in VS.verify_batch(pks, msgs, sigs)]
+    cached1 = [bool(b) for b in VS.verify_batch_cached(pks, msgs, sigs)]
+    cached2 = [bool(b) for b in VS.verify_batch_cached(pks, msgs, sigs)]
+    assert uncached == cached1 == cached2 == [True, True, False, True, True, True]
+
+
 def test_batch_merlin_challenges_bit_identical():
     """The vectorized batch transcript produces byte-identical
     challenges to the scalar merlin path, across mixed message lengths
