@@ -31,6 +31,49 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_completion(args) -> int:
+    """ref: commands/completion.go — emit a shell completion script.
+
+    Bash/zsh word completion over the full subcommand table (argparse
+    holds it at runtime, so the script never goes stale). There is no
+    installed console script, so --prog names the alias/wrapper the
+    user invokes (e.g. `alias tt='python -m tendermint_tpu.cli'` then
+    `completion --prog tt`); bash applies function completion to
+    aliases by name."""
+    prog = args.prog
+    parser = build_parser()
+    subs = sorted(
+        c for a in parser._actions if isinstance(a, argparse._SubParsersAction)
+        for c in a.choices
+    )
+    words = " ".join(subs)
+    if args.shell == "zsh":
+        print(f"#compdef {prog}\n"
+              f'_arguments "1: :({words})" "*: :_files"')
+    else:
+        fn = "_" + prog.replace("-", "_") + "_complete"
+        print(fn + "() {\n"
+              "  local cur=${COMP_WORDS[COMP_CWORD]}\n"
+              "  local i=1 w\n"
+              "  # skip global flags (--home VALUE, --flag=value) before the subcommand\n"
+              "  while [ $i -lt $COMP_CWORD ]; do\n"
+              "    w=${COMP_WORDS[$i]}\n"
+              "    case \"$w\" in\n"
+              "      --home) i=$((i+2));;\n"
+              "      -*) i=$((i+1));;\n"
+              "      *) break;;\n"
+              "    esac\n"
+              "  done\n"
+              "  if [ $i -eq $COMP_CWORD ]; then\n"
+              f'    COMPREPLY=( $(compgen -W "{words}" -- "$cur") )\n'
+              "  else\n"
+              "    COMPREPLY=( $(compgen -f -- \"$cur\") )\n"
+              "  fi\n"
+              "}\n"
+              f"complete -F {fn} {prog}")
+    return 0
+
+
 def cmd_init(args) -> int:
     """ref: commands/init.go — init validator|full|seed."""
     from .node import init_files_home
@@ -705,6 +748,12 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command", required=True)
 
     sub.add_parser("version", help="show version").set_defaults(fn=cmd_version)
+
+    sp = sub.add_parser("completion", help="emit a shell completion script (ref: commands/completion.go)")
+    sp.add_argument("shell", nargs="?", default="bash", choices=["bash", "zsh"])
+    sp.add_argument("--prog", default="tendermint-tpu",
+                    help="command name to complete (your alias/wrapper for the CLI)")
+    sp.set_defaults(fn=cmd_completion)
 
     sp = sub.add_parser("init", help="initialize a node home directory")
     sp.add_argument("mode", nargs="?", default="validator", choices=["validator", "full", "seed"])

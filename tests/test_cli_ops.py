@@ -320,3 +320,16 @@ def test_reset_family(tmp_path):
 
     assert cli_main(["--home", home, "reset", "unsafe-all"]) == 0
     assert set(os.listdir(data)) == {"priv_validator_state.json"}
+
+
+def test_completion_scripts(capsys):
+    # ref: commands/completion.go
+    assert cli_main(["completion"]) == 0
+    bash = capsys.readouterr().out
+    assert "complete -F _tendermint_tpu_complete tendermint-tpu" in bash
+    assert "start" in bash and "testnet" in bash
+    assert cli_main(["completion", "--prog", "tt"]) == 0
+    assert "complete -F _tt_complete tt" in capsys.readouterr().out
+    assert cli_main(["completion", "zsh"]) == 0
+    zsh = capsys.readouterr().out
+    assert zsh.startswith("#compdef tendermint-tpu")
