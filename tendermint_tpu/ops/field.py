@@ -129,11 +129,14 @@ for _i in range(LIMBS):
             _FOLD[_i * LIMBS + _j, _k - LIMBS] = 38
 del _i, _j, _k
 
-# Default is the dot formulation: at batch 256 it lowers to a 23.6k-line
-# StableHLO graph vs the slice form's 104k lines and compiles ~17x
-# faster (8.6s vs 146s XLA-CPU) — decisive after r2's TPU compile hang.
-# TM_TPU_FE_MUL=slice selects the elementwise VPU formulation for A/B.
-_FE_MUL_MODE = os.environ.get("TM_TPU_FE_MUL", "dot")
+# Default is the slice formulation, decided by the on-chip A/B
+# (2026-07-31, TPU v5 lite): slice 53.6k sigs/s @256 / 73.6k @1024
+# device-only vs dot's measured ~34k ceiling — the dot form's int32
+# contraction cannot use the MXU (a bf16/int8 engine) and lowers to
+# ~32x more VPU work. Slice also compiles safely on TPU since the r4
+# graph work (41k StableHLO lines @256, 74s compile). TM_TPU_FE_MUL=dot
+# keeps the compact-graph fallback selectable.
+_FE_MUL_MODE = os.environ.get("TM_TPU_FE_MUL", "slice")
 
 
 def _fe_mul_dot(x, y):
