@@ -22,7 +22,13 @@ from typing import Callable
 
 from ..state.execution import BlockExecutor
 from ..state.state import State
-from ..types.block import BLOCK_PART_SIZE_BYTES, BlockID, Commit, PartSetHeader
+from ..types.block import (
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_PART_SIZE_BYTES,
+    BlockID,
+    Commit,
+    PartSetHeader,
+)
 from ..types.part_set import PartSet
 from ..types.proposal import Proposal
 from ..types.vote import PRECOMMIT, PREVOTE, Vote
@@ -92,9 +98,13 @@ class ConsensusState:
         wait_for_txs: bool = False,
         create_empty_blocks_interval: float = 0.0,
         mempool=None,
+        double_sign_check_height: int = 0,
     ):
         from ..utils.log import new_logger
 
+        # ref: config.ConsensusConfig.DoubleSignCheckHeight — refuse to
+        # start if our own signature appears in the last N commits.
+        self.double_sign_check_height = double_sign_check_height
         # create_empty_blocks=false plumbing (ref: config.WaitForTxs)
         self.wait_for_txs = wait_for_txs
         self.create_empty_blocks_interval = create_empty_blocks_interval
@@ -141,12 +151,40 @@ class ConsensusState:
     def start(self, replay: bool = True) -> None:
         """Replay the WAL from the last height boundary, then launch the
         consumer thread (ref: OnStart state.go:393 → catchupReplay)."""
+        self._check_double_signing_risk()
         if replay:
             self._catchup_replay()
         self._stop.clear()
         self._thread = threading.Thread(target=self._receive_routine, daemon=True, name="consensus")
         self._thread.start()
         self._schedule_round_0()
+
+    def _check_double_signing_risk(self) -> None:
+        """Refuse to start signing if our own signature is present in a
+        recent commit: a validator restoring onto a chain it recently
+        signed (lost state, duplicated deployment) would equivocate.
+        ref: state.go checkDoubleSigningRisk (internal/consensus/
+        state.go:2663) — scans the double_sign_check_height most recent
+        commits for our address and errors out, halting node start."""
+        n = self.double_sign_check_height
+        height = self.state.last_block_height
+        if n <= 0 or height <= 0 or self.priv_pub_key is None:
+            return
+        addr = self.priv_pub_key.address()
+        for i in range(min(n, height)):
+            h = height - i
+            commit = self.block_store.load_seen_commit(h) if i == 0 else None
+            if commit is None:
+                commit = self.block_store.load_block_commit(h)
+            if commit is None:
+                continue
+            for sig in commit.signatures:
+                if sig.block_id_flag == BLOCK_ID_FLAG_COMMIT and sig.validator_address == addr:
+                    raise RuntimeError(
+                        f"consensus: own signature found in commit at height {h} "
+                        f"(within double_sign_check_height={n}); this key appears "
+                        "to be validating elsewhere — refusing to start"
+                    )
 
     def stop(self) -> None:
         self._stop.set()

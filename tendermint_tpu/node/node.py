@@ -351,6 +351,7 @@ class Node:
             wait_for_txs=not config.consensus.create_empty_blocks,
             create_empty_blocks_interval=config.consensus.create_empty_blocks_interval,
             mempool=self.mempool,
+            double_sign_check_height=config.consensus.double_sign_check_height,
         )
         if not config.consensus.create_empty_blocks:
             self.mempool.enable_txs_available()

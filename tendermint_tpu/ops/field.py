@@ -142,8 +142,11 @@ _FE_MUL_MODE = os.environ.get("TM_TPU_FE_MUL", "slice")
 def _fe_mul_dot(x, y):
     """z_k = sum_{ij} FOLD[ij,k] * x_i * y_j: an outer product reshaped
     to (1024, batch) contracted with the constant (1024, 32) fold matrix
-    — a single int32 dot per field mul, landing on the MXU's integer
-    path instead of the VPU. Same bounds as the slice form."""
+    — a single int32 dot per field mul. NB the MXU is a bf16/int8
+    engine, so this int32 contraction still executes on the VPU with
+    ~32x the slice form's MAC count (measured ~34k vs 53-74k sigs/s on
+    chip); its value is the compact graph (23.6k vs 41k StableHLO
+    lines), which compiles ~2x faster. Same bounds as the slice form."""
     rank = max(x.ndim, y.ndim) - 1
     x = _with_batch_rank(x, rank)
     y = _with_batch_rank(y, rank)

@@ -14,6 +14,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # Exercise the JAX batch-verify kernel in tests even though the backend is
 # the virtual CPU mesh (TM_TPU_CRYPTO auto would pick the host path there).
 os.environ.setdefault("TM_TPU_CRYPTO", "on")
+# The production default fe_mul is the slice form (the on-chip winner),
+# but XLA-CPU executes its Toeplitz slices pathologically (~8 sigs/s);
+# the dot form is the fast-enough-on-CPU candidate, and both forms are
+# bit-identical (tests/test_field.py::test_mul_modes_agree_with_oracle
+# pins slice parity explicitly). Semantics tests use dot.
+os.environ.setdefault("TM_TPU_FE_MUL", "dot")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -431,3 +431,40 @@ def test_boot_without_extended_commit_is_nonfatal_switch_is_strict():
     cs2.rs.last_commit = None
     cs2.switch_to_state(state)
     assert cs2.rs.last_commit is not None and cs2.rs.last_commit.extensions_enabled
+
+
+def test_double_sign_check_height_blocks_restart():
+    """A validator whose own signature appears in a recent commit must
+    refuse to start when double-sign-check-height is set (ref:
+    state.go:2663 checkDoubleSigningRisk) — and start fine when 0."""
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    node = make_node(keys, 0, gen_doc)
+    node.start()
+    try:
+        assert wait_for_height([node], 2, timeout=30)
+    finally:
+        node.stop()
+
+    def rebuild(check_height):
+        cs = ConsensusState(
+            node.state,
+            node.block_exec,
+            node.block_store,
+            priv_validator=node.priv_validator,
+            double_sign_check_height=check_height,
+        )
+        return cs
+
+    with pytest.raises(RuntimeError, match="refusing to start"):
+        rebuild(10).start(replay=False)
+    # A different key is not at risk; nor is check disabled.
+    other = make_node(make_keys(2), 1, gen_doc)
+    cs = ConsensusState(
+        node.state, node.block_exec, node.block_store,
+        priv_validator=other.priv_validator, double_sign_check_height=10,
+    )
+    cs._check_double_signing_risk()  # no raise
+    ok = rebuild(0)
+    ok._check_double_signing_risk()  # disabled: no raise

@@ -147,3 +147,19 @@ def test_batch_shapes():
             bv = sum(int(b[k, i, j]) << (8 * k) for k in range(32))
             got = F.limbs_to_int(canon[:, i, j]) % P
             assert got == (av * bv) % P
+
+
+def test_mul_modes_agree_with_oracle(monkeypatch):
+    """Both fe_mul formulations (slice: on-chip production default; dot:
+    compact-graph fallback and the CPU test-mesh default) must match the
+    Python-int oracle bit for bit. Un-jitted calls so the monkeypatched
+    mode is honored at trace time."""
+    cases = [(rand_fe(), rand_fe()) for _ in range(4)]
+    cases += [(P - 1, P - 1), (0, rand_fe()), (1, P - 1)]
+    for mode in ("slice", "dot"):
+        monkeypatch.setattr(F, "_FE_MUL_MODE", mode)
+        for a, b in cases:
+            got = from_limbs(F.fe_canonical(F.fe_mul(to_limbs(a), to_limbs(b))))
+            assert got == (a * b) % P, (mode, a, b)
+            sq = from_limbs(F.fe_canonical(F.fe_square(to_limbs(a))))
+            assert sq == (a * a) % P, (mode, a)
