@@ -40,6 +40,42 @@ class stage_deadline:
         return False
 
 
+def probe_device(timeout: float = 150.0) -> str | None:
+    """Probe the ambient JAX platform in a KILLABLE subprocess.
+
+    The axon tunnel's failure mode is a C-level hang inside backend init
+    that SIGALRM cannot interrupt; probing in a child means the parent
+    can give up on a deadline and fall back to the CPU backend instead
+    of hanging the whole bench. Killing a hung mid-claim child may wedge
+    the device grant for a while — acceptable, because the only path
+    that kills the child is the one where the parent has already decided
+    not to claim the device at all. A child that claims successfully
+    exits cleanly and releases the grant for the parent's own claim.
+
+    Returns the platform string ("tpu", "cpu", ...) or None on
+    timeout/failure."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "print('PLATFORM=' + jax.devices()[0].platform, flush=True)\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
 def enable_compile_cache(jax) -> None:
     """Persistent XLA compile cache: repeat runs skip the heavy
     curve-kernel compile entirely (same setup as __graft_entry__.py)."""
