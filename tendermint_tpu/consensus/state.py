@@ -624,6 +624,8 @@ class ConsensusState:
             if not self.replay_mode:
                 traceback.print_exc()
             return
+        if self.metrics is not None:
+            self.metrics.proposal_create_count.add(1)
         self._send_internal(ProposalMessage(proposal))
         self.broadcast(ProposalMessage(proposal))
         for i in range(block_parts.total()):
@@ -865,6 +867,32 @@ class ConsensusState:
             m.block_size.set(len(block.to_proto().encode()))
             if block.last_commit is not None:
                 m.commit_sigs.set(sum(1 for s in block.last_commit.signatures if s.for_block()))
+                # Participation gauges over the set that signed LastCommit
+                # (ref: metrics.go MissingValidators{,Power}).
+                missing = missing_power = 0
+                last_vals = rs.last_validators
+                if last_vals is not None and last_vals.size() == len(block.last_commit.signatures):
+                    for idx, s in enumerate(block.last_commit.signatures):
+                        if not s.for_block():
+                            missing += 1
+                            missing_power += last_vals.validators[idx].voting_power
+                m.missing_validators.set(missing)
+                m.missing_validators_power.set(missing_power)
+            power_by_addr = (
+                {v.address: v.voting_power for v in rs.last_validators.validators}
+                if rs.last_validators is not None
+                else {}
+            )
+            byz: set = set()
+            for ev in block.evidence:
+                if hasattr(ev, "vote_a"):  # DuplicateVoteEvidence
+                    byz.add(ev.vote_a.validator_address)
+                else:  # LightClientAttackEvidence
+                    for v in ev.byzantine_validators:
+                        power_by_addr.setdefault(v.address, v.voting_power)
+                        byz.add(v.address)
+            m.byzantine_validators.set(len(byz))
+            m.byzantine_validators_power.set(sum(power_by_addr.get(a, 0) for a in byz))
             m.mark_round()
         self.logger.info(
             "finalized block", height=height, hash=block_id.hash, txs=len(block.txs), round=rs.commit_round
@@ -905,6 +933,8 @@ class ConsensusState:
             return False
         added = rs.proposal_block_parts.add_part(msg.part)
         if not added:
+            if self.metrics is not None:
+                self.metrics.duplicate_block_part.add(1)
             return False
         if rs.proposal_block_parts.byte_size > self.state.consensus_params.block.max_bytes:
             raise ConsensusError(
@@ -979,6 +1009,8 @@ class ConsensusState:
             return True
 
         if vote.height != rs.height:
+            if self.metrics is not None and vote.height < rs.height:
+                self.metrics.late_votes.add(1, "prevote" if vote.type == PREVOTE else "precommit")
             return False
 
         # Vote extensions
@@ -988,8 +1020,18 @@ class ConsensusState:
                 _, val = self.state.validators.get_by_index(vote.validator_index)
                 if val is None:
                     return False  # unknown validator index — reject, don't crash
-                vote.verify_with_extension(self.state.chain_id, val.pub_key)
-                if not self.block_exec.verify_vote_extension(vote):
+                try:
+                    vote.verify_with_extension(self.state.chain_id, val.pub_key)
+                    ext_ok = self.block_exec.verify_vote_extension(vote)
+                except Exception:
+                    if self.metrics is not None:
+                        self.metrics.vote_extension_receive_count.add(1, "rejected")
+                    raise
+                if self.metrics is not None:
+                    self.metrics.vote_extension_receive_count.add(
+                        1, "accepted" if ext_ok else "rejected"
+                    )
+                if not ext_ok:
                     return False
         else:
             vote.extension = b""
@@ -998,6 +1040,10 @@ class ConsensusState:
         height = rs.height
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
+            # add_vote's False (vs raise) is specifically the
+            # exact-duplicate case (ref: metrics.go DuplicateVote).
+            if self.metrics is not None:
+                self.metrics.duplicate_vote.add(1)
             return False
         self.broadcast(HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index))
 

@@ -178,6 +178,35 @@ class ConsensusMetrics:
         self.proposal_receive_count = reg.counter(
             f"{ns}_proposal_receive_count", "Proposals received", labels=("status",)
         )
+        self.proposal_create_count = reg.counter(
+            f"{ns}_proposal_create_count", "Proposals created by this node"
+        )
+        # Per-commit validator participation (ref: metrics.go
+        # MissingValidators/ByzantineValidators and their power gauges).
+        self.missing_validators = reg.gauge(
+            f"{ns}_missing_validators", "Validators absent from the last commit"
+        )
+        self.missing_validators_power = reg.gauge(
+            f"{ns}_missing_validators_power", "Voting power absent from the last commit"
+        )
+        self.byzantine_validators = reg.gauge(
+            f"{ns}_byzantine_validators", "Validators with committed evidence this block"
+        )
+        self.byzantine_validators_power = reg.gauge(
+            f"{ns}_byzantine_validators_power", "Voting power with committed evidence"
+        )
+        self.late_votes = reg.counter(
+            f"{ns}_late_votes", "Votes for earlier rounds/heights", labels=("vote_type",)
+        )
+        self.duplicate_vote = reg.counter(f"{ns}_duplicate_vote", "Exact-duplicate votes")
+        self.duplicate_block_part = reg.counter(
+            f"{ns}_duplicate_block_part", "Block parts already held"
+        )
+        self.vote_extension_receive_count = reg.counter(
+            f"{ns}_vote_extension_receive_count",
+            "Precommit vote extensions received",
+            labels=("status",),
+        )
         self._step_start = time.monotonic()
         self._round_start = time.monotonic()
         self._last_step: str | None = None
