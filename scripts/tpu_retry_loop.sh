@@ -1,11 +1,24 @@
 #!/bin/bash
-# Gentle TPU claim loop: attempts scripts/tpu_window.py with NO external
-# timeout (a killed mid-claim process wedges the device grant; a failed
-# claim errors naturally after ~25-27 min). Stop it by touching
-# /tmp/tpu_stop — checked between attempts only, so an in-flight claim
-# always completes or fails on its own.
+# TPU claim loop with a stall watchdog.
+#
+# Each attempt runs scripts/tpu_window.py, whose phases carry SIGALRM
+# deadlines (<= 600s each). A dead tunnel can wedge the process in an
+# uninterruptible socket read where the alarm never lands (observed
+# 2026-07-31: main thread parked in wait_woken for 40+ min); the
+# watchdog reaps the attempt when the window log shows NO progress for
+# STALL_S seconds — strictly longer than any phase deadline, so a live
+# phase (even one mid-compile) always logs before the cutoff. Banked
+# phase markers survive the kill; the next attempt picks up where this
+# one stopped. A mid-claim attempt (pre-first-log) gets the same
+# treatment: the claim either resolves within ~26 min on its own or is
+# hung on a dead socket — the watchdog only fires after the natural
+# claim-failure horizon. Stop the loop by touching /tmp/tpu_stop
+# (checked between attempts).
 LOG=${TPU_WINDOW_LOG:-/tmp/tpu_window_log.txt}
 ATTEMPTS=${TPU_ATTEMPTS:-24}
+STALL_S=${TPU_STALL_S:-720}
+# Claims fail naturally after ~25-27 min; give the pre-log phase more rope.
+CLAIM_STALL_S=${TPU_CLAIM_STALL_S:-2100}
 cd "$(dirname "$0")/.."
 for i in $(seq 1 "$ATTEMPTS"); do
     if [ -e /tmp/tpu_stop ]; then
@@ -13,11 +26,36 @@ for i in $(seq 1 "$ATTEMPTS"); do
         exit 0
     fi
     echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
-    if python scripts/tpu_window.py >> "$LOG" 2>&1; then
+    claims_before=$(grep -c "claimed:" "$LOG" 2>/dev/null || echo 0)
+    python scripts/tpu_window.py >> "$LOG" 2>&1 &
+    PY=$!
+    while kill -0 "$PY" 2>/dev/null; do
+        sleep 30
+        now=$(date +%s)
+        age=$(( now - $(stat -c %Y "$LOG" 2>/dev/null || echo "$now") ))
+        # Mid-claim (no "claimed:" line yet for this attempt): killing
+        # here is what wedges the server-side grant — give the claim its
+        # natural ~26 min failure horizon. Post-claim, any phase logs
+        # well within STALL_S or its SIGALRM could not land.
+        claims_now=$(grep -c "claimed:" "$LOG" 2>/dev/null || echo 0)
+        limit=$STALL_S
+        if [ "$claims_now" -le "$claims_before" ]; then
+            limit=$CLAIM_STALL_S
+        fi
+        if [ "$age" -ge "$limit" ]; then
+            echo "=== watchdog: no progress for ${age}s; reaping $PY ===" >> "$LOG"
+            kill -TERM "$PY" 2>/dev/null
+            sleep 10
+            kill -KILL "$PY" 2>/dev/null
+        fi
+    done
+    wait "$PY"
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
         echo "=== SUCCESS attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
         exit 0
     fi
-    echo "=== attempt $i failed $(date -u +%H:%M:%S) ===" >> "$LOG"
+    echo "=== attempt $i failed rc=$rc $(date -u +%H:%M:%S) ===" >> "$LOG"
     sleep 60
 done
 echo "=== attempts exhausted ===" >> "$LOG"
