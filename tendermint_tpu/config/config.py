@@ -91,6 +91,10 @@ class MempoolConfig:
     max_tx_bytes: int = 1 << 20
     recheck: bool = True
     broadcast: bool = True
+    # ref: MempoolConfig.TTLDuration / TTLNumBlocks (config.go:762-770):
+    # 0 disables; otherwise txs expire after this many seconds / blocks.
+    ttl_duration: float = 0.0
+    ttl_num_blocks: int = 0
 
 
 @dataclass
@@ -202,6 +206,9 @@ class Config:
             )
         if self.mempool.size <= 0:
             raise ValueError("mempool.size must be positive")
+        if self.mempool.ttl_duration < 0 or self.mempool.ttl_num_blocks < 0:
+            # ref: MempoolConfig.ValidateBasic (config.go:792-800)
+            raise ValueError("mempool ttl-duration and ttl-num-blocks can't be negative")
 
     # --------------------------------------------------------------- TOML
 

@@ -84,6 +84,8 @@ class TxMempool:
         keep_invalid_txs_in_cache: bool = False,
         post_check=None,
         metrics=None,
+        ttl_duration: float = 0.0,
+        ttl_num_blocks: int = 0,
     ):
         self._app = app_client
         self._metrics = metrics  # MempoolMetrics (ref: mempool/metrics.go)
@@ -93,6 +95,11 @@ class TxMempool:
         self._cache = LRUTxCache(cache_size)
         self._keep_invalid = keep_invalid_txs_in_cache
         self._post_check = post_check
+        # ref: config.MempoolConfig TTLDuration/TTLNumBlocks — a tx is
+        # purged at Update once it has sat in the pool for more than
+        # ttl_num_blocks heights OR longer than ttl_duration seconds.
+        self._ttl_duration = ttl_duration
+        self._ttl_num_blocks = ttl_num_blocks
 
         self._mtx = threading.RLock()
         self._txs: dict[bytes, WrappedTx] = {}  # key -> wtx, insertion-ordered
@@ -198,6 +205,7 @@ class TxMempool:
                     priority=res.priority,
                     gas_wanted=res.gas_wanted,
                     sender=sender or res.sender,
+                    timestamp=time.monotonic(),
                 )
                 if sender:
                     wtx.peers.add(sender)
@@ -294,6 +302,7 @@ class TxMempool:
                 self._cache.remove(key)
             if key in self._txs:
                 self._remove(key)
+        self._purge_expired_txs(height)
         if recheck and self._txs:
             t0 = time.monotonic()
             self._recheck_txs()
@@ -303,6 +312,26 @@ class TxMempool:
         if self._metrics is not None:
             self._metrics.size.set(self.size())
         self._notify_txs_available()
+
+    def _purge_expired_txs(self, block_height: int) -> None:
+        """ref: purgeExpiredTxs (mempool.go:735) — TTL eviction by age in
+        blocks and/or wall time; expired txs also leave the cache so they
+        can be resubmitted later."""
+        if self._ttl_num_blocks == 0 and self._ttl_duration == 0:
+            return
+        now = time.monotonic()
+        for wtx in list(self._txs.values()):
+            expired = (
+                self._ttl_num_blocks > 0
+                and (block_height - wtx.height) > self._ttl_num_blocks
+            ) or (
+                self._ttl_duration > 0 and (now - wtx.timestamp) > self._ttl_duration
+            )
+            if expired:
+                self._remove(wtx.key)
+                self._cache.remove(wtx.key)
+                if self._metrics is not None:
+                    self._metrics.evicted_txs.add(1)
 
     def _recheck_txs(self) -> None:
         """ref: updateReCheckTxs mempool.go:675 — re-run CheckTx(Recheck)

@@ -320,6 +320,8 @@ class Node:
             cache_size=config.mempool.cache_size,
             max_tx_bytes=config.mempool.max_tx_bytes,
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+            ttl_duration=config.mempool.ttl_duration,
+            ttl_num_blocks=config.mempool.ttl_num_blocks,
             metrics=self.mempool_metrics,
         )
         self.evidence_pool = EvidencePool(

@@ -132,3 +132,37 @@ def test_lru_cache_eviction():
     assert not c.push(b"a")  # refreshes 'a'
     assert c.push(b"c")  # evicts 'b' (least recent)
     assert c.has(b"a") and c.has(b"c") and not c.has(b"b")
+
+
+def test_ttl_num_blocks_purges_old_txs():
+    """ref: purgeExpiredTxs (mempool.go:735) — txs older than
+    ttl-num-blocks heights are evicted at Update and leave the cache so
+    they can be resubmitted."""
+    pool = make_pool(ttl_num_blocks=2)
+    pool.check_tx(b"1:old")
+    # advance 3 heights with unrelated commits
+    for h in (1, 2, 3):
+        pool.update(h, [], [], recheck=False)
+    assert pool.size() == 0
+    # purged from cache too: resubmission is accepted, not TxInCacheError
+    pool.check_tx(b"1:old")
+    assert pool.size() == 1
+
+
+def test_ttl_duration_purges_old_txs(monkeypatch):
+    import tendermint_tpu.mempool.mempool as mp
+
+    pool = make_pool(ttl_duration=10.0)
+    pool.check_tx(b"1:aged")
+    now = mp.time.monotonic()
+    monkeypatch.setattr(mp.time, "monotonic", lambda: now + 11.0)
+    pool.update(1, [], [], recheck=False)
+    assert pool.size() == 0
+
+
+def test_ttl_zero_keeps_txs():
+    pool = make_pool()
+    pool.check_tx(b"1:keep")
+    for h in range(1, 6):
+        pool.update(h, [], [], recheck=False)
+    assert pool.size() == 1
