@@ -58,6 +58,11 @@ class RPCConfig:
     max_open_connections: int = 900
     timeout_broadcast_tx_commit: float = 10.0
     enable: bool = True
+    # ref: RPCConfig (config.go:421-470) DoS guards + CORS
+    max_body_bytes: int = 1_000_000
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    cors_allowed_origins: str = ""  # comma-separated; "*" allows all
 
 
 @dataclass

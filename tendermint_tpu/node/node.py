@@ -422,6 +422,12 @@ class Node:
                 host=rpc_addr.hostname or "127.0.0.1",
                 port=rpc_addr.port or 0,
                 event_bus=self.event_bus,
+                max_body_bytes=config.rpc.max_body_bytes,
+                max_subscription_clients=config.rpc.max_subscription_clients,
+                max_subscriptions_per_client=config.rpc.max_subscriptions_per_client,
+                cors_allowed_origins=tuple(
+                    o.strip() for o in config.rpc.cors_allowed_origins.split(",") if o.strip()
+                ),
             )
 
         self._started = threading.Event()

@@ -63,6 +63,8 @@ class _WebSocketConnection:
     SEND_QUEUE_SIZE = 512
     _SENTINEL = object()
 
+    active_subs: int = 0  # maintained by JSONRPCServer under its lock
+
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self._send_lock = threading.Lock()
@@ -179,9 +181,26 @@ class _WebSocketConnection:
 class JSONRPCServer:
     """ref: rpc/jsonrpc/server/http_server.go."""
 
-    def __init__(self, routes: dict, host: str = "127.0.0.1", port: int = 0, event_bus=None):
+    def __init__(
+        self,
+        routes: dict,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        event_bus=None,
+        max_body_bytes: int = 1_000_000,
+        max_subscription_clients: int = 100,
+        max_subscriptions_per_client: int = 5,
+        cors_allowed_origins: tuple = (),
+    ):
         self.routes = routes
         self.event_bus = event_bus
+        # DoS guards (ref: rpc/jsonrpc/server/http_server.go DefaultConfig
+        # MaxBodyBytes; config.go RPCConfig MaxSubscription*).
+        self.max_body_bytes = max_body_bytes
+        self.max_subscription_clients = max_subscription_clients
+        self.max_subscriptions_per_client = max_subscriptions_per_client
+        self.cors_allowed_origins = tuple(cors_allowed_origins)
+        self._subscriber_clients: set[str] = set()
         self._ws_conns: set[_WebSocketConnection] = set()
         self._ws_lock = threading.Lock()
         server = self
@@ -192,8 +211,41 @@ class JSONRPCServer:
             def log_message(self, fmt, *args):  # silence default stderr spam
                 pass
 
+            def _cors_origin(self):
+                origin = self.headers.get("Origin")
+                if not origin:
+                    return None
+                allowed = server.cors_allowed_origins
+                if "*" in allowed or origin in allowed:
+                    return origin
+                return None
+
+            def do_OPTIONS(self):  # noqa: N802 - CORS preflight
+                self.send_response(204)
+                origin = self._cors_origin()
+                if origin:
+                    self.send_header("Access-Control-Allow-Origin", origin)
+                    self.send_header("Access-Control-Allow-Methods", "GET, POST")
+                    self.send_header("Access-Control-Allow-Headers", "Content-Type")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
+                if length > server.max_body_bytes:
+                    # ref: MaxBytesHandler — oversized bodies refused
+                    # before reading (http_server.go:62)
+                    self._send_json(
+                        _rpc_response(
+                            None,
+                            error=RPCError(
+                                ERR_INVALID_REQUEST,
+                                f"request body too large ({length} > {server.max_body_bytes})",
+                            ),
+                        ),
+                        status=413,
+                    )
+                    return
                 body = self.rfile.read(length) if length else b""
                 try:
                     req = json.loads(body)
@@ -222,10 +274,13 @@ class JSONRPCServer:
                 req = {"jsonrpc": "2.0", "id": -1, "method": method, "params": params}
                 self._send_json(server._dispatch(req))
 
-            def _send_json(self, obj):
+            def _send_json(self, obj, status: int = 200):
                 data = json.dumps(obj).encode()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                origin = self._cors_origin()
+                if origin:
+                    self.send_header("Access-Control-Allow-Origin", origin)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -335,10 +390,17 @@ class JSONRPCServer:
                 elif method == "unsubscribe":
                     if self.event_bus is not None:
                         self.event_bus.unsubscribe(subscriber, params.get("query", ""))
+                    with self._ws_lock:
+                        conn.active_subs = max(0, conn.active_subs - 1)
+                        if conn.active_subs == 0:
+                            self._subscriber_clients.discard(subscriber)
                     conn.send_json(_rpc_response(id_, result={}))
                 elif method == "unsubscribe_all":
                     if self.event_bus is not None:
                         self.event_bus.unsubscribe_all(subscriber)
+                    with self._ws_lock:
+                        conn.active_subs = 0
+                        self._subscriber_clients.discard(subscriber)
                     conn.send_json(_rpc_response(id_, result={}))
                 else:
                     conn.send_json(self._dispatch(req))
@@ -347,15 +409,50 @@ class JSONRPCServer:
                 self.event_bus.unsubscribe_all(subscriber)
             with self._ws_lock:
                 self._ws_conns.discard(conn)
+                self._subscriber_clients.discard(subscriber)
             conn.close()
 
     def _start_subscription(self, conn, subscriber: str, id_, query: str):
         if self.event_bus is None:
             conn.send_json(_rpc_response(id_, error=RPCError(ERR_INTERNAL, "event bus not configured")))
             return None
+        # Subscription caps (ref: config.go RPCConfig.MaxSubscriptionClients
+        # / MaxSubscriptionsPerClient; enforced in the ws handler)
+        with self._ws_lock:
+            if (
+                subscriber not in self._subscriber_clients
+                and len(self._subscriber_clients) >= self.max_subscription_clients
+            ):
+                conn.send_json(
+                    _rpc_response(
+                        id_,
+                        error=RPCError(
+                            ERR_INTERNAL,
+                            f"max_subscription_clients {self.max_subscription_clients} reached",
+                        ),
+                    )
+                )
+                return None
+            if conn.active_subs >= self.max_subscriptions_per_client:
+                conn.send_json(
+                    _rpc_response(
+                        id_,
+                        error=RPCError(
+                            ERR_INTERNAL,
+                            f"max_subscriptions_per_client {self.max_subscriptions_per_client} reached",
+                        ),
+                    )
+                )
+                return None
+            self._subscriber_clients.add(subscriber)
+            conn.active_subs += 1
         try:
             sub = self.event_bus.subscribe(subscriber, query, buffer_size=256)
         except Exception as e:
+            with self._ws_lock:
+                conn.active_subs -= 1
+                if conn.active_subs <= 0:
+                    self._subscriber_clients.discard(subscriber)
             conn.send_json(_rpc_response(id_, error=RPCError(ERR_INTERNAL, str(e))))
             return None
         conn.send_json(_rpc_response(id_, result={}))

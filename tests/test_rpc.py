@@ -15,6 +15,7 @@ from tendermint_tpu.rpc import JSONRPCServer, RPCEnvironment, build_routes
 from tendermint_tpu.rpc.client import HTTPClient, RPCClientError, WSClient
 from tendermint_tpu.store.kv import MemDB
 
+live_node_server = [None]  # populated by the live_node fixture
 CHAIN = "rpc-test-chain"
 
 
@@ -50,6 +51,7 @@ def live_node():
         pub_key=keys[0].pub_key(),
     )
     server = JSONRPCServer(build_routes(env), event_bus=bus)
+    live_node_server[0] = server
     server.start()
     node.start()
     assert wait_for_height([node], 2, timeout=60)
@@ -302,3 +304,53 @@ def test_rpc_route_docs_in_sync():
         assert f.read() == gen_rpc_docs.generate(), (
             "docs/rpc-routes.md is stale: run python scripts/gen_rpc_docs.py --write"
         )
+
+
+def test_rpc_dos_guards_and_cors(live_node):
+    """ref: RPCConfig MaxBodyBytes / MaxSubscriptionsPerClient +
+    cors-allowed-origins (config.go:421-470)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    node, client, (host, port) = live_node
+    server = live_node_server[0]
+    # --- max_body_bytes: oversized POST refused with HTTP 413
+    server.max_body_bytes = 64
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/",
+            data=b'{"jsonrpc":"2.0","id":1,"method":"health","params":{"pad":"' + b"x" * 256 + b'"}}',
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 413
+        body = json.loads(ei.value.read())
+        assert "too large" in body["error"]["message"]
+    finally:
+        server.max_body_bytes = 1_000_000
+    # --- CORS: allowed origin echoed, others not
+    server.cors_allowed_origins = ("https://ok.example",)
+    req = urllib.request.Request(
+        f"http://{host}:{port}/health", headers={"Origin": "https://ok.example"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers.get("Access-Control-Allow-Origin") == "https://ok.example"
+    req = urllib.request.Request(
+        f"http://{host}:{port}/health", headers={"Origin": "https://evil.example"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers.get("Access-Control-Allow-Origin") is None
+    # --- max_subscriptions_per_client: second subscribe on one conn errors
+    server.max_subscriptions_per_client = 1
+    try:
+        ws = WSClient(host, port)
+        try:
+            ws.subscribe("tm.event = 'NewBlock'")
+            with pytest.raises(Exception, match="max_subscriptions_per_client"):
+                ws.subscribe("tm.event = 'Tx'")
+        finally:
+            ws.close()
+    finally:
+        server.max_subscriptions_per_client = 5
