@@ -92,7 +92,7 @@ from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.ops import field as F
 from tendermint_tpu.ops import verify as V
 
-PHASES = ("slice256", "slice_big", "pipe", "cutover", "cache", "sr", "dot")
+PHASES = ("slice256", "pipe_warm", "slice_big", "pipe", "cutover", "cache", "sr", "dot")
 todo = [p for p in PHASES if not banked(p)]
 if not todo:
     log("all phases banked; nothing to do")
@@ -268,6 +268,28 @@ def _phase_dot():
             f"device-only {B/dt:12,.0f} sigs/s")
 
 
+def _phase_pipe_warm():
+    # Prime the PIPELINED entry's compiles at the exact batch shapes
+    # bench.py banks first (256, 1024): verify_batch_async jits a
+    # different program than the device-only kernel, so without this the
+    # driver's bench pays a fresh ~75s compile per shape even with the
+    # window sweeps cached. Also logs small-batch pipelined rates.
+    for B in (256, 1024):
+        sub = (pks[:B], msgs[:B], sigs[:B])
+        t0 = time.time()
+        ok = V.verify_batch(*sub)
+        t_first = time.time() - t0
+        assert bool(ok.all())
+        iters = 6
+        t0 = time.time()
+        inflight = [V.verify_batch_async(*sub) for _ in range(iters)]
+        outs = [V.collect(d) for d in inflight]
+        dt = (time.time() - t0) / iters
+        assert all(bool(o.all()) for o in outs)
+        log(f"PIPEWARM B={B:5d}  first {t_first:7.2f}s  pipelined "
+            f"{dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+
+
 def _phase_cache():
     # HBM-pubkey-cache path, hit steady state: end-to-end pipelined at
     # the largest batch (bench.py stage 4 runs exactly this).
@@ -288,6 +310,7 @@ def _phase_cache():
 
 
 run_phase("slice256", 480, _phase_slice256)
+run_phase("pipe_warm", 420, _phase_pipe_warm)
 run_phase("slice_big", 360, _phase_slice_big, gate=banked("slice256"))
 run_phase("pipe", 360, _phase_pipe)
 run_phase("cutover", 360, _phase_cutover)
