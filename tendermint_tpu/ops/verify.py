@@ -25,6 +25,7 @@ Split of labor:
 from __future__ import annotations
 
 import hashlib
+import os
 
 import numpy as np
 
@@ -34,6 +35,21 @@ import jax.numpy as jnp
 from . import curve as C
 
 L = 2**252 + 27742317777372353535851937790883648493
+
+
+def _cofactored_accept(q, r_pt, a_ok, r_ok, n):
+    """Shared acceptance tail: the ZIP-215 equation
+    [8]([s]B - [k]A - R) == identity restated as the projective equality
+    [8]([s]B - [k]A) == [8]R — the subtraction (which would need the
+    ladder's T and an unrolled final window) becomes a cross-multiplied
+    equality, and the cofactor doublings of both sides run stacked in
+    one loop. Used by every verify kernel so the accepted set can never
+    fork between the uncached/cached/split planes."""
+    both = jnp.concatenate([q, r_pt], axis=-1)  # (4, 32, 2B)
+    both = jax.lax.fori_loop(
+        0, 3, lambda _, v: C.point_double(v, out_t=False), both
+    )
+    return a_ok & r_ok & C.point_equal(both[..., :n], both[..., n:])
 
 
 def verify_kernel_impl(a_enc, r_enc, s_bytes, k_bytes):
@@ -55,17 +71,8 @@ def verify_kernel_impl(a_enc, r_enc, s_bytes, k_bytes):
     pts, oks = C.decompress(jnp.concatenate([a, r], axis=1), zip215=True)
     a_pt, r_pt = pts[..., :n], pts[..., n:]
     a_ok, r_ok = oks[:n], oks[n:]
-    # ZIP-215 equation [8]([s]B - [k]A - R) == identity, restated as
-    # [8]([s]B - [k]A) == [8]R: the subtraction (which needs the
-    # ladder's T and forced an unrolled final window into the graph)
-    # becomes a projective cross-multiplied equality, and the cofactor
-    # doublings of both sides run stacked in one scanned loop.
     q = C.double_scalar_mul_base(s, k, C.point_neg(a_pt), final_t=False)
-    both = jnp.concatenate([q, r_pt], axis=-1)  # (4, 32, 2B)
-    both = jax.lax.fori_loop(
-        0, 3, lambda _, v: C.point_double(v, out_t=False), both
-    )
-    return a_ok & r_ok & C.point_equal(both[..., :n], both[..., n:])
+    return _cofactored_accept(q, r_pt, a_ok, r_ok, n)
 
 
 verify_kernel = jax.jit(verify_kernel_impl)
@@ -97,14 +104,54 @@ def verify_kernel_cached_impl(tables, oks, slots, r_enc, s_bytes, k_bytes):
     a_ok = oks[slots]
     r_pt, r_ok = C.decompress(r, zip215=True)
     q = C.double_scalar_mul_base(s, k, final_t=False, a_table=a_table)
-    both = jnp.concatenate([q, r_pt], axis=-1)  # (4, 32, 2B)
-    both = jax.lax.fori_loop(
-        0, 3, lambda _, v: C.point_double(v, out_t=False), both
-    )
-    return a_ok & r_ok & C.point_equal(both[..., :n], both[..., n:])
+    return _cofactored_accept(q, r_pt, a_ok, r_ok, n)
 
 
 verify_kernel_cached = jax.jit(verify_kernel_cached_impl)
+
+
+# Split-ladder cached plane: the HBM cache stores power-of-2^(256/S)
+# multiples tables of each negated pubkey, so the cache-hit ladder needs
+# only 256/S/4*4 - 4 shared doublings instead of 252 (doublings are
+# ~45% of the kernel; at S=4 this removes ~40% of the per-sig field
+# work). [s]B rides rows of the host-precomputed fixed-base comb, which
+# never needed doublings at all. TM_TPU_PK_SPLIT picks S (1 = legacy
+# single-table ladder).
+PK_SPLITS = int(os.environ.get("TM_TPU_PK_SPLIT", "4"))
+if PK_SPLITS not in (1, 2, 4, 8):
+    # not assert: stripped under -O, and a mismatched split silently
+    # rejects every valid signature on the cache-hit path
+    raise ValueError(f"TM_TPU_PK_SPLIT must be 1, 2, 4 or 8, got {PK_SPLITS}")
+
+
+def build_pk_tables_split_impl(a_enc):
+    """Cache-fill kernel for the split plane: (B, 32) pubkey encodings ->
+    (B, S, 16, 4, 32) int16 power-multiples tables of the negated
+    points + (B,) decode-ok bits. The (S-1)*(256/S) doubling chains run
+    once here, then never again for this key."""
+    a = a_enc.T.astype(jnp.int32)
+    a_pt, ok = C.decompress(a, zip215=True)
+    tabs = C.build_power_tables(C.point_neg(a_pt), splits=PK_SPLITS)
+    return jnp.transpose(tabs, (4, 0, 1, 2, 3)).astype(jnp.int16), ok
+
+
+build_pk_tables_split = jax.jit(build_pk_tables_split_impl)
+
+
+def verify_kernel_cached_split_impl(tables, oks, slots, r_enc, s_bytes, k_bytes):
+    """Cache-hit kernel on the split ladder (see double_scalar_mul_split)."""
+    r = r_enc.T.astype(jnp.int32)
+    s = s_bytes.T.astype(jnp.int32)
+    k = k_bytes.T.astype(jnp.int32)
+    n = r.shape[1]
+    a_tables = jnp.transpose(tables[slots].astype(jnp.int32), (1, 2, 3, 4, 0))
+    a_ok = oks[slots]
+    r_pt, r_ok = C.decompress(r, zip215=True)
+    q = C.double_scalar_mul_split(s, k, a_tables, splits=PK_SPLITS)
+    return _cofactored_accept(q, r_pt, a_ok, r_ok, n)
+
+
+verify_kernel_cached_split = jax.jit(verify_kernel_cached_split_impl)
 
 
 class PubkeyCache:
@@ -118,7 +165,7 @@ class PubkeyCache:
     which creates a NEW device array — in-flight async batches keep
     referencing the buffers they were dispatched with."""
 
-    def __init__(self, capacity: int = 4096, build_fn=None):
+    def __init__(self, capacity: int = 4096, build_fn=None, entry_shape=(16, 4, 32)):
         import collections
         import threading
 
@@ -126,7 +173,7 @@ class PubkeyCache:
         self._build = build_fn or build_pk_tables  # sr25519 plugs in its decoder
         self._lock = threading.Lock()  # reactors verify concurrently
         self._lru: "collections.OrderedDict[bytes, int]" = collections.OrderedDict()
-        self.tables = jnp.zeros((capacity, 16, 4, 32), jnp.int16)
+        self.tables = jnp.zeros((capacity,) + tuple(entry_shape), jnp.int16)
         self.oks = jnp.zeros((capacity,), bool)
 
     def ensure(self, pubkeys):
@@ -181,7 +228,13 @@ _PK_CACHE: PubkeyCache | None = None
 def pubkey_cache() -> PubkeyCache:
     global _PK_CACHE
     if _PK_CACHE is None:
-        _PK_CACHE = PubkeyCache()
+        if PK_SPLITS > 1:
+            _PK_CACHE = PubkeyCache(
+                build_fn=build_pk_tables_split,
+                entry_shape=(PK_SPLITS, 16, 4, 32),
+            )
+        else:
+            _PK_CACHE = PubkeyCache()
     return _PK_CACHE
 
 
@@ -343,8 +396,13 @@ def verify_batch_cached_async(pubkeys, msgs, sigs):
     """verify_batch_async through the HBM pubkey cache: repeated
     validator sets (every production VerifyCommit after the first at a
     given height range) skip A decompression + table build on device."""
+    cache = pubkey_cache()
+    # Pick the kernel from the cache's ACTUAL entry shape, not PK_SPLITS:
+    # a caller that installed a bare PubkeyCache() (legacy single-table
+    # entries) must not be routed to the split kernel.
+    kern = verify_kernel_cached_split if cache.tables.ndim == 5 else verify_kernel_cached
     return dispatch_cached(
-        pubkey_cache(), prepare_batch, verify_kernel_cached,
+        cache, prepare_batch, kern,
         verify_batch_async, pubkeys, msgs, sigs,
     )
 

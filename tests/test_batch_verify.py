@@ -163,3 +163,27 @@ def test_sharded_verify_sr25519_8_devices():
     bitmap, all_ok = SV.verify_batch_sharded(mesh, [pk] * n, msgs, sigs, key_type="sr25519")
     assert not all_ok
     assert not bitmap[37] and bitmap.sum() == n - 1  # fault localized
+
+
+def test_split_and_legacy_cached_planes_agree():
+    """The split-ladder cached kernel (TM_TPU_PK_SPLIT=4 default) and the
+    legacy single-table cached kernel accept identical sets: run the same
+    batch (valid + tampered + small-order edge) through BOTH cache
+    planes explicitly."""
+    pks, msgs, sigs = make_jobs(4, tamper_idx=(1,))
+    so = ref.small_order_points()[1]
+    pks.append(so); msgs.append(b"edge"); sigs.append(ref.compress(ref.IDENTITY) + b"\x00" * 32)
+
+    legacy = V.PubkeyCache(capacity=8, build_fn=V.build_pk_tables)
+    split = V.PubkeyCache(
+        capacity=8, build_fn=V.build_pk_tables_split,
+        entry_shape=(V.PK_SPLITS, 16, 4, 32),
+    )
+    got_legacy = V.collect(V.dispatch_cached(
+        legacy, V.prepare_batch, V.verify_kernel_cached, V.verify_batch_async,
+        pks, msgs, sigs))
+    got_split = V.collect(V.dispatch_cached(
+        split, V.prepare_batch, V.verify_kernel_cached_split, V.verify_batch_async,
+        pks, msgs, sigs))
+    assert [bool(b) for b in got_legacy] == [bool(b) for b in got_split]
+    assert not got_split[1] and bool(got_split[4])
