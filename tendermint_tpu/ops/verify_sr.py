@@ -74,15 +74,58 @@ def verify_sr_kernel_cached_impl(tables, oks, slots, r_enc, s_bytes, k_bytes):
 
 verify_sr_kernel_cached = jax.jit(verify_sr_kernel_cached_impl)
 
+
+def build_sr_tables_split_impl(a_enc):
+    """Split-plane cache fill (see ops/verify.py build_pk_tables_split):
+    ristretto decode + negate + power tables, (B, S, 16, 4, 32) int16."""
+    from .verify import PK_SPLITS
+
+    a = a_enc.T.astype(jnp.int32)
+    a_pt, ok = R.decode(a)
+    tabs = C.build_power_tables(C.point_neg(a_pt), splits=PK_SPLITS)
+    return jnp.transpose(tabs, (4, 0, 1, 2, 3)).astype(jnp.int16), ok
+
+
+build_sr_tables_split = jax.jit(build_sr_tables_split_impl)
+
+
+def verify_sr_kernel_cached_split_impl(tables, oks, slots, r_enc, s_bytes, k_bytes):
+    """Cache-hit kernel on the split ladder. The split ladder's output
+    carries no T, but ristretto encode reads it — adding the identity
+    point regenerates a consistent T in one unified addition (with
+    q2 = identity: C = T1*2d*0 = 0 exactly, and the result
+    (4XZ : 4YZ : 4Z^2 : 4XY) is projectively q with T3*Z3 == X3*Y3)."""
+    from .verify import PK_SPLITS
+
+    r = r_enc.T.astype(jnp.int32)
+    s = s_bytes.T.astype(jnp.int32)
+    k = k_bytes.T.astype(jnp.int32)
+    a_tables = jnp.transpose(tables[slots].astype(jnp.int32), (1, 2, 3, 4, 0))
+    a_ok = oks[slots]
+    q = C.double_scalar_mul_split(s, k, a_tables, splits=PK_SPLITS)
+    ident = C.identity_point(q.shape[2:]) + 0 * q
+    q = C.point_add(q, ident, out_t=True)
+    enc = R.encode(q)
+    return a_ok & jnp.all(enc == r, axis=0)
+
+
+verify_sr_kernel_cached_split = jax.jit(verify_sr_kernel_cached_split_impl)
+
 _SR_CACHE = None
 
 
 def sr_pubkey_cache():
-    from .verify import PubkeyCache
+    from .verify import PK_SPLITS, PubkeyCache
 
     global _SR_CACHE
     if _SR_CACHE is None:
-        _SR_CACHE = PubkeyCache(build_fn=build_sr_tables)
+        if PK_SPLITS > 1:
+            _SR_CACHE = PubkeyCache(
+                build_fn=build_sr_tables_split,
+                entry_shape=(PK_SPLITS, 16, 4, 32),
+            )
+        else:
+            _SR_CACHE = PubkeyCache(build_fn=build_sr_tables)
     return _SR_CACHE
 
 
@@ -140,8 +183,14 @@ def verify_batch_cached_async(pubkeys, msgs, sigs):
     contract as the ed25519 plane's verify_batch_cached_async)."""
     from .verify import dispatch_cached
 
+    cache = sr_pubkey_cache()
+    kern = (
+        verify_sr_kernel_cached_split
+        if cache.tables.ndim == 5
+        else verify_sr_kernel_cached
+    )
     return dispatch_cached(
-        sr_pubkey_cache(), prepare_batch, verify_sr_kernel_cached,
+        cache, prepare_batch, kern,
         verify_batch_async, pubkeys, msgs, sigs,
     )
 
