@@ -72,6 +72,98 @@ def sharded_verify_fn(mesh: Mesh, kernel_impl=V.verify_kernel_impl):
     return fn
 
 
+def sharded_cached_verify_fn(mesh: Mesh, kernel_impl):
+    """Cached-plane sharded verifier: the HBM tables cache is REPLICATED
+    across the mesh (every chip holds the full table array — the
+    north-star's 'pubkey table resident in HBM', mesh-wide), while
+    slots/r/s/k shard with the batch; each chip gathers its shard's
+    table entries locally, so no collective moves table data and the
+    verdict stays the one psum AND-reduce."""
+    key = (mesh, kernel_impl, "cached")
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        spec = P(AXIS)
+
+        def local(tables, oks, slots, r_enc, s_bytes, k_bytes):
+            ok = kernel_impl(tables, oks, slots, r_enc, s_bytes, k_bytes)
+            fails = jnp.sum(jnp.where(ok, 0, 1))
+            return ok, jax.lax.psum(fails, AXIS) == 0
+
+        fn = jax.jit(
+            shard_map(
+                local,
+                mesh=mesh,
+                in_specs=(P(), P(), spec, spec, spec, spec),
+                out_specs=(spec, P()),
+            )
+        )
+        _FN_CACHE[key] = fn
+    return fn
+
+
+def verify_batch_sharded_cached(mesh: Mesh, pubkeys, msgs, sigs, key_type: str = "ed25519"):
+    """verify_batch_sharded through the split-ladder HBM cache plane:
+    repeat validator sets skip decompression/table build on every chip
+    and take the short split ladder. Falls back to the uncached sharded
+    path when the batch holds more distinct keys than the cache."""
+    n = len(sigs)
+    if n == 0:
+        return np.zeros((0,), bool), False
+    if key_type == "ed25519":
+        plane, cache = V, V.pubkey_cache()
+        kern = (
+            V.verify_kernel_cached_split_impl
+            if cache.tables.ndim == 5
+            else V.verify_kernel_cached_impl
+        )
+    elif key_type == "sr25519":
+        plane, cache = VS, VS.sr_pubkey_cache()
+        kern = (
+            VS.verify_sr_kernel_cached_split_impl
+            if cache.tables.ndim == 5
+            else VS.verify_sr_kernel_cached_impl
+        )
+    else:
+        raise ValueError(f"unsupported key_type {key_type!r} for sharded verification")
+    keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
+    slots, tables, oks = cache.ensure_snapshot(keys)
+    if slots is None:
+        return verify_batch_sharded(mesh, pubkeys, msgs, sigs, key_type)
+    _, r_enc, s_bytes, k_bytes, precheck = plane.prepare_batch(pubkeys, msgs, sigs)
+    n_dev = mesh.devices.size
+    per_dev = -(-n // n_dev)
+    if per_dev <= 256:
+        per_dev = V._pad_pow2(per_dev, floor=8)
+    else:
+        per_dev = -(-per_dev // 256) * 256
+    pad = per_dev * n_dev - n
+    if pad:
+        r_enc = np.pad(r_enc, ((0, pad), (0, 0)))
+        s_bytes = np.pad(s_bytes, ((0, pad), (0, 0)))
+        k_bytes = np.pad(k_bytes, ((0, pad), (0, 0)))
+    # Pad slots with THIS batch's last slot, not slot 0: padded rows
+    # (s = k = 0) verify true against any VALID key's table (the ladder
+    # selects only identity entries), and if that key's encoding is
+    # invalid its own real row already fails the verdict — whereas
+    # slot 0 may hold an unrelated invalid key, failing the psum
+    # verdict for an all-valid batch.
+    slots = np.pad(slots, (0, pad), mode="edge")
+    fn = sharded_cached_verify_fn(mesh, kern)
+    shard = NamedSharding(mesh, P(AXIS))
+    repl = NamedSharding(mesh, P())
+    args = [
+        jax.device_put(tables, repl),
+        jax.device_put(oks, repl),
+        jax.device_put(jnp.asarray(slots), shard),
+        jax.device_put(jnp.asarray(r_enc), shard),
+        jax.device_put(jnp.asarray(s_bytes), shard),
+        jax.device_put(jnp.asarray(k_bytes), shard),
+    ]
+    bitmap, device_all_valid = fn(*args)
+    bitmap = np.asarray(bitmap)[:n] & precheck
+    return bitmap, bool(device_all_valid) and bool(precheck.all())
+
+
 def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs, key_type: str = "ed25519"):
     """Host glue mirroring ops.verify.verify_batch but sharded. Returns
     (bitmap numpy (n,), all_valid bool). key_type selects the plane:

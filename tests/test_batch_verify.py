@@ -187,3 +187,34 @@ def test_split_and_legacy_cached_planes_agree():
         pks, msgs, sigs))
     assert [bool(b) for b in got_legacy] == [bool(b) for b in got_split]
     assert not got_split[1] and bool(got_split[4])
+
+
+def test_sharded_cached_matches_sharded_uncached():
+    """The replicated-cache sharded plane (verify_batch_sharded_cached)
+    and the uncached sharded plane agree, incl. fault localization and
+    the all-valid ICI verdict with padded rows (n=37 not divisible by
+    the mesh)."""
+    import jax
+    from tendermint_tpu.parallel import sharded_verify as sv
+
+    mesh = sv.make_mesh(len(jax.devices()))
+    n = 37
+    pks, msgs, sigs = make_jobs(n, tamper_idx=(5,))
+    bm_u, ok_u = sv.verify_batch_sharded(mesh, pks, msgs, sigs)
+    bm_c, ok_c = sv.verify_batch_sharded_cached(mesh, pks, msgs, sigs)
+    assert [bool(b) for b in bm_u] == [bool(b) for b in bm_c]
+    assert ok_u == ok_c == False  # noqa: E712
+    assert [i for i, b in enumerate(bm_c) if not b] == [5]
+    # all-valid verdict with padding: fix the tampered sig
+    pks2, msgs2, sigs2 = make_jobs(n)
+    bm_c2, ok_c2 = sv.verify_batch_sharded_cached(mesh, pks2, msgs2, sigs2)
+    assert ok_c2 and all(bool(b) for b in bm_c2)
+    # sr25519 plane rides the same path
+    from tendermint_tpu.crypto import sr25519 as sr
+
+    spriv = sr.Sr25519PrivKey.generate(b"\x05" * 32)
+    spk = spriv.pub_key().bytes()
+    smsgs = [b"shard-sr-%d" % i for i in range(10)]
+    ssigs = [spriv.sign(m) for m in smsgs]
+    bm_s, ok_s = sv.verify_batch_sharded_cached(mesh, [spk] * 10, smsgs, ssigs, key_type="sr25519")
+    assert ok_s and all(bool(b) for b in bm_s)
