@@ -226,6 +226,13 @@ def _phase_pipe():
 
 
 def _phase_cutover():
+    # Host serial verify costs ~n/8.2k s (OpenSSL, BENCH_NOTES baseline);
+    # the device wins once steady call latency beats that. Measure the
+    # small-batch end-to-end latencies and log the break-even n — the
+    # measured value DEVICE_BATCH_CUTOVER should be set to
+    # (VERDICT r3 item 3: the cutover has never been priced on chip).
+    host_rate = 8200.0
+    pts = []
     for n in (64, 16, 128):  # one compile per padded shape
         sub = (pks[:n], msgs[:n], sigs[:n])
         t0 = time.time()
@@ -236,8 +243,18 @@ def _phase_cutover():
         for _ in range(20):
             ok = V.verify_batch(*sub)
         dt = (time.time() - t0) / 20
+        pts.append((n, dt))
         log(f"CUTOVER n={n:4d}  first {t_first:7.2f}s  steady {dt*1000:8.3f}ms/call  "
             f"({n/dt:10,.0f} sigs/s)")
+    # model call time as fixed + per-sig from the measured points and
+    # solve fixed + slope*n == n/host_rate
+    (n1, t1), (n2, t2) = pts[1], pts[2]  # n=16 and n=128
+    slope = max((t2 - t1) / (n2 - n1), 1e-9)
+    fixed = max(t1 - slope * n1, 0.0)
+    denom = 1.0 / host_rate - slope
+    be = fixed / denom if denom > 0 else float("inf")
+    log(f"CUTOVER break-even ~ n={be:,.0f}  (fixed {fixed*1000:.2f}ms, "
+        f"device {slope*1e6:.1f}us/sig vs host {1e6/host_rate:.1f}us/sig)")
 
 
 def _phase_sr():
