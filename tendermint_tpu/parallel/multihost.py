@@ -1,0 +1,96 @@
+"""Multi-host (DCN) entry points for the sharded verification plane.
+
+The reference scales its communication backend across machines with a
+custom TCP stack (SURVEY §5.8); the TPU-native analog is JAX's
+multi-controller runtime: every host runs the same program, device
+discovery spans the pod (`jax.devices()` is global after
+`jax.distributed.initialize`), in-pod collectives ride ICI and
+cross-pod collectives ride DCN — the `psum` AND-reduce in
+`sharded_verify.py` needs no code change. What DOES change on
+multi-host is data placement: a single controller can `device_put` a
+full array, but in multi-controller each process holds only its local
+shard and must assemble the global array with
+`jax.make_array_from_process_local_data`. This module provides that
+path; on a single controller it degenerates to the plain sharded call,
+which is how it is tested in-container (the driver validates the
+single-host mesh separately via __graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import verify as V
+from . import sharded_verify as sv
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the multi-controller runtime (ref analog: the NCCL/MPI init
+    the reference never needed because its backend is TCP-only; here one
+    call wires every host's chips into one global device set). No-op
+    when already initialized or when running single-controller."""
+    if jax.process_count() > 1:
+        return  # already distributed
+    if coordinator_address is None:
+        return  # single-controller run: nothing to join
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh() -> "jax.sharding.Mesh":
+    """1-D mesh over every chip in the job, across all hosts. Axis
+    layout note: jax.devices() orders devices so that intra-host (ICI)
+    neighbors are adjacent; a 1-D batch axis therefore keeps most
+    traffic of the AND-reduce on ICI with one DCN hop per host pair."""
+    return sv.make_mesh()
+
+
+def verify_batch_sharded_local(mesh, pubkeys, msgs, sigs, key_type: str = "ed25519"):
+    """Multi-controller variant of verify_batch_sharded: each process
+    passes only its LOCAL jobs; the global batch is the concatenation
+    over processes (every process must call this collectively, with
+    the same per-process count). Returns (local bitmap (n,), global
+    all-valid bool).
+
+    Single-controller (process_count == 1) this is exactly
+    verify_batch_sharded."""
+    if jax.process_count() == 1:
+        return sv.verify_batch_sharded(mesh, pubkeys, msgs, sigs, key_type)
+    plane, kernel_impl = sv._PLANES[key_type]
+    n = len(sigs)
+    a, r, s, k, precheck = plane.prepare_batch(pubkeys, msgs, sigs)
+    # pad the LOCAL shard to an equal per-process size (collective
+    # contract: same n on every process keeps shapes static)
+    n_local_dev = len(mesh.local_devices)
+    per_dev = -(-n // n_local_dev)
+    per_dev = V._pad_pow2(per_dev, floor=8) if per_dev <= 256 else -(-per_dev // 256) * 256
+    pad = per_dev * n_local_dev - n
+    if pad:
+        a, r, s, k = (np.pad(x, ((0, pad), (0, 0))) for x in (a, r, s, k))
+    sharding = NamedSharding(mesh, P(sv.AXIS))
+    args = [
+        jax.make_array_from_process_local_data(sharding, jnp.asarray(x))
+        for x in (a, r, s, k)
+    ]
+    fn = sv.sharded_verify_fn(mesh, kernel_impl)
+    bitmap, device_all_valid = fn(*args)
+    # addressable slice of the global bitmap = this process's rows
+    local = np.concatenate(
+        [np.asarray(shard.data) for shard in bitmap.addressable_shards]
+    )[:n]
+    local &= precheck
+    # global all-valid must also fold every process's HOST precheck
+    # (one tiny DCN allgather; device checks are already psum-reduced)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray([precheck.all()]))
+    return local, bool(device_all_valid) and bool(flags.all())

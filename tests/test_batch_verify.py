@@ -218,3 +218,20 @@ def test_sharded_cached_matches_sharded_uncached():
     ssigs = [spriv.sign(m) for m in smsgs]
     bm_s, ok_s = sv.verify_batch_sharded_cached(mesh, [spk] * 10, smsgs, ssigs, key_type="sr25519")
     assert ok_s and all(bool(b) for b in bm_s)
+
+
+def test_multihost_entry_single_controller():
+    """parallel.multihost: on a single controller the local entry is
+    exactly the sharded path, and initialize() is a safe no-op."""
+    import jax
+    from tendermint_tpu.parallel import multihost as mh
+    from tendermint_tpu.parallel import sharded_verify as sv
+
+    mh.initialize()  # no coordinator: no-op
+    mesh = mh.global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    pks, msgs, sigs = make_jobs(16, tamper_idx=(3,))
+    bm, ok = mh.verify_batch_sharded_local(mesh, pks, msgs, sigs)
+    bm2, ok2 = sv.verify_batch_sharded(mesh, pks, msgs, sigs)
+    assert [bool(b) for b in bm] == [bool(b) for b in bm2]
+    assert ok == ok2 == False  # noqa: E712
