@@ -61,21 +61,62 @@ class KVStoreApplication(abci.Application):
         self.val_addr_to_pubkey: dict[bytes, tuple[str, bytes]] = {}
         self._snapshots: dict[int, tuple[abci.Snapshot, list[bytes]]] = {}
         self._restore: tuple[abci.Snapshot, list[bytes | None]] | None = None
+        # FinalizeBlock effects are buffered here (key -> value, None =
+        # delete) and published to the db at Commit: Info can then
+        # honestly report the last PERSISTED height (the ABCI contract),
+        # and a node that crashed mid-block can reconnect to an
+        # out-of-process app and replay the block without double-applying
+        # (reload_committed drops the buffer).
+        self._pending: dict[bytes, bytes | None] = {}
+        self._committed = (0, 0, b"")  # (height, size, app_hash)
         self._load_state()
 
     # ------------------------------------------------------------ state io
 
     def _load_state(self) -> None:
         raw = self.db.get(STATE_KEY)
-        if not raw:
-            return
-        doc = json.loads(raw)
-        self.size = doc.get("size", 0)
-        self.height = doc.get("height", 0)
-        self.app_hash = base64.b64decode(doc.get("app_hash") or "")
+        if raw:
+            doc = json.loads(raw)
+            self.size = doc.get("size", 0)
+            self.height = doc.get("height", 0)
+            self.app_hash = base64.b64decode(doc.get("app_hash") or "")
+        self._committed = (self.height, self.size, self.app_hash)
+        self.val_addr_to_pubkey = {}
         for k, v in self.db.iterator(b"val:", b"val;"):
-            self.val_addr_to_pubkey[self._pub_to_addr(k[4:])] = ("ed25519", k[4:])
-            _ = v
+            kt, _ = self._parse_val_value(v)
+            self.val_addr_to_pubkey[self._pub_to_addr(kt, k[4:])] = (kt, k[4:])
+
+    def reload_committed(self) -> None:
+        """Drop uncommitted FinalizeBlock effects and return to the last
+        persisted state. Called by the out-of-process transports when a
+        (possibly restarted) node connects: the node's handshake will
+        decide what to replay based on Info, which must not include a
+        block whose Commit never arrived."""
+        with self._mu:
+            self._pending.clear()
+            self.val_updates = []
+            self._load_state()
+
+    # merged (committed + pending) views used inside a block
+    def _db_get(self, key: bytes):
+        if key in self._pending:
+            return self._pending[key]
+        return self.db.get(key)
+
+    def _db_has(self, key: bytes) -> bool:
+        if key in self._pending:
+            return self._pending[key] is not None
+        return self.db.has(key)
+
+    def _iter_merged(self, start: bytes, end: bytes):
+        merged = {k: v for k, v in self.db.iterator(start, end)}
+        for k, v in self._pending.items():
+            if start <= k < end:
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+        return sorted(merged.items())
 
     def _save_state(self) -> None:
         doc = {
@@ -84,23 +125,44 @@ class KVStoreApplication(abci.Application):
             "app_hash": base64.b64encode(self.app_hash).decode(),
         }
         self.db.set(STATE_KEY, json.dumps(doc).encode())
+        self._committed = (self.height, self.size, self.app_hash)
 
     @staticmethod
-    def _pub_to_addr(pub: bytes) -> bytes:
-        from ..crypto.ed25519 import Ed25519PubKey
+    def _pub_to_addr(key_type: str, pub: bytes) -> bytes:
+        """Address derivation per key type: ed25519/sr25519 share the
+        sha256[:20] address hash; secp256k1 uses RIPEMD160(SHA256)."""
+        if key_type == "secp256k1":
+            from ..crypto.secp256k1 import Secp256k1PubKey
 
-        return Ed25519PubKey(pub).address()
+            return Secp256k1PubKey(pub).address()
+        from ..crypto.ed25519 import address_hash
+
+        return address_hash(pub)
+
+    @staticmethod
+    def _parse_val_value(v: bytes) -> tuple[str, int]:
+        """Stored val: entry value 'type:power' (bare 'power' = ed25519,
+        the pre-multi-keytype format and the reference's)."""
+        if b":" in v:
+            kt, power = v.split(b":", 1)
+            return kt.decode(), int(power)
+        return "ed25519", int(v)
 
     # ------------------------------------------------------------ abci
 
     def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
         with self._mu:
+            # committed values ONLY: reporting a height whose Commit has
+            # not happened would make a reconnecting node skip replaying
+            # a block the app never persisted (ABCI contract:
+            # last_block_height = latest persisted height)
+            c_height, c_size, c_app_hash = self._committed
             return abci.ResponseInfo(
-                data='{"size":%d}' % self.size,
+                data='{"size":%d}' % c_size,
                 version="0.17.0",
                 app_version=PROTOCOL_VERSION,
-                last_block_height=self.height,
-                last_block_app_hash=self.app_hash,
+                last_block_height=c_height,
+                last_block_app_hash=c_app_hash,
             )
 
     def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
@@ -137,6 +199,13 @@ class KVStoreApplication(abci.Application):
 
     def commit(self) -> abci.ResponseCommit:
         with self._mu:
+            # publish the block's buffered writes, then the state doc
+            for k, v in self._pending.items():
+                if v is None:
+                    self.db.delete(k)
+                else:
+                    self.db.set(k, v)
+            self._pending.clear()
             self._save_state()
             if self.snapshot_interval and self.height > 0 and self.height % self.snapshot_interval == 0:
                 self._take_snapshot()
@@ -223,7 +292,8 @@ class KVStoreApplication(abci.Application):
             self.app_hash = bytes.fromhex(doc["app_hash"])
             self.val_addr_to_pubkey = {}
             for k, v in self.db.iterator(b"val:", b"val;"):
-                self.val_addr_to_pubkey[self._pub_to_addr(k[4:])] = ("ed25519", k[4:])
+                kt, _ = self._parse_val_value(v)
+                self.val_addr_to_pubkey[self._pub_to_addr(kt, k[4:])] = (kt, k[4:])
             self._save_state()
             self._restore = None
             return abci.ResponseApplySnapshotChunk(result=abci.CHUNK_ACCEPT)
@@ -251,7 +321,7 @@ class KVStoreApplication(abci.Application):
             key, value = parts[0], parts[1]
         else:
             key, value = tx, tx
-        self.db.set(prefix_key(key), value)
+        self._pending[prefix_key(key)] = value
         self.size += 1
         events = [
             abci.Event(
@@ -276,6 +346,17 @@ class KVStoreApplication(abci.Application):
                 log=f"Expected 'pubkey!power'. Got {body!r}",
             )
         pub_s, power_s = parts
+        # optional key-type prefix "type:base64pub" (bare base64 =
+        # ed25519, byte-compatible with the reference's MakeValSetChangeTx;
+        # ':' cannot appear in base64, so the split is unambiguous)
+        key_type = "ed25519"
+        if b":" in pub_s:
+            kt, pub_s = pub_s.split(b":", 1)
+            key_type = kt.decode("utf-8", "replace")
+            if key_type not in ("ed25519", "sr25519", "secp256k1"):
+                return abci.ExecTxResult(
+                    code=CODE_TYPE_ENCODING_ERROR, log=f"Unknown key type {key_type!r}"
+                )
         try:
             pub = base64.b64decode(pub_s, validate=True)
         except Exception:
@@ -284,24 +365,24 @@ class KVStoreApplication(abci.Application):
             power = int(power_s)
         except ValueError:
             return abci.ExecTxResult(code=CODE_TYPE_ENCODING_ERROR, log=f"Power ({power_s!r}) is not an int")
-        return self._update_validator(abci.ValidatorUpdate(pub_key_type="ed25519", pub_key_bytes=pub, power=power))
+        return self._update_validator(abci.ValidatorUpdate(pub_key_type=key_type, pub_key_bytes=pub, power=power))
 
     def _update_validator(self, v: abci.ValidatorUpdate) -> abci.ExecTxResult:
         """ref: kvstore.go:380 updateValidator — tracked in the merkle tree
         under val:pubkeybytes and in val_updates for the block response."""
         key = b"val:" + v.pub_key_bytes
-        addr = self._pub_to_addr(v.pub_key_bytes)
+        addr = self._pub_to_addr(v.pub_key_type, v.pub_key_bytes)
         if v.power == 0:
-            if not self.db.has(key):
+            if not self._db_has(key):
                 pub_str = base64.b64encode(v.pub_key_bytes).decode()
                 return abci.ExecTxResult(
                     code=CODE_TYPE_UNAUTHORIZED,
                     log=f"Cannot remove non-existent validator {pub_str}",
                 )
-            self.db.delete(key)
+            self._pending[key] = None
             self.val_addr_to_pubkey.pop(addr, None)
         else:
-            self.db.set(key, str(v.power).encode())
+            self._pending[key] = f"{v.pub_key_type}:{v.power}".encode()
             self.val_addr_to_pubkey[addr] = (v.pub_key_type, v.pub_key_bytes)
         self.val_updates = [u for u in self.val_updates if u.pub_key_bytes != v.pub_key_bytes]
         self.val_updates.append(v)
@@ -311,11 +392,15 @@ class KVStoreApplication(abci.Application):
         """Current validator set from the tree (ref: kvstore.go:306)."""
         out = []
         with self._mu:
-            for k, v in self.db.iterator(b"val:", b"val;"):
-                out.append(abci.ValidatorUpdate(pub_key_type="ed25519", pub_key_bytes=k[4:], power=int(v)))
+            for k, v in self._iter_merged(b"val:", b"val;"):
+                kt, power = self._parse_val_value(v)
+                out.append(abci.ValidatorUpdate(pub_key_type=kt, pub_key_bytes=k[4:], power=power))
         return out
 
 
-def make_validator_tx(pub_key_bytes: bytes, power: int) -> bytes:
-    """ref: kvstore.go:334 MakeValSetChangeTx."""
-    return b"val:" + base64.b64encode(pub_key_bytes) + b"!" + str(power).encode()
+def make_validator_tx(pub_key_bytes: bytes, power: int, key_type: str = "ed25519") -> bytes:
+    """ref: kvstore.go:334 MakeValSetChangeTx. Non-ed25519 key types
+    carry a 'type:' prefix (the bare form stays byte-compatible with
+    the reference's ed25519-only txs)."""
+    prefix = b"" if key_type == "ed25519" else key_type.encode() + b":"
+    return b"val:" + prefix + base64.b64encode(pub_key_bytes) + b"!" + str(power).encode()

@@ -138,6 +138,16 @@ class SocketServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            # A connecting client is a (possibly restarted) node whose
+            # handshake trusts Info: drop any FinalizeBlock effects whose
+            # Commit never arrived, so replay decisions see only
+            # persisted state. Idempotent (fresh boots have no pending).
+            reload = getattr(self.app, "reload_committed", None)
+            if reload is not None:
+                try:
+                    reload()
+                except Exception:
+                    pass
             with self._lock:
                 self._conns.append(conn)
             threading.Thread(

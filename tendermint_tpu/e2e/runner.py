@@ -366,7 +366,7 @@ class Runner:
                 cfg = load_config(by_name[name].home)
                 pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
                 pub = pv.get_pub_key()
-                tx = make_validator_tx(pub.bytes(), power)
+                tx = make_validator_tx(pub.bytes(), power, key_type=pub.type_name)
                 res = client.call("broadcast_tx_sync", tx=tx.hex())
                 if int(res.get("code", 0)) != 0:
                     raise RuntimeError(
@@ -475,7 +475,16 @@ class Runner:
         """ref: runner/perturb.go:40-72 (disconnect/kill/pause/restart)."""
         self.log(f"perturb {node.m.name}: {kind}")
         if kind == "kill":
+            # node AND its out-of-process app are one failure domain —
+            # the reference's kill is `docker kill` of the container
+            # holding both (perturb.go:52; the e2e binary embeds the
+            # app). Leaving the app alive hands the restarted node an
+            # app whose in-memory height includes an uncommitted
+            # FinalizeBlock, an unreachable state in the reference.
             node.proc.send_signal(signal.SIGKILL)
+            if node.app_proc is not None:
+                node.app_proc.send_signal(signal.SIGKILL)
+                node.app_proc.wait(timeout=10)
             node.proc.wait(timeout=10)
             self._start_node(node)
         elif kind == "restart":
@@ -485,6 +494,13 @@ class Runner:
             except subprocess.TimeoutExpired:
                 node.proc.kill()
                 node.proc.wait(timeout=10)
+            if node.app_proc is not None:
+                node.app_proc.send_signal(signal.SIGTERM)
+                try:
+                    node.app_proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    node.app_proc.kill()
+                    node.app_proc.wait(timeout=10)
             self._start_node(node)
         elif kind == "pause":
             node.proc.send_signal(signal.SIGSTOP)

@@ -151,3 +151,69 @@ def test_filedb_compact(tmp_path):
     db.close()
     db2 = FileDB(path)
     assert db2.get(b"k4") == b"v49"
+
+
+def test_validator_tx_key_types():
+    """val-change txs carry the key type (bare form = ed25519 for
+    reference byte-compat): an sr25519 chain's power update must round
+    back out of validators() with the right type and address mapping —
+    regression for the e2e generator's sr25519 validator_update
+    schedules, which silently never took effect."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.kvstore import KVStoreApplication, make_validator_tx
+    from tendermint_tpu.crypto.sr25519 import Sr25519PrivKey
+
+    app = KVStoreApplication()
+    spk = Sr25519PrivKey.generate(b"\x09" * 32).pub_key()
+    app.init_chain(abci.RequestInitChain(validators=[
+        abci.ValidatorUpdate(pub_key_type="sr25519", pub_key_bytes=spk.bytes(), power=10)
+    ]))
+    vals = app.validators()
+    assert vals[0].pub_key_type == "sr25519" and vals[0].power == 10
+    assert app.val_addr_to_pubkey[spk.address()] == ("sr25519", spk.bytes())
+
+    tx = make_validator_tx(spk.bytes(), 84, key_type="sr25519")
+    res = app.finalize_block(abci.RequestFinalizeBlock(txs=[tx], height=1))
+    assert res.tx_results[0].code == abci.CODE_TYPE_OK
+    vals = app.validators()
+    assert vals[0].pub_key_type == "sr25519" and vals[0].power == 84
+    assert [u.power for u in res.validator_updates] == [84]
+    # bare (reference-format) tx still means ed25519
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    epk = Ed25519PrivKey.generate(b"\x0a" * 32).pub_key()
+    res = app.finalize_block(abci.RequestFinalizeBlock(
+        txs=[make_validator_tx(epk.bytes(), 5)], height=2))
+    assert res.tx_results[0].code == abci.CODE_TYPE_OK
+    types = {u.pub_key_type for u in app.validators()}
+    assert types == {"sr25519", "ed25519"}
+
+
+def test_uncommitted_block_invisible_after_reconnect():
+    """ABCI contract: Info reports the last PERSISTED height. A node
+    killed between FinalizeBlock and Commit reconnects (the transports
+    call reload_committed) and must see the pre-block state, then replay
+    the block to the identical app hash — no double-application."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+    app = KVStoreApplication()
+    req1 = abci.RequestFinalizeBlock(txs=[b"a=1"], height=1)
+    app.finalize_block(req1)
+    app.commit()
+    assert app.info(abci.RequestInfo()).last_block_height == 1
+    committed_hash = app.info(abci.RequestInfo()).last_block_app_hash
+
+    # block 2 finalized, commit never arrives (node crashed)
+    res2 = app.finalize_block(abci.RequestFinalizeBlock(txs=[b"b=2", b"c=3"], height=2))
+    info = app.info(abci.RequestInfo())
+    assert info.last_block_height == 1  # uncommitted block invisible
+    assert info.last_block_app_hash == committed_hash
+    assert app.query(abci.RequestQuery(data=b"b")).value in (b"", None)  # not visible
+
+    app.reload_committed()  # node reconnects
+    res2b = app.finalize_block(abci.RequestFinalizeBlock(txs=[b"b=2", b"c=3"], height=2))
+    assert res2b.app_hash == res2.app_hash  # replay is idempotent
+    app.commit()
+    assert app.info(abci.RequestInfo()).last_block_height == 2
+    assert app.query(abci.RequestQuery(data=b"b")).value == b"2"
