@@ -258,3 +258,36 @@ def test_generated_manifest_runs(tmp_path):
         runner.check_consistency()
     finally:
         runner.cleanup()
+
+
+SR_UPDATE_MANIFEST = """
+chain_id = "e2e-sr-update"
+key_type = "sr25519"
+load_tx_rate = 5
+
+[validator_update.3]
+validator02 = 77
+
+[node.validator01]
+
+[node.validator02]
+"""
+
+
+@pytest.mark.slow
+def test_e2e_sr25519_validator_update(tmp_path):
+    """Regression: a validator power update on an sr25519 chain must
+    take effect on-chain (the kvstore's val-change txs used to hardcode
+    ed25519, silently no-op'ing on other key types)."""
+    m = Manifest.parse(SR_UPDATE_MANIFEST)
+    runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
+    runner.setup()
+    try:
+        runner.start(timeout=120)
+        runner.wait_for_height(2, timeout=120)
+        runner.apply_validator_updates(timeout=90)
+        vals = runner.nodes[0].client().call("validators")
+        powers = {v["address"]: int(v["voting_power"]) for v in vals["validators"]}
+        assert 77 in powers.values()
+    finally:
+        runner.cleanup()
