@@ -154,6 +154,12 @@ def main():
     make_jobs(jobs, BATCHES[-1])
     cpu_rate = bench_cpu(jobs)
     _log(f"cpu baseline (n={len(jobs[2])}): {cpu_rate:,.0f} sigs/s")
+    # trace-time host constants (fixed-base comb tables, ~2s of Python
+    # scalar mults) the kernels need — pay before the device claim
+    from tendermint_tpu.ops import curve as _curve
+
+    _curve.fixed_base_table()
+    _curve.base_table()
 
     # Stage 2: probe the tunnel in a KILLABLE subprocess before claiming
     # in-process. The tunnel's failure mode is a C-level hang in backend
@@ -171,13 +177,31 @@ def main():
             # compact kernel (the slice default is pathological on
             # XLA-CPU) and a single banked batch.
             os.environ["JAX_PLATFORMS"] = "cpu"
-            os.environ.setdefault("TM_TPU_FE_MUL", "dot")
+            if "TM_TPU_FE_MUL" not in os.environ:
+                os.environ["TM_TPU_FE_MUL"] = "dot"
+                # field may already be imported (table precompute):
+                # flip the live module too
+                from tendermint_tpu.ops import field as _field
+
+                _field._FE_MUL_MODE = "dot"
             BATCHES = (256,)
             PIPELINE_ITERS = min(PIPELINE_ITERS, 2)
 
     import jax
 
     enable_compile_cache(jax)
+    if platform is None and os.environ.get("BENCH_FORCE_DEVICE") != "1":
+        # jax may already be imported (the table precompute above pulls
+        # it in), so the env var alone is too late — force the platform
+        # through jax.config and drop any initialized backends, exactly
+        # as tests/conftest.py does.
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge as _xb
+
+            _xb._clear_backends()
+        except Exception:
+            pass
     _log("claiming device (jax.devices())...")
     dev = jax.devices()[0]
     _log(f"claimed: {dev.platform}:{dev.device_kind}")

@@ -115,6 +115,13 @@ if any(p != "sr" for p in todo):
     t0 = time.time()
     a, r, s, k, pre = V.prepare_batch(pks, msgs, sigs)
     log(f"host prep {MAX_B}: {time.time()-t0:.3f}s ({MAX_B/(time.time()-t0):,.0f} sigs/s)")
+    # trace-time host constants the cached/split kernels need (~2s of
+    # pure-Python scalar mults) — pay them before the claim, not in a
+    # window phase
+    from tendermint_tpu.ops import curve as _C
+
+    _C.fixed_base_table()
+    _C.base_table()
 
 sr_inputs = None
 if "sr" in todo:
