@@ -194,3 +194,50 @@ def test_batched_ops():
         zinv = pow(z, ref.P - 2, ref.P)
         want = ref_affine(ref.scalar_mult(k * s, ref.BASE))
         assert (x * zinv % ref.P, y * zinv % ref.P) == want
+
+
+def test_split_ladder_matches_oracle():
+    """double_scalar_mul_split over build_power_tables == [s]B + [k]P
+    for random scalars and points, incl. the zero scalar and a
+    small-order point (the power chains and per-chunk nibble weights
+    must line up exactly)."""
+    cases = []
+    for _ in range(3):
+        cases.append((secrets.randbelow(ref.L), secrets.randbelow(ref.L),
+                      ref.scalar_mult(secrets.randbelow(ref.L), ref.BASE)))
+    cases.append((0, secrets.randbelow(ref.L), ref.scalar_mult(7, ref.BASE)))
+    cases.append((secrets.randbelow(ref.L), 0, ref.scalar_mult(9, ref.BASE)))
+    so_enc = ref.small_order_points()[1]
+    so_pt = ref.decompress(so_enc, zip215=True)
+    cases.append((5, 3, so_pt))
+
+    n = len(cases)
+    pts = np.zeros((4, 32, n), np.int32)
+    for j, (_, _, p) in enumerate(cases):
+        x, y, z, _t = p
+        zinv = pow(z, ref.P - 2, ref.P)
+        xa, ya = x * zinv % ref.P, y * zinv % ref.P
+        ta = xa * ya % ref.P
+        for limb in range(32):
+            pts[0, limb, j] = (xa >> (8 * limb)) & 0xFF
+            pts[1, limb, j] = (ya >> (8 * limb)) & 0xFF
+            pts[3, limb, j] = (ta >> (8 * limb)) & 0xFF
+        pts[2, 0, j] = 1
+    to_arr = lambda vals: jnp.asarray(
+        np.array([[(v >> (8 * i)) & 0xFF for v in vals] for i in range(32)], np.int32))
+    tabs = jax.jit(C.build_power_tables)(jnp.asarray(pts))
+    got = np.asarray(jax.jit(C.double_scalar_mul_split)(
+        to_arr([c[0] for c in cases]), to_arr([c[1] for c in cases]), tabs))
+    for j, (s_val, k_val, p) in enumerate(cases):
+        exp = ref.point_add(ref.scalar_mult(s_val, ref.BASE), ref.scalar_mult(k_val, p))
+
+        def coord(i):
+            c = np.asarray(F.fe_canonical(jnp.asarray(got[i][:, j : j + 1])))[:, 0]
+            return F.limbs_to_int(c) % ref.P
+
+        gx, gy, gz = coord(0), coord(1), coord(2)
+        zg = pow(int(gz), ref.P - 2, ref.P)
+        ex, ey, ez, _ = exp
+        ze = pow(ez, ref.P - 2, ref.P)
+        assert gx * zg % ref.P == ex * ze % ref.P, ("x", j)
+        assert gy * zg % ref.P == ey * ze % ref.P, ("y", j)
