@@ -80,6 +80,14 @@ class KVStoreApplication(abci.Application):
             self.size = doc.get("size", 0)
             self.height = doc.get("height", 0)
             self.app_hash = base64.b64decode(doc.get("app_hash") or "")
+        else:
+            # No persisted state: reset any dirty in-memory values so a
+            # reload after a crash mid-first-block (FinalizeBlock done,
+            # Commit never arrived) reports genesis, not the uncommitted
+            # height whose effects were just discarded.
+            self.size = 0
+            self.height = 0
+            self.app_hash = b""
         self._committed = (self.height, self.size, self.app_hash)
         self.val_addr_to_pubkey = {}
         for k, v in self.db.iterator(b"val:", b"val;"):
@@ -93,9 +101,12 @@ class KVStoreApplication(abci.Application):
         decide what to replay based on Info, which must not include a
         block whose Commit never arrived."""
         with self._mu:
-            self._pending.clear()
-            self.val_updates = []
-            self._load_state()
+            self._rollback_pending_locked()
+
+    def _rollback_pending_locked(self) -> None:
+        self._pending.clear()
+        self.val_updates = []
+        self._load_state()
 
     # merged (committed + pending) views used inside a block
     def _db_get(self, key: bytes):
@@ -178,6 +189,20 @@ class KVStoreApplication(abci.Application):
 
     def finalize_block(self, req: abci.RequestFinalizeBlock) -> abci.ResponseFinalizeBlock:
         with self._mu:
+            # Replay of an in-flight block whose Commit never arrived
+            # (crash between FinalizeBlock(h) and Commit, then handshake
+            # replays h): roll back to the persisted state first so the
+            # block is not applied on top of its own dirty effects. This
+            # keeps replay idempotent even when the transport-level
+            # reload was skipped (e.g. a monitoring connection was open
+            # at reconnect time, or the reconnect raced the dead
+            # connection's cleanup).
+            if (
+                req.height
+                and req.height == self._committed[0] + 1
+                and self.height == req.height
+            ):
+                self._rollback_pending_locked()
             self.val_updates = []
             for ev in req.misbehavior:
                 if ev.type == abci.MISBEHAVIOR_DUPLICATE_VOTE:

@@ -141,15 +141,15 @@ class SocketServer:
             # A connecting client is a (possibly restarted) node whose
             # handshake trusts Info: drop any FinalizeBlock effects whose
             # Commit never arrived, so replay decisions see only
-            # persisted state. Idempotent (fresh boots have no pending).
-            reload = getattr(self.app, "reload_committed", None)
-            if reload is not None:
-                try:
-                    reload()
-                except Exception:
-                    pass
+            # persisted state. Only the FIRST connection (no live conns)
+            # triggers the reload — a secondary client (debug/monitoring
+            # tool) attaching while the primary node has a block in
+            # flight must not clear pending effects mid-block.
             with self._lock:
+                is_primary = not self._conns
                 self._conns.append(conn)
+            if is_primary:
+                self._reload_app()
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True, name="abci-conn"
             ).start()
@@ -178,6 +178,24 @@ class SocketServer:
             with self._lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+                now_idle = not self._conns
+            # Last connection gone (the node died or detached): return
+            # the app to its persisted state so the next handshake sees
+            # only committed effects, whichever connection arrives first.
+            # Together with the accept-time reload this leaves one racy
+            # window (reconnect lands BEFORE the dead conn's cleanup);
+            # apps close it by making FinalizeBlock replay idempotent,
+            # as KVStoreApplication does.
+            if now_idle and not self._stop.is_set():
+                self._reload_app()
+
+    def _reload_app(self) -> None:
+        reload = getattr(self.app, "reload_committed", None)
+        if reload is not None:
+            try:
+                reload()
+            except Exception:
+                pass
 
     def _handle(self, req: apb.RequestPB) -> apb.ResponsePB:
         try:

@@ -35,15 +35,32 @@ def initialize(coordinator_address: str | None = None,
     the reference never needed because its backend is TCP-only; here one
     call wires every host's chips into one global device set). No-op
     when already initialized or when running single-controller."""
-    if jax.process_count() > 1:
-        return  # already distributed
     if coordinator_address is None:
         return  # single-controller run: nothing to join
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    # Detect an already-joined runtime WITHOUT touching jax.process_count()
+    # or any other backend-initializing API: those would initialize XLA,
+    # after which jax.distributed.initialize refuses to run ("must be
+    # called before any JAX computations") and the join could never
+    # succeed.
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(getattr(_dist, "global_state", None), "client", None) is not None:
+            return  # already distributed
+    except ImportError:  # pragma: no cover - private API moved
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Already-joined runtime that the private-API probe failed to
+        # detect (e.g. jax._src.distributed moved): keep the documented
+        # no-op contract instead of crashing startup.
+        if "already initialized" not in str(e).lower():
+            raise
 
 
 def global_mesh() -> "jax.sharding.Mesh":
@@ -83,10 +100,13 @@ def verify_batch_sharded_local(mesh, pubkeys, msgs, sigs, key_type: str = "ed255
     ]
     fn = sv.sharded_verify_fn(mesh, kernel_impl)
     bitmap, device_all_valid = fn(*args)
-    # addressable slice of the global bitmap = this process's rows
-    local = np.concatenate(
-        [np.asarray(shard.data) for shard in bitmap.addressable_shards]
-    )[:n]
+    # addressable slice of the global bitmap = this process's rows;
+    # addressable_shards iteration order is not contractually sorted by
+    # global index, so order explicitly by each shard's global row start
+    shards = sorted(
+        bitmap.addressable_shards, key=lambda sh: sh.index[0].start or 0
+    )
+    local = np.concatenate([np.asarray(sh.data) for sh in shards])[:n]
     local &= precheck
     # global all-valid must also fold every process's HOST precheck
     # (one tiny DCN allgather; device checks are already psum-reduced)

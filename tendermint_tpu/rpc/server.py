@@ -63,10 +63,13 @@ class _WebSocketConnection:
     SEND_QUEUE_SIZE = 512
     _SENTINEL = object()
 
-    active_subs: int = 0  # maintained by JSONRPCServer under its lock
-
     def __init__(self, sock: socket.socket):
         self.sock = sock
+        # live subscription queries, maintained by JSONRPCServer under
+        # its lock: tracking the actual set (not a counter) means bogus
+        # unsubscribes cannot drive the count below the number of real
+        # live subscriptions and bypass max_subscriptions_per_client
+        self.sub_queries: set[str] = set()
         self._send_lock = threading.Lock()
         self.closed = threading.Event()
         self._out: queue.Queue = queue.Queue(maxsize=self.SEND_QUEUE_SIZE)
@@ -388,18 +391,29 @@ class JSONRPCServer:
                     if t is not None:
                         pushers.append(t)
                 elif method == "unsubscribe":
-                    if self.event_bus is not None:
-                        self.event_bus.unsubscribe(subscriber, params.get("query", ""))
+                    query = params.get("query", "")
                     with self._ws_lock:
-                        conn.active_subs = max(0, conn.active_subs - 1)
-                        if conn.active_subs == 0:
-                            self._subscriber_clients.discard(subscriber)
+                        known = query in conn.sub_queries
+                        if known:
+                            conn.sub_queries.discard(query)
+                            if not conn.sub_queries:
+                                self._subscriber_clients.discard(subscriber)
+                    if not known:
+                        conn.send_json(
+                            _rpc_response(
+                                id_,
+                                error=RPCError(ERR_INVALID_PARAMS, f"subscription not found: {query}"),
+                            )
+                        )
+                        continue
+                    if self.event_bus is not None:
+                        self.event_bus.unsubscribe(subscriber, query)
                     conn.send_json(_rpc_response(id_, result={}))
                 elif method == "unsubscribe_all":
                     if self.event_bus is not None:
                         self.event_bus.unsubscribe_all(subscriber)
                     with self._ws_lock:
-                        conn.active_subs = 0
+                        conn.sub_queries.clear()
                         self._subscriber_clients.discard(subscriber)
                     conn.send_json(_rpc_response(id_, result={}))
                 else:
@@ -433,7 +447,15 @@ class JSONRPCServer:
                     )
                 )
                 return None
-            if conn.active_subs >= self.max_subscriptions_per_client:
+            if query in conn.sub_queries:
+                conn.send_json(
+                    _rpc_response(
+                        id_,
+                        error=RPCError(ERR_INVALID_PARAMS, f"already subscribed: {query}"),
+                    )
+                )
+                return None
+            if len(conn.sub_queries) >= self.max_subscriptions_per_client:
                 conn.send_json(
                     _rpc_response(
                         id_,
@@ -445,13 +467,13 @@ class JSONRPCServer:
                 )
                 return None
             self._subscriber_clients.add(subscriber)
-            conn.active_subs += 1
+            conn.sub_queries.add(query)
         try:
             sub = self.event_bus.subscribe(subscriber, query, buffer_size=256)
         except Exception as e:
             with self._ws_lock:
-                conn.active_subs -= 1
-                if conn.active_subs <= 0:
+                conn.sub_queries.discard(query)
+                if not conn.sub_queries:
                     self._subscriber_clients.discard(subscriber)
             conn.send_json(_rpc_response(id_, error=RPCError(ERR_INTERNAL, str(e))))
             return None

@@ -189,6 +189,30 @@ def test_validator_tx_key_types():
     assert types == {"sr25519", "ed25519"}
 
 
+def test_replay_onto_dirty_state_is_idempotent():
+    """Crash between FinalizeBlock(h) and Commit, then the handshake
+    replays h WITHOUT any transport-level reload (a monitoring
+    connection kept the reload from firing, or the reconnect raced the
+    dead connection's cleanup): finalize_block itself must roll back the
+    dirty in-flight effects instead of applying h on top of them."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+    app = KVStoreApplication()
+    app.finalize_block(abci.RequestFinalizeBlock(txs=[b"a=1"], height=1))
+    app.commit()
+    res2 = app.finalize_block(abci.RequestFinalizeBlock(txs=[b"b=2", b"c=3"], height=2))
+    # crash: no Commit, no reload_committed; replay arrives directly
+    res2b = app.finalize_block(abci.RequestFinalizeBlock(txs=[b"b=2", b"c=3"], height=2))
+    assert res2b.app_hash == res2.app_hash
+    assert app.height == 2  # not double-incremented
+    app.commit()
+    info = app.info(abci.RequestInfo())
+    assert info.last_block_height == 2
+    assert app.query(abci.RequestQuery(data=b"b")).value == b"2"
+    assert app.query(abci.RequestQuery(data=b"c")).value == b"3"
+
+
 def test_uncommitted_block_invisible_after_reconnect():
     """ABCI contract: Info reports the last PERSISTED height. A node
     killed between FinalizeBlock and Commit reconnects (the transports

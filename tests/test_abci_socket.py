@@ -99,6 +99,49 @@ def test_socket_roundtrip_kvstore(socket_pair):
     assert q.value == b"v"
 
 
+def test_secondary_connection_keeps_pending_block(socket_pair):
+    """A second client (debug/monitoring tool) connecting while the
+    primary has a block in flight must NOT clear the app's pending
+    FinalizeBlock effects — only the FIRST connection triggers
+    reload_committed."""
+    app, srv, client = socket_pair
+    f = client.finalize_block(
+        abci.RequestFinalizeBlock(txs=[b"pend=1"], height=1, hash=b"\x02" * 32)
+    )
+    assert f.tx_results[0].is_ok
+    # block in flight (no Commit yet); a monitoring client attaches
+    client2 = SocketClient(srv.listen_addr, timeout=10.0)
+    client2.start()
+    try:
+        assert client2.echo("probe") == "probe"
+        # the pending block must survive the secondary accept
+        client.commit()
+        q = client.query(abci.RequestQuery(path="/store", data=b"pend"))
+        assert q.value == b"1"
+        assert client.info(abci.RequestInfo()).last_block_height == 1
+    finally:
+        client2.stop()
+
+
+def test_reload_after_crash_mid_first_block():
+    """Crash between FinalizeBlock(1) and Commit with NO prior persisted
+    state: reload must reset in-memory height/size/app_hash to genesis,
+    not keep reporting the uncommitted height whose effects were
+    discarded."""
+    app = KVStoreApplication()
+    app.finalize_block(abci.RequestFinalizeBlock(txs=[b"x=1"], height=1))
+    app.reload_committed()  # crash + reconnect before any Commit
+    info = app.info(abci.RequestInfo())
+    assert info.last_block_height == 0
+    assert info.last_block_app_hash in (b"", None)
+    # replaying block 1 now applies cleanly
+    res = app.finalize_block(abci.RequestFinalizeBlock(txs=[b"x=1"], height=1))
+    assert res.tx_results[0].is_ok
+    app.commit()
+    assert app.info(abci.RequestInfo()).last_block_height == 1
+    assert app.query(abci.RequestQuery(data=b"x")).value == b"1"
+
+
 def test_socket_pipelining(socket_pair):
     _, _, client = socket_pair
     # many concurrent callers; FIFO matching must never cross wires

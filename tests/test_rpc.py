@@ -350,6 +350,21 @@ def test_rpc_dos_guards_and_cors(live_node):
             ws.subscribe("tm.event = 'NewBlock'")
             with pytest.raises(Exception, match="max_subscriptions_per_client"):
                 ws.subscribe("tm.event = 'Tx'")
+            # bogus unsubscribes (never-subscribed queries) must error and
+            # must NOT free cap slots: the server tracks the live query
+            # set, not a decrementable counter
+            with pytest.raises(Exception, match="subscription not found"):
+                ws.call("unsubscribe", query="tm.event = 'Vote'")
+            with pytest.raises(Exception, match="subscription not found"):
+                ws.call("unsubscribe", query="tm.event = 'Vote'")
+            with pytest.raises(Exception, match="max_subscriptions_per_client"):
+                ws.subscribe("tm.event = 'Tx'")
+            # duplicate subscribe of a live query is rejected too
+            with pytest.raises(Exception, match="already subscribed|max_subscriptions"):
+                ws.subscribe("tm.event = 'NewBlock'")
+            # a REAL unsubscribe frees the slot
+            ws.call("unsubscribe", query="tm.event = 'NewBlock'")
+            ws.subscribe("tm.event = 'Tx'")
         finally:
             ws.close()
     finally:
