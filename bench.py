@@ -161,17 +161,42 @@ def main():
     _curve.fixed_base_table()
     _curve.base_table()
 
-    # Stage 2: probe the tunnel in a KILLABLE subprocess before claiming
-    # in-process. The tunnel's failure mode is a C-level hang in backend
-    # init that no signal can interrupt (BENCH_r02/r03 died exactly
-    # here); if the probe can't reach a device within its deadline, bank
-    # a CPU-backend number with an honest vs_baseline < 1 instead of
-    # producing no number at all. BENCH_FORCE_DEVICE=1 skips the probe.
+    # Stage 2: probe the tunnel in KILLABLE subprocesses, REPEATEDLY,
+    # across the whole budget. The tunnel's failure mode is a C-level
+    # hang in backend init that no signal can interrupt (BENCH_r02/r03
+    # died exactly here), and it recovers in windows (r3/r4 postmortem)
+    # — so one failed probe must not write off the device for the run
+    # (BENCH_r04 banked a 0.014x CPU number doing exactly that). Keep
+    # probing until only the CPU-fallback reserve remains; fall back to
+    # a CPU-backend number with an honest vs_baseline < 1 only in those
+    # final minutes. BENCH_FORCE_DEVICE=1 skips the probes.
     platform = None
     if os.environ.get("BENCH_FORCE_DEVICE") != "1":
-        _log("probing device in subprocess...")
-        platform = probe_device(timeout=min(180.0, max(60.0, _remaining() - 300)))
-        _log(f"probe: {platform or 'TIMEOUT/none'}")
+        reserve = float(os.environ.get("BENCH_CPU_RESERVE", "300"))
+        while _remaining() > reserve + 45:
+            t = min(150.0, _remaining() - reserve)
+            _log(f"probing device in subprocess (timeout {t:.0f}s, {_remaining():.0f}s left)...")
+            t0 = time.monotonic()
+            platform = probe_device(timeout=t)
+            _log(f"probe: {platform or 'TIMEOUT/none'}")
+            if platform is not None:
+                break
+            # Back off between failed probes. NOTE the tradeoff vs the
+            # probe_device docstring's original single-shot rationale:
+            # killing a hung mid-claim child can wedge the server-side
+            # grant for a while, and this loop kills one per timed-out
+            # probe — but the observed windows (r3/r4) open and close on
+            # tunnel health, not grant state, and a wedged grant decays
+            # on its own; a 60s post-kill pause gives it room without
+            # giving up the rest of the budget.
+            slept = time.monotonic() - t0
+            pause = 30.0 if slept < 30 else 60.0
+            if _remaining() > reserve + 45 + pause:
+                time.sleep(pause)
+        if platform == "cpu":
+            # ambient env has no device at all; probing again cannot
+            # change the answer — take the fallback path directly
+            platform = None
         if platform is None:
             # Tunnel wedged: fall back to the CPU backend with the
             # compact kernel (the slice default is pathological on
@@ -246,6 +271,36 @@ def main():
             _log("cached stage hit deadline; keeping uncached result")
         except Exception as e:  # noqa: BLE001
             _log(f"cached stage failed: {type(e).__name__}: {e}")
+    # Stage 5: the RLC/MSM all-valid fast path — production phase 1 for
+    # batches >= the MSM cutover (crypto/ed25519.py), i.e. the rate the
+    # framework actually verifies honest commits at. Only ever improves
+    # the banked line.
+    if best and _remaining() > 75:
+        from tendermint_tpu.ops import msm as M
+
+        pks, msgs, sigs = (x[:best_batch] for x in jobs)
+        try:
+            with stage_deadline(min(_remaining() - 15, 300)):
+                h = M.verify_batch_rlc_async(pks, msgs, sigs)
+                assert M.collect_rlc(h), "MSM rejected valid batch (warm-up)"
+                t0 = time.perf_counter()
+                inflight = [
+                    M.verify_batch_rlc_async(pks, msgs, sigs)
+                    for _ in range(PIPELINE_ITERS)
+                ]
+                oks = [M.collect_rlc(x) for x in inflight]
+                dt = (time.perf_counter() - t0) / PIPELINE_ITERS
+            assert all(oks), "MSM rejected valid batch"
+            rate = best_batch / dt
+            _log(f"batch {best_batch} msm: {rate:,.0f} sigs/s pipelined")
+            if rate > best:
+                best = rate
+                emit(best, cpu_rate)
+        except StageTimeout:
+            _log("msm stage hit deadline; keeping prior result")
+        except Exception as e:  # noqa: BLE001
+            _log(f"msm stage failed: {type(e).__name__}: {e}")
+
     if best:
         # Re-emit so the final stdout line is the best banked number
         # regardless of any later stderr interleaving in the driver's

@@ -141,6 +141,20 @@ def _pk_cache_enabled() -> bool:
 # 4-validator commit must not regress vs CPU. Tunable for benchmarking.
 DEVICE_BATCH_CUTOVER = int(os.environ.get("TM_TPU_BATCH_CUTOVER", "64"))
 
+# At or above this batch size the randomized-linear-combination MSM
+# kernel (ops/msm.py — ONE combined equation, doublings amortized away)
+# runs first and the per-signature bitmap kernel only on failure — the
+# reference's two-phase shape (types/validation.go:245-255). Below it
+# the MSM's Horner/reduce tail isn't amortized. TM_TPU_MSM=off disables
+# the fast path entirely.
+MSM_BATCH_CUTOVER = int(os.environ.get("TM_TPU_MSM_CUTOVER", "256"))
+
+
+def _msm_enabled() -> bool:
+    return os.environ.get("TM_TPU_MSM", "on").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
 try:  # native (OpenSSL) fast path for single verification
     from cryptography.exceptions import InvalidSignature as _InvalidSignature
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -212,14 +226,35 @@ class Ed25519BatchVerifier(BatchVerifier):
         if _use_device() and n >= DEVICE_BATCH_CUTOVER:
             from ..ops import verify as dev
 
-            # HBM pubkey cache (the reference's expanded-key LRU,
-            # ed25519.go:57, lifted to device memory): production
-            # commits reuse the same validator keys height after
-            # height. TM_TPU_PK_CACHE=off forces the uncached kernel.
-            if _pk_cache_enabled():
-                dispatched = dev.verify_batch_cached_async(self._pks, self._msgs, self._sigs)
-            else:
-                dispatched = dev.verify_batch_async(self._pks, self._msgs, self._sigs)
+            def bitmap_async():
+                # HBM pubkey cache (the reference's expanded-key LRU,
+                # ed25519.go:57, lifted to device memory): production
+                # commits reuse the same validator keys height after
+                # height. TM_TPU_PK_CACHE=off forces the uncached kernel.
+                if _pk_cache_enabled():
+                    return dev.verify_batch_cached_async(self._pks, self._msgs, self._sigs)
+                return dev.verify_batch_async(self._pks, self._msgs, self._sigs)
+
+            if _msm_enabled() and n >= MSM_BATCH_CUTOVER:
+                # Phase 1: the RLC/MSM all-valid fast path; phase 2 (on
+                # failure or precheck refusal) localizes with the bitmap
+                # kernel. All-valid batches accept deterministically, so
+                # the final (ok, bitmap) is identical to the per-sig
+                # plane; failure costs one extra launch, like the
+                # reference's serial re-verify (types/validation.go:245).
+                from ..ops import msm as dev_msm
+
+                handle = dev_msm.verify_batch_rlc_async(self._pks, self._msgs, self._sigs)
+
+                def complete_msm():
+                    if handle is not None and dev_msm.collect_rlc(handle):
+                        return True, [True] * n
+                    bools = [bool(b) for b in dev.collect(bitmap_async())]
+                    return all(bools), bools
+
+                return complete_msm
+
+            dispatched = bitmap_async()
 
             def complete():
                 bools = [bool(b) for b in dev.collect(dispatched)]

@@ -308,6 +308,69 @@ static void prepare_range(const uint8_t *pks, const uint8_t *sigs,
     }
 }
 
+/* ---------------------------------------------------- RLC randomizers */
+
+static void load_le(const uint8_t *b, int nbytes, u64 *out, int nlimbs) {
+    for (int i = 0; i < nlimbs; i++) {
+        out[i] = 0;
+        for (int j = 0; j < 8; j++) {
+            int idx = 8 * i + j;
+            if (idx < nbytes) out[i] |= (u64)b[idx] << (8 * j);
+        }
+    }
+}
+
+/* (2-limb a) * (4-limb b) -> 64-byte LE buffer (6 limbs + 2 zero), fed
+ * straight back through tm_mod_l's 512-bit Horner reduction. */
+static void mul_2x4_modl(const u64 a[2], const u64 b[4], uint8_t out[32]) {
+    u64 prod[8] = {0};
+    for (int i = 0; i < 2; i++) {
+        u64 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)a[i] * b[j] + prod[i + j] + carry;
+            prod[i + j] = (u64)t;
+            carry = (u64)(t >> 64);
+        }
+        prod[i + 4] += carry; /* top limb of this row; prod[5] <= 2^64-1, no overflow */
+    }
+    uint8_t buf[64];
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++) buf[8 * i + j] = (uint8_t)(prod[i] >> (8 * j));
+    tm_mod_l(buf, out);
+}
+
+/* Host-side scalar math for the RLC/MSM batch equation (ops/msm.py):
+ * per signature zk_i = z_i * k_i mod L, plus zs = sum z_i * s_i mod L.
+ * z_raw: n*16 LE randomizers; s/k rows: n*32 LE (k already < L).
+ * Exported alongside prepare_batch so the MSM path's host cost keeps
+ * up with the chip (the pure-Python loop tops out ~280k sigs/s). */
+void tm_rlc_scalars(const uint8_t *z_raw, const uint8_t *s_rows,
+                    const uint8_t *k_rows, int64_t n,
+                    uint8_t *zk_out, uint8_t *zs_out) {
+    u64 acc[4] = {0, 0, 0, 0};
+    for (int64_t i = 0; i < n; i++) {
+        u64 z[2], k4[4], s4[4];
+        load_le(z_raw + 16 * i, 16, z, 2);
+        load_le(k_rows + 32 * i, 32, k4, 4);
+        load_le(s_rows + 32 * i, 32, s4, 4);
+        mul_2x4_modl(z, k4, zk_out + 32 * i);
+        uint8_t zsm[32];
+        mul_2x4_modl(z, s4, zsm);
+        u64 t4[4];
+        load_le(zsm, 32, t4, 4);
+        /* acc = (acc + t4) mod L; both < L < 2^253 so the sum fits */
+        u64 cy = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)acc[j] + t4[j] + cy;
+            acc[j] = (u64)t;
+            cy = (u64)(t >> 64);
+        }
+        if (ge(acc, L_LIMBS, 4)) sub_n(acc, L_LIMBS, 4, 4);
+    }
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++) zs_out[8 * i + j] = (uint8_t)(acc[i] >> (8 * j));
+}
+
 typedef struct {
     const uint8_t *pks, *sigs, *msgs;
     const int64_t *offsets;

@@ -155,10 +155,11 @@ _NIBBLES = 64
 
 
 def scalar_to_nibbles(s_bytes):
-    """(32, B) byte values -> (64, B) little-endian 4-bit windows."""
+    """(n_bytes, B) byte values -> (2*n_bytes, B) little-endian 4-bit
+    windows (64 for full scalars; 32 for the MSM's 128-bit z_i)."""
     lo = s_bytes & 0x0F
     hi = (s_bytes >> 4) & 0x0F
-    return jnp.stack([lo, hi], axis=1).reshape((_NIBBLES,) + s_bytes.shape[1:])
+    return jnp.stack([lo, hi], axis=1).reshape((2 * s_bytes.shape[0],) + s_bytes.shape[1:])
 
 
 def _select16(table, nib):

@@ -9,6 +9,8 @@ all-valid verdict is an AND-reduce over ICI implemented as
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -162,6 +164,90 @@ def verify_batch_sharded_cached(mesh: Mesh, pubkeys, msgs, sigs, key_type: str =
     bitmap, device_all_valid = fn(*args)
     bitmap = np.asarray(bitmap)[:n] & precheck
     return bitmap, bool(device_all_valid) and bool(precheck.all())
+
+
+def sharded_rlc_fn(mesh: Mesh):
+    """Sharded RLC/MSM verifier: each chip evaluates the combined
+    equation over ITS shard (any subset of valid signatures sums to the
+    identity, so per-shard checks are individually sound) with a
+    per-shard zs partial sum, and the global verdict is the same one
+    psum AND-reduce as the bitmap plane — MSM sharding needs no point
+    collectives at all."""
+    from ..ops import msm as M
+
+    key = (mesh, "rlc")
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        spec = P(AXIS)
+
+        def local(a_enc, r_enc, zk, z, zs_row):
+            ok = M.msm_verify_kernel_impl(a_enc, r_enc, zk, z, zs_row)
+            return jax.lax.psum(jnp.where(ok, 0, 1), AXIS) == 0
+
+        fn = jax.jit(
+            shard_map(
+                local,
+                mesh=mesh,
+                in_specs=(spec, spec, spec, spec, spec),
+                out_specs=P(),
+            )
+        )
+        _FN_CACHE[key] = fn
+    return fn
+
+
+def verify_batch_sharded_rlc(mesh: Mesh, pubkeys, msgs, sigs, z_raw: bytes | None = None):
+    """All-valid fast path over the mesh: True iff every signature is
+    valid (deterministic for valid sets); False directs the caller to a
+    bitmap plane for localization (verify_batch_sharded), mirroring the
+    single-chip two-phase dispatch. ed25519 only — sr25519's RLC plane
+    would need its own challenge transcripting."""
+    from ..ops import msm as M
+
+    n = len(sigs)
+    if n == 0:
+        return False
+    a_enc, r_enc, s_rows, k_rows, precheck = V.prepare_batch(pubkeys, msgs, sigs)
+    if not precheck.all():
+        return False
+    if z_raw is None:
+        z_raw = os.urandom(16 * n)
+    elif len(z_raw) != 16 * n:
+        raise ValueError(f"z_raw must be {16 * n} bytes, got {len(z_raw)}")
+    n_dev = mesh.devices.size
+    per_dev = -(-n // n_dev)
+    if per_dev <= 256:
+        per_dev = V._pad_pow2(per_dev, floor=8)
+    else:
+        per_dev = -(-per_dev // 256) * 256
+    size = per_dev * n_dev
+    # per-shard scalar math: one native _rlc_scalars call per shard
+    # slice yields that shard's zk rows AND its zs partial sum directly
+    # (shard d's equation covers exactly its own rows)
+    zk = np.zeros((size, 32), np.uint8)
+    z_rows = np.zeros((size, 16), np.uint8)
+    zs_shards = np.zeros((n_dev, 32), np.uint8)
+    for d in range(n_dev):
+        lo, hi = d * per_dev, min((d + 1) * per_dev, n)
+        if lo >= hi:
+            break
+        zk_d, z_d, zs_d = M._rlc_scalars(
+            s_rows[lo:hi], k_rows[lo:hi], hi - lo, z_raw[16 * lo : 16 * hi]
+        )
+        zk[lo:hi] = zk_d
+        z_rows[lo:hi] = z_d
+        zs_shards[d] = zs_d[0]
+    pad = size - n
+    if pad:
+        a_enc = np.pad(a_enc, ((0, pad), (0, 0)))
+        r_enc = np.pad(r_enc, ((0, pad), (0, 0)))
+    fn = sharded_rlc_fn(mesh)
+    sharding = NamedSharding(mesh, P(AXIS))
+    args = [
+        jax.device_put(jnp.asarray(x), sharding)
+        for x in (a_enc, r_enc, zk, z_rows, zs_shards)
+    ]
+    return bool(fn(*args))
 
 
 def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs, key_type: str = "ed25519"):

@@ -106,3 +106,36 @@ def test_mod_l_adversarial_digests():
     cases += [rng.randbytes(64) for _ in range(2000)]
     for d in cases:
         assert c_mod_l(d) == int.from_bytes(d, "little") % L, d.hex()
+
+
+def test_native_rlc_scalars_matches_python_oracle():
+    """tm_rlc_scalars (z*k mod L rows + running z*s sum) vs the Python
+    big-int oracle, including adversarial z values (0, all-ones) and
+    s at the L boundary."""
+    from tendermint_tpu.ops import msm
+
+    rng = np.random.RandomState(9)
+    n = 300
+    s_rows = np.zeros((n, 32), np.uint8)
+    k_rows = np.zeros((n, 32), np.uint8)
+    z_raw = bytearray(rng.randint(0, 256, 16 * n, dtype=np.uint8).tobytes())
+    for i in range(n):
+        # s, k uniformly < L (mod-reduce random 256-bit draws)
+        s_rows[i] = np.frombuffer(
+            (int.from_bytes(rng.randint(0, 256, 32, dtype=np.uint8).tobytes(), "little")
+             % msm.L).to_bytes(32, "little"), np.uint8)
+        k_rows[i] = np.frombuffer(
+            (int.from_bytes(rng.randint(0, 256, 32, dtype=np.uint8).tobytes(), "little")
+             % msm.L).to_bytes(32, "little"), np.uint8)
+    # adversarial lanes
+    z_raw[0:16] = b"\x00" * 16
+    z_raw[16:32] = b"\xff" * 16
+    s_rows[2] = np.frombuffer((msm.L - 1).to_bytes(32, "little"), np.uint8)
+    k_rows[3] = np.frombuffer((msm.L - 1).to_bytes(32, "little"), np.uint8)
+    z_raw = bytes(z_raw)
+
+    zk_n, z_n, zs_n = msm._rlc_scalars(s_rows, k_rows, n, z_raw)
+    zk_p, z_p, zs_p = msm._rlc_scalars_py(s_rows, k_rows, n, z_raw)
+    assert (zk_n == zk_p).all()
+    assert (z_n == z_p).all()
+    assert (zs_n == zs_p).all()
