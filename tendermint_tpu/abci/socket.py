@@ -95,6 +95,10 @@ class SocketServer:
         self._stop = threading.Event()
         self._conns: list[socket.socket] = []
         self._lock = threading.Lock()
+        # bumped on every accept: lets the disconnect-path reload detect
+        # that a new connection raced in between "last conn gone" and
+        # the reload actually running (lock order: _app_mtx -> _lock)
+        self._accept_gen = 0
 
     def start(self) -> None:
         family, sockaddr = _parse_addr(self.addr)
@@ -148,6 +152,7 @@ class SocketServer:
             with self._lock:
                 is_primary = not self._conns
                 self._conns.append(conn)
+                self._accept_gen += 1
             if is_primary:
                 self._reload_app()
             threading.Thread(
@@ -179,19 +184,33 @@ class SocketServer:
                 if conn in self._conns:
                     self._conns.remove(conn)
                 now_idle = not self._conns
+                gen = self._accept_gen
             # Last connection gone (the node died or detached): return
             # the app to its persisted state so the next handshake sees
             # only committed effects, whichever connection arrives first.
-            # Together with the accept-time reload this leaves one racy
-            # window (reconnect lands BEFORE the dead conn's cleanup);
-            # apps close it by making FinalizeBlock replay idempotent,
-            # as KVStoreApplication does.
+            # The generation re-check under _app_mtx prevents a stale
+            # cleanup thread from firing AFTER a reconnected node has
+            # already replayed onto the app (which would clobber its
+            # in-flight block). Together with the accept-time reload
+            # this leaves one racy window (reconnect lands BEFORE the
+            # dead conn's cleanup decides idle); apps close it by making
+            # FinalizeBlock replay idempotent, as KVStoreApplication
+            # does.
             if now_idle and not self._stop.is_set():
-                self._reload_app()
+                self._reload_app(if_gen=gen)
 
-    def _reload_app(self) -> None:
+    def _reload_app(self, if_gen: int | None = None) -> None:
         reload = getattr(self.app, "reload_committed", None)
-        if reload is not None:
+        if reload is None:
+            return
+        # _app_mtx serializes the reload against in-flight app calls;
+        # no other path acquires _lock while holding _app_mtx, so the
+        # _app_mtx -> _lock order here cannot deadlock.
+        with self._app_mtx:
+            if if_gen is not None:
+                with self._lock:
+                    if self._accept_gen != if_gen or self._conns:
+                        return  # a new connection raced in; not idle
             try:
                 reload()
             except Exception:

@@ -77,6 +77,12 @@ class _NopWAL:
     def search_for_end_height(self, height):
         return []
 
+    def search_for_end_height_with_status(self, height):
+        return [], True
+
+    def repair(self):
+        return False
+
 
 class ConsensusState:
     """ref: consensus.State (internal/consensus/state.go:123)."""
@@ -1155,9 +1161,21 @@ class ConsensusState:
             self.replay_mode = False
 
     def _catchup_replay(self) -> None:
-        """Replay WAL messages since the last EndHeight
-        (ref: catchupReplay replay.go:97)."""
-        msgs = self.wal.search_for_end_height(self.rs.height - 1)
+        """Replay WAL messages since the last EndHeight, with
+        repair-and-retry on corruption: back up the damaged file,
+        truncate it at the corruption point, and replay the clean
+        prefix (ref: catchupReplay replay.go:97; the repair loop
+        state.go:420-466, one attempt then fail)."""
+        repair_attempted = False
+        while True:
+            msgs, clean = self.wal.search_for_end_height_with_status(self.rs.height - 1)
+            if clean:
+                break
+            if repair_attempted:
+                raise RuntimeError("consensus WAL corrupted and repair failed")
+            self.logger.error("the WAL file is corrupted; attempting repair")
+            self.wal.repair()
+            repair_attempted = True
         if msgs is None:
             return
         for m in msgs:

@@ -259,48 +259,106 @@ class WAL:
 
     # ------------------------------------------------------------ replay
 
-    def _read_all(self) -> list:
+    @staticmethod
+    def _scan_file(data: bytes):
+        """(decoded msgs, bytes consumed, clean) for one file's bytes."""
+        out = []
+        consumed = 0
+        for pos, payload in iter_wal_records(data):
+            try:
+                out.append(_decode_msg(json.loads(payload)))
+            except Exception:
+                return out, consumed, False
+            consumed = pos + 8 + len(payload)
+        # a torn/corrupt frame stops the iterator short of the end
+        return out, consumed, consumed == len(data)
+
+    def _paths_snapshot(self) -> list[str]:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+            return self._rotated_paths() + (
+                [self._path] if os.path.exists(self._path) else []
+            )
+
+    def read_all_with_status(self) -> tuple[list, bool]:
         """Decode every intact record across the rotated set + head,
         oldest first; stop at the FIRST corruption anywhere and do not
         read later files — replaying past a hole would hand the state
         machine a log with a silent gap (the reference's repairWalFile
-        truncates at the corruption point for the same reason)."""
+        truncates at the corruption point for the same reason,
+        state.go:2735). Returns (msgs, clean)."""
         out = []
+        for path in self._paths_snapshot():
+            with open(path, "rb") as f:
+                data = f.read()
+            msgs, _, clean = self._scan_file(data)
+            out.extend(msgs)
+            if not clean:
+                return out, False  # truncate replay at the corruption
+        return out, True
+
+    def _read_all(self) -> list:
+        return self.read_all_with_status()[0]
+
+    def repair(self) -> bool:
+        """Repair-and-continue after corruption (ref: state.go:441-466 +
+        repairWalFile state.go:2735): back up the corrupt file to
+        `<file>.CORRUPTED`, rewrite it keeping only the records before
+        the corruption point, and back up + drop any LATER files (their
+        records are beyond the hole; keeping them would splice a silent
+        gap into the log). Appends then continue on the clean tail.
+        Returns True if anything was repaired; False if the set was
+        already clean."""
         with self._lock:
             if not self._f.closed:
                 self._f.flush()
             paths = self._rotated_paths() + (
                 [self._path] if os.path.exists(self._path) else []
             )
-        for path in paths:
-            with open(path, "rb") as f:
-                data = f.read()
-            consumed = 0
-            clean = True
-            for pos, payload in iter_wal_records(data):
-                try:
-                    out.append(_decode_msg(json.loads(payload)))
-                except Exception:
-                    clean = False
+            corrupt_idx = None
+            intact = b""
+            for fi, path in enumerate(paths):
+                with open(path, "rb") as f:
+                    data = f.read()
+                _, consumed, clean = self._scan_file(data)
+                if not clean:
+                    corrupt_idx = fi
+                    intact = data[:consumed]
                     break
-                consumed = pos + 8 + len(payload)
-            if clean and consumed < len(data):
-                clean = False  # torn/corrupt frame stopped the iterator
-            if not clean:
-                break  # truncate replay at the corruption point
-        return out
+            if corrupt_idx is None:
+                return False
+            if not self._f.closed:
+                self._f.close()
+            bad = paths[corrupt_idx]
+            os.replace(bad, bad + ".CORRUPTED")
+            with open(bad, "wb") as f:
+                f.write(intact)
+                f.flush()
+                os.fsync(f.fileno())
+            for later in paths[corrupt_idx + 1 :]:
+                os.replace(later, later + ".CORRUPTED")
+            # reopen (or recreate) the head for appends
+            self._f = open(self._path, "ab")
+            self._fsync_dir()
+            return True
 
     def search_for_end_height(self, height: int) -> list | None:
         """Messages after EndHeight(height), or None if not found
         (ref: SearchForEndHeight wal.go:261; height 0 always 'found' so
         fresh chains replay from the start)."""
-        msgs = self._read_all()
+        return self.search_for_end_height_with_status(height)[0]
+
+    def search_for_end_height_with_status(self, height: int):
+        """(messages-after-EndHeight | None, clean) — the clean flag
+        drives the caller's repair-and-retry loop (ref: state.go:425)."""
+        msgs, clean = self.read_all_with_status()
         if height == 0:
-            return msgs
+            return msgs, clean
         idx = None
         for i, m in enumerate(msgs):
             if isinstance(m, EndHeightMessage) and m.height == height:
                 idx = i
         if idx is None:
-            return None
-        return msgs[idx + 1 :]
+            return None, clean
+        return msgs[idx + 1 :], clean

@@ -58,8 +58,15 @@ def initialize(coordinator_address: str | None = None,
     except RuntimeError as e:
         # Already-joined runtime that the private-API probe failed to
         # detect (e.g. jax._src.distributed moved): keep the documented
-        # no-op contract instead of crashing startup.
-        if "already initialized" not in str(e).lower():
+        # no-op contract instead of crashing startup. jax 0.9 raises
+        # "distributed.initialize should only be called once"; older/
+        # newer wordings covered by the other patterns.
+        msg = str(e).lower()
+        # Only the already-joined wordings are safe to swallow; "must be
+        # called before any JAX computations" means the join is
+        # IMPOSSIBLE (init-order bug) and must stay loud — swallowing it
+        # would silently degrade a multihost deployment to single-host.
+        if not any(pat in msg for pat in ("already initialized", "only be called once")):
             raise
 
 

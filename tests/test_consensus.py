@@ -261,6 +261,132 @@ def test_wal_mid_set_corruption_truncates_replay(tmp_path):
     wal.close()
 
 
+def test_ticker_ignores_stale_schedules():
+    """ref ticker.go:99-110: a schedule for an OLDER (height, round,
+    step) than the last scheduled one must be ignored — without the
+    gate, a stale scheduleRound0 after WAL catchup replay cancels the
+    armed later-step timer and wedges the node mid-height."""
+    import time as _t
+
+    from tendermint_tpu.consensus.ticker import TimeoutTicker
+    from tendermint_tpu.consensus.wal import TimeoutInfo
+
+    fired = []
+    tick = TimeoutTicker(lambda ti: fired.append(ti))
+    # replay armed the propose timer for (2, 0, step 3)...
+    tick.schedule_timeout(TimeoutInfo(0.15, 2, 0, 3))
+    # ...then a stale scheduleRound0 tries (2, 0, step 1): ignored
+    tick.schedule_timeout(TimeoutInfo(0.0, 2, 0, 1))
+    _t.sleep(0.05)
+    assert fired == [], "stale schedule replaced the armed timer"
+    _t.sleep(0.2)
+    assert [(t.height, t.round, t.step) for t in fired] == [(2, 0, 3)]
+    # same height, LATER step replaces; later height always replaces
+    tick.schedule_timeout(TimeoutInfo(10.0, 2, 0, 5))
+    tick.schedule_timeout(TimeoutInfo(0.05, 3, 0, 1))
+    _t.sleep(0.2)
+    assert [(t.height, t.round, t.step) for t in fired][-1] == (3, 0, 1)
+    # older height ignored even after a fire (last-scheduled persists)
+    tick.schedule_timeout(TimeoutInfo(0.0, 2, 9, 9))
+    _t.sleep(0.1)
+    assert len(fired) == 2
+    tick.stop()
+
+
+def test_wal_repair_mid_file_and_continue(tmp_path):
+    """repair-and-continue (VERDICT r4 item 6; ref repairWalFile
+    state.go:2735): mid-file corruption -> repair() backs the file up
+    to *.CORRUPTED, truncates at the corruption point, and appends
+    continue on the clean tail; the repaired set replays clean."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    path = os.path.join(tmp_path, "cs.wal")
+    wal = WAL(path)
+    for h in range(1, 50):
+        wal.write_sync(EndHeightMessage(height=h))
+    # torn MID-file damage (not just the tail)
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        f.write(b"\xde\xad\xbe\xef\xde\xad")
+    msgs, clean = wal.read_all_with_status()
+    assert not clean
+    prefix = [m.height for m in msgs]
+    assert prefix and prefix[-1] < 49
+    assert wal.repair() is True
+    assert os.path.exists(path + ".CORRUPTED")
+    # repaired set is clean and equals the intact prefix
+    msgs2, clean2 = wal.read_all_with_status()
+    assert clean2
+    assert [m.height for m in msgs2] == prefix
+    # appends continue on the clean tail and replay end-to-end
+    for h in (900, 901):
+        wal.write_sync(EndHeightMessage(height=h))
+    msgs3, clean3 = wal.read_all_with_status()
+    assert clean3
+    assert [m.height for m in msgs3] == prefix + [900, 901]
+    assert wal.repair() is False  # already clean: no-op
+    wal.close()
+
+
+def test_wal_repair_corrupt_rotated_drops_later_files(tmp_path):
+    """Corruption in a ROTATED file: repair truncates there and backs up
+    every LATER file (records beyond the hole must not splice a silent
+    gap into the log)."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    path = os.path.join(tmp_path, "cs.wal")
+    wal = WAL(path, max_file_size=512, max_files=4)
+    for h in range(1, 200):
+        wal.write_sync(EndHeightMessage(height=h))
+    rotated = wal._rotated_paths()
+    assert len(rotated) >= 2
+    victim = rotated[0]
+    with open(victim, "r+b") as f:
+        f.seek(os.path.getsize(victim) // 2)
+        f.write(b"\xde\xad\xbe\xef")
+    truncated = [m.height for m in wal._read_all()]
+    assert wal.repair() is True
+    assert os.path.exists(victim + ".CORRUPTED")
+    # later rotated files AND the old head were backed up, not kept live
+    for later in rotated[1:]:
+        assert not os.path.exists(later)
+        assert os.path.exists(later + ".CORRUPTED")
+    msgs, clean = wal.read_all_with_status()
+    assert clean
+    assert [m.height for m in msgs] == truncated
+    # appends land on a fresh head and replay contiguously
+    wal.write_sync(EndHeightMessage(height=500))
+    assert [m.height for m in wal._read_all()] == truncated + [500]
+    wal.close()
+
+
+def test_node_start_repairs_corrupt_wal(tmp_path):
+    """Node-level repair-and-continue: a validator whose WAL was torn
+    mid-file starts, repairs, replays the clean prefix, and keeps
+    producing blocks (ref: the state.go:420-466 repair loop)."""
+    wal_path = os.path.join(tmp_path, "cs.wal")
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    node = make_node(keys, 0, gen_doc, wal_path=wal_path)
+    node.start()
+    try:
+        assert wait_for_height([node], 2, timeout=30)
+    finally:
+        node.stop()
+    size = os.path.getsize(wal_path)
+    with open(wal_path, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xff" * 8)
+    node2 = make_node(keys, 0, gen_doc, wal_path=wal_path)
+    node2.start()
+    try:
+        assert wait_for_height([node2], 3, timeout=30)
+    finally:
+        node2.stop()
+    assert os.path.exists(wal_path + ".CORRUPTED"), "repair did not back up the WAL"
+
+
 def test_wal_legacy_suffix_migration(tmp_path):
     """3-digit rotated segments from the earlier rotation scheme are
     renamed into the 9-digit sequence on open, so upgraded nodes keep
