@@ -63,6 +63,9 @@ class RPCConfig:
     max_subscription_clients: int = 100
     max_subscriptions_per_client: int = 5
     cors_allowed_origins: str = ""  # comma-separated; "*" allows all
+    # ref: RPCConfig.Unsafe (config.go:429): activates unsafe_* routes
+    # (flush-mempool, partition fault injection). Never in production.
+    unsafe: bool = False
 
 
 @dataclass

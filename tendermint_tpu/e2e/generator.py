@@ -33,7 +33,7 @@ ABCI_MODES = ("builtin", "outofprocess")
 
 ABCI_PROTOCOLS = {"tcp": 20, "grpc": 20, "unix": 10}  # generate.go:36-40
 KEY_TYPES = {"ed25519": 60, "secp256k1": 20, "sr25519": 20}
-PERTURBATIONS = {"disconnect": 0.1, "pause": 0.1, "kill": 0.1, "restart": 0.1}
+PERTURBATIONS = {"disconnect": 0.1, "pause": 0.1, "kill": 0.1, "restart": 0.1, "partition": 0.1}
 # ref: generate.go:134-147 abciDelays none/small/large
 DELAY_PROFILES = {
     "none": {},
@@ -112,6 +112,12 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
                     lines.append("state_sync = true")
             else:
                 perturbs = [p for p, prob in PERTURBATIONS.items() if r.random() < prob]
+                # partition asserts the REMAINING validators keep
+                # committing, which needs a guaranteed >2/3 remainder:
+                # require >= 4 equal-power validators and no scheduled
+                # power updates
+                if n_validators < 4 or updates:
+                    perturbs = [p for p in perturbs if p != "partition"]
                 if perturbs and mode == "validator" and n_validators >= 2:
                     lines.append(f"perturb = {perturbs!r}".replace("'", '"'))
 

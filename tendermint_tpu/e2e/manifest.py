@@ -30,7 +30,7 @@ class NodeManifest:
     name: str
     mode: str = "validator"  # validator | full | seed
     abci_protocol: str = "builtin"  # builtin | tcp | unix | grpc
-    perturb: list[str] = field(default_factory=list)  # kill|pause|restart|disconnect
+    perturb: list[str] = field(default_factory=list)  # kill|pause|restart|disconnect|partition
     start_at: int = 0  # join later, at this height
     state_sync: bool = False  # late joiner restores an app snapshot first
     send_rate: int = 5_000_000  # p2p flow-control bytes/sec for tests

@@ -416,6 +416,8 @@ class Node:
                 peer_manager=self.peer_manager,
                 node_info=self.node_info,
                 pub_key=self.priv_validator.get_pub_key() if self.priv_validator else None,
+                router=self.router,
+                unsafe=self.config.rpc.unsafe,
             )
             self.rpc_server = JSONRPCServer(
                 build_routes(env),

@@ -184,6 +184,7 @@ class Router:
         self._peer_conns: dict[str, Connection] = {}
         self._peer_channels: dict[str, set[int]] = {}
         self._peer_lock = threading.RLock()
+        self._peer_veto: set[str] = set()
         self._threads: list[threading.Thread] = []  # long-lived loop threads only
         self._threads_lock = threading.Lock()
         self._stop = threading.Event()
@@ -242,6 +243,30 @@ class Router:
     @property
     def network_enabled(self) -> bool:
         return self._network_enabled.is_set()
+
+    def set_peer_veto(self, peer_ids) -> None:
+        """Per-peer partition (ref analog: the e2e runner's
+        container-level network disconnect, test/e2e/runner/perturb.go:
+        40-72, at per-link granularity): connections to the given peer
+        ids are closed NOW and refused (dial and accept) until the veto
+        is lifted. Asymmetric by construction — only THIS node refuses;
+        the vetoed side keeps trying and exercises its real
+        dial-failure/backoff/eviction paths. Pass an empty set to
+        heal."""
+        veto = {p.lower() for p in peer_ids}
+        with self._peer_lock:
+            self._peer_veto = veto
+            doomed = [c for pid, c in self._peer_conns.items() if pid in veto]
+        for conn in doomed:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    @property
+    def peer_veto(self) -> set:
+        with self._peer_lock:
+            return set(self._peer_veto)
 
     def _make_peer_queue(self):
         """ref: router.go createQueueFactory, selectable via config
@@ -410,6 +435,9 @@ class Router:
             if outgoing and endpoint is not None and endpoint.node_id and endpoint.node_id != peer_id:
                 raise ValueError(f"expected to dial {endpoint.node_id}, got {peer_id}")
             self.node_info.compatible_with(peer_info)
+            with self._peer_lock:
+                if peer_id in self._peer_veto:
+                    raise ValueError(f"peer {peer_id} vetoed (partition)")
             if self.options.filter_peer_by_id is not None:
                 self.options.filter_peer_by_id(peer_id)
 
@@ -507,7 +535,9 @@ class Router:
             endpoint = self.peer_manager.dial_next(timeout=0.2)
             if endpoint is None:
                 continue
-            if not self._network_enabled.is_set():
+            if not self._network_enabled.is_set() or (
+                endpoint.node_id and endpoint.node_id.lower() in self.peer_veto
+            ):
                 self.peer_manager.dial_failed(endpoint)  # retry after backoff
                 continue
             transport = self._transport_for(endpoint.protocol)

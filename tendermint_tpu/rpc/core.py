@@ -173,6 +173,8 @@ class RPCEnvironment:
         peer_manager=None,
         node_info=None,
         pub_key=None,
+        router=None,
+        unsafe=False,
     ):
         self.chain_id = chain_id
         self.state_store = state_store
@@ -187,6 +189,8 @@ class RPCEnvironment:
         self.peer_manager = peer_manager
         self.node_info = node_info
         self.pub_key = pub_key
+        self.router = router
+        self.unsafe = unsafe
         self.start_time = _time.time()
 
 
@@ -751,7 +755,7 @@ def build_routes(env: RPCEnvironment) -> dict:
             }
         }
 
-    return {
+    routes = {
         "health": health,
         "status": status,
         "net_info": net_info,
@@ -786,4 +790,45 @@ def build_routes(env: RPCEnvironment) -> dict:
         "broadcast_evidence": broadcast_evidence,
         "abci_query": abci_query,
         "abci_info": abci_info,
+    }
+    if env.unsafe:
+        routes.update(_unsafe_routes(env))
+    return routes
+
+
+def _unsafe_routes(env: RPCEnvironment) -> dict:
+    """Routes behind rpc.unsafe (ref: routes.go:75-79 RPCUnsafe +
+    config.go:429). unsafe_partition/unsafe_heal are the fault-injection
+    hooks the e2e runner drives for REAL per-link network partitions
+    (the analog of the reference's container-level docker network
+    disconnect, test/e2e/runner/perturb.go:40-72)."""
+
+    def unsafe_flush_mempool():
+        """ref: UnsafeFlushMempool (internal/rpc/core/mempool.go:185)."""
+        if env.mempool is None:
+            raise RPCError(ERR_INTERNAL, "mempool not configured")
+        env.mempool.flush()
+        return {}
+
+    def unsafe_partition(peers=None):
+        """Veto connections to the given peer ids (asymmetric partition:
+        only this node refuses). peers: list of hex node ids."""
+        if env.router is None:
+            raise RPCError(ERR_INTERNAL, "router not configured")
+        if not isinstance(peers, list) or not all(isinstance(p, str) for p in peers):
+            raise RPCError(-32602, "peers must be a list of node id strings")
+        env.router.set_peer_veto(peers)
+        return {"vetoed": sorted(env.router.peer_veto)}
+
+    def unsafe_heal():
+        """Lift every partition veto."""
+        if env.router is None:
+            raise RPCError(ERR_INTERNAL, "router not configured")
+        env.router.set_peer_veto(())
+        return {}
+
+    return {
+        "unsafe_flush_mempool": unsafe_flush_mempool,
+        "unsafe_partition": unsafe_partition,
+        "unsafe_heal": unsafe_heal,
     }
