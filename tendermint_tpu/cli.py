@@ -494,7 +494,21 @@ def cmd_reindex_event(args) -> int:
     cfg = load_config(args.home)
     state_store = StateStore(_make_db(cfg, "state"))
     block_store = BlockStore(_make_db(cfg, "blockstore"))
-    indexer = KVIndexer(_make_db(cfg, "tx_index"))
+    # rebuild EVERY configured sink (ref: reindex_event.go loads the
+    # eventSinks from config and refuses when indexing is disabled)
+    names = [s.strip() for s in cfg.tx_index.indexer.split(",") if s.strip()]
+    if names and set(names) == {"null"}:
+        print('reindex-event: indexing is disabled (indexer = "null")')
+        return 1
+    sinks = []
+    if not names or "kv" in names or "sqlite" in names:
+        sinks.append(KVIndexer(_make_db(cfg, "tx_index")))
+    if "psql" in names:
+        from .indexer.sink_psql import PsqlSink
+        from .types.genesis import GenesisDoc
+
+        chain_id = GenesisDoc.from_file(cfg.genesis_file).chain_id
+        sinks.append(PsqlSink(cfg.tx_index.psql_conn, chain_id=chain_id))
     start = args.start_height or block_store.base() or 1
     end = args.end_height or block_store.height()
     n = 0
@@ -503,8 +517,9 @@ def cmd_reindex_event(args) -> int:
         f_res = state_store.load_finalize_block_responses(h)
         if blk is None or f_res is None:
             continue
-        indexer.index_block_events(h, f_res)
-        indexer.index_tx_events(h, list(blk.txs), list(f_res.tx_results or []))
+        for sink in sinks:
+            sink.index_block_events(h, f_res)
+            sink.index_tx_events(h, list(blk.txs), list(f_res.tx_results or []))
         n += 1
     print(f"reindexed events for {n} blocks in [{start}, {end}]")
     return 0

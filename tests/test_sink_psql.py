@@ -9,6 +9,7 @@ conflict handling, and the runInTransaction discipline.
 
 from __future__ import annotations
 
+import os
 import re
 
 import pytest
@@ -196,3 +197,91 @@ def test_node_config_requires_dsn():
     assert "tx-index.psql-conn" not in cfg.unknown_keys
     round_tripped = Config.from_toml(cfg.to_toml())
     assert round_tripped.tx_index.psql_conn == "postgresql://h/db"
+
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "testdata", "psql_statements.golden")
+
+
+def _golden_stream():
+    """Deterministic block + txs through the sink; returns the exact
+    statement stream (sql + repr'd params), schema installation
+    excluded."""
+    db, sink = make_sink()
+    n_schema = len(db.statements)
+    f_res = ResponseFinalizeBlock(events=[
+        Event(type="rollup", attributes=[
+            EventAttribute(key="indexed", value="yes", index=True),
+            EventAttribute(key="unindexed", value="no", index=False),
+        ]),
+    ])
+    sink.index_block_events(11, f_res)
+    sink.index_tx_events(11, [b"k1=v1", b"k2=v2"], [
+        ExecTxResult(code=0, events=[Event(type="transfer", attributes=[
+            EventAttribute(key="amount", value="12", index=True)])]),
+        ExecTxResult(code=1),
+    ])
+    sink.index_block_events(11, f_res)  # idempotent re-index
+    lines = []
+    for sql, params in db.statements[n_schema:]:
+        flat = " ".join(sql.split())
+        lines.append(f"{flat} || {params!r}")
+    return "\n".join(lines) + "\n"
+
+
+def test_statement_stream_matches_golden():
+    """Wire-level golden of the EXACT statements the sink issues
+    (VERDICT r4 item 8 fallback: no live server in-container, so the
+    statement stream itself is the vendored artifact). Any change to
+    dialect, ordering, or parameter binding shows up as a byte diff.
+    Regenerate deliberately with:
+      python -c "import tests.test_sink_psql as t; open(t.GOLDEN,'w').write(t._golden_stream())"
+    """
+    got = _golden_stream()
+    with open(GOLDEN) as f:
+        assert got == f.read()
+
+
+def test_reindex_event_populates_psql_sink(tmp_path, monkeypatch):
+    """`reindex-event` with indexer = "kv,psql" rebuilds the psql sink
+    from stored blocks (ref: commands/reindex_event.go over the
+    configured event sinks)."""
+    import tendermint_tpu.indexer.sink_psql as sp
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+
+    import test_consensus as T
+
+    home = str(tmp_path / "node")
+    assert cli_main(["--home", home, "init", "validator", "--chain-id", "psql-reindex"]) == 0
+    cfg = load_config(home)
+    # produce a couple of blocks with a real single-validator node
+    from tendermint_tpu.node import Node
+
+    cfg.base.db_backend = "filedb"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.enable = False
+    cfg.save()
+    node = Node(cfg)
+    node.start()
+    try:
+        node.mempool.check_tx(b"golden=1")
+        deadline = __import__("time").monotonic() + 60
+        while __import__("time").monotonic() < deadline and node.consensus.rs.height < 3:
+            __import__("time").sleep(0.05)
+        assert node.consensus.rs.height >= 3
+    finally:
+        node.stop()
+
+    # flip config to kv,psql and reindex with the fake driver injected
+    cfg = load_config(home)
+    cfg.tx_index.indexer = "kv,psql"
+    cfg.tx_index.psql_conn = "postgresql://fake/db"
+    cfg.save()
+    db = FakePG()
+    monkeypatch.setattr(sp, "_connect_dsn", lambda dsn: db)
+    assert cli_main(["--home", home, "reindex-event"]) == 0
+    heights = sorted(r["height"] for r in db.committed["blocks"])
+    assert heights and heights[0] == 1 and len(heights) >= 2
+    attrs = {r["composite_key"] for r in db.committed["attributes"]}
+    assert "block.height" in attrs
+    assert any(r["tx_hash"] for r in db.committed["tx_results"])
