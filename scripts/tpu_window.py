@@ -63,7 +63,7 @@ MAX_B = int(os.environ.get("SWEEP_MAX", "8192"))
 
 # Phases whose measurements scale with SWEEP_MAX; the rest run at
 # fixed batch sizes and a marker from any sweep size stands.
-_MAXB_PHASES = ("slice_big", "pipe", "dot", "cache", "msm")
+_MAXB_PHASES = ("slice_big", "pipe", "dot", "cache", "msm", "msm_cache")
 
 
 def banked(phase):
@@ -92,7 +92,8 @@ from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.ops import field as F
 from tendermint_tpu.ops import verify as V
 
-PHASES = ("slice256", "pipe_warm", "slice_big", "pipe", "cutover", "cache", "msm", "sr", "dot")
+PHASES = ("slice256", "pipe_warm", "slice_big", "pipe", "cutover", "cache", "msm",
+          "msm_cache", "fastsync", "mega", "sr", "dot")
 todo = [p for p in PHASES if not banked(p)]
 if not todo:
     log("all phases banked; nothing to do")
@@ -140,6 +141,20 @@ if "msm" in todo:
         B: M._rlc_scalars(s[:B], k[:B], B, b"\x5a" * (16 * B))
         for B in sorted(b for b in _msm_bs if b > 0)
     }
+
+fastsync_chain = None
+if "fastsync" in todo:
+    from bench_baseline import make_commit as _mk_commit
+
+    fastsync_chain = [_mk_commit(1000, height=h) for h in (1, 2)]
+
+mega_jobs = None
+if "mega" in todo:
+    MEGA_N = 10000
+    mega_sk = ref.gen_privkey(b"\x4d" * 32)
+    mega_pk = mega_sk[32:]
+    mega_msgs = [b"mega-%d" % i for i in range(MEGA_N)]
+    mega_jobs = (mega_pk, mega_msgs, [ref.sign(mega_sk, m) for m in mega_msgs])
 
 sr_inputs = None
 if "sr" in todo:
@@ -309,6 +324,68 @@ def _phase_msm():
             f"device-only {B/dt:12,.0f} sigs/s")
 
 
+def _phase_msm_cache():
+    # production MSM: end-to-end pipelined through the HBM cache (keys
+    # resident after the first call) — bench.py stage 5's exact path
+    from tendermint_tpu.ops import msm as M
+
+    B = max(b for b in msm_inputs) if msm_inputs else MAX_B
+    sub = (pks[:B], msgs[:B], sigs[:B])
+    t0 = time.time()
+    ok = M.collect_rlc(M.verify_batch_rlc_cached_async(*sub))
+    t_first = time.time() - t0
+    assert ok is True, "cached MSM rejected valid batch"
+    iters = 8
+    t0 = time.time()
+    inflight = [M.verify_batch_rlc_cached_async(*sub) for _ in range(iters)]
+    outs = [M.collect_rlc(h) for h in inflight]
+    dt = (time.time() - t0) / iters
+    assert all(outs)
+    log(f"MSM-CACHE B={B}  compile+insert+1st {t_first:7.2f}s  pipelined "
+        f"{dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+
+
+def _phase_fastsync():
+    # BASELINE config 3 on chip: blocksync-style verify_commit_light at
+    # 1000 validators -> fast-sync blocks/sec (VERDICT r4 item 4)
+    from bench_baseline import CHAIN as BCHAIN
+    from tendermint_tpu.types.validation import verify_commit_light
+
+    vals0, c0 = fastsync_chain[0]
+    t0 = time.time()
+    verify_commit_light(BCHAIN, vals0, c0.block_id, c0.height, c0)
+    t_first = time.time() - t0
+    iters = 5
+    t0 = time.time()
+    for _ in range(iters):
+        for vals, commit in fastsync_chain:
+            verify_commit_light(BCHAIN, vals, commit.block_id, commit.height, commit)
+    dt = time.time() - t0
+    rate = iters * len(fastsync_chain) / dt
+    log(f"FASTSYNC 1000-val  first {t_first:7.2f}s  {rate:10,.1f} blocks/s "
+        f"({rate * 667:,.0f} sigs/s effective)")
+
+
+def _phase_mega():
+    # BASELINE config 5, single-chip shape: 10k-signature mega-commit
+    # through the sharded plane on a 1-device mesh
+    from tendermint_tpu.parallel import sharded_verify as sv
+
+    pk, msgs, sigs = mega_jobs
+    mesh = sv.make_mesh(1)
+    t0 = time.time()
+    bitmap, all_valid = sv.verify_batch_sharded(mesh, [pk] * MEGA_N, msgs, sigs)
+    t_first = time.time() - t0
+    assert all_valid and bitmap.all(), "mega-commit rejected valid signatures"
+    iters = 3
+    t0 = time.time()
+    for _ in range(iters):
+        sv.verify_batch_sharded(mesh, [pk] * MEGA_N, msgs, sigs)
+    dt = (time.time() - t0) / iters
+    log(f"MEGA 10k 1-chip  compile+1st {t_first:7.2f}s  steady {dt*1000:9.1f}ms  "
+        f"{MEGA_N/dt:12,.0f} sigs/s")
+
+
 def _phase_sr():
     from tendermint_tpu.ops import verify_sr as VS
 
@@ -386,6 +463,9 @@ run_phase("pipe", 360, _phase_pipe)
 run_phase("cutover", 360, _phase_cutover)
 run_phase("cache", 420, _phase_cache)
 run_phase("msm", 480, _phase_msm)
+run_phase("msm_cache", 480, _phase_msm_cache)
+run_phase("fastsync", 300, _phase_fastsync)
+run_phase("mega", 420, _phase_mega)
 run_phase("sr", 300, _phase_sr)
 run_phase("dot", 600, _phase_dot)
 

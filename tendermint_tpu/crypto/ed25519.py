@@ -244,7 +244,20 @@ class Ed25519BatchVerifier(BatchVerifier):
                 # reference's serial re-verify (types/validation.go:245).
                 from ..ops import msm as dev_msm
 
-                handle = dev_msm.verify_batch_rlc_async(self._pks, self._msgs, self._sigs)
+                # TM_TPU_MSM_CACHE routes phase 1 through the HBM
+                # cache (fewer adds + half the decompression, but more
+                # narrow ops + a big gather). Default OFF until the
+                # on-chip A/B (window phases msm vs msm_cache) decides:
+                # the XLA-CPU relative numbers favor uncached, and CPU
+                # op-overhead ratios don't transfer to the TPU.
+                if _pk_cache_enabled() and os.environ.get(
+                    "TM_TPU_MSM_CACHE", "off"
+                ).strip().lower() in ("on", "1", "true", "yes"):
+                    handle = dev_msm.verify_batch_rlc_cached_async(
+                        self._pks, self._msgs, self._sigs
+                    )
+                else:
+                    handle = dev_msm.verify_batch_rlc_async(self._pks, self._msgs, self._sigs)
 
                 def complete_msm():
                     if handle is not None and dev_msm.collect_rlc(handle):

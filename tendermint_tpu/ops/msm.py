@@ -147,6 +147,78 @@ def msm_verify_kernel_impl(a_enc, r_enc, zk_bytes, z_bytes, zs_bytes):
 msm_verify_kernel = jax.jit(msm_verify_kernel_impl)
 
 
+def msm_verify_kernel_cached_impl(tables, oks, slots, r_enc, zk_bytes, z_bytes, zs_bytes):
+    """Cache-hit MSM: A arrives as slot indices into the HBM-resident
+    split power-table cache (ops/verify.PubkeyCache with PK_SPLITS
+    rows: row c holds the 16-multiples table of -[2^(256/S * c)]A), so
+    the A side needs NO decompression and NO per-round table build, and
+    its window count drops from 64 to 64/S — chunk c of zk rides row c,
+    landing in the same low windows. R still decompresses + builds
+    (every signature's R is fresh). W covers max(32, 64/S) windows."""
+    r = r_enc.T.astype(jnp.int32)
+    n = r.shape[1]
+    r_pt, r_oks = C.decompress(r, zip215=True)
+    neg_r = C.point_neg(r_pt)
+    a_ok = jnp.all(oks[slots])
+    all_ok = a_ok & jnp.all(r_oks)
+
+    s_chunks = tables.shape[1]  # PK_SPLITS rows per cache entry
+    per = 64 // s_chunks  # zk nibbles per chunk
+    nibs_zk = C.scalar_to_nibbles(zk_bytes.T.astype(jnp.int32))  # (64, B)
+    nibs_z = C.scalar_to_nibbles(z_bytes.T.astype(jnp.int32))  # (32, B)
+
+    g = min(G_STREAMS, n)
+    rounds = n // g
+    wn = max(32, per)
+    w0 = C.identity_point((wn, g)) + 0 * neg_r[:, :, :1, None]
+    # ONE gather of every row this batch touches, transposed to the
+    # limb layout up front — a per-round gather inside the loop costs
+    # far more than slicing a pre-gathered array
+    tabs_a = jnp.transpose(tables[slots].astype(jnp.int32), (1, 2, 3, 4, 0))
+    # (S, 16, 4, 32, B)
+
+    def round_body(t, w_acc):
+        col_r = lax.dynamic_slice_in_dim(neg_r, t * g, g, axis=2)
+        tab_r = C._build_var_table(col_r)  # (16, 4, 32, g)
+        d_r = lax.dynamic_slice_in_dim(nibs_z, t * g, g, axis=1)  # (32, g)
+        pad_r = wn - 32
+        entry_r = _select_windows(tab_r, d_r)  # (4, 32, 32, g)
+        if pad_r:
+            ident = C.identity_point((pad_r, g)) + 0 * entry_r[:, :, :1, :1]
+            entry_r = jnp.concatenate([entry_r, ident], axis=2)
+        w_acc = C.point_add(w_acc, entry_r, out_t=True)
+        # A chunks: chunk c's 16-nibble sub-scalar lands in windows
+        # [0, per), riding cache row c (pre-multiplied by 2^(256c/S))
+        d_zk = lax.dynamic_slice_in_dim(nibs_zk, t * g, g, axis=1)  # (64, g)
+        lo = w_acc[:, :, :per]
+        for c in range(s_chunks):
+            tab_c = lax.dynamic_slice_in_dim(tabs_a[c], t * g, g, axis=3)
+            d_c = lax.dynamic_slice_in_dim(d_zk, c * per, per, axis=0)
+            entry_c = _select_windows(tab_c, d_c)  # (4, 32, per, g)
+            lo = C.point_add(lo, entry_c, out_t=True)
+        return jnp.concatenate([lo, w_acc[:, :, per:]], axis=2)
+
+    w_acc = lax.fori_loop(0, rounds, round_body, w0)
+
+    def horner_step(i, acc):
+        acc = C.point_double(acc, out_t=False)
+        acc = C.point_double(acc, out_t=False)
+        acc = C.point_double(acc, out_t=False)
+        acc = C.point_double(acc, out_t=True)
+        wth = lax.dynamic_index_in_dim(w_acc, wn - 2 - i, axis=2, keepdims=False)
+        return C.point_add(acc, wth, out_t=True)
+
+    acc = lax.fori_loop(0, wn - 1, horner_step, w_acc[:, :, wn - 1])
+    total = _tree_reduce_points(acc)
+    sb = C.fixed_base_mul(zs_bytes.T.astype(jnp.int32))
+    total = C.point_add(total, sb, out_t=False)
+    total = lax.fori_loop(0, 3, lambda _, v: C.point_double(v, out_t=False), total)
+    return all_ok & C.point_is_identity(total)[0]
+
+
+msm_verify_kernel_cached = jax.jit(msm_verify_kernel_cached_impl)
+
+
 def _rlc_scalars_py(s_rows, k_rows, n, z_raw):
     """Pure-Python randomizer math (fallback + oracle for the native
     path): per-signature zk = z*h mod L rows, the z rows, and
@@ -196,6 +268,22 @@ def _rlc_scalars(s_rows, k_rows, n, z_raw):
     return zk, z_out, zs_row
 
 
+def _ensure_z_raw(n: int, z_raw: bytes | None) -> bytes:
+    """Sample (or validate) the per-batch randomizers. A zero z_i would
+    null that signature's contribution (false accept) — regenerate, hit
+    with probability ~n * 2^-128. A short caller-supplied buffer would
+    yield z_i = 0 for the tail rows, silently excluding them."""
+    if z_raw is None:
+        z_raw = os.urandom(16 * n)
+        while any(
+            z_raw[16 * i : 16 * i + 16] == b"\x00" * 16 for i in range(n)
+        ):  # pragma: no cover
+            z_raw = os.urandom(16 * n)
+    elif len(z_raw) != 16 * n:
+        raise ValueError(f"z_raw must be {16 * n} bytes, got {len(z_raw)}")
+    return z_raw
+
+
 def verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
     """Dispatch the RLC check without blocking. Returns an opaque handle
     for collect_rlc, or None when a precheck failed (malformed input or
@@ -207,18 +295,7 @@ def verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
     a_enc, r_enc, s_rows, k_rows, precheck = prepare_batch(pubkeys, msgs, sigs)
     if not precheck.all():
         return None
-    if z_raw is None:
-        z_raw = os.urandom(16 * n)
-        # a zero z_i would null that signature's contribution (false
-        # accept); regenerate — hit with probability ~n * 2^-128
-        while any(
-            z_raw[16 * i : 16 * i + 16] == b"\x00" * 16 for i in range(n)
-        ):  # pragma: no cover
-            z_raw = os.urandom(16 * n)
-    elif len(z_raw) != 16 * n:
-        # a short caller-supplied buffer would yield z_i = 0 for the
-        # tail rows — silently excluding those signatures from the check
-        raise ValueError(f"z_raw must be {16 * n} bytes, got {len(z_raw)}")
+    z_raw = _ensure_z_raw(n, z_raw)
     zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
     a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
     ok_dev = msm_verify_kernel(
@@ -226,6 +303,40 @@ def verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
         jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
     )
     return ok_dev
+
+
+def verify_batch_rlc_cached_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
+    """The RLC check through the HBM pubkey cache: cache hits skip A
+    decompression AND the per-round A table build, and ride the split
+    power tables (Horner depth 32 instead of 64). Falls back to the
+    uncached MSM when the cache overflows or holds legacy-shape
+    entries. Same contract as verify_batch_rlc_async."""
+    from .verify import pubkey_cache
+
+    n = len(sigs)
+    if n == 0:
+        return None
+    cache = pubkey_cache()
+    if cache.tables.ndim != 5:
+        return verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw)
+    keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
+    slots, tables, oks = cache.ensure_snapshot(keys)
+    if slots is None:
+        return verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw)
+    _, r_enc, s_rows, k_rows, precheck = prepare_batch(pubkeys, msgs, sigs)
+    if not precheck.all():
+        return None
+    z_raw = _ensure_z_raw(n, z_raw)
+    zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
+    r_enc, zk, z_out = pad_pow2_rows([r_enc, zk, z_out], n)
+    # padded rows carry zero scalars (identity contributions), but their
+    # slot must point at a VALID cached key: slot 0 may hold a key whose
+    # encoding fails decode, which would sink all_ok for a valid batch
+    slots = np.pad(slots, (0, len(r_enc) - n), mode="edge")
+    return msm_verify_kernel_cached(
+        tables, oks, jnp.asarray(slots),
+        jnp.asarray(r_enc), jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+    )
 
 
 def collect_rlc(dispatched) -> bool:
