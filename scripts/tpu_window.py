@@ -328,7 +328,15 @@ def _phase_msm_cache():
     # production MSM: end-to-end pipelined through the HBM cache (keys
     # resident after the first call) — bench.py stage 5's exact path
     from tendermint_tpu.ops import msm as M
+    from tendermint_tpu.ops import verify as V2
 
+    # loud guard: if the cache holds legacy 4-dim entries the cached
+    # dispatcher silently falls back to the UNCACHED kernel — banking
+    # that as MSM-CACHE would corrupt the A/B this phase exists for
+    assert V2.pubkey_cache().tables.ndim == 5, (
+        f"split table cache required for msm_cache (got "
+        f"{V2.pubkey_cache().tables.ndim}-dim entries; TM_TPU_PK_SPLIT?)"
+    )
     B = max(b for b in msm_inputs) if msm_inputs else MAX_B
     sub = (pks[:B], msgs[:B], sigs[:B])
     t0 = time.time()

@@ -1,0 +1,6 @@
+"""Machine-checkable specifications (ref analog: spec/light-client TLA+,
+spec/ivy-proofs — here as executable Python model checking run in CI)."""
+
+from .model import Model
+
+__all__ = ["Model"]

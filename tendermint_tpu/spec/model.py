@@ -1,0 +1,345 @@
+"""Executable formal specification of the Tendermint consensus round
+protocol — an explicit-state model checker.
+
+The reference ships TLA+/Ivy specs (spec/light-client/, spec/
+ivy-proofs/) that require external checkers; this module is the
+machine-checkable spec for THIS repo: the consensus algorithm of
+Buchman/Kwon/Milosevic (arXiv 1807.04938, the algorithm state.go
+implements) as a small transition system, explored exhaustively with
+the safety properties asserted in every reachable state:
+
+  AGREEMENT — no two correct validators decide different values
+  VALIDITY  — a decided value was proposed by some round's proposer
+
+Abstraction (what makes exhaustive exploration tractable): every
+algorithm rule has only POSITIVE, monotone message conditions — a rule
+is enabled once enough messages EXIST, and more messages never disable
+it. Under full asynchrony the adversary schedules deliveries, so
+validator i can fire a rule exactly when the global pool of sent
+messages contains its justification (the adversary delivers precisely
+that evidence first). Per-validator delivered views therefore collapse
+into one global pool without losing any safety-relevant behavior:
+global state = (per-correct-validator local state, pool), transitions
+= one validator fires one enabled rule. Timeouts are modeled as
+always-available alternatives gated exactly as the algorithm gates
+them (asynchrony can starve any wait).
+
+The adversary is otherwise maximal: byzantine validators pre-populate
+the pool with BOTH candidate values as prevotes and precommits for
+every round and with conflicting proposals for their proposer slots;
+the correct round-0 proposer's getValue() is adversarial too (either
+candidate value).
+
+Bounds: one height, rounds {0..max_round}, two values — the classic
+fork scenarios (lock at round r, conflicting 2/3 at r+1) need exactly
+one round boundary. The f < n/3 threshold itself is validated by the
+companion tests: the same model with byzantine share >= 1/3 must FAIL
+agreement, and does (tests/test_spec_model.py).
+
+Mapping to the implementation (consensus/state.py), rule for rule:
+  L22  on PROPOSAL(h,r,v,-1)        -> _do_prevote fresh-proposal arm
+  L28  on PROPOSAL(h,r,v,vr)+POL    -> _do_prevote POL arm
+  L34  on 2/3 prevotes any          -> _enter_prevote_wait timeout
+  L36  on PROPOSAL + 2/3 prevotes v -> lock + precommit (enterPrecommit)
+  L44  on 2/3 prevotes nil          -> precommit nil
+  L47  on 2/3 precommits any        -> precommit-wait timeout
+  L49  on PROPOSAL + 2/3 precommit v-> decide (finalizeCommit)
+  L55  on f+1 future round          -> round skip (state.py:1069)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+NIL = "nil"
+VALUES = ("A", "B")
+
+PROPOSE, PREVOTE, PRECOMMIT, DECIDED = range(4)
+
+
+@dataclass(frozen=True)
+class VState:
+    """One correct validator's algorithm state (arXiv fig. 1 locals)."""
+
+    step: int = PROPOSE
+    round: int = 0
+    locked_value: str | None = None
+    locked_round: int = -1
+    valid_value: str | None = None
+    valid_round: int = -1
+    decision: str | None = None
+
+
+def prop_key(rnd, value, valid_round, sender):
+    return ("prop", rnd, value, valid_round, sender)
+
+
+def vote_key(kind, rnd, value, sender):
+    return (kind, rnd, value, sender)
+
+
+class Model:
+    """n validators, the last `n_byz` byzantine, power 1 each: the 2/3
+    threshold is `quorum = 2n//3 + 1`, f+1 = n//3 + 1 (matching
+    validator_set.py tallies for equal powers)."""
+
+    def __init__(self, n: int = 4, n_byz: int = 1, max_round: int = 1):
+        self.n = n
+        self.n_byz = n_byz
+        self.correct = list(range(n - n_byz))
+        self.max_round = max_round
+        self.quorum = 2 * n // 3 + 1
+        self.skip_threshold = n // 3 + 1
+
+    def proposer(self, rnd: int) -> int:
+        return rnd % self.n
+
+    # ------------------------------------------------------------ messages
+
+    def byzantine_messages(self) -> frozenset:
+        msgs = set()
+        for b in range(self.n - self.n_byz, self.n):
+            for rnd in range(self.max_round + 1):
+                for v in VALUES:
+                    msgs.add(vote_key("prevote", rnd, v, b))
+                    msgs.add(vote_key("precommit", rnd, v, b))
+                msgs.add(vote_key("prevote", rnd, NIL, b))
+                msgs.add(vote_key("precommit", rnd, NIL, b))
+                if self.proposer(rnd) == b:
+                    for v in VALUES:
+                        msgs.add(prop_key(rnd, v, -1, b))
+                        for vr in range(rnd):
+                            msgs.add(prop_key(rnd, v, vr, b))
+        return frozenset(msgs)
+
+    # ------------------------------------------------------- initial states
+
+    def initial(self):
+        vstates = tuple(VState() for _ in self.correct)
+        pool = self.byzantine_messages()
+        p0 = self.proposer(0)
+        if p0 in self.correct:
+            # getValue() is adversarial: either candidate
+            return [
+                (vstates, pool | {prop_key(0, v, -1, p0)}) for v in VALUES
+            ]
+        return [(vstates, pool)]
+
+    # ------------------------------------------------------ pool predicates
+
+    def _count(self, pool, kind, rnd, value):
+        return len({k[3] for k in pool if k[0] == kind and k[1] == rnd and k[2] == value})
+
+    def _any_twothirds(self, pool, kind, rnd):
+        return len({k[3] for k in pool if k[0] == kind and k[1] == rnd}) >= self.quorum
+
+    def _proposal(self, pool, rnd, value=None, valid_round=None):
+        for k in pool:
+            if k[0] != "prop" or k[1] != rnd or k[4] != self.proposer(rnd):
+                continue
+            if value is not None and k[2] != value:
+                continue
+            if valid_round is not None and k[3] != valid_round:
+                continue
+            return k
+        return None
+
+    # ---------------------------------------------------------- transitions
+
+    def successors(self, state):
+        vstates, pool = state
+        out = []
+        for i, vs in enumerate(vstates):
+            if vs.decision is not None:
+                continue
+            rnd = vs.round
+
+            # L49 decide: proposal + 2/3 precommits for v at ANY round
+            for r in range(self.max_round + 1):
+                for v in VALUES:
+                    if (
+                        self._count(pool, "precommit", r, v) >= self.quorum
+                        and self._proposal(pool, r, value=v) is not None
+                    ):
+                        out.append(
+                            self._set(state, i, replace(vs, step=DECIDED, decision=v))
+                        )
+
+            # L55 round skip: f+1 distinct senders with a future round
+            future = {}
+            for k in pool:
+                r = k[1]
+                if r > rnd and r <= self.max_round:
+                    future.setdefault(r, set()).add(k[4] if k[0] == "prop" else k[3])
+            for r, senders in future.items():
+                if len(senders) >= self.skip_threshold:
+                    out.append(self._start_round(state, i, r))
+
+            if vs.step == PROPOSE:
+                # L22 fresh proposal
+                for v in VALUES:
+                    if self._proposal(pool, rnd, value=v, valid_round=-1) is not None:
+                        ok = vs.locked_round == -1 or vs.locked_value == v
+                        out.append(self._prevote(state, i, v if ok else NIL))
+                # L28 re-proposal with POL
+                for v in VALUES:
+                    for vr in range(rnd):
+                        if (
+                            self._proposal(pool, rnd, value=v, valid_round=vr) is not None
+                            and self._count(pool, "prevote", vr, v) >= self.quorum
+                        ):
+                            ok = vs.locked_round <= vr or vs.locked_value == v
+                            out.append(self._prevote(state, i, v if ok else NIL))
+                # L57 timeoutPropose (asynchrony can starve the wait)
+                out.append(self._prevote(state, i, NIL))
+
+            if vs.step == PREVOTE:
+                # L36: proposal + 2/3 prevotes v -> lock + precommit v
+                for v in VALUES:
+                    if (
+                        self._count(pool, "prevote", rnd, v) >= self.quorum
+                        and self._proposal(pool, rnd, value=v) is not None
+                    ):
+                        vs2 = replace(
+                            vs,
+                            step=PRECOMMIT,
+                            locked_value=v,
+                            locked_round=rnd,
+                            valid_value=v,
+                            valid_round=rnd,
+                        )
+                        out.append(
+                            self._emit(
+                                self._set(state, i, vs2),
+                                vote_key("precommit", rnd, v, i),
+                            )
+                        )
+                # L44: 2/3 prevotes nil -> precommit nil
+                if self._count(pool, "prevote", rnd, NIL) >= self.quorum:
+                    out.append(self._precommit_nil(state, i))
+                # L61 timeoutPrevote: gated on 2/3-any prevotes (L34)
+                if self._any_twothirds(pool, "prevote", rnd):
+                    out.append(self._precommit_nil(state, i))
+
+            if vs.step == PRECOMMIT:
+                # L36 valid-value update while past prevote
+                for v in VALUES:
+                    if (
+                        self._count(pool, "prevote", rnd, v) >= self.quorum
+                        and self._proposal(pool, rnd, value=v) is not None
+                        and (vs.valid_value, vs.valid_round) != (v, rnd)
+                    ):
+                        out.append(
+                            self._set(
+                                state, i, replace(vs, valid_value=v, valid_round=rnd)
+                            )
+                        )
+
+            if vs.step in (PREVOTE, PRECOMMIT):
+                # L65 timeoutPrecommit: gated on 2/3-any precommits (L47)
+                if rnd < self.max_round and self._any_twothirds(pool, "precommit", rnd):
+                    out.append(self._start_round(state, i, rnd + 1))
+        return out
+
+    # -- transition helpers
+
+    @staticmethod
+    def _set(state, i, vs):
+        vstates, pool = state
+        new = list(vstates)
+        new[i] = vs
+        return (tuple(new), pool)
+
+    @staticmethod
+    def _emit(state, key):
+        vstates, pool = state
+        return (vstates, pool | {key})
+
+    def _prevote(self, state, i, value):
+        vs = state[0][i]
+        st = self._set(state, i, replace(vs, step=PREVOTE))
+        return self._emit(st, vote_key("prevote", vs.round, value, i))
+
+    def _precommit_nil(self, state, i):
+        vs = state[0][i]
+        st = self._set(state, i, replace(vs, step=PRECOMMIT))
+        return self._emit(st, vote_key("precommit", vs.round, NIL, i))
+
+    def _start_round(self, state, i, rnd):
+        """L11 StartRound (proposer re-proposes its valid value if any,
+        else a fresh adversarial value)."""
+        vs = replace(state[0][i], round=rnd, step=PROPOSE)
+        state = self._set(state, i, vs)
+        if self.proposer(rnd) == i:
+            if vs.valid_value is not None:
+                state = self._emit(
+                    state, prop_key(rnd, vs.valid_value, vs.valid_round, i)
+                )
+            else:
+                state = self._emit(state, prop_key(rnd, VALUES[0], -1, i))
+        return state
+
+    # ------------------------------------------------------------ checking
+
+    def check_safety(self, max_states: int = 2_000_000):
+        """DFS over the full transition system; assert AGREEMENT and
+        VALIDITY in each reachable state. Returns (states_explored,
+        violation | None)."""
+        seen = set()
+        frontier = list(self.initial())
+        explored = 0
+        while frontier:
+            state = frontier.pop()
+            if state in seen:
+                continue
+            seen.add(state)
+            explored += 1
+            if explored > max_states:
+                raise RuntimeError(f"state budget exceeded ({max_states})")
+            bad = self._violation(state)
+            if bad is not None:
+                return explored, bad
+            frontier.extend(self.successors(state))
+        return explored, None
+
+    def check_liveness_fair(self):
+        """Termination under eventual synchrony: on a fair schedule
+        (repeatedly give every validator its first enabled transition,
+        preferring non-timeout rules), every correct validator decides.
+        One schedule per initial state — liveness under full asynchrony
+        is unattainable (FLP); the property is progress once the
+        network behaves."""
+        for first in self.initial():
+            state = first
+            for _ in range(500):
+                vstates, _ = state
+                if all(vs.decision is not None for vs in vstates):
+                    break
+                succ = self.successors(state)
+                if not succ:
+                    break
+                # prefer a deciding transition, then any non-timeout
+                pick = None
+                for s in succ:
+                    if any(
+                        a.decision is not None and b.decision is None
+                        for a, b in zip(s[0], state[0])
+                    ):
+                        pick = s
+                        break
+                state = pick if pick is not None else succ[0]
+            if not all(vs.decision is not None for vs in state[0]):
+                return False
+        return True
+
+    def _violation(self, state):
+        vstates, pool = state
+        decisions = {vs.decision for vs in vstates if vs.decision is not None}
+        if len(decisions) > 1:
+            return ("agreement", state)
+        for vs in vstates:
+            if vs.decision is not None and not any(
+                k[0] == "prop" and k[2] == vs.decision for k in pool
+            ):
+                return ("validity", state)
+        return None
