@@ -173,7 +173,7 @@ class Model:
                     future.setdefault(r, set()).add(k[4] if k[0] == "prop" else k[3])
             for r, senders in future.items():
                 if len(senders) >= self.skip_threshold:
-                    out.append(self._start_round(state, i, r))
+                    out.extend(self._start_round(state, i, r))
 
             if vs.step == PROPOSE:
                 # L22 fresh proposal
@@ -238,7 +238,7 @@ class Model:
             if vs.step in (PREVOTE, PRECOMMIT):
                 # L65 timeoutPrecommit: gated on 2/3-any precommits (L47)
                 if rnd < self.max_round and self._any_twothirds(pool, "precommit", rnd):
-                    out.append(self._start_round(state, i, rnd + 1))
+                    out.extend(self._start_round(state, i, rnd + 1))
         return out
 
     # -- transition helpers
@@ -260,24 +260,26 @@ class Model:
         st = self._set(state, i, replace(vs, step=PREVOTE))
         return self._emit(st, vote_key("prevote", vs.round, value, i))
 
+    def _start_round(self, state, i, rnd):
+        """L11 StartRound -> list of successor states: the proposer
+        re-proposes its valid value if it has one (deterministic), else
+        getValue() is adversarial and EVERY candidate value is a
+        separate successor — no reliance on value symmetry."""
+        vs = replace(state[0][i], round=rnd, step=PROPOSE)
+        state = self._set(state, i, vs)
+        if self.proposer(rnd) != i:
+            return [state]
+        if vs.valid_value is not None:
+            return [
+                self._emit(state, prop_key(rnd, vs.valid_value, vs.valid_round, i))
+            ]
+        return [self._emit(state, prop_key(rnd, v, -1, i)) for v in VALUES]
+
     def _precommit_nil(self, state, i):
         vs = state[0][i]
         st = self._set(state, i, replace(vs, step=PRECOMMIT))
         return self._emit(st, vote_key("precommit", vs.round, NIL, i))
 
-    def _start_round(self, state, i, rnd):
-        """L11 StartRound (proposer re-proposes its valid value if any,
-        else a fresh adversarial value)."""
-        vs = replace(state[0][i], round=rnd, step=PROPOSE)
-        state = self._set(state, i, vs)
-        if self.proposer(rnd) == i:
-            if vs.valid_value is not None:
-                state = self._emit(
-                    state, prop_key(rnd, vs.valid_value, vs.valid_round, i)
-                )
-            else:
-                state = self._emit(state, prop_key(rnd, VALUES[0], -1, i))
-        return state
 
     # ------------------------------------------------------------ checking
 
@@ -303,12 +305,13 @@ class Model:
         return explored, None
 
     def check_liveness_fair(self):
-        """Termination under eventual synchrony: on a fair schedule
-        (repeatedly give every validator its first enabled transition,
-        preferring non-timeout rules), every correct validator decides.
-        One schedule per initial state — liveness under full asynchrony
-        is unattainable (FLP); the property is progress once the
-        network behaves."""
+        """Termination under eventual synchrony, on ONE greedy schedule
+        per initial state: at each step take a successor in which some
+        validator newly decided if one exists, else the first enabled
+        successor. This checks 'some fair execution decides', not
+        all-fair-executions liveness — full liveness under asynchrony
+        is unattainable anyway (FLP); the property of interest is that
+        progress is reachable once the network behaves."""
         for first in self.initial():
             state = first
             for _ in range(500):

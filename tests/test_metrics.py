@@ -206,3 +206,24 @@ def test_consensus_net_populates_participation_metrics():
     # single validator, always present: missing == 0 after first commit
     assert "consensus_missing_validators 0" in out
     assert "consensus_byzantine_validators 0" in out
+
+
+def test_metricsgen_doc_in_sync():
+    """docs/metrics.md is generated from the live registry
+    (scripts/metricsgen.py --write) and must not drift from the code —
+    the metricsdiff discipline of the reference's metricsgen, enforced
+    in CI instead of at codegen time."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "metricsgen.py"),
+         "--diff", os.path.join(root, "docs", "metrics.md")],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0, f"metrics doc drifted from registry:\n{r.stdout}{r.stderr}"
