@@ -1,0 +1,79 @@
+"""Larger-instance exploration of the consensus spec model.
+
+The CI tests (tests/test_spec_model.py) check the n=4 instances
+exhaustively; this tool pushes the same model to bigger instances where
+exhaustive exploration is out of reach, via randomized deep walks that
+still assert AGREEMENT and VALIDITY in every visited state — a
+bounded-budget smoke of the algorithm at larger n (the reference's
+TLA+ configs bound state similarly). NOTE random walks are a safety
+smoke, not a refutation tool: the >= n/3 fork needs a coordinated rare
+path random walks are unlikely to hit — the exhaustive n=4 CI test
+(test_agreement_breaks_at_threshold) is what proves the checker can
+find forks at all.
+
+Usage:
+  python scripts/spec_explore.py [n] [n_byz] [max_round] [walks] [seed]
+defaults: 7 2 1 2000 0
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tendermint_tpu.spec.model import Model  # noqa: E402
+
+
+def random_walks(m: Model, walks: int, seed: int, depth: int = 400):
+    r = random.Random(seed)
+    visited = 0
+    t0 = time.time()
+    for w in range(walks):
+        state = r.choice(m.initial())
+        for _ in range(depth):
+            bad = m._violation(state)
+            if bad is not None:
+                return visited, bad
+            succ = m.successors(state)
+            if not succ:
+                break
+            state = r.choice(succ)
+            visited += 1
+        if w and w % 200 == 0:
+            print(
+                f"# walk {w}/{walks}: {visited} states visited "
+                f"({time.time()-t0:.0f}s)",
+                flush=True,
+            )
+    return visited, None
+
+
+def main(argv):
+    n = int(argv[0]) if len(argv) > 0 else 7
+    n_byz = int(argv[1]) if len(argv) > 1 else 2
+    max_round = int(argv[2]) if len(argv) > 2 else 1
+    walks = int(argv[3]) if len(argv) > 3 else 2000
+    seed = int(argv[4]) if len(argv) > 4 else 0
+    m = Model(n=n, n_byz=n_byz, max_round=max_round)
+    print(
+        f"model n={n} byz={n_byz} rounds<={max_round} "
+        f"quorum={m.quorum} skip={m.skip_threshold}; {walks} walks"
+    )
+    visited, bad = random_walks(m, walks, seed)
+    if bad is not None:
+        print(f"VIOLATION ({bad[0]}) after {visited} states")
+        for i, vs in enumerate(bad[1][0]):
+            print(f"  v{i}: round={vs.round} decision={vs.decision} "
+                  f"locked={vs.locked_value}@{vs.locked_round}")
+        return 1
+    print(f"no violation in {visited} visited states")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

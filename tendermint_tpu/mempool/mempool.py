@@ -86,7 +86,11 @@ class TxMempool:
         metrics=None,
         ttl_duration: float = 0.0,
         ttl_num_blocks: int = 0,
+        max_gas: int = -1,
     ):
+        # block gas cap for admission (PostCheckMaxGas analog); the node
+        # refreshes it when on-chain ConsensusParams change
+        self.max_gas = max_gas
         self._app = app_client
         self._metrics = metrics  # MempoolMetrics (ref: mempool/metrics.go)
         self._size = size
@@ -196,6 +200,24 @@ class TxMempool:
                     wtx.peers.add(sender)
             raise TxInCacheError()
         res = self._app.check_tx(abci.RequestCheckTx(tx=tx, type=0))
+        # ref: PostCheckMaxGas (types.go:131, wired by the node from
+        # ConsensusParams.Block.MaxGas): a tx wanting more gas than a
+        # block may carry can never be reaped — reject at admission
+        # instead of polluting the pool forever. A POLICY rejection, not
+        # a peer fault: gossiping peers may hold the older cap (the
+        # reference's postCheck failures never punish the sender).
+        if (
+            res.is_ok
+            and self.max_gas > -1
+            and res.gas_wanted > self.max_gas
+        ):
+            if not self._keep_invalid:
+                self._cache.remove(key)
+            if self._metrics is not None:
+                self._metrics.failed_txs.add(1)
+            raise TxPolicyError(
+                f"gas wanted {res.gas_wanted} exceeds block max gas {self.max_gas}"
+            )
         if res.is_ok:
             with self._mtx:
                 wtx = WrappedTx(
@@ -335,13 +357,22 @@ class TxMempool:
 
     def _recheck_txs(self) -> None:
         """ref: updateReCheckTxs mempool.go:675 — re-run CheckTx(Recheck)
-        on every remaining tx, evicting newly-invalid ones."""
+        on every remaining tx, evicting newly-invalid ones. The gas cap
+        applies here too (the reference runs postCheck on recheck): a
+        lowered on-chain Block.MaxGas must flush now-over-cap txs, or a
+        high-priority one would stop every reap at the front of the
+        queue forever."""
         for wtx in list(self._txs.values()):
             res = self._app.check_tx(abci.RequestCheckTx(tx=wtx.tx, type=1))
-            if not res.is_ok:
+            over_gas = (
+                res.is_ok and self.max_gas > -1 and res.gas_wanted > self.max_gas
+            )
+            if not res.is_ok or over_gas:
                 self._remove(wtx.key)
                 if not self._keep_invalid:
                     self._cache.remove(wtx.key)
+                if self._metrics is not None:
+                    self._metrics.failed_txs.add(1)
             else:
                 wtx.priority = res.priority
                 wtx.gas_wanted = res.gas_wanted
@@ -352,3 +383,10 @@ class TxInCacheError(Exception):
 
     def __str__(self):
         return "tx already exists in cache"
+
+
+class TxPolicyError(ValueError):
+    """Admission-policy rejection (pre/postCheck analog): the tx is
+    refused but the SENDER is not at fault — gossip peers may hold
+    different caps mid-params-change, so reactors must not evict on
+    this (unlike protocol violations)."""

@@ -11,7 +11,7 @@ import threading
 import time
 
 from ..p2p.types import CHANNEL_MEMPOOL, ChannelDescriptor, PEER_STATUS_UP, PeerError
-from .mempool import TxInCacheError, TxMempool, tx_key
+from .mempool import TxInCacheError, TxMempool, TxPolicyError, tx_key
 
 
 def mempool_channel_descriptor() -> ChannelDescriptor:
@@ -102,5 +102,9 @@ class MempoolReactor:
                 self.mempool.check_tx(tx, sender=nid)
             except TxInCacheError:
                 pass  # duplicate — normal gossip redundancy
+            except TxPolicyError:
+                # policy rejection (gas/size caps): the sender may hold
+                # the pre-update caps — not a peer fault, no eviction
+                pass
             except Exception as e:
                 self.channel.send_error(PeerError(node_id=nid, err=e))

@@ -323,6 +323,9 @@ class Node:
             ttl_duration=config.mempool.ttl_duration,
             ttl_num_blocks=config.mempool.ttl_num_blocks,
             metrics=self.mempool_metrics,
+            # PostCheckMaxGas analog (node.go wires it from consensus
+            # params); refreshed after each commit in BlockExecutor
+            max_gas=state.consensus_params.block.max_gas,
         )
         self.evidence_pool = EvidencePool(
             _make_db(config, "evidence"), self.state_store, self.block_store,

@@ -84,6 +84,9 @@ class _NopMempool:
     def unlock(self):
         pass
 
+    max_gas = -1  # admission gas cap; kept in the interface so the
+    # commit-path refresh needs no duck-typing guard
+
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
         return []
 
@@ -257,6 +260,9 @@ class BlockExecutor:
         self.mempool.lock()
         try:
             res = self.app.commit()
+            # on-chain ConsensusParams may have changed this block:
+            # refresh the admission gas cap (PostCheckMaxGas analog)
+            self.mempool.max_gas = state.consensus_params.block.max_gas
             self.mempool.update(
                 block.header.height,
                 list(block.txs),

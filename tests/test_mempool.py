@@ -166,3 +166,43 @@ def test_ttl_zero_keeps_txs():
     for h in range(1, 6):
         pool.update(h, [], [], recheck=False)
     assert pool.size() == 1
+
+
+def test_max_gas_admission_rejected():
+    """PostCheckMaxGas analog (ref: types.go:131): a tx wanting more
+    gas than a block may carry is rejected at admission (it could never
+    be reaped) and evicted from the cache so a later resubmission under
+    a raised cap is re-evaluated."""
+    import pytest
+
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci import LocalClient
+    from tendermint_tpu.mempool.mempool import TxMempool
+
+    class GasApp(abci.BaseApplication):
+        def check_tx(self, req):
+            return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=500)
+
+    from tendermint_tpu.mempool.mempool import TxPolicyError
+
+    mp = TxMempool(LocalClient(GasApp()), max_gas=100)
+    # a POLICY error (sender not at fault — reactors must not evict)
+    with pytest.raises(TxPolicyError, match="block max gas"):
+        mp.check_tx(b"expensive-tx")
+    assert mp.size() == 0
+    # raise the cap (on-chain param change): the SAME tx is admitted
+    mp.max_gas = 1000
+    res = mp.check_tx(b"expensive-tx")
+    assert res.is_ok and mp.size() == 1
+    # LOWER the cap (params changed again): recheck must flush the
+    # now-over-cap tx, or its priority would block every reap forever
+    mp.max_gas = 100
+    mp.lock()
+    try:
+        mp.update(2, [], [], recheck=True)
+    finally:
+        mp.unlock()
+    assert mp.size() == 0, "over-cap tx survived recheck under the lowered cap"
+    # unlimited (-1) never rejects
+    mp2 = TxMempool(LocalClient(GasApp()), max_gas=-1)
+    assert mp2.check_tx(b"any").is_ok

@@ -320,6 +320,61 @@ def test_network_disconnect_is_a_real_partition():
         router_b.stop()
 
 
+def test_peer_veto_is_asymmetric_per_link():
+    """router.set_peer_veto: the vetoing side closes + refuses the
+    specific peer (per-link, unlike set_network_enabled's all-links cut) while
+    remaining reachable to others; healing with an empty veto lets the
+    dial-retry path reconnect."""
+    import json
+
+    def mk(seed):
+        desc = ChannelDescriptor(
+            id=0x78, name="veto-test",
+            encode=lambda m: json.dumps(m).encode(),
+            decode=lambda b: json.loads(b.decode()),
+        )
+        key = Ed25519PrivKey.generate(bytes([seed]) * 32)
+        nid = node_id_from_pubkey(key.pub_key())
+        t = TcpTransport([desc])
+        pm = PeerManager(nid, PeerManagerOptions(max_connected=8))
+        router = Router(NodeInfo(node_id=nid, network="veto-net"), key, pm, [t])
+        router.open_channel(desc)
+        return nid, t, pm, router
+
+    nid_a, t_a, pm_a, router_a = mk(0x41)
+    nid_b, t_b, pm_b, router_b = mk(0x42)
+    nid_c, t_c, pm_c, router_c = mk(0x43)
+    for r in (router_a, router_b, router_c):
+        r.start()
+    try:
+        for pm, t_other, nid_other in (
+            (pm_a, t_b, nid_b),
+            (pm_a, t_c, nid_c),
+        ):
+            ep = t_other.endpoint()
+            pm.add(Endpoint(protocol="mconn", host=ep.host, port=ep.port, node_id=nid_other))
+        assert wait_until(lambda: {nid_b, nid_c} <= set(pm_a.peers()), timeout=10)
+
+        # B vetoes A: the A<->B link drops and stays down; A<->C lives
+        router_b.set_peer_veto({nid_a})
+        assert router_b.peer_veto == {nid_a}
+        assert wait_until(lambda: nid_b not in pm_a.peers(), timeout=5), (
+            "vetoed peer connection was not closed"
+        )
+        time.sleep(1.5)  # A's dial retries must be refused post-handshake
+        assert nid_b not in pm_a.peers()
+        assert nid_c in pm_a.peers(), "veto leaked to an unrelated link"
+
+        # heal: empty veto lifts the partition; A reconnects via retry
+        router_b.set_peer_veto(())
+        assert wait_until(lambda: nid_b in pm_a.peers(), timeout=30), (
+            "peers did not reconnect after the veto was lifted"
+        )
+    finally:
+        for r in (router_a, router_b, router_c):
+            r.stop()
+
+
 def test_priority_queue_discipline():
     """ref: pqueue.go:289 — strict priority dequeue, FIFO within a
     priority, lowest-priority dropped on overflow."""
