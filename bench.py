@@ -319,12 +319,12 @@ def main():
 
         pks, msgs, sigs = (x[:best_batch] for x in jobs)
         # cached vs uncached phase-1 follows the production gate
-        # (_pk_cache_enabled AND TM_TPU_MSM_CACHE; see crypto/ed25519.py)
-        from tendermint_tpu.crypto.ed25519 import _pk_cache_enabled
+        from tendermint_tpu.crypto.ed25519 import (
+            _msm_cache_enabled,
+            _pk_cache_enabled,
+        )
 
-        if _pk_cache_enabled() and os.environ.get(
-            "TM_TPU_MSM_CACHE", "off"
-        ).strip().lower() in ("on", "1", "true", "yes"):
+        if _pk_cache_enabled() and _msm_cache_enabled():
             dispatch_msm = M.verify_batch_rlc_cached_async
         else:
             dispatch_msm = M.verify_batch_rlc_async

@@ -501,13 +501,24 @@ def cmd_reindex_event(args) -> int:
         print('reindex-event: indexing is disabled (indexer = "null")')
         return 1
     sinks = []
-    if not names or "kv" in names or "sqlite" in names:
+    chain_id = None
+    if not names or "kv" in names:
         sinks.append(KVIndexer(_make_db(cfg, "tx_index")))
+    if "sqlite" in names:
+        import os as _os
+
+        from .indexer.sink_sql import SQLSink
+        from .types.genesis import GenesisDoc
+
+        chain_id = GenesisDoc.from_file(cfg.genesis_file).chain_id
+        _os.makedirs(cfg.db_dir, exist_ok=True)
+        sinks.append(SQLSink(_os.path.join(cfg.db_dir, "events.sqlite"), chain_id))
     if "psql" in names:
         from .indexer.sink_psql import PsqlSink
         from .types.genesis import GenesisDoc
 
-        chain_id = GenesisDoc.from_file(cfg.genesis_file).chain_id
+        if chain_id is None:
+            chain_id = GenesisDoc.from_file(cfg.genesis_file).chain_id
         sinks.append(PsqlSink(cfg.tx_index.psql_conn, chain_id=chain_id))
     start = args.start_height or block_store.base() or 1
     end = args.end_height or block_store.height()

@@ -155,6 +155,17 @@ def _msm_enabled() -> bool:
         "off", "0", "false", "no",
     )
 
+
+def _msm_cache_enabled() -> bool:
+    """TM_TPU_MSM_CACHE routes MSM phase 1 through the HBM cache.
+    Default OFF until the on-chip A/B (window phases msm vs msm_cache)
+    decides — XLA-CPU relative numbers favor uncached and don't
+    transfer. Default-off flags parse the on-list; default-on flags
+    (_msm_enabled above) parse the off-list."""
+    return os.environ.get("TM_TPU_MSM_CACHE", "off").strip().lower() in (
+        "on", "1", "true", "yes",
+    )
+
 try:  # native (OpenSSL) fast path for single verification
     from cryptography.exceptions import InvalidSignature as _InvalidSignature
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -244,15 +255,7 @@ class Ed25519BatchVerifier(BatchVerifier):
                 # reference's serial re-verify (types/validation.go:245).
                 from ..ops import msm as dev_msm
 
-                # TM_TPU_MSM_CACHE routes phase 1 through the HBM
-                # cache (fewer adds + half the decompression, but more
-                # narrow ops + a big gather). Default OFF until the
-                # on-chip A/B (window phases msm vs msm_cache) decides:
-                # the XLA-CPU relative numbers favor uncached, and CPU
-                # op-overhead ratios don't transfer to the TPU.
-                if _pk_cache_enabled() and os.environ.get(
-                    "TM_TPU_MSM_CACHE", "off"
-                ).strip().lower() in ("on", "1", "true", "yes"):
+                if _pk_cache_enabled() and _msm_cache_enabled():
                     handle = dev_msm.verify_batch_rlc_cached_async(
                         self._pks, self._msgs, self._sigs
                     )

@@ -547,6 +547,11 @@ class Runner:
                     f"({height_before} -> {stalled})"
                 )
             client.call("unsafe_heal")
+            # run_perturbations' wait_progress gates the NEXT
+            # perturbation on this node's height advancing — which a
+            # lone partitioned validator cannot do without reconnecting
+            # and catching up, so heal-then-repartition starvation
+            # can't sneak past it.
         else:
             raise ValueError(f"unknown perturbation {kind!r}")
 

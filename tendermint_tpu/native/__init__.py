@@ -63,16 +63,20 @@ def load_prep():
                 ctypes.c_char_p,  # precheck
             ]
             lib.prepare_batch.restype = None
-            u8p = ctypes.POINTER(ctypes.c_uint8)
-            lib.tm_rlc_scalars.argtypes = [
-                ctypes.c_char_p,  # z_raw (n*16)
-                u8p,  # s_rows (n*32)
-                u8p,  # k_rows (n*32)
-                ctypes.c_int64,  # n
-                u8p,  # zk_out (n*32)
-                u8p,  # zs_out (32)
-            ]
-            lib.tm_rlc_scalars.restype = None
+            # a stale .so may predate tm_rlc_scalars; its absence must
+            # degrade only the RLC path (msm.py falls back per-call),
+            # not poison the whole native prep load
+            if hasattr(lib, "tm_rlc_scalars"):
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                lib.tm_rlc_scalars.argtypes = [
+                    ctypes.c_char_p,  # z_raw (n*16)
+                    u8p,  # s_rows (n*32)
+                    u8p,  # k_rows (n*32)
+                    ctypes.c_int64,  # n
+                    u8p,  # zk_out (n*32)
+                    u8p,  # zs_out (32)
+                ]
+                lib.tm_rlc_scalars.restype = None
             _lib = lib
         except Exception:
             _load_failed = True

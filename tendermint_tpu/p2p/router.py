@@ -252,7 +252,15 @@ class Router:
         is lifted. Asymmetric by construction — only THIS node refuses;
         the vetoed side keeps trying and exercises its real
         dial-failure/backoff/eviction paths. Pass an empty set to
-        heal."""
+        heal.
+
+        Granularity note: inbound peers are identified only by the
+        handshake, so a vetoed dialer completes the handshake and is
+        dropped immediately after — it observes short connect/close
+        blips rather than refused SYNs (the reference's docker
+        partition cuts at the packet level; this cuts at the link
+        level). Data-plane isolation is unaffected: no envelope is
+        routed to or from a vetoed peer."""
         veto = {p.lower() for p in peer_ids}
         with self._peer_lock:
             self._peer_veto = veto
@@ -473,8 +481,9 @@ class Router:
             # Re-check under the lock set_network_enabled snapshots with:
             # a connection that finished its handshake while the switch
             # flipped would otherwise register AFTER the close sweep and
-            # survive the "partition".
-            if not self._network_enabled.is_set():
+            # survive the "partition". Same for a per-peer veto landing
+            # mid-handshake.
+            if not self._network_enabled.is_set() or peer_id in self._peer_veto:
                 conn.close()
                 self.peer_manager.disconnected(peer_id)
                 return

@@ -210,10 +210,7 @@ def verify_batch_sharded_rlc(mesh: Mesh, pubkeys, msgs, sigs, z_raw: bytes | Non
     a_enc, r_enc, s_rows, k_rows, precheck = V.prepare_batch(pubkeys, msgs, sigs)
     if not precheck.all():
         return False
-    if z_raw is None:
-        z_raw = os.urandom(16 * n)
-    elif len(z_raw) != 16 * n:
-        raise ValueError(f"z_raw must be {16 * n} bytes, got {len(z_raw)}")
+    z_raw = M._ensure_z_raw(n, z_raw)
     n_dev = mesh.devices.size
     per_dev = -(-n // n_dev)
     if per_dev <= 256:

@@ -355,14 +355,27 @@ def test_peer_veto_is_asymmetric_per_link():
             pm.add(Endpoint(protocol="mconn", host=ep.host, port=ep.port, node_id=nid_other))
         assert wait_until(lambda: {nid_b, nid_c} <= set(pm_a.peers()), timeout=10)
 
-        # B vetoes A: the A<->B link drops and stays down; A<->C lives
+        # B vetoes A: the A<->B link drops and stays down; A<->C lives.
+        # A's retries complete the handshake before B identifies and
+        # drops them (see set_peer_veto granularity note), so A may show
+        # short up/down BLIPS — assert the link is down for MOST samples
+        # over a window, not at one instant.
         router_b.set_peer_veto({nid_a})
         assert router_b.peer_veto == {nid_a}
-        assert wait_until(lambda: nid_b not in pm_a.peers(), timeout=5), (
-            "vetoed peer connection was not closed"
-        )
-        time.sleep(1.5)  # A's dial retries must be refused post-handshake
-        assert nid_b not in pm_a.peers()
+        # both sides observe the drop (each side's recv-loop cleanup
+        # runs on its own thread — wait for both before asserting)
+        assert wait_until(
+            lambda: nid_b not in pm_a.peers() and nid_a not in pm_b.peers(),
+            timeout=5,
+        ), "vetoed peer connection was not closed on both sides"
+        down = 0
+        for _ in range(15):
+            # B's side is DETERMINISTIC: the veto check precedes peer
+            # registration, so A must never appear as B's peer
+            assert nid_a not in pm_b.peers(), "veto side registered the vetoed peer"
+            down += nid_b not in pm_a.peers()
+            time.sleep(0.1)
+        assert down >= 10, f"vetoed link mostly up on the dialer side ({15 - down}/15)"
         assert nid_c in pm_a.peers(), "veto leaked to an unrelated link"
 
         # heal: empty veto lifts the partition; A reconnects via retry

@@ -285,3 +285,43 @@ def test_reindex_event_populates_psql_sink(tmp_path, monkeypatch):
     attrs = {r["composite_key"] for r in db.committed["attributes"]}
     assert "block.height" in attrs
     assert any(r["tx_hash"] for r in db.committed["tx_results"])
+
+
+def test_reindex_event_populates_sqlite_sink(tmp_path):
+    """`reindex-event` with indexer = "sqlite" rebuilds the SQLSink at
+    db_dir/events.sqlite (it previously wrote only a kv index the node
+    never reads under that configuration)."""
+    import sqlite3
+
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+
+    home = str(tmp_path / "node")
+    assert cli_main(["--home", home, "init", "validator", "--chain-id", "sqlite-reindex"]) == 0
+    cfg = load_config(home)
+    cfg.base.db_backend = "filedb"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.enable = False
+    cfg.save()
+    node = Node(cfg)
+    node.start()
+    try:
+        deadline = __import__("time").monotonic() + 60
+        while __import__("time").monotonic() < deadline and node.consensus.rs.height < 3:
+            __import__("time").sleep(0.05)
+        assert node.consensus.rs.height >= 3
+    finally:
+        node.stop()
+
+    cfg = load_config(home)
+    cfg.tx_index.indexer = "sqlite"
+    cfg.save()
+    db_path = os.path.join(cfg.db_dir, "events.sqlite")
+    if os.path.exists(db_path):
+        os.remove(db_path)  # operator wiped the sink; reindex rebuilds it
+    assert cli_main(["--home", home, "reindex-event"]) == 0
+    conn = sqlite3.connect(db_path)
+    heights = [r[0] for r in conn.execute("SELECT height FROM blocks ORDER BY height")]
+    conn.close()
+    assert heights and heights[0] == 1 and len(heights) >= 2
