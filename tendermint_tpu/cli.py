@@ -505,14 +505,12 @@ def cmd_reindex_event(args) -> int:
     if not names or "kv" in names:
         sinks.append(KVIndexer(_make_db(cfg, "tx_index")))
     if "sqlite" in names:
-        import os as _os
-
         from .indexer.sink_sql import SQLSink
         from .types.genesis import GenesisDoc
 
         chain_id = GenesisDoc.from_file(cfg.genesis_file).chain_id
-        _os.makedirs(cfg.db_dir, exist_ok=True)
-        sinks.append(SQLSink(_os.path.join(cfg.db_dir, "events.sqlite"), chain_id))
+        os.makedirs(cfg.db_dir, exist_ok=True)
+        sinks.append(SQLSink(os.path.join(cfg.db_dir, "events.sqlite"), chain_id))
     if "psql" in names:
         from .indexer.sink_psql import PsqlSink
         from .types.genesis import GenesisDoc

@@ -9,8 +9,6 @@ all-valid verdict is an AND-reduce over ICI implemented as
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 import jax

@@ -1,0 +1,283 @@
+"""Implementation-level locking-rule tests for ConsensusState, driven
+deterministically (ref: the TestStateLock_* family,
+internal/consensus/state_test.go — the reference has ten of these; the
+abstract algorithm is model-checked in test_spec_model.py, THESE pin
+the production state machine itself).
+
+Harness: our node is one of four equal-power validators and is never
+the proposer for the rounds under test; the test holds the other three
+keys, crafts signed proposals/parts/votes, feeds them through
+add_peer_message + process_all (no consumer thread), and fires
+timeouts by hand through a capturing ticker — every transition happens
+on the test thread in a deterministic order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from helpers import make_genesis_doc, make_keys
+from tendermint_tpu.abci import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus import ConsensusState, Handshaker
+from tendermint_tpu.consensus.messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.proto.messages import (
+    SIGNED_MSG_TYPE_PRECOMMIT as PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE as PREVOTE,
+)
+from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from tendermint_tpu.store.blockstore import BlockStore
+from tendermint_tpu.store.kv import MemDB
+from tendermint_tpu.consensus.round_state import (
+    STEP_PRECOMMIT_WAIT,
+    STEP_PROPOSE,
+)
+from tendermint_tpu.types.block import BlockID, Commit
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN = "lock-test-chain"
+PART_SIZE = 65536
+
+
+class ManualTicker:
+    """Captures scheduled timeouts; the test fires them by hand."""
+
+    def __init__(self):
+        self.scheduled = []
+
+    def schedule_timeout(self, ti):
+        self.scheduled.append(ti)
+
+    def stop(self):
+        pass
+
+
+class Driver:
+    """One ConsensusState under test + the other three validators'
+    keys for crafting signed traffic."""
+
+    def __init__(self):
+        self.keys = make_keys(4)
+        self.gen_doc = make_genesis_doc(self.keys, CHAIN)
+        state = make_genesis_state(self.gen_doc)
+
+        # our validator must NOT propose in rounds 0..3 of height 1
+        proposers = []
+        vals = state.validators.copy()
+        for _ in range(4):
+            proposers.append(vals.get_proposer().address)
+            vals.increment_proposer_priority(1)
+        by_addr = {k.pub_key().address(): k for k in self.keys}
+        ours = next(
+            k for k in self.keys if k.pub_key().address() not in proposers[:3]
+        )
+        self.our_key = ours
+        self.ext_keys = [k for k in self.keys if k is not ours]
+        self.proposer_key = lambda rnd: by_addr[proposers[rnd]]
+
+        app = LocalClient(KVStoreApplication())
+        store = StateStore(MemDB())
+        bstore = BlockStore(MemDB())
+        store.save(state)
+        state = Handshaker(store, state, bstore, self.gen_doc).handshake(app)
+        self.state = state
+        self.exec = BlockExecutor(store, app, block_store=bstore)
+        self.cs = ConsensusState(
+            state,
+            self.exec,
+            bstore,
+            priv_validator=FilePV(priv_key=ours),
+        )
+        self.ticker = ManualTicker()
+        self.cs.ticker = self.ticker
+        # begin height 1 round 0 (scheduleRound0 analog, fired eagerly)
+        self.cs._enter_new_round(1, 0)
+        self.cs.process_all(0)
+
+    # ------------------------------------------------------------- craft
+
+    def make_block(self, marker: bytes):
+        """A valid height-1 proposal block; marker txs make each block
+        distinct."""
+        app = LocalClient(KVStoreApplication())
+        store = StateStore(MemDB())
+        bstore = BlockStore(MemDB())
+        store.save(make_genesis_state(self.gen_doc))
+        st = Handshaker(store, make_genesis_state(self.gen_doc), bstore, self.gen_doc).handshake(app)
+        ex = BlockExecutor(store, app, block_store=bstore)
+
+        class _Pool:
+            def reap_max_bytes_max_gas(self, mb, mg):
+                return [b"k-%s=1" % marker]
+
+        ex.mempool = _Pool()
+        proposer = self.state.validators.get_proposer().address
+        block = ex.create_proposal_block(1, st, Commit(height=0), proposer)
+        parts = block.make_part_set(PART_SIZE)
+        bid = BlockID(hash=block.hash(), part_set_header=parts.header)
+        return block, parts, bid
+
+    def send_proposal(self, rnd: int, block, parts, bid, pol_round: int = -1):
+        prop = Proposal(
+            height=1, round=rnd, pol_round=pol_round, block_id=bid,
+            timestamp=block.header.time,
+        )
+        key = self.proposer_key(rnd)
+        prop.signature = key.sign(prop.sign_bytes(CHAIN))
+        self.cs.add_peer_message(ProposalMessage(prop), "peer")
+        for i in range(parts.total()):
+            self.cs.add_peer_message(BlockPartMessage(1, rnd, parts.get_part(i)), "peer")
+        self.cs.process_all(0)
+
+    def send_votes(self, vtype: int, rnd: int, bid: BlockID, n: int = 3):
+        vals = self.cs.rs.validators
+        by_addr = {k.pub_key().address(): k for k in self.keys}
+        sent = 0
+        for idx, val in enumerate(vals.validators):
+            key = by_addr[val.address]
+            if key is self.our_key or sent >= n:
+                continue
+            vote = Vote(
+                type=vtype, height=1, round=rnd, block_id=bid,
+                timestamp=Time.now(), validator_address=val.address,
+                validator_index=idx,
+            )
+            vote.signature = key.sign(vote.sign_bytes(CHAIN))
+            self.cs.add_peer_message(VoteMessage(vote), "peer")
+            sent += 1
+        self.cs.process_all(0)
+
+    def fire(self, step: int):
+        """Fire the most recent scheduled timeout with the given step."""
+        for ti in reversed(self.ticker.scheduled):
+            if ti.step == step and ti.height == self.cs.rs.height:
+                self.cs._handle_timeout(ti)
+                self.cs.process_all(0)
+                return
+        raise AssertionError(f"no scheduled timeout with step {step}")
+
+    # ------------------------------------------------------------ observe
+
+    def our_vote(self, vtype: int, rnd: int):
+        vs = (
+            self.cs.rs.votes.prevotes(rnd)
+            if vtype == PREVOTE
+            else self.cs.rs.votes.precommits(rnd)
+        )
+        addr = self.our_key.pub_key().address()
+        for v in vs.list():
+            if v.validator_address == addr:
+                return v
+        return None
+
+
+def _lock_on_block_round0(d: Driver):
+    """Drive round 0 to a lock: proposal + our prevote + 2/3 prevotes
+    for the block -> we lock and precommit it."""
+    block, parts, bid = d.make_block(b"one")
+    d.send_proposal(0, block, parts, bid)
+    v = d.our_vote(PREVOTE, 0)
+    assert v is not None and v.block_id.hash == bid.hash, "did not prevote the proposal"
+    d.send_votes(PREVOTE, 0, bid, n=2)  # +us = 3/4 > 2/3
+    assert d.cs.rs.locked_round == 0
+    assert d.cs.rs.locked_block is not None and d.cs.rs.locked_block.hashes_to(bid.hash)
+    pv = d.our_vote(PRECOMMIT, 0)
+    assert pv is not None and pv.block_id.hash == bid.hash, "did not precommit the lock"
+    return bid
+
+
+def _advance_to_round1(d: Driver):
+    """2/3 nil precommits + precommit-wait timeout -> round 1."""
+    d.send_votes(PRECOMMIT, 0, BlockID(), n=3)
+    d.fire(STEP_PRECOMMIT_WAIT)
+    assert d.cs.rs.round == 1, f"round is {d.cs.rs.round}"
+
+
+def test_lock_then_prevote_nil_on_missing_proposal():
+    """ref TestStateLock_NoPOL: locked at round 0, round 1 brings NO
+    proposal -> propose-timeout prevote is NIL and the lock holds."""
+    d = Driver()
+    bid = _lock_on_block_round0(d)
+    _advance_to_round1(d)
+    d.fire(STEP_PROPOSE)  # propose timeout: no proposal at round 1
+    v = d.our_vote(PREVOTE, 1)
+    assert v is not None and v.is_nil(), "must prevote nil without a proposal"
+    assert d.cs.rs.locked_round == 0
+    assert d.cs.rs.locked_block.hashes_to(bid.hash), "lock must survive"
+
+
+def test_lock_prevote_nil_on_different_fresh_proposal():
+    """ref TestStateLock_PrevoteNilWhenLockedAndDifferentProposal: a
+    DIFFERENT block proposed fresh (no POL) at round 1 gets a NIL
+    prevote from a locked validator; the lock holds."""
+    d = Driver()
+    bid = _lock_on_block_round0(d)
+    _advance_to_round1(d)
+    block2, parts2, bid2 = d.make_block(b"two")
+    assert bid2.hash != bid.hash
+    d.send_proposal(1, block2, parts2, bid2)
+    v = d.our_vote(PREVOTE, 1)
+    assert v is not None and v.is_nil(), "locked validator must not prevote another block"
+    assert d.cs.rs.locked_round == 0
+    assert d.cs.rs.locked_block.hashes_to(bid.hash)
+
+
+def test_relock_same_block_on_new_round():
+    """ref TestStateLock_POLRelock essence: the SAME locked block
+    re-proposed at round 1 gets our prevote (lockedValue == v), and
+    2/3 round-1 prevotes re-lock it at the new round."""
+    d = Driver()
+    bid = _lock_on_block_round0(d)
+    locked_block = d.cs.rs.locked_block
+    locked_parts = d.cs.rs.locked_block_parts
+    _advance_to_round1(d)
+    d.send_proposal(1, locked_block, locked_parts, bid)
+    v = d.our_vote(PREVOTE, 1)
+    assert v is not None and v.block_id.hash == bid.hash, "must prevote own locked block"
+    d.send_votes(PREVOTE, 1, bid, n=2)
+    assert d.cs.rs.locked_round == 1, "lock round must advance on re-lock"
+    assert d.cs.rs.locked_block.hashes_to(bid.hash)
+    pv = d.our_vote(PRECOMMIT, 1)
+    assert pv is not None and pv.block_id.hash == bid.hash
+
+
+def test_pol_updates_lock_to_new_block():
+    """ref TestStateLock_POLUpdateLock: round 1 proposes a DIFFERENT
+    block with 2/3 round-1 prevotes behind it — on seeing proposal +
+    quorum, the validator UNLOCKS the old block, locks the new one,
+    and precommits it (lockedRound <= POL round rule)."""
+    d = Driver()
+    bid = _lock_on_block_round0(d)
+    _advance_to_round1(d)
+    block2, parts2, bid2 = d.make_block(b"two")
+    d.send_proposal(1, block2, parts2, bid2)
+    # our prevote at round 1 was nil (locked elsewhere) — but the other
+    # three prevote the new block: quorum without us
+    d.send_votes(PREVOTE, 1, bid2, n=3)
+    assert d.cs.rs.locked_round == 1, "lock must move to the POL round"
+    assert d.cs.rs.locked_block.hashes_to(bid2.hash), "lock must move to the new block"
+    pv = d.our_vote(PRECOMMIT, 1)
+    assert pv is not None and pv.block_id.hash == bid2.hash
+
+
+def test_no_lock_without_proposal_despite_quorum():
+    """2/3 prevotes for a block we have NO proposal/block for must not
+    lock or precommit it (L36 needs the proposal; matches
+    enterPrecommit's valid-block requirement)."""
+    d = Driver()
+    # round 0: no proposal delivered; externals prevote some unknown id
+    ghost = BlockID(hash=b"\x99" * 32)
+    d.fire(STEP_PROPOSE)  # propose timeout -> we prevote nil
+    d.send_votes(PREVOTE, 0, ghost, n=3)
+    assert d.cs.rs.locked_round == -1
+    assert d.cs.rs.locked_block is None
+    pv = d.our_vote(PRECOMMIT, 0)
+    if pv is not None:
+        assert pv.is_nil(), "precommitted a block we never saw"
