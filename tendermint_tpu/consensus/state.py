@@ -917,11 +917,16 @@ class ConsensusState:
             return
         if proposal.height != rs.height or proposal.round != rs.round:
             return
+        # PEER-INPUT validation failures are ValueErrors: _handle_msg
+        # logs and drops them (ref: defaultSetProposal RETURNS
+        # ErrInvalidProposalPOLRound/Signature, state.go:2151-2161, and
+        # handleMsg logs — one malicious proposal must not be able to
+        # halt the node the way a real invariant break does).
         if proposal.pol_round < -1 or (proposal.pol_round >= 0 and proposal.pol_round >= proposal.round):
-            raise ConsensusError("invalid proposal POL round")
+            raise ValueError("invalid proposal POL round")
         proposer = rs.validators.get_proposer()
         if not proposer.pub_key.verify_signature(proposal.sign_bytes(self.state.chain_id), proposal.signature):
-            raise ConsensusError("invalid proposal signature")
+            raise ValueError("invalid proposal signature")
         rs.proposal = proposal
         rs.proposal_receive_time = recv_time
         if rs.proposal_block_parts is None:
