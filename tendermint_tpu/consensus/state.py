@@ -947,14 +947,21 @@ class ConsensusState:
             if self.metrics is not None:
                 self.metrics.duplicate_block_part.add(1)
             return False
+        # PEER-INPUT failures below are ValueErrors (logged + dropped):
+        # parts and their contents are proposer-controlled bytes, and
+        # the reference RETURNS errors for both (state.go:2220-2233) —
+        # a byzantine proposer must cost a round, not halt the node.
         if rs.proposal_block_parts.byte_size > self.state.consensus_params.block.max_bytes:
-            raise ConsensusError(
+            raise ValueError(
                 f"total size of proposal block parts exceeds maximum block bytes "
                 f"({rs.proposal_block_parts.byte_size} > {self.state.consensus_params.block.max_bytes})"
             )
         if rs.proposal_block_parts.is_complete():
             data = rs.proposal_block_parts.get_data()
-            rs.proposal_block = Block.from_proto(pb.Block.decode(data))
+            try:
+                rs.proposal_block = Block.from_proto(pb.Block.decode(data))
+            except Exception as e:
+                raise ValueError(f"malformed proposal block encoding: {e!r}") from e
         return added
 
     def _handle_complete_proposal(self, height: int) -> None:

@@ -379,3 +379,52 @@ def test_prevote_wait_timeout_precommits_nil():
     pv = d.our_vote(PRECOMMIT, 0)
     assert pv is not None and pv.is_nil(), "split prevotes must precommit nil"
     assert d.cs.rs.locked_round == -1, "must not lock on a split round"
+
+
+def test_malformed_block_encoding_not_fatal():
+    """A byzantine proposer can commit (via the part-set merkle root)
+    to bytes that are NOT a valid block encoding. Decoding failure must
+    be logged-and-dropped like the reference's returned error
+    (state.go:2227-2233), costing the proposer the round — not halt the
+    node. The machine then times out, prevotes nil, and stays live."""
+    from tendermint_tpu.types.part_set import PartSet
+
+    d = Driver()
+    garbage = b"\xde\xad" * 5000  # decodes as no valid Block
+    parts = PartSet.from_data(garbage, PART_SIZE)
+    bid = BlockID(hash=b"\x77" * 32, part_set_header=parts.header)
+    prop = Proposal(height=1, round=0, pol_round=-1, block_id=bid,
+                    timestamp=Time.now())
+    prop.signature = d.proposer_key(0).sign(prop.sign_bytes(CHAIN))
+    d.cs.add_peer_message(ProposalMessage(prop), "peer")
+    for i in range(parts.total()):
+        d.cs.add_peer_message(BlockPartMessage(1, 0, parts.get_part(i)), "peer")
+    d.cs.process_all(0)  # must not raise (fatal in the consumer thread)
+    assert d.cs.rs.proposal is not None  # proposal itself was well-signed
+    assert d.cs.rs.proposal_block is None, "decoded a garbage block"
+    d.fire(STEP_PROPOSE)
+    v = d.our_vote(PREVOTE, 0)
+    assert v is not None and v.is_nil()
+
+
+def test_oversized_proposal_parts_not_fatal():
+    """Parts summing past Block.MaxBytes are rejected with a logged
+    error (ref returns it, state.go:2220-2224), never a halt."""
+    from tendermint_tpu.types.part_set import PartSet
+
+    d = Driver()
+    over = d.cs.state.consensus_params.block.max_bytes + PART_SIZE
+    parts = PartSet.from_data(b"\xab" * over, PART_SIZE)
+    bid = BlockID(hash=b"\x66" * 32, part_set_header=parts.header)
+    prop = Proposal(height=1, round=0, pol_round=-1, block_id=bid,
+                    timestamp=Time.now())
+    prop.signature = d.proposer_key(0).sign(prop.sign_bytes(CHAIN))
+    d.cs.add_peer_message(ProposalMessage(prop), "peer")
+    for i in range(parts.total()):
+        d.cs.add_peer_message(BlockPartMessage(1, 0, parts.get_part(i)), "peer")
+    d.cs.process_all(0)  # must not raise
+    assert d.cs.rs.proposal_block is None
+    # still alive: propose timeout -> nil prevote
+    d.fire(STEP_PROPOSE)
+    v = d.our_vote(PREVOTE, 0)
+    assert v is not None and v.is_nil()
