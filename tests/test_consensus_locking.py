@@ -428,3 +428,49 @@ def test_oversized_proposal_parts_not_fatal():
     d.fire(STEP_PROPOSE)
     v = d.our_vote(PREVOTE, 0)
     assert v is not None and v.is_nil()
+
+
+def test_pbts_untimely_proposal_gets_nil_prevote():
+    """PBTS (defaultDoPrevote's timely arm, state.go:1507 + Proposal.
+    IsTimely types/proposal.go:73): a fresh proposal whose timestamp is
+    further in the past than message_delay + precision is NOT timely —
+    an unlocked validator prevotes nil even though the block itself is
+    valid. A POL re-proposal is exempt (only checked when pol_round ==
+    -1 and we are unlocked)."""
+    from tendermint_tpu.utils.tmtime import Time as T
+
+    d = Driver()
+    block, parts, bid = d.make_block(b"one")
+    # stamp the proposal (and block time must match) far in the past:
+    # beyond message_delay (12s) + precision (505ms) for round 0
+    past = T.from_unix_ns(T.now().unix_ns() - 60 * 1_000_000_000)
+    block.header.time = past
+    block.header.data_hash = b""  # force re-fill of cached hashes
+    block.fill_header()
+    parts = block.make_part_set(PART_SIZE)
+    bid = BlockID(hash=block.hash(), part_set_header=parts.header)
+    d.send_proposal(0, block, parts, bid)
+    v = d.our_vote(PREVOTE, 0)
+    assert v is not None and v.is_nil(), "untimely proposal must get a nil prevote"
+    assert d.cs.rs.locked_round == -1
+
+
+def test_pbts_timely_control_for_untimely_case():
+    """Control for the untimely test: the SAME construction with a
+    current timestamp is accepted and prevoted — proving the nil above
+    comes specifically from the timeliness check, not a side effect of
+    rebuilding the header."""
+    from tendermint_tpu.utils.tmtime import Time as T
+
+    d = Driver()
+    block, parts, bid = d.make_block(b"one")
+    block.header.time = T.now()
+    block.header.data_hash = b""
+    block.fill_header()
+    parts = block.make_part_set(PART_SIZE)
+    bid = BlockID(hash=block.hash(), part_set_header=parts.header)
+    d.send_proposal(0, block, parts, bid)
+    v = d.our_vote(PREVOTE, 0)
+    assert v is not None and v.block_id.hash == bid.hash, (
+        "control construction was rejected for a non-PBTS reason"
+    )
