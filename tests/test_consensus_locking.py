@@ -474,3 +474,76 @@ def test_pbts_timely_control_for_untimely_case():
     assert v is not None and v.block_id.hash == bid.hash, (
         "control construction was rejected for a non-PBTS reason"
     )
+
+
+def test_pol_reproposal_prevoted_when_unlocked():
+    """Algorithm L28 / the defaultDoPrevote POL arm (state.go:1552): an
+    UNLOCKED validator that sees a round-1 re-proposal carrying
+    pol_round=0, with 2/3 round-0 prevotes for that block on record,
+    prevotes it — the POL substitutes for freshness."""
+    d = Driver()
+    block, parts, bid = d.make_block(b"one")
+    # we never see the round-0 proposal: propose timeout -> nil prevote
+    d.fire(STEP_PROPOSE)
+    v0 = d.our_vote(PREVOTE, 0)
+    assert v0 is not None and v0.is_nil()
+    # but the other three DID prevote it at round 0 (2/3 without us)
+    d.send_votes(PREVOTE, 0, bid, n=3)
+    # ... and nil-precommit into round 1
+    d.send_votes(PRECOMMIT, 0, BlockID(), n=3)
+    d.fire(STEP_PRECOMMIT_WAIT)
+    assert d.cs.rs.round == 1
+    # round-1 proposer re-proposes the SAME block with pol_round = 0
+    d.send_proposal(1, block, parts, bid, pol_round=0)
+    v1 = d.our_vote(PREVOTE, 1)
+    assert v1 is not None and v1.block_id.hash == bid.hash, (
+        "POL re-proposal must be prevoted by an unlocked validator"
+    )
+
+
+def test_invalid_block_gets_nil_prevote():
+    """defaultDoPrevote's validate_block arm (state.go:1522): a
+    well-formed proposal whose BLOCK fails validation (wrong app hash
+    lineage — built against a different genesis) draws a nil prevote."""
+    d = Driver()
+    # a block from a DIFFERENT chain: same key set, different chain id
+    other_doc = make_genesis_doc(d.keys, "other-chain")
+    app = LocalClient(KVStoreApplication())
+    store = StateStore(MemDB())
+    bstore = BlockStore(MemDB())
+    store.save(make_genesis_state(other_doc))
+    st = Handshaker(store, make_genesis_state(other_doc), bstore, other_doc).handshake(app)
+    ex = BlockExecutor(store, app, block_store=bstore)
+    proposer = d.cs.rs.validators.get_proposer().address
+    block = ex.create_proposal_block(1, st, Commit(height=0), proposer)
+    parts = block.make_part_set(PART_SIZE)
+    bid = BlockID(hash=block.hash(), part_set_header=parts.header)
+    d.send_proposal(0, block, parts, bid)
+    v = d.our_vote(PREVOTE, 0)
+    assert v is not None and v.is_nil(), "invalid block must get a nil prevote"
+    assert d.cs.rs.locked_round == -1
+
+
+def test_precommit_polka_for_unseen_block_precommits_nil_and_fetches():
+    """enterPrecommit's 'polka for a block we don't have' arm
+    (state.go:1770): 2/3 prevotes land for a block whose proposal/parts
+    we never received while we're in prevote-wait — we precommit NIL
+    and reset ProposalBlockParts to the polka header to fetch it."""
+    from tendermint_tpu.consensus.round_state import STEP_PREVOTE_WAIT
+
+    d = Driver()
+    block, parts, bid = d.make_block(b"one")
+    d.fire(STEP_PROPOSE)  # no proposal: we prevote nil
+    # externals prevote the (to us unknown) block: 2/3 without us
+    d.send_votes(PREVOTE, 0, bid, n=3)
+    # 2/3-any seen -> prevote-wait was scheduled; fire it
+    d.fire(STEP_PREVOTE_WAIT)
+    pv = d.our_vote(PRECOMMIT, 0)
+    assert pv is not None and pv.is_nil(), "must precommit nil for an unseen block"
+    rs = d.cs.rs
+    assert rs.proposal_block is None
+    assert rs.proposal_block_parts is not None
+    assert rs.proposal_block_parts.header == bid.part_set_header, (
+        "must arm the part set to fetch the polka block"
+    )
+    assert rs.locked_round == -1
