@@ -61,7 +61,8 @@ class Driver:
     """One ConsensusState under test + the other three validators'
     keys for crafting signed traffic."""
 
-    def __init__(self):
+    def __init__(self, app_factory=KVStoreApplication):
+        self.app_factory = app_factory
         self.keys = make_keys(4)
         self.gen_doc = make_genesis_doc(self.keys, CHAIN)
         state = make_genesis_state(self.gen_doc)
@@ -81,7 +82,7 @@ class Driver:
         self.ext_keys = [k for k in self.keys if k is not ours]
         self.proposer_key = lambda rnd: by_addr[proposers[rnd]]
 
-        app = LocalClient(KVStoreApplication())
+        app = LocalClient(self.app_factory())
         store = StateStore(MemDB())
         bstore = BlockStore(MemDB())
         store.save(state)
@@ -547,3 +548,24 @@ def test_precommit_polka_for_unseen_block_precommits_nil_and_fetches():
         "must arm the part set to fetch the polka block"
     )
     assert rs.locked_round == -1
+
+
+def test_process_proposal_rejection_gets_nil_prevote():
+    """defaultDoPrevote's ProcessProposal arm (state.go:1537 /
+    PrevoteOnProposalNotAccepted behavior): the APP rejecting the block
+    via ProcessProposal draws a nil prevote even though the block is
+    structurally valid."""
+    from tendermint_tpu.abci import types as abci
+
+    class Rejector(KVStoreApplication):
+        def process_proposal(self, req):
+            return abci.ResponseProcessProposal(
+                status=abci.PROPOSAL_STATUS_REJECT
+            )
+
+    d = Driver(app_factory=Rejector)
+    block, parts, bid = d.make_block(b"one")
+    d.send_proposal(0, block, parts, bid)
+    v = d.our_vote(PREVOTE, 0)
+    assert v is not None and v.is_nil(), "app-rejected proposal must get nil prevote"
+    assert d.cs.rs.locked_round == -1
