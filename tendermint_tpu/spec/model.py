@@ -345,4 +345,29 @@ class Model:
                 k[0] == "prop" and k[2] == vs.decision for k in pool
             ):
                 return ("validity", state)
+        # Lemma invariants — these hold ONLY below the f < n/3
+        # threshold (byzantine double-votes alone fabricate double
+        # polkas at f >= n/3, where agreement itself is the property
+        # under test), so gate them; below threshold they catch a rule
+        # regression at its root, before it cascades into a split
+        # decision:
+        #   polka-exclusivity — no round carries 2/3 prevote quorums for
+        #     two different non-nil values
+        #   decision-evidence — every decision is backed by a 2/3
+        #     precommit quorum for it at some round, in the pool
+        if 3 * self.n_byz >= self.n:
+            return None
+        for r in range(self.max_round + 1):
+            with_quorum = [
+                v for v in VALUES
+                if self._count(pool, "prevote", r, v) >= self.quorum
+            ]
+            if len(with_quorum) > 1:
+                return ("polka-exclusivity", state)
+        for vs in vstates:
+            if vs.decision is not None and not any(
+                self._count(pool, "precommit", r, vs.decision) >= self.quorum
+                for r in range(self.max_round + 1)
+            ):
+                return ("decision-evidence", state)
         return None
