@@ -61,10 +61,18 @@ class Driver:
     """One ConsensusState under test + the other three validators'
     keys for crafting signed traffic."""
 
-    def __init__(self, app_factory=KVStoreApplication):
+    def __init__(self, app_factory=KVStoreApplication, abci_params=None):
         self.app_factory = app_factory
         self.keys = make_keys(4)
         self.gen_doc = make_genesis_doc(self.keys, CHAIN)
+        if abci_params is not None:
+            import dataclasses
+
+            from tendermint_tpu.types.params import ConsensusParams
+
+            self.gen_doc.consensus_params = dataclasses.replace(
+                self.gen_doc.consensus_params or ConsensusParams(), abci=abci_params
+            )
         state = make_genesis_state(self.gen_doc)
 
         # our validator must NOT propose in rounds 0..2 of height 1
@@ -569,3 +577,49 @@ def test_process_proposal_rejection_gets_nil_prevote():
     v = d.our_vote(PREVOTE, 0)
     assert v is not None and v.is_nil(), "app-rejected proposal must get nil prevote"
     assert d.cs.rs.locked_round == -1
+
+
+def test_vote_extensions_deterministic_decide():
+    """Vote-extension height: non-nil precommits must carry app
+    extensions with valid extension signatures (addVote's verification,
+    state.go:2380); a correct set decides and the seen commit is
+    stored. A precommit with a TAMPERED extension signature is rejected
+    (not fatal) and does not count toward the quorum."""
+    from tendermint_tpu.abci.types import RequestExtendVote
+    from tendermint_tpu.types.params import ABCIParams
+
+    d = Driver(abci_params=ABCIParams(vote_extensions_enable_height=1))
+    by_addr = {k.pub_key().address(): k for k in d.keys}
+    block, parts, bid = d.make_block(b"one")
+    d.send_proposal(0, block, parts, bid)
+    d.send_votes(PREVOTE, 0, bid, n=2)
+    # our own precommit must carry the app's extension
+    pv = d.our_vote(PRECOMMIT, 0)
+    assert pv is not None and pv.extension_signature, "own precommit missing extension"
+
+    ext_payload = d.exec.app.extend_vote(RequestExtendVote(height=1)).vote_extension
+    sent = 0
+    for idx, val in enumerate(d.cs.rs.validators.validators):
+        key = by_addr[val.address]
+        if key is d.our_key or sent >= 2:
+            continue
+        vote = Vote(type=PRECOMMIT, height=1, round=0, block_id=bid,
+                    timestamp=Time.now(), validator_address=val.address,
+                    validator_index=idx, extension=ext_payload)
+        vote.signature = key.sign(vote.sign_bytes(CHAIN))
+        if sent == 0:
+            # first one TAMPERED: wrong extension signature -> rejected
+            vote.extension_signature = key.sign(b"not-the-extension-bytes")
+            d.cs.add_peer_message(VoteMessage(vote), "peer")
+            d.cs.process_all(0)
+            assert d.cs.block_store.height() == 0, "decided on a tampered extension"
+            vote = Vote(type=PRECOMMIT, height=1, round=0, block_id=bid,
+                        timestamp=Time.now(), validator_address=val.address,
+                        validator_index=idx, extension=ext_payload)
+            vote.signature = key.sign(vote.sign_bytes(CHAIN))
+        vote.extension_signature = key.sign(vote.extension_sign_bytes(CHAIN))
+        d.cs.add_peer_message(VoteMessage(vote), "peer")
+        d.cs.process_all(0)
+        sent += 1
+    assert d.cs.block_store.height() == 1, "extension-enabled decide failed"
+    assert d.cs.block_store.load_seen_commit(1) is not None
