@@ -289,11 +289,16 @@ class LightClient:
         if not self.witnesses:
             return
         primary_hash = new_lb.signed_header.hash()
+        cross_referenced = 0
         for witness in list(self.witnesses):
             try:
                 w_lb = witness.light_block(new_lb.height)
-            except ProviderError:
-                continue  # witness down — the reference drops it after retries
+            except (ProviderError, OSError):
+                # witness down (wrapped provider error OR a raw network
+                # error from a duck-typed provider) — skip it; the
+                # all-down case is handled below
+                continue
+            cross_referenced += 1
             if w_lb.signed_header.hash() == primary_hash:
                 continue
             # Diverging witness: build attack evidence against whichever
@@ -325,4 +330,12 @@ class LightClient:
             raise ErrLightClientAttack(
                 f"witness {witness.id()} has a different header {w_lb.signed_header.hash().hex()} "
                 f"at height {new_lb.height} (primary: {primary_hash.hex()})"
+            )
+        if cross_referenced == 0:
+            # Every configured witness was unreachable: accepting the
+            # primary's header with ZERO cross-checks is exactly the
+            # eclipse scenario witnesses exist to defeat (ref:
+            # detector.go ErrFailedHeaderCrossReferencing).
+            raise LightClientError(
+                "failed to cross-reference the header with any witness"
             )

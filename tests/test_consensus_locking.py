@@ -598,28 +598,30 @@ def test_vote_extensions_deterministic_decide():
     assert pv is not None and pv.extension_signature, "own precommit missing extension"
 
     ext_payload = d.exec.app.extend_vote(RequestExtendVote(height=1)).vote_extension
-    sent = 0
-    for idx, val in enumerate(d.cs.rs.validators.validators):
-        key = by_addr[val.address]
-        if key is d.our_key or sent >= 2:
-            continue
+    externals = [
+        (idx, by_addr[val.address])
+        for idx, val in enumerate(d.cs.rs.validators.validators)
+        if by_addr[val.address] is not d.our_key
+    ]
+
+    def precommit(idx, key, tampered=False):
         vote = Vote(type=PRECOMMIT, height=1, round=0, block_id=bid,
-                    timestamp=Time.now(), validator_address=val.address,
+                    timestamp=Time.now(), validator_address=key.pub_key().address(),
                     validator_index=idx, extension=ext_payload)
         vote.signature = key.sign(vote.sign_bytes(CHAIN))
-        if sent == 0:
-            # first one TAMPERED: wrong extension signature -> rejected
-            vote.extension_signature = key.sign(b"not-the-extension-bytes")
-            d.cs.add_peer_message(VoteMessage(vote), "peer")
-            d.cs.process_all(0)
-            assert d.cs.block_store.height() == 0, "decided on a tampered extension"
-            vote = Vote(type=PRECOMMIT, height=1, round=0, block_id=bid,
-                        timestamp=Time.now(), validator_address=val.address,
-                        validator_index=idx, extension=ext_payload)
-            vote.signature = key.sign(vote.sign_bytes(CHAIN))
-        vote.extension_signature = key.sign(vote.extension_sign_bytes(CHAIN))
+        vote.extension_signature = key.sign(
+            b"not-the-extension-bytes" if tampered else vote.extension_sign_bytes(CHAIN)
+        )
         d.cs.add_peer_message(VoteMessage(vote), "peer")
         d.cs.process_all(0)
-        sent += 1
+
+    # validator A tampered + validator B valid: with ours that is 3
+    # distinct voters ONLY IF the tampered one counted — height must
+    # still be 0, proving it was excluded from the quorum
+    precommit(*externals[0], tampered=True)
+    precommit(*externals[1])
+    assert d.cs.block_store.height() == 0, "tampered extension counted toward quorum"
+    # a VALID vote from A completes the quorum
+    precommit(*externals[0])
     assert d.cs.block_store.height() == 1, "extension-enabled decide failed"
     assert d.cs.block_store.load_seen_commit(1) is not None

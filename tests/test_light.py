@@ -16,7 +16,7 @@ from tendermint_tpu.light import (
     verify_adjacent,
     verify_non_adjacent,
 )
-from tendermint_tpu.light.client import SEQUENTIAL, ErrLightClientAttack
+from tendermint_tpu.light.client import SEQUENTIAL, ErrLightClientAttack, LightClientError
 from tendermint_tpu.light.verifier import (
     ErrInvalidHeader,
     ErrNewValSetCantBeTrusted,
@@ -238,3 +238,100 @@ def test_client_update_follows_head():
     )
     lb = client.update()
     assert lb.height == node.block_store.height()
+
+
+def test_update_noop_and_conflict_at_trusted_height():
+    """Update() against a primary whose head equals our trusted height:
+    same header -> no-op returning the trusted block; DIFFERENT header
+    at that height -> conflict error, never a silent overwrite
+    (ref: client.go Update same-height hash mismatch)."""
+    node, provider = build_chain()
+    target = node.block_store.height()
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider, clock=lambda: now_after(provider)
+    )
+    client.verify_light_block_at_height(target)
+
+    got = client.update()
+    assert got is not None and got.height == target  # no-op: already at head
+
+    # a primary that rewrites history at our trusted height
+    forged = provider.light_block(target)
+    import copy
+
+    forged = copy.deepcopy(forged)
+    forged.signed_header.header.app_hash = b"\x13" * 32
+    real_lb = provider.light_block
+
+    def lying(h):
+        if h in (0, target):
+            return forged
+        return real_lb(h)
+
+    provider.light_block = lying
+    try:
+        with pytest.raises(LightClientError, match="conflicting header"):
+            client.update()
+    finally:
+        provider.light_block = real_lb
+
+
+def test_verify_below_any_trusted_state_rejected():
+    """Skipping mode holds only the trust root + verified heads; asking
+    for a height BELOW every trusted state must error (backwards
+    verification is its own entry point, ref client.go:497)."""
+    node, provider = build_chain()
+    target = node.block_store.height()
+    client = LightClient(
+        CHAIN,
+        TrustOptions(
+            period_ns=24 * HOUR_NS,
+            height=target,
+            hash=provider.light_block(target).signed_header.hash(),
+        ),
+        provider,
+        clock=lambda: now_after(provider),
+    )
+    client.verify_light_block_at_height(target)
+    with pytest.raises(LightClientError, match="no trusted state below"):
+        client._verify_light_block(provider.light_block(1), now_after(provider))
+
+
+def test_witness_down_is_skipped_not_fatal():
+    """A witness that errors during divergence detection is skipped
+    (the reference drops it after retries); detection still passes via
+    the remaining honest witness."""
+    node, provider = build_chain()
+    target = node.block_store.height()
+
+    class DownProvider:
+        def light_block(self, height):
+            raise ConnectionError("witness down")
+
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider,
+        witnesses=[DownProvider(), provider],
+        clock=lambda: now_after(provider),
+    )
+    lb = client.verify_light_block_at_height(target)
+    assert lb.height == target
+
+
+def test_all_witnesses_down_fails_cross_reference():
+    """Eclipse defense (ref: detector.go ErrFailedHeaderCrossReferencing):
+    when EVERY configured witness is unreachable, verification must fail
+    rather than trust the primary with zero cross-checks."""
+    node, provider = build_chain()
+    target = node.block_store.height()
+
+    class DownProvider:
+        def light_block(self, height):
+            raise ConnectionError("witness down")
+
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider,
+        witnesses=[DownProvider(), DownProvider()],
+        clock=lambda: now_after(provider),
+    )
+    with pytest.raises(LightClientError, match="cross-reference"):
+        client.verify_light_block_at_height(target)
