@@ -39,9 +39,20 @@ class EvidenceABCIError(EvidenceVerifyError):
 def verify_evidence(ev, state, state_store, block_store) -> None:
     """Full contextual verification (ref: verify.go:34 verify).
 
-    Checks age (both height AND time window must be exceeded for
-    expiry, verify.go:59), then dispatches by type.
+    Runs the evidence's stateless ValidateBasic FIRST — the reference's
+    verify CONTRACT ("must run ValidateBasic() on the evidence before
+    verifying", verify.go:159) — which is what ties an LCA's
+    conflicting commit to the header it claims to sign
+    (commit.block_id == header.hash()); without it a crafted LCA with a
+    rewritten conflicting header passes the signature checks, since
+    those verify against commit.block_id. Then checks age (both height
+    AND time window must be exceeded for expiry, verify.go:59) and
+    dispatches by type.
     """
+    try:
+        ev.validate_basic()
+    except ValueError as e:
+        raise EvidenceVerifyError(f"invalid evidence: {e}") from e
     height = state.last_block_height
     ev_params = state.consensus_params.evidence
 

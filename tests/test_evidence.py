@@ -216,3 +216,106 @@ def test_evidence_included_in_proposed_block():
     blk = node.block_store.load_block(found_height)
     assert blk.evidence[0].hash() == ev.hash()
     assert pool.size() == 0  # committed → pruned from pending
+
+
+def _forge_lca_evidence():
+    """Real LightClientAttackEvidence produced by the light client's
+    detector against a forged witness (the lunatic shape: conflicting
+    header carries a different app hash), plus the honest node whose
+    stores a full node would verify it against."""
+    import copy
+
+    from test_light import CHAIN as LCHAIN, _trust_options, build_chain, now_after
+    from tendermint_tpu.light import LightClient, LocalProvider
+    from tendermint_tpu.light.client import ErrLightClientAttack
+
+    node, provider = build_chain()
+    target = node.block_store.height()
+
+    from helpers import sign_commit
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+
+    keys = make_keys(1)  # deterministic: the chain's validator key
+
+    class EvilProvider(LocalProvider):
+        """A REAL lunatic attack: the (byzantine) validator re-signs a
+        header with a forged app hash, so the conflicting block is
+        internally consistent (commit covers the forged header) and
+        passes ValidateBasic — only contextual verification against the
+        honest chain exposes it."""
+
+        def light_block(self, height):
+            lb = super().light_block(height)
+            evil = copy.deepcopy(lb)
+            evil.signed_header.header.app_hash = b"\x66" * 32
+            forged_hash = evil.signed_header.header.hash()
+            bid = BlockID(hash=forged_hash,
+                          part_set_header=PartSetHeader(total=1, hash=b"\x67" * 32))
+            evil.signed_header.commit = sign_commit(
+                LCHAIN, evil.validator_set, keys,
+                evil.signed_header.header.height,
+                lb.signed_header.commit.round, bid,
+            )
+            return evil
+
+    evil = EvilProvider(LCHAIN, node.block_store, node.block_exec.store, name="evil")
+    client = LightClient(
+        LCHAIN, _trust_options(provider), provider, witnesses=[evil],
+        clock=lambda: now_after(provider),
+    )
+    with pytest.raises(ErrLightClientAttack):
+        client.verify_light_block_at_height(target)
+    ev = client.latest_attack_evidence
+    assert ev is not None
+    return node, ev
+
+
+def test_verify_light_client_attack_contextual():
+    """Pool-side contextual verification of REAL detector-produced LCA
+    evidence against the honest chain's stores (ref: verify.go:34 +
+    VerifyLightClientAttack verify.go:115) — the path a full node runs
+    when such evidence arrives by gossip or in a proposed block."""
+    from tendermint_tpu.evidence.verify import (
+        EvidenceABCIError,
+        EvidenceVerifyError,
+        verify_evidence,
+    )
+
+    node, ev = _forge_lca_evidence()
+    state = node.block_exec.store.load()
+    verify_evidence(ev, state, node.block_exec.store, node.block_store)  # valid
+
+    # tampered ABCI component: wrong total voting power -> ABCI error
+    # carrying a regenerator that rectifies it in place (verify.go:136)
+    import copy as _copy
+
+    bad = _copy.deepcopy(ev)
+    bad.total_voting_power = ev.total_voting_power + 7
+    try:
+        verify_evidence(bad, state, node.block_exec.store, node.block_store)
+        raise AssertionError("tampered total power accepted")
+    except EvidenceABCIError as e:
+        e.regenerate()
+    verify_evidence(bad, state, node.block_exec.store, node.block_store)
+
+    # conflicting header REWRITTEN after signing: the attack signatures
+    # no longer cover it -> rejected outright
+    bad2 = _copy.deepcopy(ev)
+    bad2.conflicting_block.signed_header.header.proposer_address = b"\x01" * 20
+    try:
+        verify_evidence(bad2, state, node.block_exec.store, node.block_store)
+        raise AssertionError("rewritten conflicting header accepted")
+    except EvidenceVerifyError as e:
+        # must be the HARD reject (ValidateBasic contract), not an ABCI
+        # mismatch: pool.add_evidence regenerates + stores on the latter
+        assert not isinstance(e, EvidenceABCIError), e
+        assert "invalid evidence" in str(e)
+
+    # evidence rooted at a common height we never had -> rejected
+    bad3 = _copy.deepcopy(ev)
+    bad3.common_height = node.block_store.height() + 100
+    try:
+        verify_evidence(bad3, state, node.block_exec.store, node.block_store)
+        raise AssertionError("unknown common height accepted")
+    except EvidenceVerifyError:
+        pass
