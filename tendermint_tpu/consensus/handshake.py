@@ -133,7 +133,8 @@ class Handshaker:
                     f"app height {app_height} is too far below block store base {store_base}; "
                     "statesync or app snapshot restore required"
                 )
-            state = self._replay_range(state, app_client, app_height, store_height, mutate_app=True)
+            state = self._replay_range(state, app_client, app_height, store_height,
+                                       mutate_app=True, reported_app_hash=app_hash)
             return state
 
         raise AppHashMismatchError(
@@ -141,7 +142,8 @@ class Handshaker:
             "rollback the app or resync"
         )
 
-    def _replay_range(self, state, app_client, from_height: int, to_height: int, mutate_app: bool):
+    def _replay_range(self, state, app_client, from_height: int, to_height: int,
+                      mutate_app: bool, reported_app_hash: bytes = b""):
         """Replay (from, to] (ref: replay.go:378-470 replayBlocks).
 
         Heights the state already covers are executed against the app
@@ -156,27 +158,54 @@ class Handshaker:
             block_store=self.block_store,
             event_publisher=self.event_publisher,
         )
+        # Seed the divergence check with the app's Info-reported hash:
+        # the FIRST replayed block's header records exactly the hash the
+        # app should currently hold — without the seed, divergence that
+        # happened BEFORE the crash slips through when only the final
+        # block needs replaying (apply_block validates against framework
+        # state, not the app).
+        app_hash = reported_app_hash or None
+        state_height_before = state.last_block_height
         for height in range(from_height + 1, to_height + 1):
             block = self.block_store.load_block(height)
             if block is None:
                 raise HandshakeError(f"block store is missing block at height {height}")
+            # each block's header records the app hash AFTER the
+            # previous block: the app's replayed execution must match
+            # it or the app has diverged from the chain (ref:
+            # checkAppHashEqualsOneFromBlock, replay.go:487 — starting
+            # a forked app would make this node propose invalid blocks)
+            if app_hash is not None and block.header.app_hash != app_hash:
+                raise AppHashMismatchError(
+                    f"app hash after replaying height {height - 1} "
+                    f"({app_hash.hex()}) does not match the chain "
+                    f"({block.header.app_hash.hex()})"
+                )
             meta = self.block_store.load_block_meta(height)
             block_id = meta.block_id if meta else BlockID(hash=block.hash(), part_set_header=None)
             if height <= state.last_block_height:
                 if mutate_app:
-                    self._exec_block_on_app(executor, app_client, block, state)
+                    app_hash = self._exec_block_on_app(executor, app_client, block, state)
                     self.n_blocks += 1
                 continue
             state = executor.apply_block(state, block_id, block)
+            app_hash = state.app_hash
             self.n_blocks += 1
+        # the final block has no successor header to check against; when
+        # the framework state ALREADY covered it (exec-only path — gate
+        # on the pre-loop height, apply_block advances the live one),
+        # the state's recorded app hash is the authority
+        if mutate_app and app_hash is not None and to_height <= state_height_before:
+            self._assert_app_hash(state.app_hash, app_hash)
         return state
 
-    def _exec_block_on_app(self, executor, app_client, block, state) -> None:
-        """FinalizeBlock + Commit without touching framework state
-        (ref: replay.go execBlockOnProxyApp)."""
+    def _exec_block_on_app(self, executor, app_client, block, state) -> bytes:
+        """FinalizeBlock + Commit without touching framework state;
+        returns the app's post-block hash for divergence checking
+        (ref: replay.go execBlockOnProxyApp -> ExecCommitBlock)."""
         from ..types.evidence import evidence_to_abci
 
-        app_client.finalize_block(
+        res = app_client.finalize_block(
             abci.RequestFinalizeBlock(
                 hash=block.hash(),
                 height=block.header.height,
@@ -189,6 +218,7 @@ class Handshaker:
             )
         )
         app_client.commit()
+        return res.app_hash
 
     def _apply_from_stored_responses(self, state, height: int):
         """Advance state one height using the FinalizeBlock responses

@@ -90,3 +90,118 @@ def test_handshake_in_sync_is_noop():
     new_state = hs.handshake(client)
     assert hs.n_blocks == 0
     assert new_state.last_block_height == node.block_store.height()
+
+
+def test_handshake_store_ahead_of_both_state_and_app():
+    """Blocks persisted but never applied to EITHER the app or the
+    framework state (crash after block save, before apply): the
+    handshake replays them through the full BlockExecutor.apply_block
+    path (replay.go:378 heights beyond the state)."""
+    from tendermint_tpu.state import make_genesis_state
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.kv import MemDB
+
+    keys = make_keys(1)
+    node, gen_doc = _run_chain(keys, 3)
+    store_height = node.block_store.height()
+    old_hash = node.block_exec.app._app.app_hash
+
+    state0 = make_genesis_state(gen_doc)
+    fresh_store = StateStore(MemDB())
+    fresh_store.save(state0)
+    fresh_app = KVStoreApplication()
+    hs = Handshaker(fresh_store, state0, node.block_store, gen_doc)
+    new_state = hs.handshake(LocalClient(fresh_app))
+    assert new_state.last_block_height == store_height
+    assert hs.n_blocks == store_height
+    assert fresh_app.height == store_height
+    assert fresh_app.app_hash == old_hash
+    assert new_state.app_hash == old_hash
+
+
+def test_handshake_detects_diverged_app_hash():
+    """An app whose replayed execution produces a DIFFERENT app hash
+    than the chain recorded must fail the handshake loudly
+    (AppHashMismatchError) — restarting on corrupted app state would
+    fork the node at its next proposal."""
+    import pytest
+
+    from tendermint_tpu.consensus.handshake import AppHashMismatchError
+
+    keys = make_keys(1)
+    node, gen_doc = _run_chain(keys, 3)
+
+    class DivergedApp(KVStoreApplication):
+        def finalize_block(self, req):
+            res = super().finalize_block(req)
+            res.app_hash = bytes(b ^ 0xFF for b in res.app_hash)
+            self.app_hash = res.app_hash
+            return res
+
+    state = node.block_exec.store.load()
+    hs = Handshaker(node.block_exec.store, state, node.block_store, gen_doc)
+    with pytest.raises(AppHashMismatchError):
+        hs.handshake(LocalClient(DivergedApp()))
+
+
+def test_handshake_app_ahead_of_chain_refused():
+    """An app taller than the block store (wrong data dir / wiped
+    chain) must refuse the handshake (replay.go:368 panic analog) —
+    both with an empty store and with a shorter store."""
+    import pytest
+
+    from tendermint_tpu.abci import types as abci_types
+    from tendermint_tpu.consensus.handshake import AppHashMismatchError
+    from tendermint_tpu.state import make_genesis_state
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.blockstore import BlockStore
+    from tendermint_tpu.store.kv import MemDB
+
+    keys = make_keys(1)
+    node, gen_doc = _run_chain(keys, 2)
+
+    tall_app = KVStoreApplication()
+    for h in range(1, node.block_store.height() + 4):
+        tall_app.finalize_block(abci_types.RequestFinalizeBlock(height=h))
+        tall_app.commit()
+
+    state = node.block_exec.store.load()
+    hs = Handshaker(node.block_exec.store, state, node.block_store, gen_doc)
+    with pytest.raises(AppHashMismatchError, match="higher than the chain"):
+        hs.handshake(LocalClient(tall_app))
+
+    # empty store variant
+    state0 = make_genesis_state(gen_doc)
+    empty_state_store = StateStore(MemDB())
+    empty_state_store.save(state0)
+    hs2 = Handshaker(empty_state_store, state0, BlockStore(MemDB()), gen_doc)
+    with pytest.raises(AppHashMismatchError, match="block store is empty"):
+        hs2.handshake(LocalClient(tall_app))
+
+
+def test_handshake_detects_pre_crash_divergence_on_final_block_replay():
+    """Divergence that happened BEFORE the crash: the app sits at
+    store_height-1 but its Info-reported hash does not match what the
+    chain recorded for that height. The replay seed check must refuse
+    (ref: checkAppHashEqualsOneFromBlock, replay.go:487) — without the
+    seed, only ONE block needs replaying and no later header would
+    ever expose the fork."""
+    import pytest
+
+    from tendermint_tpu.consensus.handshake import AppHashMismatchError
+
+    keys = make_keys(1)
+    node, gen_doc = _run_chain(keys, 3)
+    h = node.block_store.height()
+
+    app = node.block_exec.app._app
+    # roll the app back one height with a CORRUPTED hash
+    app.height = h - 1
+    app.size = max(0, app.size - 1)
+    app.app_hash = b"\xfe" * 8
+    app._committed = (app.height, app.size, app.app_hash)
+
+    state = node.block_exec.store.load()
+    hs = Handshaker(node.block_exec.store, state, node.block_store, gen_doc)
+    with pytest.raises(AppHashMismatchError, match="does not match the chain"):
+        hs.handshake(node.block_exec.app)
