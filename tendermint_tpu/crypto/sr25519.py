@@ -361,7 +361,13 @@ class Sr25519BatchVerifier(BatchVerifier):
         """Device path: launch prep + H2D + kernel now, return a
         completion callable so callers overlap the kernel with host
         work (same contract as Ed25519BatchVerifier.verify_async)."""
-        from .ed25519 import DEVICE_BATCH_CUTOVER, _pk_cache_enabled, _use_device
+        from .ed25519 import (
+            DEVICE_BATCH_CUTOVER,
+            MSM_BATCH_CUTOVER,
+            _msm_enabled,
+            _pk_cache_enabled,
+            _use_device,
+        )
 
         n = len(self._jobs)
         if n == 0:
@@ -372,10 +378,28 @@ class Sr25519BatchVerifier(BatchVerifier):
             pks = [j[0] for j in self._jobs]
             msgs = [j[1] for j in self._jobs]
             sigs = [j[2] for j in self._jobs]
-            if _pk_cache_enabled():
-                dispatched = dev.verify_batch_cached_async(pks, msgs, sigs)
-            else:
-                dispatched = dev.verify_batch_async(pks, msgs, sigs)
+
+            def bitmap_async():
+                if _pk_cache_enabled():
+                    return dev.verify_batch_cached_async(pks, msgs, sigs)
+                return dev.verify_batch_async(pks, msgs, sigs)
+
+            if _msm_enabled() and n >= MSM_BATCH_CUTOVER:
+                # two-phase like the ed25519 plane: the RLC/MSM combined
+                # equation first, per-signature bitmap only on failure
+                from ..ops import msm as dev_msm
+
+                handle = dev_msm.verify_batch_rlc_sr_async(pks, msgs, sigs)
+
+                def complete_msm():
+                    if handle is not None and dev_msm.collect_rlc(handle):
+                        return True, [True] * n
+                    bools = [bool(b) for b in dev.collect(bitmap_async())]
+                    return all(bools), bools
+
+                return complete_msm
+
+            dispatched = bitmap_async()
 
             def complete():
                 bools = [bool(b) for b in dev.collect(dispatched)]

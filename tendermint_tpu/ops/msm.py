@@ -81,6 +81,41 @@ def _tree_reduce_points(p):
     return p
 
 
+def _accumulate_windows(neg, nibs_zk, nibs_z, n):
+    """Shared window-parallel Straus accumulation + Horner + stream
+    reduce for both signature planes: neg holds the stacked negated
+    points (-A | -R, shape (4, 32, 2n)); returns the (4, 32, 1) total
+    of sum zk_i*(-A_i) + z_i*(-R_i) with a valid T coordinate."""
+    g = min(G_STREAMS, n)
+    rounds = n // g
+    w0 = C.identity_point((64, g)) + 0 * neg[:, :, :1, None]  # vma tie
+
+    def round_body(t, w_acc):
+        col_a = lax.dynamic_slice_in_dim(neg, t * g, g, axis=2)
+        col_r = lax.dynamic_slice_in_dim(neg, n + t * g, g, axis=2)
+        tables = C._build_var_table(jnp.concatenate([col_a, col_r], axis=2))
+        d_a = lax.dynamic_slice_in_dim(nibs_zk, t * g, g, axis=1)  # (64, g)
+        d_r = lax.dynamic_slice_in_dim(nibs_z, t * g, g, axis=1)  # (32, g)
+        entry_a = _select_windows(tables[..., :g], d_a)  # (4,32,64,g)
+        entry_r = _select_windows(tables[..., g:], d_r)  # (4,32,32,g)
+        w_acc = C.point_add(w_acc, entry_a, out_t=True)
+        lo = C.point_add(w_acc[:, :, :32], entry_r, out_t=True)
+        return jnp.concatenate([lo, w_acc[:, :, 32:]], axis=2)
+
+    w_acc = lax.fori_loop(0, rounds, round_body, w0)
+
+    def horner_step(i, acc):
+        acc = C.point_double(acc, out_t=False)
+        acc = C.point_double(acc, out_t=False)
+        acc = C.point_double(acc, out_t=False)
+        acc = C.point_double(acc, out_t=True)
+        wth = lax.dynamic_index_in_dim(w_acc, 62 - i, axis=2, keepdims=False)
+        return C.point_add(acc, wth, out_t=True)
+
+    acc = lax.fori_loop(0, 63, horner_step, w_acc[:, :, 63])
+    return _tree_reduce_points(acc)
+
+
 def msm_verify_kernel_impl(a_enc, r_enc, zk_bytes, z_bytes, zs_bytes):
     """Device kernel: the whole RLC equation in one launch.
 
@@ -100,40 +135,7 @@ def msm_verify_kernel_impl(a_enc, r_enc, zk_bytes, z_bytes, zs_bytes):
 
     nibs_zk = C.scalar_to_nibbles(zk_bytes.T.astype(jnp.int32))  # (64, B)
     nibs_z = C.scalar_to_nibbles(z_bytes.T.astype(jnp.int32))  # (32, B)
-
-    g = min(G_STREAMS, n)
-    rounds = n // g
-
-    # W[w, stream] accumulates radix-16 window w contributions; R's
-    # 128-bit scalars only ever touch W[:32].
-    w0 = C.identity_point((64, g)) + 0 * neg[:, :, :1, None]  # vma tie
-
-    def round_body(t, w_acc):
-        # this round's stream columns: A points t*g.., R points offset n
-        col_a = lax.dynamic_slice_in_dim(neg, t * g, g, axis=2)
-        col_r = lax.dynamic_slice_in_dim(neg, n + t * g, g, axis=2)
-        tables = C._build_var_table(jnp.concatenate([col_a, col_r], axis=2))
-        d_a = lax.dynamic_slice_in_dim(nibs_zk, t * g, g, axis=1)  # (64, g)
-        d_r = lax.dynamic_slice_in_dim(nibs_z, t * g, g, axis=1)  # (32, g)
-        entry_a = _select_windows(tables[..., :g], d_a)  # (4,32,64,g)
-        entry_r = _select_windows(tables[..., g:], d_r)  # (4,32,32,g)
-        w_acc = C.point_add(w_acc, entry_a, out_t=True)
-        lo = C.point_add(w_acc[:, :, :32], entry_r, out_t=True)
-        return jnp.concatenate([lo, w_acc[:, :, 32:]], axis=2)
-
-    w_acc = lax.fori_loop(0, rounds, round_body, w0)
-
-    # Horner over windows, most significant first: acc = 16*acc + W[w].
-    def horner_step(i, acc):
-        acc = C.point_double(acc, out_t=False)
-        acc = C.point_double(acc, out_t=False)
-        acc = C.point_double(acc, out_t=False)
-        acc = C.point_double(acc, out_t=True)
-        wth = lax.dynamic_index_in_dim(w_acc, 62 - i, axis=2, keepdims=False)
-        return C.point_add(acc, wth, out_t=True)
-
-    acc = lax.fori_loop(0, 63, horner_step, w_acc[:, :, 63])
-    total = _tree_reduce_points(acc)  # (4, 32, 1)
+    total = _accumulate_windows(neg, nibs_zk, nibs_z, n)
 
     # + [sum z_i s_i]B via the fixed-base comb (64 adds, width 1)
     sb = C.fixed_base_mul(zs_bytes.T.astype(jnp.int32))  # (4, 32, 1)
@@ -219,6 +221,45 @@ def msm_verify_kernel_cached_impl(tables, oks, slots, r_enc, zk_bytes, z_bytes, 
 msm_verify_kernel_cached = jax.jit(msm_verify_kernel_cached_impl)
 
 
+def msm_verify_sr_kernel_impl(a_enc, r_enc, zk_bytes, z_bytes, zs_bytes):
+    """sr25519/ristretto variant of the RLC check: schnorrkel verifies
+    R = [s]B - [c]A, so sum z_i([s_i]B - [c_i]A_i - R_i) must be the
+    group identity. ristretto255 is PRIME order — no cofactor clearing,
+    and identity is decided by the ristretto ENCODING being the
+    32-zero-byte string (projective Edwards equality would miss
+    identity-coset representatives). Same window-parallel accumulation
+    as the ed25519 kernel; decoding rides the ristretto codec
+    (ops/ristretto.py). Padding rows: zero encodings decode to the
+    identity, zero scalars select identity table entries."""
+    from . import ristretto as R
+
+    a = a_enc.T.astype(jnp.int32)
+    r = r_enc.T.astype(jnp.int32)
+    n = a.shape[1]
+    pts, oks = R.decode(jnp.concatenate([a, r], axis=1))
+    neg = C.point_neg(pts)  # -A | -R stacked
+    all_ok = jnp.all(oks)
+
+    nibs_zk = C.scalar_to_nibbles(zk_bytes.T.astype(jnp.int32))  # (64, B)
+    nibs_z = C.scalar_to_nibbles(z_bytes.T.astype(jnp.int32))  # (32, B)
+    total = _accumulate_windows(neg, nibs_zk, nibs_z, n)
+    sb = C.fixed_base_mul(zs_bytes.T.astype(jnp.int32))
+    total = C.point_add(total, sb, out_t=True)  # ristretto encode reads T
+    enc = R.encode(total)  # (32, 1)
+    return all_ok & jnp.all(enc == 0)
+
+
+msm_verify_sr_kernel = jax.jit(msm_verify_sr_kernel_impl)
+
+
+def verify_batch_rlc_sr_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
+    """sr25519 RLC dispatch (same contract as verify_batch_rlc_async;
+    the per-signature sr25519 bitmap kernel is the failure fallback)."""
+    from . import verify_sr as VS
+
+    return _dispatch_rlc(VS.prepare_batch, msm_verify_sr_kernel, pubkeys, msgs, sigs, z_raw)
+
+
 def _rlc_scalars_py(s_rows, k_rows, n, z_raw):
     """Pure-Python randomizer math (fallback + oracle for the native
     path): per-signature zk = z*h mod L rows, the z rows, and
@@ -284,25 +325,30 @@ def _ensure_z_raw(n: int, z_raw: bytes | None) -> bytes:
     return z_raw
 
 
-def verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
-    """Dispatch the RLC check without blocking. Returns an opaque handle
-    for collect_rlc, or None when a precheck failed (malformed input or
-    s >= L) — the caller should go straight to the bitmap plane, exactly
-    like the reference's early return on AddWithError."""
+def _dispatch_rlc(prepare, kernel, pubkeys, msgs, sigs, z_raw):
+    """Shared RLC dispatch for both signature planes: prep, precheck
+    refusal (None -> caller goes straight to its bitmap plane, exactly
+    like the reference's early return on AddWithError), randomizer
+    math, pow2 padding, kernel launch."""
     n = len(sigs)
     if n == 0:
         return None
-    a_enc, r_enc, s_rows, k_rows, precheck = prepare_batch(pubkeys, msgs, sigs)
+    a_enc, r_enc, s_rows, k_rows, precheck = prepare(pubkeys, msgs, sigs)
     if not precheck.all():
         return None
     z_raw = _ensure_z_raw(n, z_raw)
     zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
     a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
-    ok_dev = msm_verify_kernel(
+    return kernel(
         jnp.asarray(a_enc), jnp.asarray(r_enc),
         jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
     )
-    return ok_dev
+
+
+def verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
+    """Dispatch the ed25519 RLC check without blocking. Returns an
+    opaque handle for collect_rlc, or None on precheck refusal."""
+    return _dispatch_rlc(prepare_batch, msm_verify_kernel, pubkeys, msgs, sigs, z_raw)
 
 
 def verify_batch_rlc_cached_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):

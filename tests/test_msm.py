@@ -165,3 +165,38 @@ def test_batch_verifier_two_phase_dispatch(monkeypatch):
     ok2, bools2 = bv2.verify()
     assert ok2 is False
     assert bools2 == [i not in {2, 6} for i in range(8)]
+
+
+def test_msm_sr25519_matches_bitmap_plane(monkeypatch):
+    """sr25519 RLC (ristretto, prime order — identity by zero encoding)
+    agrees with the per-signature sr25519 plane on both polarities, and
+    the Sr25519BatchVerifier two-phase dispatch returns byte-identical
+    results."""
+    from tendermint_tpu.crypto import sr25519 as sr
+    from tendermint_tpu.ops import msm as M
+    from tendermint_tpu.ops import verify_sr as VS
+
+    n = 8
+    priv = sr.Sr25519PrivKey.generate(b"sr-msm-test")
+    pk = priv.pub_key().bytes()
+    msgs = [b"sr-msm-%d" % i for i in range(n)]
+    sigs = [priv.sign(m) for m in msgs]
+    z = Z16 * n
+    assert M.collect_rlc(M.verify_batch_rlc_sr_async([pk] * n, msgs, sigs, z_raw=z)) is True
+    bad = bytearray(sigs[5]); bad[1] ^= 1
+    sigs2 = list(sigs); sigs2[5] = bytes(bad)
+    assert M.collect_rlc(M.verify_batch_rlc_sr_async([pk] * n, msgs, sigs2, z_raw=z)) is False
+    bitmap = VS.collect(VS.verify_batch_async([pk] * n, msgs, sigs2))
+    assert [bool(b) for b in bitmap] == [i != 5 for i in range(n)]
+
+    # two-phase dispatch via the public BatchVerifier
+    import tendermint_tpu.crypto.ed25519 as ed
+
+    monkeypatch.setenv("TM_TPU_CRYPTO", "on")
+    monkeypatch.setattr(ed, "DEVICE_BATCH_CUTOVER", 4)
+    monkeypatch.setattr(ed, "MSM_BATCH_CUTOVER", 4)
+    bv = sr.Sr25519BatchVerifier()
+    for m, s in zip(msgs, sigs2):
+        bv.add(sr.Sr25519PubKey(pk), m, s)
+    ok, bools = bv.verify()
+    assert ok is False and bools == [i != 5 for i in range(n)]
