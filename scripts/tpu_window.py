@@ -93,7 +93,7 @@ from tendermint_tpu.ops import field as F
 from tendermint_tpu.ops import verify as V
 
 PHASES = ("slice256", "pipe_warm", "slice_big", "pipe", "cutover", "cache", "msm",
-          "msm_cache", "fastsync", "mega", "sr", "dot")
+          "msm_cache", "fastsync", "mega", "sr", "msm_sr", "dot")
 todo = [p for p in PHASES if not banked(p)]
 if not todo:
     log("all phases banked; nothing to do")
@@ -155,6 +155,20 @@ if "mega" in todo:
     mega_pk = mega_sk[32:]
     mega_msgs = [b"mega-%d" % i for i in range(MEGA_N)]
     mega_jobs = (mega_pk, mega_msgs, [ref.sign(mega_sk, m) for m in mega_msgs])
+
+sr_msm_jobs = None
+if "msm_sr" in todo:
+    from tendermint_tpu.crypto import sr25519 as _srh
+
+    SR_B = 256
+    _spriv = _srh.Sr25519PrivKey.generate(b"window-sr-msm")
+    sr_msm_jobs = (
+        _spriv.pub_key().bytes(),
+        [b"sr-msm-%03d" % i for i in range(256)],
+        None,
+    )
+    sr_msm_jobs = (sr_msm_jobs[0], sr_msm_jobs[1],
+                   [_spriv.sign(m) for m in sr_msm_jobs[1]])
 
 sr_inputs = None
 if "sr" in todo:
@@ -415,6 +429,27 @@ def _phase_sr():
         f"device-only {B/dt:12,.0f} sigs/s")
 
 
+def _phase_msm_sr():
+    # sr25519 RLC end-to-end at the sr batch size (shares the compiled
+    # accumulation with the ed25519 MSM; ristretto codec differs)
+    from tendermint_tpu.ops import msm as M
+
+    B = SR_B
+    spk2, smsgs2, ssigs2 = sr_msm_jobs
+    t0 = time.time()
+    ok = M.collect_rlc(M.verify_batch_rlc_sr_async([spk2] * B, smsgs2, ssigs2))
+    t_first = time.time() - t0
+    assert ok is True, "sr25519 MSM rejected valid batch"
+    iters = 6
+    t0 = time.time()
+    inflight = [M.verify_batch_rlc_sr_async([spk2] * B, smsgs2, ssigs2) for _ in range(iters)]
+    outs = [M.collect_rlc(h) for h in inflight]
+    dt = (time.time() - t0) / iters
+    assert all(outs)
+    log(f"MSM-SR B={B}  compile+1st {t_first:7.2f}s  pipelined "
+        f"{dt*1000:8.1f}ms = {B/dt:10,.0f} sigs/s")
+
+
 def _phase_dot():
     for B in sorted({b for b in (256, 1024, 2048, 4096, 8192) if b <= MAX_B}):
         t_c, dt = device_only(V.verify_kernel, B)
@@ -475,6 +510,7 @@ run_phase("msm_cache", 480, _phase_msm_cache)
 run_phase("fastsync", 300, _phase_fastsync)
 run_phase("mega", 420, _phase_mega)
 run_phase("sr", 300, _phase_sr)
+run_phase("msm_sr", 420, _phase_msm_sr)
 run_phase("dot", 600, _phase_dot)
 
 remaining = [p for p in PHASES if not banked(p)]
