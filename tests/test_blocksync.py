@@ -650,3 +650,85 @@ def test_blocksync_then_reconstruct_extended_last_commit():
     assert lc is not None and lc.extensions_enabled
     assert lc.has_two_thirds_majority()
     assert any(v is not None and v.extension_signature for v in lc.votes)
+
+
+def test_tampered_block_with_distinct_peers_bans_both():
+    """When blocks h and h+1 came from DIFFERENT peers, a verification
+    failure must ban BOTH and refetch BOTH heights — either sender
+    could be the liar (ref: reactor.go:592-604 errors both)."""
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = fast_params()
+    source = make_node(keys, 0, gen_doc)
+    source.start()
+    try:
+        assert wait_for_height([source], 3, timeout=60)
+    finally:
+        source.stop()
+
+    fresh = make_node(keys, 0, gen_doc)
+    errors = []
+    reactor = _stub_reactor(fresh, errors)
+    b1 = source.block_store.load_block(1)
+    b2 = source.block_store.load_block(2)
+    b1.txs = [b"evil"]
+    b1.header.data_hash = b"\x99" * 32
+    peer1, peer2 = "aa" * 20, "bb" * 20
+    reactor.pool.set_peer_range(peer1, 1, 1)
+    reactor.pool.set_peer_range(peer2, 2, 3)
+    reactor.pool._fill_requests()
+    reactor.pool.add_block(peer1, b1)
+    reactor.pool.add_block(peer2, b2)
+    assert reactor._try_sync_one() is False
+    banned = {e.node_id for e in errors}
+    assert banned == {peer1, peer2}, banned
+    assert peer1 not in reactor.pool.peers
+    assert peer2 not in reactor.pool.peers
+
+
+def test_missing_extended_commit_refetches_at_ve_height():
+    """Vote-extension heights REQUIRE the extended commit alongside the
+    block; a peer omitting it is re-requested + reported
+    (reactor.go:549-553, 590) — without the EC the synced node could
+    never serve extension-aware catch-up."""
+    import dataclasses
+
+    from tendermint_tpu.types.params import ABCIParams
+
+    keys = make_keys(1)
+    gen_doc = make_genesis_doc(keys, CHAIN)
+    gen_doc.consensus_params = dataclasses.replace(
+        fast_params(), abci=ABCIParams(vote_extensions_enable_height=1)
+    )
+    source = make_node(keys, 0, gen_doc)
+    source.start()
+    try:
+        assert wait_for_height([source], 3, timeout=60)
+    finally:
+        source.stop()
+
+    fresh = make_node(keys, 0, gen_doc)
+    errors = []
+    reactor = _stub_reactor(fresh, errors)
+    b1 = source.block_store.load_block(1)
+    b2 = source.block_store.load_block(2)
+    peer = "cc" * 20
+    reactor.pool.set_peer_range(peer, 1, 3)
+    reactor.pool._fill_requests()
+    # peer serves block 1 WITHOUT its extended commit (ext_commit=None)
+    reactor.pool.add_block(peer, b1, ext_commit=None)
+    reactor.pool.add_block(peer, b2)
+    assert reactor._try_sync_one() is False
+    assert errors and errors[0].node_id == peer
+    assert fresh.block_store.height() == 0, "block persisted without its EC"
+    # the honest EC makes the same blocks sync
+    errors.clear()
+    ec1 = source.block_store.load_extended_commit_proto(1)
+    assert ec1 is not None
+    peer2 = "dd" * 20
+    reactor.pool.set_peer_range(peer2, 1, 3)
+    reactor.pool._fill_requests()
+    reactor.pool.add_block(peer2, b1, ext_commit=ec1)
+    reactor.pool.add_block(peer2, b2)
+    assert reactor._try_sync_one() is True
+    assert fresh.block_store.height() == 1
