@@ -394,3 +394,61 @@ def test_node_statesync_join_p2p_only(tmp_path):
             fresh.stop()
     finally:
         validator.stop()
+
+
+def test_statesync_chunk_retry_and_snapshot_retry():
+    """ApplySnapshotChunk result handling (syncer.go fetchChunks):
+    CHUNK_RETRY refetches the one chunk; CHUNK_RETRY_SNAPSHOT restarts
+    the whole chunk set; the sync still completes against a flaky
+    restoring app — the arms a healthy test never touches."""
+    keys, gen_doc, cs, app, client, state_store, block_store = _source_chain()
+
+    from tendermint_tpu.abci import types as abci
+
+    class FlakyRestore(KVStoreApplication):
+        def __init__(self):
+            super().__init__()
+            self.retried = False
+            self.snapshot_retried = False
+
+        def apply_snapshot_chunk(self, req):
+            if not self.retried:
+                self.retried = True
+                return abci.ResponseApplySnapshotChunk(result=abci.CHUNK_RETRY)
+            if not self.snapshot_retried and req.index == 0:
+                # second pass at chunk 0 (after the RETRY refetch):
+                # demand the whole snapshot again once
+                self.snapshot_retried = True
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.CHUNK_RETRY_SNAPSHOT
+                )
+            return super().apply_snapshot_chunk(req)
+
+    net = MemoryNetwork()
+    provider = LocalProvider(CHAIN, block_store, state_store)
+    server = SSNode(net, 0x85, client, state_store, block_store, local_provider=provider)
+
+    fresh_app = FlakyRestore()
+    fresh_client = LocalClient(fresh_app)
+    client_node = SSNode(net, 0x86, fresh_client, StateStore(MemDB()), BlockStore(MemDB()))
+    server.start()
+    client_node.start()
+    try:
+        client_node.pm.add(Endpoint(protocol="memory", host=server.node_id, node_id=server.node_id))
+        lb1 = provider.light_block(1)
+        lc = LightClient(
+            CHAIN,
+            TrustOptions(period_ns=24 * 3600 * 10**9, height=1, hash=lb1.signed_header.hash()),
+            provider,
+            clock=lambda: Time.from_unix_ns(
+                provider.light_block(0).signed_header.header.time.unix_ns() + 10**9
+            ),
+        )
+        sp = LightClientStateProvider(lc, gen_doc)
+        state, commit = client_node.reactor.sync(sp, gen_doc, discovery_time=20.0)
+        assert fresh_app.retried and fresh_app.snapshot_retried, "flaky arms never hit"
+        assert fresh_app.height == state.last_block_height
+        assert fresh_app.db.get(b"kvPairKey:sskey0") == b"ssval0"
+    finally:
+        client_node.stop()
+        server.stop()
