@@ -32,7 +32,9 @@ candidate value).
 
 Bounds: one height, rounds {0..max_round}, two values — the classic
 fork scenarios (lock at round r, conflicting 2/3 at r+1) need exactly
-one round boundary. The f < n/3 threshold itself is validated by the
+one round boundary. Exhaustively verified instances: n=4 f=1 r<=1
+(~600k states, CI), n=5 f=1 r<=1 (11.57M states, off-CI soak), plus a
+20M-state bounded soak at n=4 r<=2 — all violation-free. The f < n/3 threshold itself is validated by the
 companion tests: the same model with byzantine share >= 1/3 must FAIL
 agreement, and does (tests/test_spec_model.py).
 
