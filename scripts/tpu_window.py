@@ -162,13 +162,9 @@ if "msm_sr" in todo:
 
     SR_B = 256
     _spriv = _srh.Sr25519PrivKey.generate(b"window-sr-msm")
-    sr_msm_jobs = (
-        _spriv.pub_key().bytes(),
-        [b"sr-msm-%03d" % i for i in range(256)],
-        None,
-    )
-    sr_msm_jobs = (sr_msm_jobs[0], sr_msm_jobs[1],
-                   [_spriv.sign(m) for m in sr_msm_jobs[1]])
+    _sr_msgs = [b"sr-msm-%03d" % i for i in range(256)]
+    sr_msm_jobs = (_spriv.pub_key().bytes(), _sr_msgs,
+                   [_spriv.sign(m) for m in _sr_msgs])
 
 sr_inputs = None
 if "sr" in todo:

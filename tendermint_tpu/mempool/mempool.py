@@ -183,6 +183,10 @@ class TxMempool:
 
     # ----------------------------------------------------------- checktx
 
+    def _over_gas_cap(self, res) -> bool:
+        """PostCheckMaxGas predicate, shared by admission and recheck."""
+        return res.is_ok and self.max_gas > -1 and res.gas_wanted > self.max_gas
+
     def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
         """Admission path (ref: CheckTx mempool.go:175). Raises on
         oversize/full/duplicate; returns the app's response otherwise."""
@@ -206,11 +210,7 @@ class TxMempool:
         # instead of polluting the pool forever. A POLICY rejection, not
         # a peer fault: gossiping peers may hold the older cap (the
         # reference's postCheck failures never punish the sender).
-        if (
-            res.is_ok
-            and self.max_gas > -1
-            and res.gas_wanted > self.max_gas
-        ):
+        if self._over_gas_cap(res):
             if not self._keep_invalid:
                 self._cache.remove(key)
             if self._metrics is not None:
@@ -364,10 +364,7 @@ class TxMempool:
         queue forever."""
         for wtx in list(self._txs.values()):
             res = self._app.check_tx(abci.RequestCheckTx(tx=wtx.tx, type=1))
-            over_gas = (
-                res.is_ok and self.max_gas > -1 and res.gas_wanted > self.max_gas
-            )
-            if not res.is_ok or over_gas:
+            if not res.is_ok or self._over_gas_cap(res):
                 self._remove(wtx.key)
                 if not self._keep_invalid:
                     self._cache.remove(wtx.key)

@@ -323,7 +323,8 @@ class Model:
                 succ = self.successors(state)
                 if not succ:
                     break
-                # prefer a deciding transition, then any non-timeout
+                # prefer a successor where someone newly decided,
+                # else take the first enabled transition (greedy)
                 pick = None
                 for s in succ:
                     if any(

@@ -335,3 +335,33 @@ def test_all_witnesses_down_fails_cross_reference():
     )
     with pytest.raises(LightClientError, match="cross-reference"):
         client.verify_light_block_at_height(target)
+
+
+def test_lagging_witness_retried_not_fatal():
+    """A witness that merely LAGS the head (ErrLightBlockNotFound, not
+    a network failure) is retried with backoff and verification
+    succeeds once it catches up — head-of-chain updates must not trip
+    the zero-cross-reference failure on honest setups."""
+    from tendermint_tpu.light.provider import ErrLightBlockNotFound
+
+    node, provider = build_chain()
+    target = node.block_store.height()
+
+    class LaggingProvider:
+        def __init__(self):
+            self.calls = 0
+
+        def light_block(self, height):
+            self.calls += 1
+            if self.calls <= 2:
+                raise ErrLightBlockNotFound(f"no light block at height {height}")
+            return provider.light_block(height)
+
+    lagging = LaggingProvider()
+    client = LightClient(
+        CHAIN, _trust_options(provider), provider, witnesses=[lagging],
+        clock=lambda: now_after(provider),
+    )
+    lb = client.verify_light_block_at_height(target)
+    assert lb.height == target
+    assert lagging.calls >= 3, "witness was not retried"
