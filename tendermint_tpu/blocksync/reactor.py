@@ -414,7 +414,7 @@ class BlockSyncReactor:
             return None
         from ..crypto import batch as crypto_batch
         from ..types.block import Commit, CommitSig
-        from ..types.validation import verify_commit
+        from ..types.validation import verify_commit_async
         from ..types.vote import votes_from_extended_commit
         from ..utils.tmtime import Time
 
@@ -442,13 +442,19 @@ class BlockSyncReactor:
                 for s in sigs
             ],
         )
+        # Dispatch the vote-signature batch NOW and collect it after the
+        # extension batch is also in flight: the two launches overlap
+        # (and coalesce into one when the engine plane is on) instead of
+        # running back to back. Error priority is unchanged — vote
+        # verification failures report before address/extension ones.
         try:
-            verify_commit(chain_id, vals, first_id, height, commit)
+            complete_votes = verify_commit_async(chain_id, vals, first_id, height, commit)
         except Exception as e:
             return ValueError(f"extended commit votes failed verification: {e}")
         # Extension signatures (COMMIT slots only), batched likewise.
         votes = votes_from_extended_commit(ec)
         ext_jobs = []
+        addr_err = None
         for idx, v in enumerate(votes):
             if v is None:
                 continue
@@ -457,19 +463,39 @@ class BlockSyncReactor:
             # letting one through here would poison the store.
             addr, val = vals.get_by_index(idx)
             if val is None or v.validator_address != addr:
-                return ValueError(f"extended commit signature {idx} has wrong validator address")
+                addr_err = ValueError(f"extended commit signature {idx} has wrong validator address")
+                break
             if v.block_id.is_nil():
                 continue
             ext_jobs.append((val.pub_key, v.extension_sign_bytes(chain_id), v.extension_signature))
-        if ext_jobs:
+        pending_ext = None
+        if addr_err is None and ext_jobs:
             proposer_pk = ext_jobs[0][0]
             if crypto_batch.supports_batch_verifier(proposer_pk):
                 bv = crypto_batch.create_batch_verifier(proposer_pk)
                 try:
                     for pk, msg, sig in ext_jobs:
                         bv.add(pk, msg, sig)
-                    ok, bits = bv.verify()
+                    pending_ext = bv.verify_async()
                 except ValueError:
+                    pending_ext = None  # mixed key types: serial below
+        try:
+            complete_votes()
+        except Exception as e:
+            return ValueError(f"extended commit votes failed verification: {e}")
+        if addr_err is not None:
+            return addr_err
+        if ext_jobs:
+            if pending_ext is not None:
+                try:
+                    ok, _ = pending_ext()
+                except Exception:
+                    # Batch/engine failure (mixed key types at collect,
+                    # a dropped device tunnel, a coalesced group sunk by
+                    # another caller's job): the serial host chain is
+                    # authoritative and dependency-free. Escaping here
+                    # would halt the node via on_fatal for a fault that
+                    # only deserves a peer retry.
                     ok = all(pk.verify_signature(msg, sig) for pk, msg, sig in ext_jobs)
             else:
                 ok = all(pk.verify_signature(msg, sig) for pk, msg, sig in ext_jobs)

@@ -138,7 +138,9 @@ def _pk_cache_enabled() -> bool:
 # Below this many signatures a device launch costs more than it saves
 # (dispatch + transfer latency vs ~125us/sig native host verify); the
 # batch verifier then runs serially on host. SURVEY "hard parts": a
-# 4-validator commit must not regress vs CPU. Tunable for benchmarking.
+# 4-validator commit must not regress vs CPU. The env value pins it;
+# otherwise it is a DEFAULT that ops/engine.maybe_autotune refines from
+# a one-shot launch-latency microprobe when an accelerator is present.
 DEVICE_BATCH_CUTOVER = int(os.environ.get("TM_TPU_BATCH_CUTOVER", "64"))
 
 # At or above this batch size the randomized-linear-combination MSM
@@ -146,7 +148,7 @@ DEVICE_BATCH_CUTOVER = int(os.environ.get("TM_TPU_BATCH_CUTOVER", "64"))
 # runs first and the per-signature bitmap kernel only on failure — the
 # reference's two-phase shape (types/validation.go:245-255). Below it
 # the MSM's Horner/reduce tail isn't amortized. TM_TPU_MSM=off disables
-# the fast path entirely.
+# the fast path entirely. Autotuned like DEVICE_BATCH_CUTOVER above.
 MSM_BATCH_CUTOVER = int(os.environ.get("TM_TPU_MSM_CUTOVER", "256"))
 
 
@@ -192,6 +194,15 @@ def _single_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
             return True
         except (_InvalidSignature, ValueError):
             pass  # fall through: may still be ZIP-215-acceptable
+    elif len(pub) == 32 and len(sig) == 64:
+        # no `cryptography` package: the dlopen'd libcrypto loop
+        # (native/prep.c tm_host_verify) gives the same OpenSSL fast
+        # path — acceptance is a subset of ZIP-215, so True is final
+        from ..native import host_verify_batch
+
+        bitmap = host_verify_batch([pub], [msg], [sig])
+        if bitmap is not None and bitmap[0]:
+            return True
     return ref.verify(pub, msg, sig, zip215=True)
 
 
@@ -227,13 +238,30 @@ class Ed25519BatchVerifier(BatchVerifier):
         return self.verify_async()()
 
     def verify_async(self):
-        """Device path: launch prep + H2D + kernel now, return a
-        completion callable — callers overlap the kernel with host work
-        (e.g. blocksync applies block h while h+1's commit verifies).
-        Host path: completes eagerly (nothing to overlap)."""
+        """Engine path (TM_TPU_ENGINE=auto/on, the default): submit to
+        the process-wide coalescing pipeline (ops/engine.py) — jobs from
+        concurrent callers merge into one launch with per-caller demux,
+        prep for batch i+1 overlaps batch i's kernel, and sub-cutover
+        batches ride the threaded C host plane. Returns a completion
+        callable either way.
+
+        Direct path (TM_TPU_ENGINE=off): launch prep + H2D + kernel
+        now, return a completion callable — callers overlap the kernel
+        with host work (e.g. blocksync applies block h while h+1's
+        commit verifies). Host path completes eagerly (nothing to
+        overlap). Acceptance is byte-identical between the two."""
         n = len(self._sigs)
         if n == 0:
             return lambda: (False, [])
+        from ..ops import engine as _engine
+
+        if _engine.engine_enabled():
+            return _engine.verify_async_via_engine(
+                KEY_TYPE, self._pks, self._msgs, self._sigs
+            )
+        # direct dispatch: the cutovers below still deserve the one-shot
+        # launch-latency calibration (no-op after the first call)
+        _engine.maybe_autotune()
         if _use_device() and n >= DEVICE_BATCH_CUTOVER:
             from ..ops import verify as dev
 
@@ -261,11 +289,17 @@ class Ed25519BatchVerifier(BatchVerifier):
                     )
                 else:
                     handle = dev_msm.verify_batch_rlc_async(self._pks, self._msgs, self._sigs)
+                # A precheck refusal (None handle) means phase 2 is
+                # certain: dispatch the bitmap NOW so the caller keeps
+                # the launch-now/collect-later overlap instead of
+                # paying the whole launch at collect time.
+                dispatched = bitmap_async() if handle is None else None
 
                 def complete_msm():
                     if handle is not None and dev_msm.collect_rlc(handle):
                         return True, [True] * n
-                    bools = [bool(b) for b in dev.collect(bitmap_async())]
+                    pending = dispatched if dispatched is not None else bitmap_async()
+                    bools = [bool(b) for b in dev.collect(pending)]
                     return all(bools), bools
 
                 return complete_msm

@@ -372,6 +372,18 @@ class Sr25519BatchVerifier(BatchVerifier):
         n = len(self._jobs)
         if n == 0:
             return lambda: (False, [])
+        from ..ops import engine as _engine
+
+        if _engine.engine_enabled():
+            return _engine.verify_async_via_engine(
+                KEY_TYPE,
+                [j[0] for j in self._jobs],
+                [j[1] for j in self._jobs],
+                [j[2] for j in self._jobs],
+            )
+        # direct dispatch: the cutovers below still deserve the one-shot
+        # launch-latency calibration (no-op after the first call)
+        _engine.maybe_autotune()
         if _use_device() and n >= DEVICE_BATCH_CUTOVER:
             from ..ops import verify_sr as dev
 
@@ -386,15 +398,19 @@ class Sr25519BatchVerifier(BatchVerifier):
 
             if _msm_enabled() and n >= MSM_BATCH_CUTOVER:
                 # two-phase like the ed25519 plane: the RLC/MSM combined
-                # equation first, per-signature bitmap only on failure
+                # equation first, per-signature bitmap only on failure.
+                # A precheck refusal dispatches the bitmap immediately,
+                # preserving the launch-now/collect-later overlap.
                 from ..ops import msm as dev_msm
 
                 handle = dev_msm.verify_batch_rlc_sr_async(pks, msgs, sigs)
+                dispatched = bitmap_async() if handle is None else None
 
                 def complete_msm():
                     if handle is not None and dev_msm.collect_rlc(handle):
                         return True, [True] * n
-                    bools = [bool(b) for b in dev.collect(bitmap_async())]
+                    pending = dispatched if dispatched is not None else bitmap_async()
+                    bools = [bool(b) for b in dev.collect(pending)]
                     return all(bools), bools
 
                 return complete_msm

@@ -289,63 +289,72 @@ class LightClient:
         if not self.witnesses:
             return
         primary_hash = new_lb.signed_header.hash()
+        # A witness merely LAGGING the head (ErrLightBlockNotFound: it
+        # has not stored the freshly-committed height yet) gets bounded
+        # retries with a short backoff before being counted down — the
+        # reference detector retries not-yet-available witnesses the
+        # same way (detector.go compareNewHeaderWithWitness
+        # maxRetryAttempts); without this, every head-of-chain update
+        # intermittently trips the zero-cross-reference failure on
+        # honest setups. Retries run as SHARED passes over every
+        # still-lagging witness (one backoff sleep per pass, between
+        # passes only — never after the final attempt), so k exhausted
+        # witnesses cost one 0.6s retry window total, not 0.6s each.
         cross_referenced = 0
-        for witness in list(self.witnesses):
-            w_lb = None
-            # A witness merely LAGGING the head (ErrLightBlockNotFound:
-            # it has not stored the freshly-committed height yet) gets
-            # bounded retries with a short backoff before being counted
-            # down — the reference detector retries not-yet-available
-            # witnesses the same way (detector.go compareNewHeaderWith
-            # Witness maxRetryAttempts); without this, every
-            # head-of-chain update intermittently trips the
-            # zero-cross-reference failure on honest setups.
-            for attempt in range(3):
+        remaining = list(self.witnesses)
+        for attempt in range(3):
+            if attempt:
+                import time as _time
+
+                _time.sleep(0.2 * attempt)
+            lagging = []
+            for witness in remaining:
                 try:
                     w_lb = witness.light_block(new_lb.height)
-                    break
                 except ErrLightBlockNotFound:
-                    import time as _time
-
-                    _time.sleep(0.2 * (attempt + 1))
+                    lagging.append(witness)
+                    continue
                 except (ProviderError, OSError):
                     # hard-down witness (network error): no retry value
-                    break
-            if w_lb is None:
-                continue
-            cross_referenced += 1
-            if w_lb.signed_header.hash() == primary_hash:
-                continue
-            # Diverging witness: build attack evidence against whichever
-            # chain is lying, with the ABCI component fully populated so
-            # full nodes accept it as-is (ref: detector.go:404
-            # newLightClientAttackEvidence).
-            common = self.store.light_block_before(new_lb.height)
-            ev = LightClientAttackEvidence(conflicting_block=w_lb)
-            if common is not None and ev.conflicting_header_is_invalid(new_lb.signed_header.header):
-                # lunatic: root at the common header
-                ev.common_height = common.height
-                ev.timestamp = common.signed_header.header.time
-                ev.total_voting_power = common.validator_set.total_voting_power()
-            else:
-                # equivocation/amnesia: validator sets are the same
-                ev.common_height = new_lb.height
-                ev.timestamp = new_lb.signed_header.header.time
-                ev.total_voting_power = new_lb.validator_set.total_voting_power()
-            if common is not None:
-                ev.byzantine_validators = ev.get_byzantine_validators(
-                    common.validator_set, new_lb.signed_header
+                    continue
+                cross_referenced += 1
+                if w_lb.signed_header.hash() == primary_hash:
+                    continue
+                # Diverging witness: build attack evidence against
+                # whichever chain is lying, with the ABCI component
+                # fully populated so full nodes accept it as-is
+                # (ref: detector.go:404 newLightClientAttackEvidence).
+                # Raised IMMEDIATELY — a conflicting header must not
+                # wait out other witnesses' retry backoffs.
+                common = self.store.light_block_before(new_lb.height)
+                ev = LightClientAttackEvidence(conflicting_block=w_lb)
+                if common is not None and ev.conflicting_header_is_invalid(new_lb.signed_header.header):
+                    # lunatic: root at the common header
+                    ev.common_height = common.height
+                    ev.timestamp = common.signed_header.header.time
+                    ev.total_voting_power = common.validator_set.total_voting_power()
+                else:
+                    # equivocation/amnesia: validator sets are the same
+                    ev.common_height = new_lb.height
+                    ev.timestamp = new_lb.signed_header.header.time
+                    ev.total_voting_power = new_lb.validator_set.total_voting_power()
+                if common is not None:
+                    ev.byzantine_validators = ev.get_byzantine_validators(
+                        common.validator_set, new_lb.signed_header
+                    )
+                self.latest_attack_evidence = ev
+                for p in [self.primary] + self.witnesses:
+                    try:
+                        p.report_evidence(ev)
+                    except Exception:
+                        pass
+                raise ErrLightClientAttack(
+                    f"witness {witness.id()} has a different header {w_lb.signed_header.hash().hex()} "
+                    f"at height {new_lb.height} (primary: {primary_hash.hex()})"
                 )
-            self.latest_attack_evidence = ev
-            for p in [self.primary] + self.witnesses:
-                try:
-                    p.report_evidence(ev)
-                except Exception:
-                    pass
-            raise ErrLightClientAttack(
-                f"witness {witness.id()} has a different header {w_lb.signed_header.hash().hex()} "
-                f"at height {new_lb.height} (primary: {primary_hash.hex()})"
-            )
+            remaining = lagging
+            if not remaining:
+                break
         if cross_referenced == 0:
             # Every configured witness was unreachable: accepting the
             # primary's header with ZERO cross-checks is exactly the

@@ -77,7 +77,63 @@ def load_prep():
                     u8p,  # zs_out (32)
                 ]
                 lib.tm_rlc_scalars.restype = None
+            # a stale .so may predate tm_host_verify; absence degrades
+            # only the host-path batch verify (callers fall back to the
+            # per-signature Python chain)
+            if hasattr(lib, "tm_host_verify"):
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                lib.tm_host_verify.argtypes = [
+                    ctypes.c_char_p,  # pks (n*32)
+                    ctypes.c_char_p,  # sigs (n*64)
+                    ctypes.c_char_p,  # msgs (concatenated)
+                    ctypes.POINTER(ctypes.c_int64),  # offsets (n+1)
+                    ctypes.c_int64,  # n
+                    u8p,  # out (n)
+                ]
+                lib.tm_host_verify.restype = ctypes.c_int
             _lib = lib
         except Exception:
             _load_failed = True
     return _lib
+
+
+def host_verify_batch(pubkeys, msgs, sigs):
+    """Batched host-path ed25519 verification through libcrypto's EVP
+    loop in C (prep.c tm_host_verify): ONE ctypes call per batch, GIL
+    released throughout, threaded across cores inside C.
+
+    Returns an (n,) bool numpy array where True is authoritative
+    (OpenSSL acceptance is a subset of ZIP-215 acceptance) and False
+    means "re-check with the ZIP-215 oracle", or None when the native
+    library / libcrypto is unavailable or the inputs have non-standard
+    lengths (callers take the per-signature Python chain)."""
+    import numpy as np
+
+    n = len(sigs)
+    if (
+        n == 0
+        or len(pubkeys) != n
+        or len(msgs) != n
+        or any(len(pk) != 32 for pk in pubkeys)
+        or any(len(sg) != 64 for sg in sigs)
+    ):
+        return None
+    lib = load_prep()
+    if lib is None or not hasattr(lib, "tm_host_verify"):
+        return None
+    import ctypes
+
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(m) for m in msgs], out=offsets[1:])
+    out = np.zeros(n, np.uint8)
+    rc = lib.tm_host_verify(
+        b"".join(pubkeys),
+        b"".join(sigs),
+        b"".join(msgs),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if not rc:
+        return None
+    return out.astype(bool)

@@ -425,3 +425,135 @@ void prepare_batch(const uint8_t *pks, const uint8_t *sigs,
     }
     for (int t = 0; t < started; t++) pthread_join(threads[t], 0);
 }
+
+/* -------------------- OpenSSL EVP ed25519 host verify -----------------
+ *
+ * The host-path analog of the batch kernel: one C call verifies a whole
+ * batch through libcrypto's ed25519 (RFC 8032, cofactorless), threaded
+ * across cores. The caller's ctypes FFI releases the GIL for the whole
+ * batch, so — unlike a Python loop over per-signature FFI calls, which
+ * reacquires the GIL between calls and scales at ~0.6x with threads —
+ * this reaches near-linear multicore scaling.
+ *
+ * Acceptance contract (same as crypto/ed25519._single_verify): anything
+ * OpenSSL ACCEPTS is also ZIP-215-valid, so out[i]=1 is authoritative;
+ * out[i]=0 only means "not RFC-8032-accepted" and the caller re-checks
+ * those rows with the pure-Python ZIP-215 oracle. libcrypto is dlopen'd
+ * like SHA512 above — its absence degrades to the Python path (return
+ * 0), never breaks the build. */
+
+typedef void *(*evp_pkey_new_raw_fn)(int, void *, const unsigned char *, size_t);
+typedef void (*evp_pkey_free_fn)(void *);
+typedef void *(*evp_md_ctx_new_fn)(void);
+typedef void (*evp_md_ctx_free_fn)(void *);
+typedef int (*evp_dv_init_fn)(void *, void **, const void *, void *, void *);
+typedef int (*evp_dv_fn)(void *, const unsigned char *, size_t,
+                         const unsigned char *, size_t);
+typedef void (*err_clear_fn)(void);
+
+static struct {
+    int ready;
+    evp_pkey_new_raw_fn pkey_new_raw;
+    evp_pkey_free_fn pkey_free;
+    evp_md_ctx_new_fn ctx_new;
+    evp_md_ctx_free_fn ctx_free;
+    evp_dv_init_fn dv_init;
+    evp_dv_fn dv;
+    err_clear_fn err_clear;
+} evp;
+static pthread_once_t evp_once = PTHREAD_ONCE_INIT;
+
+static void evp_resolve(void) {
+    const char *names[] = {"libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so", 0};
+    for (int i = 0; names[i]; i++) {
+        void *h = dlopen(names[i], RTLD_NOW | RTLD_LOCAL);
+        if (!h) continue;
+        evp.pkey_new_raw = (evp_pkey_new_raw_fn)dlsym(h, "EVP_PKEY_new_raw_public_key");
+        evp.pkey_free = (evp_pkey_free_fn)dlsym(h, "EVP_PKEY_free");
+        evp.ctx_new = (evp_md_ctx_new_fn)dlsym(h, "EVP_MD_CTX_new");
+        evp.ctx_free = (evp_md_ctx_free_fn)dlsym(h, "EVP_MD_CTX_free");
+        evp.dv_init = (evp_dv_init_fn)dlsym(h, "EVP_DigestVerifyInit");
+        evp.dv = (evp_dv_fn)dlsym(h, "EVP_DigestVerify");
+        evp.err_clear = (err_clear_fn)dlsym(h, "ERR_clear_error");
+        if (evp.pkey_new_raw && evp.pkey_free && evp.ctx_new && evp.ctx_free
+            && evp.dv_init && evp.dv) {
+            evp.ready = 1;
+            return;
+        }
+        dlclose(h);
+    }
+}
+
+#define TM_EVP_PKEY_ED25519 1087 /* NID_ED25519, stable across 1.1.1 / 3.x */
+
+static void verify_range(const uint8_t *pks, const uint8_t *sigs,
+                         const uint8_t *msgs, const int64_t *offsets,
+                         int64_t lo, int64_t hi, uint8_t *out) {
+    for (int64_t i = lo; i < hi; i++) {
+        out[i] = 0;
+        void *pkey = evp.pkey_new_raw(TM_EVP_PKEY_ED25519, 0, pks + 32 * i, 32);
+        if (!pkey) {
+            if (evp.err_clear) evp.err_clear();
+            continue;
+        }
+        void *ctx = evp.ctx_new();
+        if (ctx) {
+            if (evp.dv_init(ctx, 0, 0, 0, pkey) == 1
+                && evp.dv(ctx, sigs + 64 * i, 64, msgs + offsets[i],
+                          (size_t)(offsets[i + 1] - offsets[i])) == 1)
+                out[i] = 1;
+            evp.ctx_free(ctx);
+        }
+        evp.pkey_free(pkey);
+        /* failed inits/verifies leave entries on the thread-local error
+         * queue; clear so long-lived callers don't accumulate them */
+        if (!out[i] && evp.err_clear) evp.err_clear();
+    }
+}
+
+typedef struct {
+    const uint8_t *pks, *sigs, *msgs;
+    const int64_t *offsets;
+    int64_t lo, hi;
+    uint8_t *out;
+} verify_job;
+
+static void *verify_worker(void *arg) {
+    verify_job *j = (verify_job *)arg;
+    verify_range(j->pks, j->sigs, j->msgs, j->offsets, j->lo, j->hi, j->out);
+    return 0;
+}
+
+/* Inputs: pks n*32, sigs n*64, msgs concatenated with offsets[n+1].
+ * Output: out[i] = 1 iff OpenSSL accepts row i. Returns 1 when
+ * libcrypto served the batch, 0 when it is unavailable (out untouched —
+ * the caller must take its Python path). */
+int tm_host_verify(const uint8_t *pks, const uint8_t *sigs,
+                   const uint8_t *msgs, const int64_t *offsets, int64_t n,
+                   uint8_t *out) {
+    pthread_once(&evp_once, evp_resolve);
+    if (!evp.ready) return 0;
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    int nthreads = (int)(ncpu < 1 ? 1 : (ncpu > 8 ? 8 : ncpu));
+    /* a verify is ~100x a prep row, so threads pay off far earlier */
+    if (n < 16 || nthreads == 1) {
+        verify_range(pks, sigs, msgs, offsets, 0, n, out);
+        return 1;
+    }
+    pthread_t threads[8];
+    verify_job jobs[8];
+    int64_t chunk = (n + nthreads - 1) / nthreads;
+    int started = 0;
+    for (int t = 0; t < nthreads; t++) {
+        int64_t lo = t * chunk, hi = lo + chunk > n ? n : lo + chunk;
+        if (lo >= hi) break;
+        jobs[t] = (verify_job){pks, sigs, msgs, offsets, lo, hi, out};
+        if (pthread_create(&threads[t], 0, verify_worker, &jobs[t]) != 0) {
+            verify_range(pks, sigs, msgs, offsets, lo, n, out);
+            break;
+        }
+        started++;
+    }
+    for (int t = 0; t < started; t++) pthread_join(threads[t], 0);
+    return 1;
+}

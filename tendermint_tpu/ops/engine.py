@@ -1,0 +1,446 @@
+"""Unified async verification engine (the process-wide dispatch plane).
+
+Every batch-capable caller — blocksync verify-ahead, light-client
+bisection, evidence verification, consensus commit checks — used to
+dispatch its own device launch (or fall back to a serial host loop)
+independently. Committee-signature verification amortizes best over
+large combined batches (EdDSA/BLS committee study, arxiv 2302.00418),
+and hardware verification engines win by pipelining prep/transfer/
+compute stages rather than by faster single ops (FPGA ECDSA engine,
+arxiv 2112.02229). This module is that pipeline:
+
+  coalescing   — concurrent callers' jobs merge into ONE launch with
+                 per-caller result demux: three 67-sig commits become a
+                 single 256-row launch instead of three sub-cutover
+                 host fallbacks.
+  double-buffer— a dispatch worker runs host prep (native prep.c where
+                 available) + the async kernel launch for batch i+1
+                 while batch i's kernel still runs; a collect worker
+                 blocks on results and demuxes. JAX queues launches, so
+                 prep genuinely overlaps device compute.
+  host plane   — below the device cutover (or with no accelerator) the
+                 coalesced batch runs through libcrypto's EVP loop in C
+                 (native/prep.c tm_host_verify): one GIL-free call,
+                 threaded across cores, with the ZIP-215 oracle
+                 re-checking only rows OpenSSL rejects — byte-identical
+                 acceptance to the serial path.
+  autotune     — DEVICE_BATCH_CUTOVER / MSM_BATCH_CUTOVER come from a
+                 one-shot startup microprobe of real launch latency vs
+                 host verify rate when an accelerator is present,
+                 instead of hardcoded constants (env still wins).
+
+Gating: TM_TPU_ENGINE = auto (default, engine on) | on | off. `off`
+restores the direct per-caller dispatch paths; acceptance is
+byte-identical either way (the engine runs the same kernels and the
+same host acceptance chain, only scheduled differently).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+# Rows per coalesced launch. Jobs beyond this form the next batch (the
+# double buffer absorbs them); bounds both padding waste and the jit
+# shape zoo.
+MAX_COALESCE_ROWS = int(os.environ.get("TM_TPU_ENGINE_MAX_ROWS", "8192"))
+
+
+def engine_enabled() -> bool:
+    """TM_TPU_ENGINE gate. auto == on (the engine is the default path);
+    off restores the direct dispatch paths in crypto/ed25519.py and
+    crypto/sr25519.py byte-identically."""
+    return os.environ.get("TM_TPU_ENGINE", "auto").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+# ------------------------------------------------------------------ autotune
+
+
+_AUTOTUNE = {"done": False}
+_AUTOTUNE_LOCK = threading.Lock()
+
+
+def _autotune_enabled() -> bool:
+    return os.environ.get("TM_TPU_AUTOTUNE", "auto").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+def maybe_autotune() -> None:
+    """One-shot cutover microprobe. When a real accelerator is present
+    and the env didn't pin TM_TPU_BATCH_CUTOVER / TM_TPU_MSM_CUTOVER,
+    measure (a) the host per-signature verify time and (b) the warm
+    end-to-end latency of a tiny device launch, and set the cutovers to
+    the batch size where the device launch actually pays for itself —
+    the hardcoded 64/256 were calibrated on one chip generation and are
+    wrong on both faster tunnels and slower hosts. The probe runs in a
+    DAEMON thread (the tiny launch may compile on a fresh cache, and no
+    caller should stall behind that); the defaults stay in effect until
+    it lands. No accelerator (or TM_TPU_AUTOTUNE=off) leaves the
+    defaults untouched, so CPU test runs stay deterministic."""
+    if _AUTOTUNE["done"]:
+        return
+    with _AUTOTUNE_LOCK:
+        if _AUTOTUNE["done"]:
+            return
+        _AUTOTUNE["done"] = True
+        if not _autotune_enabled():
+            return
+        dev_pinned = "TM_TPU_BATCH_CUTOVER" in os.environ
+        msm_pinned = "TM_TPU_MSM_CUTOVER" in os.environ
+        if dev_pinned and msm_pinned:
+            return
+        t = threading.Thread(
+            target=_autotune_probe, args=(dev_pinned, msm_pinned),
+            daemon=True, name="tm-engine-autotune",
+        )
+        t.start()
+
+
+def _autotune_probe(dev_pinned: bool, msm_pinned: bool) -> None:
+    try:
+        from ..crypto import ed25519 as ed
+
+        if not ed._accelerator_present():
+            return
+        import time
+
+        from ..crypto import ed25519_ref as ref
+        from . import verify as V
+
+        sk = ref.gen_privkey(b"\x5a" * 32)
+        pk, msg = sk[32:], b"tm-engine-autotune-probe"
+        sig = ref.sign(sk, msg)
+        t0 = time.perf_counter()
+        for _ in range(16):
+            ed._single_verify(pk, msg, sig)
+        t_host = (time.perf_counter() - t0) / 16
+        jobs = ([pk] * 8, [msg] * 8, [sig] * 8)
+        V.verify_batch(*jobs)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(3):
+            V.verify_batch(*jobs)
+        t_launch = (time.perf_counter() - t0) / 3
+        cutover = 8
+        while cutover * t_host < t_launch and cutover < 4096:
+            cutover *= 2
+        if not dev_pinned:
+            ed.DEVICE_BATCH_CUTOVER = cutover
+        if not msm_pinned:
+            # the MSM's Horner/reduce tail is a roughly constant extra
+            # launch cost; it amortizes ~4x past the point a plain
+            # launch does
+            ed.MSM_BATCH_CUTOVER = max(64, min(4 * cutover, 8192))
+    except Exception:  # noqa: BLE001 - a failed probe keeps the defaults
+        pass
+
+
+# ------------------------------------------------------------------- engine
+
+
+class _Job:
+    __slots__ = ("plane", "pks", "msgs", "sigs", "n", "event", "result", "error")
+
+    def __init__(self, plane, pks, msgs, sigs):
+        self.plane = plane
+        self.pks = pks
+        self.msgs = msgs
+        self.sigs = sigs
+        self.n = len(sigs)
+        self.event = threading.Event()
+        self.result: list[bool] | None = None
+        self.error: BaseException | None = None
+
+
+class JobHandle:
+    """Returned by VerifyEngine.submit; result() blocks until the
+    coalesced launch containing this job completes and returns the
+    job's own per-signature bools (demuxed)."""
+
+    __slots__ = ("_job",)
+
+    def __init__(self, job: _Job):
+        self._job = job
+
+    def done(self) -> bool:
+        return self._job.event.is_set()
+
+    def result(self, timeout: float | None = None) -> list[bool]:
+        if not self._job.event.wait(timeout):
+            raise TimeoutError("verification engine result timed out")
+        if self._job.error is not None:
+            # raise a COPY: every coalesced caller shares one exception
+            # instance, and raising the same object from several threads
+            # concurrently mutates its __traceback__ (one caller's log
+            # would show another caller's raise frames)
+            import copy
+
+            try:
+                err = copy.copy(self._job.error)
+            except Exception:  # exotic exception, uncopyable: share it
+                err = self._job.error
+            raise err
+        return self._job.result
+
+
+def _fail_jobs(jobs, exc: BaseException) -> None:
+    for j in jobs:
+        j.error = exc
+        j.event.set()
+
+
+def _host_verify_ed25519(pks, msgs, sigs) -> list[bool]:
+    """Coalesced host-path ed25519: the C libcrypto loop (GIL-free,
+    multicore) with the ZIP-215 oracle re-checking only rejected rows —
+    the exact acceptance chain of _single_verify, batched."""
+    from ..crypto import ed25519_ref as _ref
+    from ..crypto.ed25519 import _single_verify
+    from ..native import host_verify_batch
+
+    bitmap = host_verify_batch(pks, msgs, sigs)
+    if bitmap is None:
+        return [_single_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+    out = bitmap.tolist()
+    for i, ok in enumerate(out):
+        if not ok:
+            # may still be ZIP-215-acceptable — ask the oracle directly:
+            # OpenSSL already rejected this row, so _single_verify's
+            # OpenSSL-first chain would just repeat that verdict
+            out[i] = _ref.verify(pks[i], msgs[i], sigs[i], zip215=True)
+    return out
+
+
+def _host_verify_sr25519(pks, msgs, sigs) -> list[bool]:
+    from ..crypto import sr25519 as sr
+
+    return [sr.verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+
+_HOST_VERIFY = {"ed25519": _host_verify_ed25519, "sr25519": _host_verify_sr25519}
+
+_HOST_POOL = None
+_HOST_POOL_LOCK = threading.Lock()
+
+
+def _host_pool():
+    """Shared executor for host-plane batches: the verify starts at
+    DISPATCH time (overlapping whatever the collector is blocked on)
+    instead of serializing on the collect thread — a slow pure-Python
+    sr25519 loop must not head-of-line-block a finished device batch's
+    demux behind it."""
+    global _HOST_POOL
+    if _HOST_POOL is None:
+        with _HOST_POOL_LOCK:
+            if _HOST_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _HOST_POOL = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="ThreadPoolExecutor-engine-host"
+                )
+    return _HOST_POOL
+
+
+class VerifyEngine:
+    """Process-wide coalescing verification pipeline.
+
+    Two worker threads form the double buffer:
+      dispatch — drains the submission queue, coalesces same-plane jobs
+                 (bounded by MAX_COALESCE_ROWS), runs host prep and the
+                 ASYNC kernel launch (or schedules the host C verify),
+                 and hands the in-flight batch to the collector. While
+                 the collector blocks on batch i's device result, this
+                 thread is already prepping + launching batch i+1.
+      collect  — blocks on the device result (or runs the host verify),
+                 demuxes the combined bitmap back to per-caller slices,
+                 and wakes the callers.
+
+    Threads are daemons, started lazily on first submit, and named with
+    the tm-engine prefix (allow-listed by utils/leaktest.py — engine
+    lifetime is the process, not a test body)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._have_jobs = threading.Condition(self._lock)
+        self._pending: list[_Job] = []
+        self._inflight: list = []  # (jobs, collect_thunk)
+        self._have_inflight = threading.Condition()
+        self._started = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _ensure_started(self) -> None:
+        if self._started:
+            return
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            for name, fn in (("tm-engine-dispatch", self._dispatch_loop),
+                             ("tm-engine-collect", self._collect_loop)):
+                t = threading.Thread(target=fn, daemon=True, name=name)
+                t.start()
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, plane: str, pubkeys, msgs, sigs) -> JobHandle:
+        """Queue one caller's batch for the next coalesced launch.
+        plane is "ed25519" or "sr25519"; returns a JobHandle whose
+        result() yields this caller's bools in input order."""
+        if plane not in _HOST_VERIFY:
+            raise ValueError(f"unknown verification plane {plane!r}")
+        job = _Job(plane, list(pubkeys), list(msgs), list(sigs))
+        if len(job.pks) != job.n or len(job.msgs) != job.n:
+            # ragged inputs would silently truncate in the verify
+            # planes' zip()s, reporting unverified tail rows as accepted
+            # and shifting later coalesced callers' demux slices
+            raise ValueError(
+                f"ragged batch: {len(job.pks)} pubkeys / {len(job.msgs)} msgs "
+                f"/ {job.n} sigs"
+            )
+        if job.n == 0:
+            job.result = []
+            job.event.set()
+            return JobHandle(job)
+        maybe_autotune()
+        self._ensure_started()
+        with self._lock:
+            self._pending.append(job)
+            self._have_jobs.notify()
+        return JobHandle(job)
+
+    # ------------------------------------------------------------ dispatch
+
+    def _take_group(self):
+        """Pop a coalescable group: the oldest pending job plus every
+        other queued job on the same plane, up to MAX_COALESCE_ROWS.
+        Called with the lock held."""
+        first = self._pending.pop(0)
+        group, rows = [first], first.n
+        keep = []
+        for j in self._pending:
+            if j.plane == first.plane and rows + j.n <= MAX_COALESCE_ROWS:
+                group.append(j)
+                rows += j.n
+            else:
+                keep.append(j)
+        self._pending = keep
+        return group
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending:
+                    self._have_jobs.wait()
+                group = self._take_group()
+            try:
+                thunk = self._dispatch_group(group)
+            except BaseException as e:  # noqa: BLE001 - deliver, don't die
+                _fail_jobs(group, e)
+                continue
+            with self._have_inflight:
+                self._inflight.append((group, thunk))
+                self._have_inflight.notify()
+
+    def _dispatch_group(self, group):
+        """Coalesce one group's rows, decide the plane (device bitmap /
+        two-phase MSM / host C), run prep + the async launch NOW, and
+        return a collect thunk producing the combined (rows,) bools."""
+        from ..crypto import ed25519 as ed
+
+        plane = group[0].plane
+        pks, msgs, sigs = [], [], []
+        for j in group:
+            pks += j.pks
+            msgs += j.msgs
+            sigs += j.sigs
+        total = len(sigs)
+
+        if not (ed._use_device() and total >= ed.DEVICE_BATCH_CUTOVER):
+            future = _host_pool().submit(_HOST_VERIFY[plane], pks, msgs, sigs)
+            return future.result  # raises the worker's exception, if any
+
+        if plane == "ed25519":
+            from . import verify as dev
+        else:
+            from . import verify_sr as dev
+
+        def bitmap_async():
+            if ed._pk_cache_enabled():
+                return dev.verify_batch_cached_async(pks, msgs, sigs)
+            return dev.verify_batch_async(pks, msgs, sigs)
+
+        if ed._msm_enabled() and total >= ed.MSM_BATCH_CUTOVER:
+            # two-phase: the RLC/MSM all-valid fast path first, the
+            # bitmap kernel only on failure — the reference's shape
+            # (types/validation.go:245-255). A precheck refusal (None
+            # handle) dispatches the bitmap immediately, preserving the
+            # launch-now/collect-later overlap.
+            from . import msm as dev_msm
+
+            if plane == "sr25519":
+                rlc = dev_msm.verify_batch_rlc_sr_async(pks, msgs, sigs)
+            elif ed._pk_cache_enabled() and ed._msm_cache_enabled():
+                rlc = dev_msm.verify_batch_rlc_cached_async(pks, msgs, sigs)
+            else:
+                rlc = dev_msm.verify_batch_rlc_async(pks, msgs, sigs)
+            dispatched = bitmap_async() if rlc is None else None
+
+            def collect_two_phase():
+                if rlc is not None and dev_msm.collect_rlc(rlc):
+                    return [True] * total
+                handle = dispatched if dispatched is not None else bitmap_async()
+                return [bool(b) for b in dev.collect(handle)]
+
+            return collect_two_phase
+
+        dispatched = bitmap_async()
+        return lambda: [bool(b) for b in dev.collect(dispatched)]
+
+    # ------------------------------------------------------------- collect
+
+    def _collect_loop(self) -> None:
+        while True:
+            with self._have_inflight:
+                while not self._inflight:
+                    self._have_inflight.wait()
+                group, thunk = self._inflight.pop(0)
+            try:
+                bools = thunk()
+            except BaseException as e:  # noqa: BLE001
+                _fail_jobs(group, e)
+                continue
+            lo = 0
+            for j in group:
+                j.result = bools[lo : lo + j.n]
+                lo += j.n
+                j.event.set()
+
+
+_ENGINE: VerifyEngine | None = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def get_engine() -> VerifyEngine:
+    global _ENGINE
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                _ENGINE = VerifyEngine()
+    return _ENGINE
+
+
+def verify_async_via_engine(plane: str, pubkeys, msgs, sigs):
+    """The BatchVerifier.verify_async seam, shared by both signature
+    planes: submit to the engine, return a completion callable yielding
+    the (all_ok, per-signature bools) contract."""
+    handle = get_engine().submit(plane, pubkeys, msgs, sigs)
+
+    def complete():
+        bools = handle.result()
+        return all(bools), bools
+
+    return complete

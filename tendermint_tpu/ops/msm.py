@@ -87,6 +87,17 @@ def _accumulate_windows(neg, nibs_zk, nibs_z, n):
     points (-A | -R, shape (4, 32, 2n)); returns the (4, 32, 1) total
     of sum zk_i*(-A_i) + z_i*(-R_i) with a valid T coordinate."""
     g = min(G_STREAMS, n)
+    if n % g:
+        # Trace-time guard (n and g are static shapes): rounds = n // g
+        # would silently DROP the tail rows from the RLC sum — a tail
+        # row holding the only invalid signature would be excluded and
+        # the batch falsely accepted. In-repo dispatchers pad to power-
+        # of-two sizes so this never fires for them; a direct caller
+        # must fail loudly, not truncate.
+        raise ValueError(
+            f"MSM batch size {n} is not a multiple of the stream count {g}; "
+            f"pad the batch (pad_pow2_rows) so no rows drop from the RLC sum"
+        )
     rounds = n // g
     w0 = C.identity_point((64, g)) + 0 * neg[:, :, :1, None]  # vma tie
 
@@ -170,6 +181,13 @@ def msm_verify_kernel_cached_impl(tables, oks, slots, r_enc, zk_bytes, z_bytes, 
     nibs_z = C.scalar_to_nibbles(z_bytes.T.astype(jnp.int32))  # (32, B)
 
     g = min(G_STREAMS, n)
+    if n % g:
+        # same trace-time tail-row guard as _accumulate_windows: the
+        # cached kernel's rounds loop would silently drop n % g rows
+        raise ValueError(
+            f"cached MSM batch size {n} is not a multiple of the stream count {g}; "
+            f"pad the batch (pad_pow2_rows) so no rows drop from the RLC sum"
+        )
     rounds = n // g
     wn = max(32, per)
     w0 = C.identity_point((wn, g)) + 0 * neg_r[:, :, :1, None]
@@ -365,15 +383,28 @@ def verify_batch_rlc_cached_async(pubkeys, msgs, sigs, z_raw: bytes | None = Non
     cache = pubkey_cache()
     if cache.tables.ndim != 5:
         return verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw)
-    keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
-    slots, tables, oks = cache.ensure_snapshot(keys)
-    if slots is None:
-        return verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw)
-    _, r_enc, s_rows, k_rows, precheck = prepare_batch(pubkeys, msgs, sigs)
+    # prep/precheck BEFORE touching the cache: this path REFUSES any
+    # batch with a malformed row, so inserting its keys first would
+    # build zero-byte entries into the HBM cache (possibly evicting
+    # live validator keys) for a batch that never verifies. The bitmap
+    # cached path legitimately inserts first — it verifies malformed
+    # rows masked, not refused.
+    a_enc, r_enc, s_rows, k_rows, precheck = prepare_batch(pubkeys, msgs, sigs)
     if not precheck.all():
         return None
+    keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
+    slots, tables, oks = cache.ensure_snapshot(keys)
     z_raw = _ensure_z_raw(n, z_raw)
     zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
+    if slots is None:
+        # more distinct keys than the cache holds: take the uncached
+        # kernel, reusing the prep + scalar math already done instead
+        # of re-dispatching through verify_batch_rlc_async
+        a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
+        return msm_verify_kernel(
+            jnp.asarray(a_enc), jnp.asarray(r_enc),
+            jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+        )
     r_enc, zk, z_out = pad_pow2_rows([r_enc, zk, z_out], n)
     # padded rows carry zero scalars (identity contributions), but their
     # slot must point at a VALID cached key: slot 0 may hold a key whose

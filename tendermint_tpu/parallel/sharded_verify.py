@@ -9,6 +9,8 @@ all-valid verdict is an AND-reduce over ICI implemented as
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 import jax
@@ -47,6 +49,27 @@ def _local_verify_with(kernel_impl):
 
 
 _FN_CACHE: dict[tuple, object] = {}
+
+_SCALAR_POOL = None
+_SCALAR_POOL_LOCK = threading.Lock()
+
+
+def _scalar_pool():
+    """Shared executor for per-shard RLC scalar prep: one verification
+    per commit on the hot sync path must not pay thread create/teardown
+    per batch. Idle workers are cheap; the pool lives for the process.
+    Locked init — concurrent first callers must not each build (and
+    leak) a pool."""
+    global _SCALAR_POOL
+    if _SCALAR_POOL is None:
+        with _SCALAR_POOL_LOCK:
+            if _SCALAR_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _SCALAR_POOL = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="ThreadPoolExecutor-rlc"
+                )
+    return _SCALAR_POOL
 
 
 def sharded_verify_fn(mesh: Mesh, kernel_impl=V.verify_kernel_impl):
@@ -218,20 +241,30 @@ def verify_batch_sharded_rlc(mesh: Mesh, pubkeys, msgs, sigs, z_raw: bytes | Non
     size = per_dev * n_dev
     # per-shard scalar math: one native _rlc_scalars call per shard
     # slice yields that shard's zk rows AND its zs partial sum directly
-    # (shard d's equation covers exactly its own rows)
+    # (shard d's equation covers exactly its own rows). Shards run on a
+    # thread pool: the native call is a ctypes FFI that releases the
+    # GIL, so per-shard prep scales across cores instead of serializing
+    # the device feed behind one Python loop.
     zk = np.zeros((size, 32), np.uint8)
     z_rows = np.zeros((size, 16), np.uint8)
     zs_shards = np.zeros((n_dev, 32), np.uint8)
-    for d in range(n_dev):
+
+    def shard_scalars(d):
         lo, hi = d * per_dev, min((d + 1) * per_dev, n)
-        if lo >= hi:
-            break
         zk_d, z_d, zs_d = M._rlc_scalars(
             s_rows[lo:hi], k_rows[lo:hi], hi - lo, z_raw[16 * lo : 16 * hi]
         )
         zk[lo:hi] = zk_d
         z_rows[lo:hi] = z_d
         zs_shards[d] = zs_d[0]
+
+    live = [d for d in range(n_dev) if d * per_dev < n]
+    if len(live) > 1:
+        # list() propagates the first worker exception, if any
+        list(_scalar_pool().map(shard_scalars, live))
+    else:
+        for d in live:
+            shard_scalars(d)
     pad = size - n
     if pad:
         a_enc = np.pad(a_enc, ((0, pad), (0, 0)))

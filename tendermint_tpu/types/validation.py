@@ -50,14 +50,32 @@ def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID, height: 
     """Verify +2/3 signed AND check every signature (ref: VerifyCommit,
     types/validation.go:27 — all signatures are checked because apps'
     incentivization logic depends on LastCommitInfo)."""
+    verify_commit_async(chain_id, vals, block_id, height, commit)()
+
+
+def verify_commit_async(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+):
+    """verify_commit split at the device boundary, mirroring
+    verify_commit_light_async: host-side checks raise NOW, the
+    signature batch is dispatched (through the coalescing engine when
+    enabled — concurrent dispatches from blocksync, the light client,
+    and evidence verification merge into one launch), and the returned
+    no-arg callable raises (or not) with verify_commit's exact error
+    surface. Lets a caller overlap two verifications — e.g. blocksync
+    checks an extended commit's vote signatures and its extension
+    signatures in flight together instead of back to back."""
     _verify_basic_vals_and_commit(vals, commit, height, block_id)
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = lambda c: c.block_id_flag == 1  # absent
     count = lambda c: c.block_id_flag == 2  # commit
     if _should_batch_verify(vals, commit):
-        _verify_commit_batch(chain_id, vals, commit, voting_power_needed, ignore, count, True, True)
-    else:
-        _verify_commit_single(chain_id, vals, commit, voting_power_needed, ignore, count, True, True)
+        return _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True, True,
+            defer=True,
+        )
+    _verify_commit_single(chain_id, vals, commit, voting_power_needed, ignore, count, True, True)
+    return lambda: None
 
 
 def verify_commit_light(chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit) -> None:

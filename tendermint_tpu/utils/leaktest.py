@@ -18,8 +18,11 @@ import time
 from contextlib import contextmanager
 
 # Threads whose lifetime legitimately exceeds a single test body.
+# tm-engine-*: the process-wide verification engine's dispatch/collect
+# workers (ops/engine.py) — started lazily on first batch verify and
+# alive for the remainder of the process by design.
 _ALLOWED_PREFIXES = (
-    "pydev", "ThreadPoolExecutor", "asyncio_",
+    "pydev", "ThreadPoolExecutor", "asyncio_", "tm-engine",
 )
 
 
