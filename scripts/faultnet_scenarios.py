@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Off-CI faultnet scenario matrix: run a real multi-process testnet
+through the packet-level fault plane under a battery of degraded-network
+scenarios and report block cadence + fault metrics per scenario.
+
+The tier-1 suite keeps a deterministic no-sleep subset
+(tests/test_faultnet.py); this runner is the full matrix — real sleeps,
+real latency, minutes per scenario. Usage:
+
+    python scripts/faultnet_scenarios.py                 # whole matrix
+    python scripts/faultnet_scenarios.py --only latency_spike,blackhole
+    python scripts/faultnet_scenarios.py --list
+    python scripts/faultnet_scenarios.py --scenario-file my_scenario.toml
+
+Each run: 4-validator testnet with every link proxied (e2e runner's
+faultnet mode), load injected, the scenario timeline applied, then
+convergence + consistency checks and a cadence benchmark. Exit nonzero
+if any scenario fails. See docs/faultnet.md for the scenario format.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MANIFEST = """
+chain_id = "faultnet-matrix"
+load_tx_rate = 10
+
+[faultnet]
+enabled = true
+
+[node.validator01]
+
+[node.validator02]
+
+[node.validator03]
+
+[node.validator04]
+"""
+
+# Named scenario timelines over the runner's link names
+# ("dialer->target"). validator01 is always the victim.
+SCENARIOS: dict[str, str] = {
+    "latency_spike": """
+name = "latency_spike"
+[[event]]
+at = 2.0
+link = "*"
+latency = 0.05
+jitter = 0.02
+[[event]]
+at = 12.0
+link = "*"
+heal = true
+""",
+    "lossy_mesh": """
+name = "lossy_mesh"
+[[event]]
+at = 2.0
+link = "*"
+drop = 0.05
+latency = 0.01
+[[event]]
+at = 14.0
+link = "*"
+heal = true
+""",
+    "bandwidth_squeeze": """
+name = "bandwidth_squeeze"
+[[event]]
+at = 2.0
+link = "validator01->*"
+bandwidth = 16384
+[[event]]
+at = 12.0
+link = "*"
+heal = true
+""",
+    "blackhole": """
+name = "blackhole"
+[[event]]
+at = 2.0
+link = "validator01->*"
+blackhole = true
+drop_conns = true
+[[event]]
+at = 10.0
+link = "*"
+heal = true
+""",
+    "half_open_peer": """
+name = "half_open_peer"
+[[event]]
+at = 2.0
+link = "validator01->validator02"
+half_open = true
+[[event]]
+at = 12.0
+link = "*"
+heal = true
+""",
+    "rst_storm": """
+name = "rst_storm"
+[[event]]
+at = 2.0
+link = "validator01->*"
+rst = true
+[[event]]
+at = 8.0
+link = "*"
+heal = true
+""",
+    "slow_drip": """
+name = "slow_drip"
+[[event]]
+at = 2.0
+link = "validator01->validator02"
+slow_drip = 64
+[[event]]
+at = 12.0
+link = "*"
+heal = true
+""",
+}
+
+
+def run_scenario(name: str, scenario_text: str, base_dir: str, settle: float = 8.0) -> dict:
+    from tendermint_tpu.e2e import Manifest, Runner
+    from tendermint_tpu.faultnet import Scenario
+
+    manifest = Manifest.parse(MANIFEST)
+    runner = Runner(manifest, base_dir, logger=lambda *a: None)
+    scenario = Scenario.parse(scenario_text)
+    out: dict = {"scenario": name, "ok": False}
+    t0 = time.monotonic()
+    try:
+        runner.setup()
+        runner.start(timeout=120)
+        runner.wait_for_height(2, timeout=120)
+        stop = scenario.start(runner.faultnet, log=print)
+        try:
+            runner.inject_load(scenario.duration + settle)
+        finally:
+            stop.set()
+        runner.faultnet.heal()
+        # every node recovers and converges
+        h = max(n.height() for n in runner.nodes)
+        runner.wait_for_height(h + 2, timeout=120)
+        runner.check_consistency()
+        out["bench"] = runner.benchmark()
+        reg = runner.faultnet_registry
+        out["faults"] = {
+            m.name: sum(v for _, _, v in m.samples())
+            for m in (runner.faultnet.metrics.faults_injected,
+                      runner.faultnet.metrics.dropped_chunks,
+                      runner.faultnet.metrics.delayed_chunks,
+                      runner.faultnet.metrics.blackholed_bytes,
+                      runner.faultnet.metrics.rst_connections,
+                      runner.faultnet.metrics.half_open_connections)
+        }
+        assert reg is not None
+        out["ok"] = True
+    except Exception as e:  # report, keep sweeping
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        runner.cleanup()
+        out["seconds"] = round(time.monotonic() - t0, 1)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--only", help="comma-separated scenario names")
+    ap.add_argument("--list", action="store_true", help="list scenarios and exit")
+    ap.add_argument("--scenario-file", help="run one scenario from a TOML file instead")
+    ap.add_argument("--base-dir", help="testnet scratch dir (default: tempdir)")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in SCENARIOS:
+            print(name)
+        return 0
+
+    todo: list[tuple[str, str]] = []
+    if args.scenario_file:
+        with open(args.scenario_file) as f:
+            todo.append((os.path.basename(args.scenario_file), f.read()))
+    else:
+        names = args.only.split(",") if args.only else list(SCENARIOS)
+        for n in names:
+            if n not in SCENARIOS:
+                ap.error(f"unknown scenario {n!r} (use --list)")
+            todo.append((n, SCENARIOS[n]))
+
+    results = []
+    with tempfile.TemporaryDirectory() as tmp:
+        base = args.base_dir or tmp
+        for name, text in todo:
+            res = run_scenario(name, text, os.path.join(base, name))
+            results.append(res)
+            if not args.json:
+                status = "ok" if res["ok"] else f"FAIL ({res.get('error')})"
+                cadence = (res.get("bench") or {}).get("avg_interval_s")
+                print(f"[{res['seconds']:7.1f}s] {name:<20} {status}"
+                      + (f"  avg block interval {cadence}s" if cadence else ""))
+    if args.json:
+        print(json.dumps(results, indent=2))
+    return 0 if all(r["ok"] for r in results) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Fetch-and-pin an EXTERNAL sr25519 known-answer triple from a live
+Substrate chain (VERDICT r5 next-round #4).
+
+Why a fetcher: schnorrkel signing is randomized, so no published
+(pubkey, msg, sig) KATs exist to transcribe, and this container has no
+schnorrkel runtime to generate one — fabricating bytes from memory
+would pin the wrong thing. The moment network access exists, this
+script pulls a REAL signed extrinsic from a public Substrate RPC node,
+reconstructs its signing payload, checks that OUR implementation
+verifies it (context b"substrate"), and pins the triple into
+tests/testdata/sr25519_kat.json. From then on
+tests/test_sr25519.py::test_external_substrate_extrinsic_kat replays it
+offline forever — the last unpinned layer (transcript labels, marker
+bit, challenge reduction) anchored to a production schnorrkel
+deployment.
+
+Usage:
+    python scripts/fetch_sr25519_kat.py                  # try default RPCs
+    python scripts/fetch_sr25519_kat.py --rpc https://rpc.polkadot.io
+    python scripts/fetch_sr25519_kat.py --blocks 200     # scan depth
+
+Extrinsic payload reconstruction (v4 extrinsics):
+    signed payload = call ++ extra ++ additional
+      extra      = era ++ compact(nonce) ++ compact(tip) [++ mode byte]
+      additional = spec_version(u32 LE) ++ tx_version(u32 LE)
+                   ++ genesis_hash ++ era_checkpoint_hash
+                   [++ metadata_hash Option (0x00 = None)]
+    payloads > 256 bytes are signed via blake2b-256(payload).
+Runtimes differ in which signed extensions they enable (the optional
+CheckMetadataHash mode/option bytes), so the script enumerates the
+small set of plausible layouts and pins the first that VERIFIES —
+self-validating by construction: a wrong layout (or an incompatible
+implementation) simply never verifies and nothing gets pinned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_RPCS = [
+    "https://rpc.polkadot.io",
+    "https://kusama-rpc.polkadot.io",
+    "https://westend-rpc.polkadot.io",
+]
+KAT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "testdata", "sr25519_kat.json",
+)
+
+
+def rpc_call(url: str, method: str, params=(), timeout: float = 15.0):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)}
+    ).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        doc = json.loads(resp.read())
+    if "error" in doc:
+        raise RuntimeError(f"{method}: {doc['error']}")
+    return doc["result"]
+
+
+# ------------------------------------------------------------------ SCALE
+
+
+def read_compact(data: bytes, off: int) -> tuple[int, int]:
+    """SCALE compact<u128>: (value, new offset)."""
+    b0 = data[off]
+    mode = b0 & 0b11
+    if mode == 0:
+        return b0 >> 2, off + 1
+    if mode == 1:
+        return int.from_bytes(data[off : off + 2], "little") >> 2, off + 2
+    if mode == 2:
+        return int.from_bytes(data[off : off + 4], "little") >> 2, off + 4
+    n = (b0 >> 2) + 4
+    return int.from_bytes(data[off + 1 : off + 1 + n], "little"), off + 1 + n
+
+
+def era_bytes(data: bytes, off: int) -> tuple[bytes, int]:
+    """Era: 0x00 (immortal) is 1 byte, anything else 2 bytes."""
+    if data[off] == 0x00:
+        return data[off : off + 1], off + 1
+    return data[off : off + 2], off + 2
+
+
+def era_birth(era: bytes, current: int) -> int | None:
+    """Mortal era → birth block number (None for immortal)."""
+    if era == b"\x00":
+        return None
+    enc = int.from_bytes(era, "little")
+    period = 2 << (enc & 0b1111)
+    quantized_phase = enc >> 4
+    quantize_factor = max(period >> 12, 1)
+    phase = quantized_phase * quantize_factor
+    return (max(current, phase) - phase) // period * period + phase
+
+
+# ------------------------------------------------------------ extraction
+
+
+def candidate_payloads(extrinsic: bytes, ctx: dict):
+    """Yield (payload, meta) candidates for one signed v4 extrinsic.
+
+    Layout after the length prefix: 0x84, MultiAddress, MultiSignature,
+    extra..., call... . We only take MultiAddress::Id (0x00) +
+    MultiSignature::Sr25519 (0x01)."""
+    _, off = read_compact(extrinsic, 0)
+    if off >= len(extrinsic) or extrinsic[off] != 0x84:  # signed, version 4
+        return
+    off += 1
+    if extrinsic[off] != 0x00:  # MultiAddress::Id
+        return
+    pubkey = extrinsic[off + 1 : off + 33]
+    off += 33
+    if extrinsic[off] != 0x01:  # MultiSignature::Sr25519
+        return
+    signature = extrinsic[off + 1 : off + 65]
+    off += 65
+    era, off2 = era_bytes(extrinsic, off)
+    nonce_v, off3 = read_compact(extrinsic, off2)
+    tip_v, off4 = read_compact(extrinsic, off3)
+    extra_core = extrinsic[off : off4]
+    birth = era_birth(era, ctx["number"])
+    checkpoint = ctx["genesis"] if birth is None else ctx["hash_at"](birth)
+    if checkpoint is None:
+        return
+    base_additional = (
+        ctx["spec_version"].to_bytes(4, "little")
+        + ctx["tx_version"].to_bytes(4, "little")
+        + ctx["genesis"]
+        + checkpoint
+    )
+    # Runtimes with CheckMetadataHash append a mode byte to extra and an
+    # Option<hash> (0x00 = None) to additional; older runtimes have
+    # neither. Enumerate both layouts (mode byte, if present, precedes
+    # the call only when it was part of extra — try both call offsets).
+    for mode_bytes, add_suffix, tag in (
+        (b"", b"", "plain-v4"),
+        (b"\x00", b"\x00", "metadata-hash-disabled"),
+    ):
+        call_off = off4 + len(mode_bytes)
+        call = extrinsic[call_off:]
+        if not call:
+            continue
+        payload = call + extra_core + mode_bytes + base_additional + add_suffix
+        signed = payload if len(payload) <= 256 else hashlib.blake2b(payload, digest_size=32).digest()
+        yield signed, {
+            "layout": tag,
+            "nonce": nonce_v,
+            "tip": tip_v,
+            "era": era.hex(),
+            "pubkey": pubkey.hex(),
+            "signature": signature.hex(),
+            "payload": payload.hex(),
+        }
+
+
+def scan_chain(rpc: str, max_blocks: int, log=print):
+    from tendermint_tpu.crypto import sr25519 as sr
+
+    genesis = bytes.fromhex(rpc_call(rpc, "chain_getBlockHash", [0])[2:])
+    head = rpc_call(rpc, "chain_getFinalizedHead")
+    rt = rpc_call(rpc, "state_getRuntimeVersion", [head])
+    spec_version, tx_version = int(rt["specVersion"]), int(rt["transactionVersion"])
+    chain = rpc_call(rpc, "system_chain")
+    log(f"{rpc}: chain={chain} spec={spec_version} tx={tx_version}")
+
+    block_hash = head
+    for _ in range(max_blocks):
+        block = rpc_call(rpc, "chain_getBlock", [block_hash])["block"]
+        number = int(block["header"]["number"], 16)
+        ctx = {
+            "genesis": genesis,
+            "number": number,
+            "spec_version": spec_version,
+            "tx_version": tx_version,
+            "hash_at": lambda n: (
+                lambda h: bytes.fromhex(h[2:]) if h else None
+            )(rpc_call(rpc, "chain_getBlockHash", [n])),
+        }
+        for xt_hex in block["extrinsics"]:
+            xt = bytes.fromhex(xt_hex[2:])
+            for signed, meta in candidate_payloads(xt, ctx):
+                ok = sr.verify(
+                    bytes.fromhex(meta["pubkey"]), signed,
+                    bytes.fromhex(meta["signature"]), context=b"substrate",
+                )
+                if ok:
+                    meta.update(
+                        chain=chain, rpc=rpc, block=number,
+                        block_hash=block_hash, genesis_hash=genesis.hex(),
+                        spec_version=spec_version, tx_version=tx_version,
+                        signed_payload=signed.hex(), context="substrate",
+                        extrinsic=xt_hex,
+                    )
+                    return meta
+        block_hash = block["header"]["parentHash"]
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--rpc", action="append", help="Substrate RPC URL(s) to try")
+    ap.add_argument("--blocks", type=int, default=100, help="blocks to scan per chain")
+    ap.add_argument("--output", default=KAT_PATH)
+    ap.add_argument("--force", action="store_true", help="overwrite an existing pin")
+    args = ap.parse_args(argv)
+
+    if os.path.exists(args.output) and not args.force:
+        print(f"already pinned: {args.output} (use --force to refresh)")
+        return 0
+
+    for rpc in args.rpc or DEFAULT_RPCS:
+        try:
+            meta = scan_chain(rpc, args.blocks)
+        except Exception as e:
+            print(f"{rpc}: {type(e).__name__}: {e}")
+            continue
+        if meta is None:
+            print(f"{rpc}: no verifying sr25519 extrinsic in {args.blocks} blocks")
+            continue
+        os.makedirs(os.path.dirname(args.output), exist_ok=True)
+        with open(args.output, "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"PINNED {meta['chain']} block {meta['block']} layout={meta['layout']}")
+        print(f"  pubkey    {meta['pubkey']}")
+        print(f"  signature {meta['signature']}")
+        print(f"  -> {args.output}")
+        print("tests/test_sr25519.py::test_external_substrate_extrinsic_kat "
+              "now replays this offline.")
+        return 0
+    print("no KAT pinned — every RPC failed or yielded nothing; rerun with --rpc")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

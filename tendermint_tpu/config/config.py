@@ -13,10 +13,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
-try:
-    import tomllib  # py3.11+
-except ImportError:  # pragma: no cover
-    tomllib = None
+from ..utils.compat import tomllib
 
 DEFAULT_DATA_DIR = "data"
 DEFAULT_CONFIG_DIR = "config"
@@ -83,6 +80,11 @@ class P2PConfig:
     # per-connection flow control, bytes/sec (ref: conn/connection.go:45-46)
     send_rate: int = 512000
     recv_rate: int = 512000
+    # connection liveness (ref: conn/connection.go pingRoutine): ping
+    # cadence and how long a link may stay silent after a ping before it
+    # is closed as half-open/dead; ping_interval <= 0 disables both
+    ping_interval: float = 15.0
+    pong_timeout: float = 45.0
     # per-peer outbound queue discipline: fifo | priority |
     # simple-priority (ref: config.go P2PConfig.QueueType)
     queue_type: str = "fifo"

@@ -19,15 +19,22 @@ from __future__ import annotations
 
 import hashlib
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+
+    _HAVE_OSSL = True
+except ImportError:  # no `cryptography` wheel: pure-Python curve math
+    from . import softcrypto as _soft
+
+    _HAVE_OSSL = False
 
 from . import PrivKey, PubKey
 
@@ -54,7 +61,15 @@ class Secp256k1PubKey(PubKey):
 
     def _load(self):
         if self._key is None:
-            self._key = ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256K1(), self._bytes)
+            if _HAVE_OSSL:
+                self._key = ec.EllipticCurvePublicKey.from_encoded_point(
+                    ec.SECP256K1(), self._bytes
+                )
+            else:
+                pt = _soft.secp_decompress(self._bytes)
+                if pt is None:
+                    raise ValueError("invalid secp256k1 point encoding")
+                self._key = pt
         return self._key
 
     def address(self) -> bytes:
@@ -75,6 +90,11 @@ class Secp256k1PubKey(PubKey):
         if r == 0 or s == 0 or r >= _N or s > _HALF_N:
             return False
         digest = hashlib.sha256(msg).digest()
+        if not _HAVE_OSSL:
+            try:
+                return _soft.secp_verify(self._load(), digest, r, s)
+            except ValueError:
+                return False
         try:
             self._load().verify(
                 encode_dss_signature(r, s), digest, ec.ECDSA(Prehashed(hashes.SHA256()))
@@ -98,7 +118,12 @@ class Secp256k1PrivKey(PrivKey):
         if len(data) != PRIVKEY_SIZE:
             raise ValueError(f"secp256k1 privkey must be {PRIVKEY_SIZE} bytes")
         self._bytes = bytes(data)
-        self._key = ec.derive_private_key(int.from_bytes(data, "big"), ec.SECP256K1())
+        if _HAVE_OSSL:
+            self._key = ec.derive_private_key(int.from_bytes(data, "big"), ec.SECP256K1())
+        else:
+            self._key = int.from_bytes(data, "big")
+            if not 0 < self._key < _N:
+                raise ValueError("secp256k1 privkey scalar out of range")
 
     @classmethod
     def generate(cls, secret: bytes | None = None) -> "Secp256k1PrivKey":
@@ -121,16 +146,21 @@ class Secp256k1PrivKey(PrivKey):
     def sign(self, msg: bytes) -> bytes:
         """64-byte R||S, lower-S normalized (ref: secp256k1.go:166 Sign)."""
         digest = hashlib.sha256(msg).digest()
-        der = self._key.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
-        r, s = decode_dss_signature(der)
+        if _HAVE_OSSL:
+            der = self._key.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+            r, s = decode_dss_signature(der)
+        else:
+            r, s = _soft.secp_sign(self._key, digest)
         if s > _HALF_N:
             s = _N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> Secp256k1PubKey:
-        return Secp256k1PubKey(
-            self._key.public_key().public_bytes(Encoding.X962, PublicFormat.CompressedPoint)
-        )
+        if _HAVE_OSSL:
+            return Secp256k1PubKey(
+                self._key.public_key().public_bytes(Encoding.X962, PublicFormat.CompressedPoint)
+            )
+        return Secp256k1PubKey(_soft.secp_compress(_soft.secp_mult(self._key)))
 
     @property
     def type_name(self) -> str:

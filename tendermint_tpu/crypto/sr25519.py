@@ -139,11 +139,13 @@ def _expand_ed25519(mini: bytes) -> tuple[int, bytes]:
     return int.from_bytes(bytes(key), "little") >> 3, h[32:64]
 
 
-def _signing_transcript(msg: bytes) -> Transcript:
-    """signingCtx.NewTranscriptBytes(msg) with an empty context
-    (ref: privkey.go:18)."""
+def _signing_transcript(msg: bytes, context: bytes = b"") -> Transcript:
+    """signingCtx.NewTranscriptBytes(msg): tendermint uses the EMPTY
+    signing context (ref: privkey.go:18); Substrate chains use
+    b"substrate" — the external extrinsic KAT verifies through that
+    path (scripts/fetch_sr25519_kat.py)."""
     t = Transcript(b"SigningContext")
-    t.append_message(b"", b"")
+    t.append_message(b"", context)
     t.append_message(b"sign-bytes", msg)
     return t
 
@@ -248,7 +250,7 @@ def _double_scalar_mult(a: int, b: int, q) -> tuple:
     return acc
 
 
-def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+def verify(pub: bytes, msg: bytes, sig: bytes, context: bytes = b"") -> bool:
     if len(pub) != PUBKEY_SIZE or len(sig) != SIG_SIZE:
         return False
     if not sig[63] & 0x80:  # marker bit required (schnorrkel "not marked")
@@ -262,7 +264,7 @@ def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     r_pt = ristretto_decode(sig[:32])
     if a_pt is None or r_pt is None:
         return False
-    t = _signing_transcript(msg)
+    t = _signing_transcript(msg, context)
     k = _challenge(t, pub, sig[:32])
     # R =? s*B - k*A, compared as canonical ristretto encodings —
     # Edwards-coordinate equality is wrong here (ristretto points are

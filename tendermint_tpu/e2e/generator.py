@@ -33,7 +33,17 @@ ABCI_MODES = ("builtin", "outofprocess")
 
 ABCI_PROTOCOLS = {"tcp": 20, "grpc": 20, "unix": 10}  # generate.go:36-40
 KEY_TYPES = {"ed25519": 60, "secp256k1": 20, "sr25519": 20}
-PERTURBATIONS = {"disconnect": 0.1, "pause": 0.1, "kill": 0.1, "restart": 0.1, "partition": 0.1}
+PERTURBATIONS = {"disconnect": 0.1, "pause": 0.1, "kill": 0.1, "restart": 0.1, "partition": 0.1,
+                 # packet-level faultnet kinds (docs/faultnet.md); like
+                 # partition they assert the remaining validators keep
+                 # committing, so they carry the same >=4-validator gate
+                 "blackhole": 0.1, "halfopen": 0.1}
+# ambient degraded-network profiles for the [faultnet] section
+FAULTNET_PROFILES = {
+    "off": None,
+    "latency": {"latency_ms": 5, "jitter_ms": 3},
+    "lossy": {"latency_ms": 2, "jitter_ms": 1, "drop": 0.01},
+}
 # ref: generate.go:134-147 abciDelays none/small/large
 DELAY_PROFILES = {
     "none": {},
@@ -68,6 +78,14 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
     if r.random() < 0.5:
         lines.append(f"vote_extensions_enable_height = {r.choice((2, 3, 10))}")
 
+    # Degraded-network ambiance: a quarter of quad+ testnets run every
+    # link through faultnet with latency/jitter/drop (docs/faultnet.md).
+    # Emitted as a [faultnet] section AFTER the remaining top-level keys
+    # (TOML: keys following a table header belong to that table).
+    faultnet_profile = None
+    if n_validators >= 4 and r.random() < 0.25:
+        faultnet_profile = FAULTNET_PROFILES[r.choice(("latency", "lossy"))]
+
     for field, value in DELAY_PROFILES[r.choice(tuple(DELAY_PROFILES))].items():
         lines.append(f"{field} = {value}")
 
@@ -93,6 +111,12 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
         updates.setdefault(start_at + 2, {})[name] = 30 + r.randrange(71)
     if n_validators >= 2 and r.random() < 0.3:
         updates.setdefault(3, {})["validator01"] = 30 + r.randrange(71)
+    if faultnet_profile:
+        lines.append("[faultnet]")
+        lines.append("enabled = true")
+        for key, value in faultnet_profile.items():
+            lines.append(f"{key} = {value}")
+
     for height, upd in sorted(updates.items()):
         lines.append(f"[validator_update.{height}]")
         for name, power in sorted(upd.items()):
@@ -112,12 +136,19 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
                     lines.append("state_sync = true")
             else:
                 perturbs = [p for p, prob in PERTURBATIONS.items() if r.random() < prob]
-                # partition asserts the REMAINING validators keep
-                # committing, which needs a guaranteed >2/3 remainder:
-                # require >= 4 equal-power validators and no scheduled
-                # power updates
+                # partition/blackhole/halfopen assert the REMAINING
+                # validators keep committing, which needs a guaranteed
+                # >2/3 remainder: require >= 4 equal-power validators
+                # and no scheduled power updates
                 if n_validators < 4 or updates:
-                    perturbs = [p for p in perturbs if p != "partition"]
+                    perturbs = [p for p in perturbs
+                                if p not in ("partition", "blackhole", "halfopen")]
+                # the faultnet kinds proxy only configured peer links;
+                # seed-bootstrapped meshes discover peers over PEX
+                # outside the plane, so keep them off there
+                if n_seeds:
+                    perturbs = [p for p in perturbs
+                                if p not in ("blackhole", "halfopen")]
                 if perturbs and mode == "validator" and n_validators >= 2:
                     lines.append(f"perturb = {perturbs!r}".replace("'", '"'))
 

@@ -19,8 +19,9 @@ reference's:
 
 from __future__ import annotations
 
-import tomllib
 from dataclasses import dataclass, field
+
+from ..utils.compat import require_tomllib
 
 
 @dataclass
@@ -30,10 +31,43 @@ class NodeManifest:
     name: str
     mode: str = "validator"  # validator | full | seed
     abci_protocol: str = "builtin"  # builtin | tcp | unix | grpc
-    perturb: list[str] = field(default_factory=list)  # kill|pause|restart|disconnect|partition
+    # kill|pause|restart|disconnect|partition, plus the packet-level
+    # faultnet kinds blackhole|halfopen (docs/faultnet.md) — those
+    # auto-enable the fault plane
+    perturb: list[str] = field(default_factory=list)
     start_at: int = 0  # join later, at this height
     state_sync: bool = False  # late joiner restores an app snapshot first
     send_rate: int = 5_000_000  # p2p flow-control bytes/sec for tests
+
+
+# perturbation kinds that require every link proxied through faultnet
+FAULTNET_PERTURBATIONS = ("blackhole", "halfopen")
+
+
+@dataclass
+class FaultNetManifest:
+    """[faultnet] section: route every node-to-node link through the
+    packet-level fault plane (docs/faultnet.md), with an ambient
+    degraded-network policy applied to all links."""
+
+    enabled: bool = False
+    latency_ms: float = 0.0
+    jitter_ms: float = 0.0
+    drop: float = 0.0  # per-chunk drop probability
+    bandwidth: int = 0  # bytes/sec serialization cap, 0 = unlimited
+
+    def policy_fields(self) -> dict:
+        """Nonzero ambient fields as faultnet LinkPolicy kwargs."""
+        out = {}
+        if self.latency_ms:
+            out["latency"] = self.latency_ms / 1000.0
+        if self.jitter_ms:
+            out["jitter"] = self.jitter_ms / 1000.0
+        if self.drop:
+            out["drop"] = self.drop
+        if self.bandwidth:
+            out["bandwidth"] = self.bandwidth
+        return out
 
 
 @dataclass
@@ -64,10 +98,12 @@ class Manifest:
     process_proposal_delay_ms: int = 0
     check_tx_delay_ms: int = 0
     finalize_block_delay_ms: int = 0
+    # packet-level fault plane for every link (docs/faultnet.md)
+    faultnet: FaultNetManifest = field(default_factory=FaultNetManifest)
 
     @classmethod
     def parse(cls, text: str) -> "Manifest":
-        doc = tomllib.loads(text)
+        doc = require_tomllib().loads(text)
         m = cls(
             chain_id=doc.get("chain_id", "e2e-chain"),
             load_tx_rate=int(doc.get("load_tx_rate", 10)),
@@ -79,6 +115,14 @@ class Manifest:
             process_proposal_delay_ms=int(doc.get("process_proposal_delay_ms", 0)),
             check_tx_delay_ms=int(doc.get("check_tx_delay_ms", 0)),
             finalize_block_delay_ms=int(doc.get("finalize_block_delay_ms", 0)),
+        )
+        fn = doc.get("faultnet") or {}
+        m.faultnet = FaultNetManifest(
+            enabled=bool(fn.get("enabled", False)),
+            latency_ms=float(fn.get("latency_ms", 0.0)),
+            jitter_ms=float(fn.get("jitter_ms", 0.0)),
+            drop=float(fn.get("drop", 0.0)),
+            bandwidth=int(fn.get("bandwidth", 0)),
         )
         for h, updates in (doc.get("validator_update") or {}).items():
             m.validator_updates[int(h)] = {k: int(v) for k, v in updates.items()}
@@ -101,3 +145,11 @@ class Manifest:
     @property
     def validators(self) -> list[NodeManifest]:
         return [n for n in self.nodes if n.mode == "validator"]
+
+    @property
+    def faultnet_needed(self) -> bool:
+        """The plane is on when asked for explicitly OR any node carries
+        a packet-level perturbation kind."""
+        return self.faultnet.enabled or any(
+            k in FAULTNET_PERTURBATIONS for n in self.nodes for k in n.perturb
+        )

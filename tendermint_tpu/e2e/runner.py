@@ -74,6 +74,11 @@ class Runner:
         self.log = logger
         self.nodes: list[E2ENode] = []
         self._load_proc_stop = False
+        # packet-level fault plane (docs/faultnet.md): built in setup()
+        # when the manifest asks for it; every persistent-peer link is
+        # then carried through a per-link proxy named "dialer->target"
+        self.faultnet = None
+        self.faultnet_registry = None
 
     # ----------------------------------------------------------------- setup
 
@@ -114,6 +119,16 @@ class Runner:
             import shutil
 
             shutil.rmtree(self.base_dir)
+        if self.manifest.faultnet_needed:
+            from ..metrics import FaultNetMetrics, Registry
+            from ..faultnet import FaultNet
+
+            self.faultnet_registry = Registry()
+            self.faultnet = FaultNet(metrics=FaultNetMetrics(self.faultnet_registry))
+            ambient = self.manifest.faultnet.policy_fields()
+            if ambient:
+                self.faultnet.set_default_policy(**ambient)
+            self.log(f"faultnet enabled (ambient policy: {ambient or 'pass-through'})")
         ports = _free_ports(3 * len(ms))
         pvs = {}
         for i, nm in enumerate(ms):
@@ -189,16 +204,24 @@ class Runner:
                 # seed-bootstrapped topology: nodes know ONLY the seeds;
                 # PEX discovers the mesh (ref: manifest seeds + pex)
                 cfg.p2p.bootstrap_peers = ",".join(
-                    f"{o.node_id}@127.0.0.1:{o.p2p_port}" for o in seeds
+                    self._peer_addr(node, o) for o in seeds
                 )
                 cfg.p2p.persistent_peers = ""
             else:
                 peers = [
-                    f"{o.node_id}@127.0.0.1:{o.p2p_port}"
+                    self._peer_addr(node, o)
                     for o in self.nodes
                     if o is not node
                 ]
                 cfg.p2p.persistent_peers = ",".join(peers)
+            if self.faultnet is not None and not seeds:
+                # Keep every byte inside the fault plane: without PEX
+                # and with an undialable advertised address, a node can
+                # only reach peers through its configured per-link
+                # proxies — learned real addresses would bypass the
+                # faults (seed topologies need PEX and keep it).
+                cfg.p2p.pex = False
+                cfg.p2p.external_address = "0.0.0.0:0"
             if node.m.abci_protocol in ("tcp", "unix", "grpc"):
                 if node.m.abci_protocol == "unix":
                     addr = f"unix://{node.home}/app.sock"
@@ -210,6 +233,18 @@ class Runner:
                     f"builtin:kvstore:snapshot={self.manifest.snapshot_interval}"
                 )
             cfg.save()
+
+    def _peer_addr(self, dialer: E2ENode, target: E2ENode) -> str:
+        """target's address as `dialer` should dial it: direct, or via a
+        per-link faultnet proxy named 'dialer->target'."""
+        if self.faultnet is None:
+            return f"{target.node_id}@127.0.0.1:{target.p2p_port}"
+        name = f"{dialer.m.name}->{target.m.name}"
+        try:
+            link = self.faultnet.link(name)
+        except KeyError:
+            link = self.faultnet.add_link(name, ("127.0.0.1", target.p2p_port))
+        return f"{target.node_id}@{link.host}:{link.port}"
 
     def _configure_statesync(self, node: E2ENode) -> None:
         """Point a late joiner at a live node's RPC for the light-client
@@ -552,6 +587,55 @@ class Runner:
             # lone partitioned validator cannot do without reconnecting
             # and catching up, so heal-then-repartition starvation
             # can't sneak past it.
+        elif kind == "blackhole":
+            # packet-level severance BELOW the router (docs/faultnet.md):
+            # every link touching this node goes black in both
+            # directions, and live proxied connections are RST so
+            # re-dials become mid-handshake black holes — the dialer's
+            # TCP connect succeeds, its handshake bytes vanish, and the
+            # handshake watchdog must fail it over within its timeout.
+            # The rest of the net must keep committing throughout.
+            fn = self.faultnet
+            assert fn is not None, "blackhole perturbation without faultnet"
+            fn.fault_node(node.m.name, blackhole=True, drop_conns=True)
+            live = [
+                o for o in self.nodes
+                if o is not node and o.m.mode == "validator"
+            ]
+            if live:
+                target = self._max_height(live) + 2
+                self._wait_heights(live, target, timeout=90)
+            fn.heal_node(node.m.name)
+            # wait_progress (run_perturbations) asserts the victim
+            # recovers through the healed links
+        elif kind == "halfopen":
+            # one of the node's links freezes: the proxy stops reading,
+            # so the peer stays TCP-ESTABLISHED while every byte the
+            # node sends backs up into kernel buffers. The node must NOT
+            # stall — consensus continues over its other links and the
+            # MConn pong timeout eventually reaps the dead one.
+            fn = self.faultnet
+            assert fn is not None, "halfopen perturbation without faultnet"
+            links = [
+                l for l in fn.node_links(node.m.name)
+                if l.name.startswith(f"{node.m.name}->")
+            ]
+            assert links, f"{node.m.name} has no outbound faultnet links"
+            victim_link = links[0]
+            fn.fault(victim_link.name, half_open=True)
+            live = [
+                o for o in self.nodes
+                if o is not node and o.m.mode == "validator"
+            ]
+            if live:
+                # block production sustained with the frozen link in place
+                target = self._max_height(live) + 2
+                self._wait_heights(live, target, timeout=90)
+            # the faulted node itself must also keep advancing: a single
+            # half-open peer out of n-1 must never stall it
+            self.wait_progress(node, timeout=90)
+            victim_link.heal()
+            victim_link.drop_connections()  # unblock writers wedged in the freeze
         else:
             raise ValueError(f"unknown perturbation {kind!r}")
 
@@ -654,6 +738,8 @@ class Runner:
     # ----------------------------------------------------------------- stop
 
     def cleanup(self) -> None:
+        if self.faultnet is not None:
+            self.faultnet.close()
         for node in self.nodes:
             for proc in (node.proc, node.app_proc):
                 if proc is not None and proc.poll() is None:

@@ -1,0 +1,26 @@
+"""faultnet — packet-level network fault injection below the router.
+
+The e2e reference perturbs real containers (docker network disconnect,
+test/e2e/runner/perturb.go:40-72); this repo's runner previously
+injected partitions *above* the socket layer via router vetoes, so the
+p2p stack had never seen a half-open connection, a latency spike, or a
+black-holed handshake. faultnet closes that gap in-process: every
+node-to-node link is carried through a TCP proxy endpoint with
+independently controllable per-direction policies, a declarative
+scenario timeline, and Prometheus metrics for injected faults and link
+state. See docs/faultnet.md.
+"""
+
+from .policy import FakeClock, LinkPolicy, SystemClock
+from .proxy import FaultLink, FaultNet
+from .scenario import FaultEvent, Scenario
+
+__all__ = [
+    "FakeClock",
+    "FaultEvent",
+    "FaultLink",
+    "FaultNet",
+    "LinkPolicy",
+    "Scenario",
+    "SystemClock",
+]

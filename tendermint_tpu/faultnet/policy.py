@@ -1,0 +1,130 @@
+"""Per-direction link fault policies and the injectable clock.
+
+A LinkPolicy describes what one direction of one link does to the bytes
+flowing through it. Policies are plain data — the proxy pumps consult
+the *current* policy object on every chunk, so replacing a link's
+policy mid-stream retunes live connections without touching sockets.
+
+The clock is injectable so the deterministic tier-1 tests can drive
+latency/bandwidth/drip math through a FakeClock with zero real sleeps
+(the CI-budget rule in ISSUE satellite 6); production uses SystemClock.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, fields, replace
+
+# Policy fields a scenario event may set (everything except the name).
+POLICY_FIELDS = (
+    "latency",
+    "jitter",
+    "bandwidth",
+    "drop",
+    "blackhole",
+    "half_open",
+    "rst",
+    "slow_drip",
+)
+
+
+@dataclass(frozen=True)
+class LinkPolicy:
+    """One direction of one link.
+
+    latency    — seconds added before each chunk is forwarded
+    jitter     — uniform ±seconds on top of latency
+    bandwidth  — serialization cap in bytes/sec (0 = unlimited)
+    drop       — probability in [0,1] that a chunk silently vanishes
+    blackhole  — bytes are read and discarded; nothing is forwarded
+                 (new connections are accepted but never reach upstream,
+                 so a dialer sees a mid-handshake black hole: TCP
+                 connect succeeds, handshake bytes go nowhere)
+    half_open  — the proxy stops reading entirely: the peer looks alive
+                 at the TCP level but its writes back up into kernel
+                 buffers and nothing ever arrives (frozen peer)
+    rst        — connections are reset (SO_LINGER 0 close → ECONNRESET)
+    slow_drip  — forward at most this many bytes/sec, one byte at a
+                 time (0 = disabled) — stretches handshakes/packets to
+                 expose unbounded per-op timeouts
+    """
+
+    latency: float = 0.0
+    jitter: float = 0.0
+    bandwidth: int = 0
+    drop: float = 0.0
+    blackhole: bool = False
+    half_open: bool = False
+    rst: bool = False
+    slow_drip: int = 0
+
+    def __post_init__(self):
+        if not 0.0 <= self.drop <= 1.0:
+            raise ValueError(f"drop probability {self.drop} outside [0, 1]")
+        if self.latency < 0 or self.jitter < 0 or self.bandwidth < 0 or self.slow_drip < 0:
+            raise ValueError("latency/jitter/bandwidth/slow_drip must be >= 0")
+
+    def faulted(self) -> bool:
+        """True when ANY fault is active (healthy pass-through is the
+        all-defaults policy)."""
+        return self != LinkPolicy()
+
+    def with_(self, **changes) -> "LinkPolicy":
+        unknown = set(changes) - {f.name for f in fields(self)}
+        if unknown:
+            raise ValueError(f"unknown policy fields: {sorted(unknown)}")
+        return replace(self, **changes)
+
+    def delay_for(self, nbytes: int, rng) -> float:
+        """Forwarding delay for a chunk of nbytes: base latency, jitter
+        drawn from rng, plus bandwidth serialization time. Pure — the
+        deterministic tests pin it with a seeded rng and no clock."""
+        d = self.latency
+        if self.jitter:
+            d += rng.uniform(-self.jitter, self.jitter)
+        if self.bandwidth:
+            d += nbytes / float(self.bandwidth)
+        return max(0.0, d)
+
+    def should_drop(self, rng) -> bool:
+        return self.drop > 0 and rng.random() < self.drop
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "LinkPolicy":
+        return cls().with_(**doc)
+
+
+class SystemClock:
+    """Real time. sleep() returns early if `wake` (a threading.Event)
+    fires — so healing a link interrupts an in-flight latency sleep."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float, wake=None) -> None:
+        if seconds <= 0:
+            return
+        if wake is not None:
+            wake.wait(seconds)
+        else:
+            time.sleep(seconds)
+
+
+class FakeClock:
+    """Deterministic clock for tier-1 tests: sleep() records the request
+    and advances virtual time instantly; nothing blocks."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self.sleeps: list[float] = []
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float, wake=None) -> None:
+        if seconds > 0:
+            self.sleeps.append(seconds)
+            self._now += seconds
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds

@@ -1,0 +1,505 @@
+"""The fault-injection proxy plane: FaultLink (one node-to-node link
+carried through a TCP proxy endpoint) and FaultNet (the set of links
+plus default policies, pattern-based fault control, and metrics).
+
+A link proxies client → upstream with two pump threads per accepted
+connection (one per direction). Pumps re-read the link's current
+policy on every chunk, so engaging a fault retunes live connections
+immediately:
+
+  blackhole  — chunks are read and discarded (established streams), and
+               newly accepted connections never get an upstream at all —
+               a dialer's TCP connect succeeds but its handshake bytes
+               vanish (the mid-handshake black hole of perturb.go's
+               packet-drop partitions)
+  half_open  — the pump stops reading; the sender's writes back up into
+               kernel buffers behind a connection that still looks
+               ESTABLISHED (frozen peer)
+  rst        — SO_LINGER(0) close → the peer sees ECONNRESET
+  drop/latency/jitter/bandwidth/slow_drip — per-chunk treatments
+
+The proxy is transparent to SecretConnection: it moves ciphertext and
+never needs keys, so faults land *below* the router — real sockets,
+no veto.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import socket
+import struct
+import threading
+
+from ..metrics import FaultNetMetrics, Registry
+from .policy import LinkPolicy, SystemClock
+
+CHUNK = 16384
+DIRECTIONS = ("fwd", "rev")  # fwd: client → upstream; rev: upstream → client
+
+
+def _rst_close(sock: socket.socket) -> None:
+    """Close with SO_LINGER(0) so the kernel sends RST, not FIN."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class _ProxyConn:
+    __slots__ = ("client", "upstream", "closed", "_close_lock", "_sides_done")
+
+    def __init__(self, client, upstream):
+        self.client = client
+        self.upstream = upstream
+        self.closed = threading.Event()
+        self._close_lock = threading.Lock()
+        self._sides_done = 0
+
+    def side_done(self) -> int:
+        """A pump finished cleanly (EOF); returns how many have."""
+        with self._close_lock:
+            self._sides_done += 1
+            return self._sides_done
+
+    def close(self, rst: bool = False) -> bool:
+        """Close both sockets; True only for the caller that performed
+        the transition (metrics count each connection once)."""
+        with self._close_lock:
+            if self.closed.is_set():
+                return False
+            self.closed.set()
+        for s in (self.client, self.upstream):
+            if s is None:
+                continue
+            if rst:
+                _rst_close(s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        return True
+
+
+class FaultLink:
+    """One directed node-to-node link: a listening proxy endpoint in
+    front of `upstream`, with independent fwd/rev policies."""
+
+    def __init__(
+        self,
+        name: str,
+        upstream: tuple[str, int],
+        policy_fwd: LinkPolicy | None = None,
+        policy_rev: LinkPolicy | None = None,
+        metrics: FaultNetMetrics | None = None,
+        rng: random.Random | None = None,
+        clock=None,
+        bind_host: str = "127.0.0.1",
+        connect_timeout: float = 5.0,
+    ):
+        self.name = name
+        self.upstream = upstream
+        self.metrics = metrics
+        self.rng = rng or random.Random()
+        self.clock = clock or SystemClock()
+        self.connect_timeout = connect_timeout
+        self._policies = {
+            "fwd": policy_fwd or LinkPolicy(),
+            "rev": policy_rev or LinkPolicy(),
+        }
+        # the link's configured baseline (e.g. the manifest's ambient
+        # latency/jitter/drop): heal() restores THIS, not pass-through,
+        # and "faulted" means perturbed beyond it
+        self._baseline = dict(self._policies)
+        self._policy_lock = threading.Lock()
+        self._wake = threading.Event()  # pulsed on policy change: interrupts sleeps
+        self._conns: set[_ProxyConn] = set()
+        self._conns_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind_host, 0))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()[:2]
+        if self.metrics is not None:
+            for d in DIRECTIONS:
+                self.metrics.link_faulted.set(0.0, self.name, d)
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"faultnet:{name}"
+        ).start()
+
+    # ------------------------------------------------------------- policies
+
+    def policy(self, direction: str) -> LinkPolicy:
+        with self._policy_lock:
+            return self._policies[direction]
+
+    def set_policy(self, direction: str = "both", **fields) -> None:
+        """Update one or both directions' policies in place. Live pumps
+        pick the change up on their next chunk; sleeps are interrupted.
+        Setting rst=True also resets existing connections NOW."""
+        dirs = DIRECTIONS if direction == "both" else (direction,)
+        for d in dirs:
+            if d not in DIRECTIONS:
+                raise ValueError(f"unknown direction {d!r} (fwd|rev|both)")
+        with self._policy_lock:
+            for d in dirs:
+                self._policies[d] = self._policies[d].with_(**fields)
+                if self.metrics is not None:
+                    self.metrics.link_faulted.set(
+                        0.0 if self._policies[d] == self._baseline[d] else 1.0,
+                        self.name, d,
+                    )
+        # pulse: wake every sleeping pump so it re-reads the policy
+        self._wake.set()
+        self._wake.clear()
+        if fields.get("rst"):
+            self.drop_connections(rst=True)
+
+    def heal(self) -> None:
+        """Restore both directions to the link's BASELINE policy (the
+        manifest's ambient degradation, pass-through when none was
+        configured) — healing a perturbation must not silently strip
+        the configured ambiance. Connections that were accepted INTO a
+        black hole or freeze have no upstream and can never carry data
+        — close them so the peer sees the disconnect and re-dials
+        through the healed link (mid-stream-frozen connections keep
+        their pumps and resume)."""
+        with self._policy_lock:
+            for d in DIRECTIONS:
+                self._policies[d] = self._baseline[d]
+                if self.metrics is not None:
+                    self.metrics.link_faulted.set(0.0, self.name, d)
+        self._wake.set()
+        self._wake.clear()
+        with self._conns_lock:
+            orphans = [c for c in self._conns if c.upstream is None]
+        for c in orphans:
+            c.close()
+            self._untrack(c)
+
+    def faulted(self) -> bool:
+        """True while either direction is perturbed beyond its baseline."""
+        with self._policy_lock:
+            return any(self._policies[d] != self._baseline[d] for d in DIRECTIONS)
+
+    def drop_connections(self, rst: bool = False) -> None:
+        """Kill live proxied connections (peers re-dial through whatever
+        the current policy is — engage blackhole first to turn re-dials
+        into mid-handshake black holes)."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            if c.close(rst=rst) and rst and self.metrics is not None:
+                self.metrics.rst_connections.add(1, self.name)
+
+    # ------------------------------------------------------------ data path
+
+    def _accept_loop(self) -> None:
+        try:
+            self._listener.settimeout(0.2)
+        except OSError:
+            return  # closed before the loop started
+        while not self._closed.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if self.metrics is not None:
+                self.metrics.connections.add(1, self.name)
+            pol = self.policy("fwd")
+            if pol.rst:
+                if self.metrics is not None:
+                    self.metrics.rst_connections.add(1, self.name)
+                _rst_close(client)
+                continue
+            if pol.half_open:
+                # accepted, never read, never forwarded: the dialer's
+                # connect succeeds and then the world goes silent
+                if self.metrics is not None:
+                    self.metrics.half_open_connections.add(1, self.name)
+                self._track(_ProxyConn(client, None))
+                continue
+            if pol.blackhole:
+                if self.metrics is not None:
+                    self.metrics.blackholed_connections.add(1, self.name)
+                conn = _ProxyConn(client, None)
+                self._track(conn)
+                threading.Thread(
+                    target=self._pump, args=(conn, client, None, "fwd"),
+                    daemon=True, name=f"faultnet:{self.name}:bh",
+                ).start()
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=self.connect_timeout)
+                up.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            conn = _ProxyConn(client, up)
+            self._track(conn)
+            for src, dst, d in ((client, up, "fwd"), (up, client, "rev")):
+                threading.Thread(
+                    target=self._pump, args=(conn, src, dst, d),
+                    daemon=True, name=f"faultnet:{self.name}:{d}",
+                ).start()
+
+    def _track(self, conn: _ProxyConn) -> None:
+        with self._conns_lock:
+            self._conns.add(conn)
+        if self.metrics is not None:
+            self.metrics.active_connections.set(len(self._conns), self.name)
+
+    def _untrack(self, conn: _ProxyConn) -> None:
+        with self._conns_lock:
+            self._conns.discard(conn)
+        if self.metrics is not None:
+            self.metrics.active_connections.set(len(self._conns), self.name)
+
+    def _pump(self, conn: _ProxyConn, src, dst, direction: str) -> None:
+        """Move bytes src → dst under the link's live policy. dst=None
+        for a black-holed connection (drain only)."""
+        m = self.metrics
+        eof_clean = False
+        try:
+            src.settimeout(0.2)
+            while not conn.closed.is_set() and not self._closed.is_set():
+                pol = self.policy(direction)
+                if pol.rst:
+                    if conn.close(rst=True) and m is not None:
+                        m.rst_connections.add(1, self.name)
+                    return
+                if pol.half_open:
+                    # freeze: stop reading so the sender's TCP buffers
+                    # fill behind an ESTABLISHED connection. This is an
+                    # indefinite park, not a modeled delay — block on a
+                    # real wait (a FakeClock's instant sleep would spin
+                    # this thread hot); a policy change pulses _wake
+                    self._wake.wait(0.05)
+                    continue
+                try:
+                    chunk = src.recv(CHUNK)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not chunk:
+                    # half-close toward the destination, mirror EOF —
+                    # the REVERSE direction may still be draining, so a
+                    # clean EOF must not tear the whole connection down
+                    if dst is not None:
+                        try:
+                            dst.shutdown(socket.SHUT_WR)
+                        except OSError:
+                            pass
+                        eof_clean = True
+                    break
+                pol = self.policy(direction)  # may have changed while blocked
+                if pol.blackhole or pol.half_open or dst is None:
+                    # a chunk read in the race window around a fault
+                    # engagement cannot be un-read: swallow it (the
+                    # half-open freeze proper resumes next iteration)
+                    if m is not None:
+                        m.blackholed_bytes.add(len(chunk), self.name, direction)
+                    continue
+                if pol.should_drop(self.rng):
+                    if m is not None:
+                        m.dropped_chunks.add(1, self.name, direction)
+                    continue
+                delay = pol.delay_for(len(chunk), self.rng)
+                if delay > 0:
+                    if m is not None:
+                        m.delayed_chunks.add(1, self.name, direction)
+                    self.clock.sleep(delay, wake=self._wake)
+                    if conn.closed.is_set():
+                        return
+                try:
+                    if pol.slow_drip > 0:
+                        interval = 1.0 / pol.slow_drip
+                        for i in range(len(chunk)):
+                            dst.sendall(chunk[i : i + 1])
+                            self.clock.sleep(interval, wake=self._wake)
+                            if conn.closed.is_set() or self.policy(direction).slow_drip <= 0:
+                                # policy changed mid-drip: flush the rest plain
+                                dst.sendall(chunk[i + 1 :])
+                                break
+                    else:
+                        dst.sendall(chunk)
+                except OSError:
+                    break
+                if m is not None:
+                    m.forwarded_bytes.add(len(chunk), self.name, direction)
+        finally:
+            # half-close semantics: after a clean EOF, keep the
+            # connection alive until the other pump also finishes
+            # (error/fault exits close immediately)
+            if not eof_clean or conn.side_done() >= 2:
+                conn.close()
+            if conn.closed.is_set():
+                self._untrack(conn)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.drop_connections()
+
+
+class FaultNet:
+    """The set of links plus default policies and pattern-based control.
+
+    Two ways to build links:
+      - add_link(name, upstream): explicit, one per directed node pair
+        (the e2e runner names them "dialer->target")
+      - gateway(src): a dial-through hook for TcpTransport — any dial
+        the node makes is routed through a lazily created link named
+        "src->host:port", so even addresses learned at runtime stay
+        inside the fault plane
+    """
+
+    def __init__(self, metrics: FaultNetMetrics | None = None, seed: int = 0, clock=None):
+        self.registry = None
+        if metrics is None:
+            self.registry = Registry()
+            metrics = FaultNetMetrics(self.registry)
+        self.metrics = metrics
+        self.clock = clock or SystemClock()
+        self._rng = random.Random(seed)
+        self._links: dict[str, FaultLink] = {}
+        self._lock = threading.Lock()
+        self._default = LinkPolicy()
+        self._closed = False
+
+    # --------------------------------------------------------------- links
+
+    def set_default_policy(self, **fields) -> None:
+        """Baseline policy applied to both directions of every link
+        created from now on (the manifest's ambient latency/jitter/drop)."""
+        self._default = LinkPolicy().with_(**fields)
+
+    @property
+    def default_policy(self) -> LinkPolicy:
+        return self._default
+
+    def add_link(self, name: str, upstream: tuple[str, int], **kwargs) -> FaultLink:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("faultnet is closed")
+            if name in self._links:
+                raise ValueError(f"link {name!r} already exists")
+            link = FaultLink(
+                name,
+                upstream,
+                policy_fwd=kwargs.pop("policy_fwd", self._default),
+                policy_rev=kwargs.pop("policy_rev", self._default),
+                metrics=self.metrics,
+                rng=random.Random(self._rng.getrandbits(64)),
+                clock=self.clock,
+                **kwargs,
+            )
+            self._links[name] = link
+            self.metrics.links.set(len(self._links))
+            return link
+
+    def link(self, name: str) -> FaultLink:
+        with self._lock:
+            return self._links[name]
+
+    def links(self, pattern: str = "*") -> list[FaultLink]:
+        with self._lock:
+            return [l for n, l in sorted(self._links.items()) if fnmatch.fnmatch(n, pattern)]
+
+    def gateway(self, src: str):
+        """Dial-through hook for TcpTransport: (host, port) → the
+        proxied (host, port). Links are created on demand per
+        destination, inheriting the default policy."""
+
+        def route(host: str, port: int) -> tuple[str, int]:
+            name = f"{src}->{host}:{port}"
+            with self._lock:
+                link = self._links.get(name)
+            if link is None:
+                try:
+                    link = self.add_link(name, (host, port))
+                except ValueError:
+                    # lost a create race with a concurrent dial to the
+                    # same destination — use the winner's link
+                    link = self.link(name)
+            return link.host, link.port
+
+        return route
+
+    # -------------------------------------------------------------- faults
+
+    def fault(self, pattern: str, direction: str = "both", drop_conns: bool = False,
+              **fields) -> list[FaultLink]:
+        """Engage policy fields on every link matching the fnmatch
+        pattern. Returns the matched links. drop_conns=True also kills
+        live connections (with RST) so peers re-dial into the fault."""
+        matched = self.links(pattern)
+        for link in matched:
+            link.set_policy(direction, **fields)
+            if drop_conns:
+                link.drop_connections(rst=True)
+        for kind, active in sorted(fields.items()):
+            if active:
+                self.metrics.faults_injected.add(len(matched), kind)
+        return matched
+
+    def heal(self, pattern: str = "*") -> list[FaultLink]:
+        matched = self.links(pattern)
+        for link in matched:
+            link.heal()
+        if matched:
+            self.metrics.faults_injected.add(len(matched), "heal")
+        return matched
+
+    def node_links(self, node: str) -> list[FaultLink]:
+        """Every link that touches `node` under the runner's
+        "dialer->target" naming convention."""
+        out = []
+        for link in self.links():
+            dialer, _, target = link.name.partition("->")
+            if node in (dialer, target):
+                out.append(link)
+        return out
+
+    def fault_node(self, node: str, direction: str = "both", drop_conns: bool = False,
+                   **fields) -> list[FaultLink]:
+        matched = self.node_links(node)
+        for link in matched:
+            link.set_policy(direction, **fields)
+            if drop_conns:
+                link.drop_connections(rst=True)
+        for kind, active in sorted(fields.items()):
+            if active:
+                self.metrics.faults_injected.add(len(matched), kind)
+        return matched
+
+    def heal_node(self, node: str) -> list[FaultLink]:
+        matched = self.node_links(node)
+        for link in matched:
+            link.heal()
+        if matched:
+            self.metrics.faults_injected.add(len(matched), "heal")
+        return matched
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            links = list(self._links.values())
+        for link in links:
+            link.close()

@@ -1,0 +1,149 @@
+"""Declarative fault scenarios: a timeline of policy events keyed by
+link pattern and direction.
+
+TOML format (JSON with the same shape also accepted via from_doc):
+
+    name = "handshake-blackhole"
+
+    [[event]]
+    at = 2.0                       # seconds from scenario start
+    link = "validator01->*"        # fnmatch over link names ("*" = all)
+    direction = "both"             # fwd | rev | both
+    blackhole = true
+    drop_conns = true              # reset live conns into the fault
+
+    [[event]]
+    at = 6.0
+    link = "validator01->*"
+    heal = true
+
+Deterministic replay: apply_until(net, t) consumes every event with
+at <= t without sleeping — the tier-1 tests drive scenarios on a fake
+timeline. run(net) walks real time (injectable clock) for the e2e
+runner and scripts/faultnet_scenarios.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..utils.compat import require_tomllib
+from .policy import SystemClock
+
+from .policy import POLICY_FIELDS
+
+
+@dataclass
+class FaultEvent:
+    at: float
+    link: str = "*"
+    direction: str = "both"
+    heal: bool = False
+    drop_conns: bool = False
+    policy: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.at < 0:
+            raise ValueError(f"event at={self.at} before scenario start")
+        if self.direction not in ("fwd", "rev", "both"):
+            raise ValueError(f"unknown direction {self.direction!r}")
+        unknown = set(self.policy) - set(POLICY_FIELDS)
+        if unknown:
+            raise ValueError(f"unknown policy fields: {sorted(unknown)}")
+        if not self.heal and not self.policy:
+            raise ValueError("event sets no policy fields and is not a heal")
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "FaultEvent":
+        doc = dict(doc)
+        return cls(
+            at=float(doc.pop("at", 0.0)),
+            link=doc.pop("link", "*"),
+            direction=doc.pop("direction", "both"),
+            heal=bool(doc.pop("heal", False)),
+            drop_conns=bool(doc.pop("drop_conns", False)),
+            policy=doc,  # every remaining key must be a policy field
+        )
+
+    def apply(self, net) -> list:
+        """Apply to a FaultNet; returns the matched links."""
+        if self.heal:
+            return net.heal(self.link)
+        return net.fault(self.link, direction=self.direction,
+                         drop_conns=self.drop_conns, **self.policy)
+
+
+class Scenario:
+    """An ordered fault timeline."""
+
+    def __init__(self, events: list[FaultEvent], name: str = "scenario"):
+        self.name = name
+        self.events = sorted(events, key=lambda e: e.at)
+        self._applied = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "Scenario":
+        doc = require_tomllib().loads(text)
+        return cls.from_doc(doc)
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Scenario":
+        events = [FaultEvent.from_doc(e) for e in doc.get("event", [])]
+        if not events:
+            raise ValueError("scenario has no [[event]] entries")
+        return cls(events, name=doc.get("name", "scenario"))
+
+    @property
+    def duration(self) -> float:
+        return self.events[-1].at if self.events else 0.0
+
+    def reset(self) -> None:
+        self._applied = 0
+
+    def apply_until(self, net, t: float) -> list[FaultEvent]:
+        """Consume every not-yet-applied event with at <= t. No clock,
+        no sleeping — deterministic by construction."""
+        fired = []
+        while self._applied < len(self.events) and self.events[self._applied].at <= t:
+            ev = self.events[self._applied]
+            ev.apply(net)
+            fired.append(ev)
+            self._applied += 1
+        return fired
+
+    def run(self, net, clock=None, stop: threading.Event | None = None, log=None) -> int:
+        """Blocking real-time replay from t=0; returns events applied.
+        `stop` aborts between events (the e2e runner's teardown)."""
+        clock = clock or net.clock
+        self.reset()
+        start = clock.now()
+        n = 0
+        for ev in self.events:
+            delay = ev.at - (clock.now() - start)
+            if delay > 0:
+                if stop is not None and isinstance(clock, SystemClock):
+                    if stop.wait(delay):
+                        return n
+                else:
+                    # a fake clock must advance its own time, or the
+                    # absolute offsets degrade into cumulative sums
+                    clock.sleep(delay)
+            if stop is not None and stop.is_set():
+                return n
+            matched = ev.apply(net)
+            n += 1
+            if log is not None:
+                what = "heal" if ev.heal else ",".join(sorted(ev.policy))
+                log(f"faultnet scenario {self.name!r} t={ev.at:g}s: {what} on "
+                    f"{len(matched)} link(s) matching {ev.link!r}")
+        return n
+
+    def start(self, net, log=None) -> threading.Event:
+        """Fire-and-forget run(); returns the stop event."""
+        stop = threading.Event()
+        threading.Thread(
+            target=self.run, args=(net,), kwargs={"stop": stop, "log": log},
+            daemon=True, name=f"faultnet-scenario:{self.name}",
+        ).start()
+        return stop

@@ -322,6 +322,62 @@ class StateMetrics:
             h.observe(value)
 
 
+class FaultNetMetrics:
+    """Metrics for the faultnet fault-injection plane (docs/faultnet.md).
+
+    No reference analog — the reference perturbs docker networks from
+    outside the process; here the injection plane is in-process and
+    observable, so fault state and recovery are asserted from these
+    series in the e2e tests."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_faultnet"
+        self.links = reg.gauge(f"{ns}_links", "Configured faultnet links")
+        self.link_faulted = reg.gauge(
+            f"{ns}_link_faulted",
+            "1 while any fault policy is active on the link direction",
+            labels=("link", "dir"),
+        )
+        self.faults_injected = reg.counter(
+            f"{ns}_faults_injected_total",
+            "Fault policy engagements by kind (heal included)",
+            labels=("kind",),
+        )
+        self.connections = reg.counter(
+            f"{ns}_connections_total", "Connections accepted per link", labels=("link",)
+        )
+        self.active_connections = reg.gauge(
+            f"{ns}_active_connections", "Live proxied connections", labels=("link",)
+        )
+        self.forwarded_bytes = reg.counter(
+            f"{ns}_forwarded_bytes_total", "Bytes forwarded", labels=("link", "dir")
+        )
+        self.delayed_chunks = reg.counter(
+            f"{ns}_delayed_chunks_total",
+            "Chunks forwarded after an injected delay",
+            labels=("link", "dir"),
+        )
+        self.dropped_chunks = reg.counter(
+            f"{ns}_dropped_chunks_total", "Chunks probabilistically dropped", labels=("link", "dir")
+        )
+        self.blackholed_bytes = reg.counter(
+            f"{ns}_blackholed_bytes_total", "Bytes swallowed by a black hole", labels=("link", "dir")
+        )
+        self.blackholed_connections = reg.counter(
+            f"{ns}_blackholed_connections_total",
+            "Connections accepted into a black hole (no upstream)",
+            labels=("link",),
+        )
+        self.half_open_connections = reg.counter(
+            f"{ns}_half_open_connections_total",
+            "Connections accepted then frozen (never read)",
+            labels=("link",),
+        )
+        self.rst_connections = reg.counter(
+            f"{ns}_rst_connections_total", "Connections hard-reset", labels=("link",)
+        )
+
+
 class PrometheusServer:
     """Minimal /metrics HTTP endpoint (ref: node/node.go:575)."""
 

@@ -260,6 +260,8 @@ class Node:
             bind_port=laddr.port or 0,
             send_rate=config.p2p.send_rate,
             recv_rate=config.p2p.recv_rate,
+            ping_interval=config.p2p.ping_interval,
+            pong_timeout=config.p2p.pong_timeout,
         )
         persistent = parse_peer_list(config.p2p.persistent_peers)
         self.peer_manager = PeerManager(

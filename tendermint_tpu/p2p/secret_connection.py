@@ -16,10 +16,27 @@ from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes
+    _HKDF = lambda length, info: HKDF(
+        algorithm=hashes.SHA256(), length=length, salt=None, info=info
+    ).derive
+except ImportError:  # no `cryptography` wheel: pure-Python primitives
+    from ..crypto.softcrypto import (  # noqa: F401
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hkdf_sha256,
+    )
+    _HKDF = lambda length, info: (
+        lambda ikm: hkdf_sha256(ikm, length, info)
+    )
 
 from ..crypto.ed25519 import Ed25519PubKey
 from ..proto import messages as pb
@@ -52,7 +69,7 @@ class _NonceCounter:
 
 def derive_secrets(dh_secret: bytes, loc_is_least: bool) -> tuple[bytes, bytes, bytes]:
     """HKDF → (recv_key, send_key, challenge) (ref: deriveSecrets :337)."""
-    okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=None, info=_HKDF_INFO).derive(dh_secret)
+    okm = _HKDF(96, _HKDF_INFO)(dh_secret)
     if loc_is_least:
         recv_key, send_key = okm[0:32], okm[32:64]
     else:

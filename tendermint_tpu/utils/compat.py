@@ -1,0 +1,26 @@
+"""Small stdlib compatibility shims shared across the package.
+
+tomllib landed in Python 3.11; on 3.10 the tomli backport has the same
+API. One shim here keeps the behavior uniform (config, e2e manifests,
+and faultnet scenarios previously each inlined their own with diverging
+failure modes)."""
+
+from __future__ import annotations
+
+try:
+    import tomllib  # py3.11+
+except ImportError:
+    try:
+        import tomli as tomllib  # backport with the same API
+    except ImportError:  # pragma: no cover
+        tomllib = None
+
+
+def require_tomllib():
+    """The module, or a friendly error at USE time (an import-time crash
+    would take whole subsystems down with it)."""
+    if tomllib is None:
+        raise RuntimeError(
+            "TOML parsing requires Python 3.11+ (tomllib) or the tomli package"
+        )
+    return tomllib

@@ -1,0 +1,588 @@
+"""faultnet tier-1 suite: deterministic, no real fault sleeps
+(docs/faultnet.md — policy math on a seeded RNG, scenarios on a fake
+timeline, immediate blackhole/half-open/RST behavior, the transport's
+handshake watchdog and pong-timeout reap through real faultnet links).
+The real-sleep matrix lives in tests/test_faultnet_e2e.py (slow) and
+scripts/faultnet_scenarios.py.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu.faultnet import (
+    FakeClock,
+    FaultNet,
+    LinkPolicy,
+    Scenario,
+)
+from tendermint_tpu.metrics import FaultNetMetrics, Registry
+
+# ----------------------------------------------------------- policy math
+
+
+def test_policy_validation_and_with():
+    with pytest.raises(ValueError, match="drop probability"):
+        LinkPolicy(drop=1.5)
+    with pytest.raises(ValueError, match=">= 0"):
+        LinkPolicy(latency=-1)
+    with pytest.raises(ValueError, match="unknown policy fields"):
+        LinkPolicy().with_(latencyy=0.1)
+    p = LinkPolicy().with_(latency=0.2, drop=0.5)
+    assert p.latency == 0.2 and p.drop == 0.5
+    assert p.faulted() and not LinkPolicy().faulted()
+
+
+def test_policy_delay_is_deterministic_under_seeded_rng():
+    p = LinkPolicy(latency=0.05, jitter=0.01, bandwidth=1000)
+    d1 = p.delay_for(100, random.Random(7))
+    d2 = p.delay_for(100, random.Random(7))
+    assert d1 == d2
+    # latency - jitter + serialization <= d <= latency + jitter + serialization
+    assert 0.05 - 0.01 + 0.1 <= d1 <= 0.05 + 0.01 + 0.1
+    # bandwidth term scales with chunk size; no negative delays ever
+    assert p.delay_for(200, random.Random(7)) > d1
+    assert LinkPolicy(jitter=0.5).delay_for(1, random.Random(0)) >= 0.0
+
+
+def test_policy_drop_rate_under_seeded_rng():
+    p = LinkPolicy(drop=0.25)
+    rng = random.Random(42)
+    hits = sum(p.should_drop(rng) for _ in range(4000))
+    assert 800 < hits < 1200  # ~25%
+    assert not LinkPolicy().should_drop(rng)
+
+
+def test_fake_clock_records_sleeps_without_sleeping():
+    fc = FakeClock()
+    t0 = time.monotonic()
+    fc.sleep(100.0)
+    fc.sleep(0.0)  # no-op, not recorded
+    assert time.monotonic() - t0 < 1.0
+    assert fc.sleeps == [100.0] and fc.now() == 100.0
+
+
+# -------------------------------------------------------------- scenario
+
+
+def test_scenario_parse_validation():
+    with pytest.raises(ValueError, match="no \\[\\[event\\]\\]"):
+        Scenario.parse('name = "empty"\n')
+    with pytest.raises(ValueError, match="unknown policy fields"):
+        Scenario.parse('[[event]]\nat = 1.0\nlatencyy = 0.1\n')
+    with pytest.raises(ValueError, match="no policy fields"):
+        Scenario.parse('[[event]]\nat = 1.0\n')
+    with pytest.raises(ValueError, match="unknown direction"):
+        Scenario.parse('[[event]]\nat = 1.0\ndirection = "up"\nlatency = 0.1\n')
+    sc = Scenario.parse(
+        'name = "x"\n'
+        "[[event]]\nat = 3.0\nlink = \"a->b\"\nheal = true\n"
+        "[[event]]\nat = 1.0\nblackhole = true\ndrop_conns = true\n"
+    )
+    assert sc.name == "x" and sc.duration == 3.0
+    assert [e.at for e in sc.events] == [1.0, 3.0]  # sorted
+    assert sc.events[0].drop_conns and sc.events[1].heal
+
+
+def test_scenario_apply_until_is_deterministic(faultnet_pair):
+    net, link, _ = faultnet_pair
+    sc = Scenario.parse(
+        "[[event]]\nat = 1.0\nlink = \"a->b\"\ndirection = \"fwd\"\nlatency = 0.25\n"
+        "[[event]]\nat = 2.0\nlink = \"*\"\nblackhole = true\n"
+        "[[event]]\nat = 5.0\nlink = \"*\"\nheal = true\n"
+    )
+    assert sc.apply_until(net, 0.99) == []
+    assert len(sc.apply_until(net, 1.0)) == 1
+    assert link.policy("fwd").latency == 0.25 and link.policy("rev").latency == 0.0
+    assert len(sc.apply_until(net, 10.0)) == 2  # remaining two, once each
+    assert not link.faulted()
+    assert sc.apply_until(net, 99.0) == []  # exhausted
+    sc.reset()
+    assert len(sc.apply_until(net, 10.0)) == 3
+
+
+# ------------------------------------------------------------ proxy plane
+
+
+@pytest.fixture
+def faultnet_pair():
+    """(net, link, connect): an echo upstream behind one faultnet link."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(16)
+    stop = threading.Event()
+
+    def echo_loop():
+        while not stop.is_set():
+            try:
+                srv.settimeout(0.2)
+                c, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+            def handle(c=c):
+                while True:
+                    try:
+                        d = c.recv(4096)
+                    except OSError:
+                        return
+                    if not d:
+                        return
+                    try:
+                        c.sendall(d)
+                    except OSError:
+                        return
+
+            threading.Thread(target=handle, daemon=True).start()
+
+    threading.Thread(target=echo_loop, daemon=True).start()
+    net = FaultNet(seed=0xF0)
+    link = net.add_link("a->b", srv.getsockname())
+
+    def connect():
+        return socket.create_connection((link.host, link.port), timeout=5)
+
+    yield net, link, connect
+    stop.set()
+    net.close()
+    srv.close()
+
+
+def _roundtrip(conn, payload: bytes, timeout: float = 5.0) -> bytes:
+    conn.sendall(payload)
+    conn.settimeout(timeout)
+    got = b""
+    while len(got) < len(payload):
+        got += conn.recv(len(payload) - len(got))
+    return got
+
+
+def test_passthrough_and_live_blackhole_and_heal(faultnet_pair):
+    net, link, connect = faultnet_pair
+    c = connect()
+    assert _roundtrip(c, b"hello") == b"hello"
+    # engage mid-stream: bytes vanish, the connection stays up
+    link.set_policy("fwd", blackhole=True)
+    c.sendall(b"vanish")
+    c.settimeout(0.3)
+    with pytest.raises(socket.timeout):
+        c.recv(1)
+    link.heal()
+    assert _roundtrip(c, b"revived") == b"revived"
+    c.close()
+    m = net.metrics
+    assert dict(
+        ((s[1]["link"], s[1]["dir"]), s[2]) for s in m.blackholed_bytes.samples()
+    )[("a->b", "fwd")] >= 6
+    faulted = {(s[1]["link"], s[1]["dir"]): s[2] for s in m.link_faulted.samples()}
+    assert faulted[("a->b", "fwd")] == 0.0  # healed
+
+
+def test_new_connection_into_blackhole_never_reaches_upstream(faultnet_pair):
+    net, link, connect = faultnet_pair
+    link.set_policy("both", blackhole=True)
+    c = connect()  # TCP connect SUCCEEDS — that's the point
+    c.sendall(b"handshake-bytes-go-nowhere")
+    c.settimeout(0.3)
+    with pytest.raises(socket.timeout):
+        c.recv(1)
+    c.close()
+    counts = {s[1]["link"]: s[2] for s in net.metrics.blackholed_connections.samples()}
+    assert counts.get("a->b", 0) >= 1
+
+
+def test_half_open_freezes_reads(faultnet_pair):
+    net, link, connect = faultnet_pair
+    c = connect()
+    assert _roundtrip(c, b"warm") == b"warm"
+    link.set_policy("both", half_open=True)
+    # nothing comes back; the socket itself stays ESTABLISHED
+    c.sendall(b"frozen?")
+    c.settimeout(0.3)
+    with pytest.raises(socket.timeout):
+        c.recv(1)
+    # new connections are accepted then frozen too
+    c2 = connect()
+    c2.settimeout(0.3)
+    with pytest.raises(socket.timeout):
+        c2.recv(1)
+    counts = {s[1]["link"]: s[2] for s in net.metrics.half_open_connections.samples()}
+    assert counts.get("a->b", 0) >= 1
+    c.close()
+    c2.close()
+
+
+def test_rst_resets_live_and_new_connections(faultnet_pair):
+    net, link, connect = faultnet_pair
+    c = connect()
+    assert _roundtrip(c, b"pre") == b"pre"
+    link.set_policy("fwd", rst=True)  # resets existing conns NOW
+    c.settimeout(2.0)
+    with pytest.raises((ConnectionResetError, BrokenPipeError, ConnectionAbortedError)):
+        for _ in range(20):  # reset may land on read or a later write
+            c.sendall(b"x")
+            if c.recv(1) == b"":
+                raise ConnectionResetError
+    c.close()
+    counts = {s[1]["link"]: s[2] for s in net.metrics.rst_connections.samples()}
+    assert counts.get("a->b", 0) >= 1
+
+
+def test_drop_policy_loses_chunks_deterministically(faultnet_pair):
+    net, link, connect = faultnet_pair
+    link.set_policy("fwd", drop=1.0)  # every request chunk vanishes
+    c = connect()
+    c.sendall(b"dropped")
+    c.settimeout(0.3)
+    with pytest.raises(socket.timeout):
+        c.recv(1)
+    link.set_policy("fwd", drop=0.0)
+    assert _roundtrip(c, b"clean") == b"clean"
+    c.close()
+    counts = {
+        (s[1]["link"], s[1]["dir"]): s[2] for s in net.metrics.dropped_chunks.samples()
+    }
+    assert counts.get(("a->b", "fwd"), 0) >= 1
+
+
+def test_fault_patterns_and_node_links():
+    net = FaultNet(seed=1)
+    try:
+        # upstreams never dialed: policy bookkeeping only
+        a_b = net.add_link("a->b", ("127.0.0.1", 1))
+        b_a = net.add_link("b->a", ("127.0.0.1", 1))
+        a_c = net.add_link("a->c", ("127.0.0.1", 1))
+        c_b = net.add_link("c->b", ("127.0.0.1", 1))
+        matched = net.fault("a->*", blackhole=True)
+        assert {l.name for l in matched} == {"a->b", "a->c"}
+        assert a_b.policy("fwd").blackhole and not c_b.policy("fwd").blackhole
+        assert {l.name for l in net.node_links("b")} == {"a->b", "b->a", "c->b"}
+        net.fault_node("b", direction="rev", latency=0.5)
+        assert b_a.policy("rev").latency == 0.5 and b_a.policy("fwd").latency == 0.0
+        healed = net.heal()
+        assert len(healed) == 4
+        assert not any(l.faulted() for l in (a_b, b_a, a_c, c_b))
+        kinds = {s[1]["kind"]: s[2] for s in net.metrics.faults_injected.samples()}
+        assert kinds["blackhole"] == 2 and kinds["latency"] == 3 and kinds["heal"] == 4
+    finally:
+        net.close()
+
+
+def test_default_policy_applies_to_new_links():
+    net = FaultNet(seed=2)
+    try:
+        net.set_default_policy(latency=0.01, drop=0.05)
+        link = net.add_link("x->y", ("127.0.0.1", 1))
+        assert link.policy("fwd").latency == 0.01
+        assert link.policy("rev").drop == 0.05
+        # the ambient default IS the link's baseline: not "faulted"
+        assert not link.faulted()
+        # a perturbation beyond the baseline is; heal restores the
+        # BASELINE (the ambient degradation), not pass-through
+        link.set_policy("fwd", blackhole=True)
+        assert link.faulted()
+        link.heal()
+        assert not link.faulted()
+        assert link.policy("fwd").latency == 0.01, "heal stripped the ambient policy"
+    finally:
+        net.close()
+
+
+def test_latency_uses_injected_clock_not_real_time(faultnet_pair):
+    """Ambient latency on a FakeClock link: bytes still flow instantly in
+    real time while the virtual clock records the injected delays — the
+    no-sleep determinism contract for tier-1 scenarios."""
+    fc = FakeClock()
+    net = FaultNet(seed=3, clock=fc)
+    try:
+        # reuse the echo upstream from the fixture's server via a fresh link
+        upstream_net, upstream_link, _ = faultnet_pair
+        link = net.add_link("fc->echo", upstream_link.upstream)
+        link.set_policy("fwd", latency=5.0)  # five VIRTUAL seconds per chunk
+        t0 = time.monotonic()
+        c = socket.create_connection((link.host, link.port), timeout=5)
+        assert _roundtrip(c, b"instant") == b"instant"
+        c.close()
+        assert time.monotonic() - t0 < 3.0, "fake-clock latency slept for real"
+        assert any(s >= 5.0 for s in fc.sleeps), fc.sleeps
+        delayed = {
+            (s[1]["link"], s[1]["dir"]): s[2]
+            for s in net.metrics.delayed_chunks.samples()
+        }
+        assert delayed.get(("fc->echo", "fwd"), 0) >= 1
+    finally:
+        net.close()
+
+
+# ------------------------------------------------ transport through faults
+
+
+def _mk_transport(descs=None, **kw):
+    from tendermint_tpu.p2p.transport_tcp import TcpTransport
+    from tendermint_tpu.p2p.types import ChannelDescriptor
+
+    ident = lambda b: b
+    descs = descs or [
+        ChannelDescriptor(id=0x21, name="d", priority=5, encode=ident, decode=ident)
+    ]
+    return TcpTransport(descs, **kw)
+
+
+def _node_info(key):
+    from tendermint_tpu.p2p.types import NodeInfo, node_id_from_pubkey
+
+    return NodeInfo(
+        node_id=node_id_from_pubkey(key.pub_key()),
+        network="fn-test",
+        channels=bytes([0x21]),
+        listen_addr="127.0.0.1:1",
+    )
+
+
+def test_handshake_watchdog_escapes_blackhole_within_timeout():
+    """The tentpole bug fix: a mid-handshake black hole (TCP connect
+    succeeds, handshake bytes vanish) must fail over within the
+    configured handshake timeout, not hold the thread forever."""
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.p2p.transport import Endpoint
+
+    net = FaultNet(seed=4)
+    try:
+        bh = net.add_link("z->w", ("127.0.0.1", 1))
+        bh.set_policy("both", blackhole=True)
+        t = _mk_transport()
+        key = Ed25519PrivKey.generate(b"\x31" * 32)
+        t0 = time.monotonic()
+        conn = t.dial(Endpoint(protocol="mconn", host=bh.host, port=bh.port), timeout=5)
+        with pytest.raises((TimeoutError, OSError, ConnectionError)):
+            conn.handshake(_node_info(key), key, timeout=1.0)
+        assert time.monotonic() - t0 < 3.0, "handshake did not respect its deadline"
+        conn.close()
+        t.close()
+    finally:
+        net.close()
+
+
+def test_handshake_watchdog_escapes_slow_drip():
+    """A peer dripping one byte per interval resets per-op socket
+    timeouts forever; only the wall-clock watchdog bounds it."""
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.p2p.transport import Endpoint
+
+    # upstream that sends one byte every 50 ms, forever
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = threading.Event()
+
+    def dripper():
+        while not stop.is_set():
+            try:
+                srv.settimeout(0.2)
+                c, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+            def drip(c=c):
+                try:
+                    while not stop.is_set():
+                        c.sendall(b"\x00")
+                        time.sleep(0.05)
+                except OSError:
+                    pass
+
+            threading.Thread(target=drip, daemon=True).start()
+
+    threading.Thread(target=dripper, daemon=True).start()
+    try:
+        t = _mk_transport()
+        key = Ed25519PrivKey.generate(b"\x32" * 32)
+        host, port = srv.getsockname()[:2]
+        conn = t.dial(Endpoint(protocol="mconn", host=host, port=port), timeout=5)
+        t0 = time.monotonic()
+        with pytest.raises((TimeoutError, OSError, ConnectionError, ValueError)):
+            conn.handshake(_node_info(key), key, timeout=1.0)
+        assert time.monotonic() - t0 < 3.0, "slow drip held the handshake past its deadline"
+        conn.close()
+        t.close()
+    finally:
+        stop.set()
+        srv.close()
+
+
+def _handshaken_pair_through(link_net, ping_interval=0.2, pong_timeout=1.0):
+    """Dial a2 -> (faultnet link) -> t2-acceptor; both handshaken."""
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.p2p.transport import Endpoint
+
+    k1 = Ed25519PrivKey.generate(b"\x41" * 32)
+    k2 = Ed25519PrivKey.generate(b"\x42" * 32)
+    t1 = _mk_transport(ping_interval=ping_interval, pong_timeout=pong_timeout)
+    t2 = _mk_transport(ping_interval=ping_interval, pong_timeout=pong_timeout)
+    link = link_net.add_link("p->q", ("127.0.0.1", t2.endpoint().port))
+    res = {}
+
+    def accept():
+        c = t2.accept(timeout=5)
+        res["b"] = c
+        c.handshake(_node_info(k2), k2, timeout=5)
+
+    th = threading.Thread(target=accept)
+    th.start()
+    a = t1.dial(Endpoint(protocol="mconn", host=link.host, port=link.port), timeout=5)
+    a.handshake(_node_info(k1), k1, timeout=5)
+    th.join(timeout=5)
+    return t1, t2, link, a, res["b"]
+
+
+def _poll_receive(conn, stop):
+    while not stop.is_set():
+        try:
+            conn.receive_message(timeout=0.2)
+        except TimeoutError:
+            continue
+        except Exception:
+            return
+
+
+def test_pong_timeout_reaps_half_open_link():
+    """Once the link freezes (half-open: ESTABLISHED but silent), the
+    keepalive must close the connection within ~pong_timeout — before
+    faultnet exposed this, a frozen peer held its slot forever."""
+    net = FaultNet(seed=5)
+    try:
+        t1, t2, link, a, b = _handshaken_pair_through(net)
+        stop = threading.Event()
+        poller = threading.Thread(target=_poll_receive, args=(a, stop), daemon=True)
+        poller.start()
+        # healthy first: a full ping/pong cycle keeps the link open
+        time.sleep(0.6)
+        assert not a._closed.is_set(), "healthy link died"
+        link.set_policy("both", half_open=True)
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline and not a._closed.is_set():
+            time.sleep(0.05)
+        assert a._closed.is_set(), "half-open link never reaped"
+        assert "pong timeout" in str(a._send_error)
+        stop.set()
+        for c in (a, b):
+            c.close()
+        t1.close()
+        t2.close()
+    finally:
+        net.close()
+
+
+def test_slow_drip_link_reaped_by_pong_timeout():
+    """slow_drip on one direction stretches every sealed frame to
+    minutes; the victim's pongs never make it back in time, so the
+    OTHER side's keepalive reaps the link within ~pong_timeout instead
+    of waiting on a frame that will never complete."""
+    net = FaultNet(seed=6)
+    try:
+        t1, t2, link, a, b = _handshaken_pair_through(net)
+        stops = []
+        for conn in (a, b):
+            stop = threading.Event()
+            threading.Thread(target=_poll_receive, args=(conn, stop), daemon=True).start()
+            stops.append(stop)
+        # a's frames (pings, pongs) toward b now drip at 4 B/s — b stops
+        # hearing from a even though b's own frames flow clean
+        link.set_policy("fwd", slow_drip=4)
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline and not b._closed.is_set():
+            time.sleep(0.05)
+        assert b._closed.is_set(), "slow-dripped link never reaped"
+        assert "pong timeout" in str(b._send_error)
+        for stop in stops:
+            stop.set()
+        for c in (a, b):
+            c.close()
+        t1.close()
+        t2.close()
+    finally:
+        net.close()
+
+
+def test_mid_packet_stall_branch_is_fatal(monkeypatch):
+    """Unit pin of the receive path's in-body bound: a packet whose
+    header arrived but whose body stalls past PACKET_FINISH_TIMEOUT
+    closes the connection (fatal), rather than resuming a byte-drip
+    forever. Driven with a stub sealed-stream so the stall lands
+    exactly between header and body."""
+    from tendermint_tpu.p2p import transport_tcp as ttcp
+    from tendermint_tpu.p2p.transport import ConnectionClosed
+
+    monkeypatch.setattr(ttcp, "PACKET_FINISH_TIMEOUT", 0.2)
+    s1, s2 = socket.socketpair()
+
+    class _StalledSecret:
+        """Yields the uvarint header for a 10-byte packet, then stalls."""
+
+        def __init__(self):
+            self.fed = [bytes([12])]  # uvarint(12): channel+eof+10 chunk bytes
+
+        def read_exact(self, n):
+            if self.fed:
+                return self.fed.pop(0)
+            time.sleep(0.25)  # longer than the (patched) finish bound
+            raise socket.timeout("stalled mid-body")
+
+    conn = ttcp.TcpConnection(s1, {}, ping_interval=0)
+    conn._secret = _StalledSecret()
+    with pytest.raises(ConnectionClosed, match="stalled mid-flight"):
+        conn.receive_message(timeout=5.0)
+    assert conn._closed.is_set(), "stalled connection left open"
+    conn.close()
+    s2.close()
+
+
+def test_dial_through_gateway_routes_all_dials():
+    """TcpTransport.dial_through (the faultnet seam): every dial — even
+    to addresses never registered as links — transits a lazily created
+    per-destination proxy."""
+    net = FaultNet(seed=7)
+    try:
+        from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+        from tendermint_tpu.p2p.transport import Endpoint
+
+        k1 = Ed25519PrivKey.generate(b"\x51" * 32)
+        k2 = Ed25519PrivKey.generate(b"\x52" * 32)
+        t1 = _mk_transport(dial_through=net.gateway("n1"))
+        t2 = _mk_transport()
+        res = {}
+
+        def accept():
+            c = t2.accept(timeout=5)
+            res["b"] = c
+            c.handshake(_node_info(k2), k2, timeout=5)
+
+        th = threading.Thread(target=accept)
+        th.start()
+        ep = t2.endpoint()
+        a = t1.dial(Endpoint(protocol="mconn", host=ep.host, port=ep.port), timeout=5)
+        a.handshake(_node_info(k1), k1, timeout=5)
+        th.join(timeout=5)
+        names = [l.name for l in net.links()]
+        assert names == [f"n1->{ep.host}:{ep.port}"]
+        forwarded = sum(v for _, _, v in net.metrics.forwarded_bytes.samples())
+        assert forwarded > 0, "handshake bytes did not transit the gateway link"
+        a.close()
+        res["b"].close()
+        t1.close()
+        t2.close()
+    finally:
+        net.close()

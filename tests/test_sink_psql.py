@@ -241,6 +241,124 @@ def test_statement_stream_matches_golden():
         assert got == f.read()
 
 
+# ------------------------------------------------------- live-server gate
+
+LIVE_DSN = os.environ.get("TM_PSQL_DSN", "")
+
+live_postgres = pytest.mark.skipif(
+    not LIVE_DSN,
+    reason="TM_PSQL_DSN not set — start a server (docs/psql-live.md: one "
+    "docker/podman command) and export the DSN to run the live gate",
+)
+
+
+@pytest.fixture
+def live_sink():
+    """PsqlSink against the real server from TM_PSQL_DSN, isolated in a
+    throwaway schema that is dropped afterwards."""
+    from tendermint_tpu.indexer.sink_psql import _connect_dsn
+
+    try:
+        conn = _connect_dsn(LIVE_DSN)
+    except RuntimeError as e:
+        pytest.skip(str(e))  # no driver in this environment
+    schema = f"tm_live_{os.getpid()}"
+    cur = conn.cursor()
+    cur.execute(f"DROP SCHEMA IF EXISTS {schema} CASCADE;")
+    cur.execute(f"CREATE SCHEMA {schema};")
+    cur.execute(f"SET search_path TO {schema};")
+    conn.commit()
+    cur.close()
+    sink = PsqlSink(connect=lambda: conn, chain_id="psql-live-chain")
+    yield conn, sink
+    cur = conn.cursor()
+    conn.rollback()
+    cur.execute(f"DROP SCHEMA IF EXISTS {schema} CASCADE;")
+    conn.commit()
+    cur.close()
+    conn.close()
+
+
+@live_postgres
+def test_live_postgres_schema_and_golden_stream(live_sink):
+    """VERDICT r5 next-round #6: the byte-pinned statement stream runs
+    against a REAL server — dialect, `index` as a column name,
+    ON CONFLICT … RETURNING, and transactional discipline judged by the
+    real planner instead of the DB-API fake."""
+    conn, sink = live_sink
+    sink.ensure_schema()  # idempotent second install
+    f_res = ResponseFinalizeBlock(events=[
+        Event(type="rollup", attributes=[
+            EventAttribute(key="indexed", value="yes", index=True),
+            EventAttribute(key="unindexed", value="no", index=False),
+        ]),
+    ])
+    sink.index_block_events(11, f_res)
+    sink.index_tx_events(11, [b"k1=v1", b"k2=v2"], [
+        ExecTxResult(code=0, events=[Event(type="transfer", attributes=[
+            EventAttribute(key="amount", value="12", index=True)])]),
+        ExecTxResult(code=1),
+    ])
+    # idempotent re-index: quiet no-op, no duplicate rows
+    sink.index_block_events(11, f_res)
+    sink.index_tx_events(11, [b"k1=v1"], [ExecTxResult(code=0)])
+    rows = sink.query("SELECT height, chain_id FROM blocks;")
+    assert rows == [(11, "psql-live-chain")]
+    assert sink.query("SELECT count(*) FROM tx_results;")[0][0] == 2
+    # only index-flagged attributes land
+    composite = {r[0] for r in sink.query("SELECT composite_key FROM attributes;")}
+    assert "rollup.indexed" in composite and "rollup.unindexed" not in composite
+
+
+@live_postgres
+def test_live_postgres_tx_search_roundtrip(live_sink):
+    """tx_search-style round-trip through the tx_events view: find the
+    indexed tx by app-event composite key and get back the same tx.hash
+    meta-event the sink computed (the operator-facing query surface the
+    reference documents for the psql sink)."""
+    from tendermint_tpu.eventbus.event_bus import tx_hash
+
+    conn, sink = live_sink
+    txs = [b"search=me", b"other=tx"]
+    sink.index_block_events(7, ResponseFinalizeBlock())
+    sink.index_tx_events(7, txs, [
+        ExecTxResult(code=0, events=[Event(type="transfer", attributes=[
+            EventAttribute(key="amount", value="12", index=True)])]),
+        ExecTxResult(code=0),
+    ])
+    hits = sink.query(
+        "SELECT height, index FROM tx_events"
+        " WHERE composite_key = %s AND value = %s;",
+        ("transfer.amount", "12"),
+    )
+    assert hits == [(7, 0)]
+    want_hash = tx_hash(txs[0]).hex().upper()
+    got = sink.query(
+        "SELECT value FROM tx_events"
+        " WHERE composite_key = 'tx.hash' AND height = %s AND index = %s;",
+        (7, 0),
+    )
+    assert got == [(want_hash,)]
+
+
+@live_postgres
+def test_live_postgres_rollback_on_failure(live_sink):
+    """A failing statement mid-transaction leaves no partial rows —
+    runInTransaction's discipline enforced by the real server."""
+    conn, sink = live_sink
+    sink.index_block_events(1, ResponseFinalizeBlock())
+    before = sink.query("SELECT count(*) FROM events;")[0][0]
+    with pytest.raises(Exception):
+        with sink._tx() as cur:
+            cur.execute(
+                "INSERT INTO events (block_id, tx_id, type) VALUES (%s, %s, %s)"
+                " RETURNING rowid;",
+                (1, None, "doomed"),
+            )
+            cur.execute("SELECT * FROM no_such_table;")
+    assert sink.query("SELECT count(*) FROM events;")[0][0] == before
+
+
 def test_reindex_event_populates_psql_sink(tmp_path, monkeypatch):
     """`reindex-event` with indexer = "kv,psql" rebuilds the psql sink
     from stored blocks (ref: commands/reindex_event.go over the
