@@ -60,6 +60,11 @@ RESULT_TAGS = ("SLICE", "DOT", "MSM", "MSM-CACHE", "PIPE", "PIPEWARM",
                "CACHE", "FASTSYNC", "MEGA", "SR25519", "CUTOVER")
 BUDGET = float(os.environ.get("BENCH_BUDGET", "840"))
 PIPELINE_ITERS = int(os.environ.get("BENCH_ITERS", "8"))
+# Per-stage Chrome-trace artifacts (tendermint_tpu.trace): each stage's
+# engine/dispatch spans land next to the numbers so BENCH rounds carry
+# a timeline, not just totals. BENCH_TRACE=off disables (e.g. when
+# hunting for the tracer's own overhead).
+TRACE_DIR = os.environ.get("BENCH_TRACE_DIR", os.path.join(_ROOT, ".bench_traces"))
 _T0 = time.monotonic()
 
 
@@ -69,6 +74,24 @@ def _remaining():
 
 def _log(msg):
     print(f"# [{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _save_stage_trace(stage: str) -> None:
+    """Flush the span ring into TRACE_DIR/<stage>.trace.json (Perfetto/
+    chrome://tracing format) and clear it so the next stage's artifact
+    holds only its own spans. No-op when tracing is disabled."""
+    from tendermint_tpu import trace as T
+
+    if not T.enabled():
+        return
+    try:
+        os.makedirs(TRACE_DIR, exist_ok=True)
+        path = os.path.join(TRACE_DIR, f"{stage}.trace.json")
+        n = T.save(path)
+        T.clear()
+        _log(f"stage trace: {path} ({n} events)")
+    except OSError as e:
+        _log(f"stage trace save failed ({stage}): {e}")
 
 
 def make_jobs(jobs, n):
@@ -241,6 +264,10 @@ def bench_fastsync(chain):
 
 def main():
     global BATCHES, PIPELINE_ITERS
+    if os.environ.get("BENCH_TRACE", "on").strip().lower() not in ("off", "0", "false", "no"):
+        from tendermint_tpu import trace as _tmtrace
+
+        _tmtrace.set_enabled(True)
     jobs = ([], [], [])
 
     # Stage 1 (no device): ALL job generation (pure-Python signing,
@@ -368,6 +395,7 @@ def main():
             _log(f"batch {batch} failed: {type(e).__name__}: {e}")
             break
         _log(f"batch {batch}: {rate:,.0f} sigs/s pipelined")
+        _save_stage_trace(f"device_b{batch}")
         best_batch = batch
         if rate > best:
             best = rate
@@ -381,6 +409,7 @@ def main():
             with stage_deadline(min(_remaining() - 15, 240)):
                 rate = bench_device(jobs, best_batch, cached=True)
             _log(f"batch {best_batch} cached: {rate:,.0f} sigs/s pipelined")
+            _save_stage_trace("cached")
             if rate > best:
                 best = rate
                 emit(best, cpu_rate)
@@ -419,6 +448,7 @@ def main():
             assert all(oks), "MSM rejected valid batch"
             rate = best_batch / dt
             _log(f"batch {best_batch} msm: {rate:,.0f} sigs/s pipelined")
+            _save_stage_trace("msm")
             if rate > best:
                 best = rate
                 emit(best, cpu_rate)
@@ -438,6 +468,7 @@ def main():
                 blocks_rate = bench_fastsync(fastsync_chain)
             cpu_blocks = cpu_rate / 667.0
             _log(f"fast-sync: {blocks_rate:,.1f} blocks/s @1000 vals")
+            _save_stage_trace("fastsync")
             print(
                 json.dumps(
                     {
@@ -466,6 +497,7 @@ def main():
             with stage_deadline(min(_remaining() - 15, 240)):
                 rate = bench_coalesced(jobs)
             _log(f"coalesced 4-caller engine throughput: {rate:,.0f} sigs/s")
+            _save_stage_trace("coalesced")
             print(
                 json.dumps(
                     {

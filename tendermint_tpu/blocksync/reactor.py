@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import trace as _trace
 from ..p2p.types import CHANNEL_BLOCKSYNC, ChannelDescriptor, PEER_STATUS_UP, PeerError
 from ..proto import messages as pb
 from ..types.block import Block, BlockID
@@ -325,13 +326,15 @@ class BlockSyncReactor:
             else:
                 first_parts = first.make_part_set()
                 first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
-                verify_commit_light(
-                    self.state.chain_id,
-                    self.state.validators,
-                    first_id,
-                    first.header.height,
-                    second.last_commit,
-                )
+                with _trace.span("blocksync.verify_commit", "blocksync",
+                                 height=first.header.height):
+                    verify_commit_light(
+                        self.state.chain_id,
+                        self.state.validators,
+                        first_id,
+                        first.header.height,
+                        second.last_commit,
+                    )
             self._dispatch_verify_ahead(second)
         except Exception as e:
             # Either sender could be lying (a forged second.LastCommit
@@ -374,7 +377,8 @@ class BlockSyncReactor:
         self.block_store.save_block(
             first, first_parts, second.last_commit, extended_commit=ec
         )
-        self.state = self.block_exec.apply_block(self.state, first_id, first)
+        with _trace.span("blocksync.apply", "blocksync", height=height):
+            self.state = self.block_exec.apply_block(self.state, first_id, first)
         self.blocks_synced += 1
         if self.metrics is not None:
             self.metrics.num_blocks.add(1)

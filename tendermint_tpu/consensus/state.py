@@ -20,6 +20,7 @@ import threading
 import traceback
 from typing import Callable
 
+from .. import trace as _trace
 from ..state.execution import BlockExecutor
 from ..state.state import State
 from ..types.block import (
@@ -503,6 +504,14 @@ class ConsensusState:
         rs = self.rs
         self.wal.write(EventRoundStep(rs.height, rs.round, rs.step))
         self._n_steps += 1
+        if _trace.enabled():
+            from .round_state import STEP_NAMES
+
+            _trace.instant(
+                "consensus.step", "consensus",
+                step=STEP_NAMES.get(rs.step, str(rs.step)),
+                height=rs.height, round=rs.round,
+            )
         if self.metrics is not None:
             from .round_state import STEP_NAMES
 
@@ -829,6 +838,12 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or rs.step != STEP_COMMIT:
             return
+        with _trace.span("consensus.finalize_commit", "consensus",
+                         height=height, round=rs.commit_round):
+            self._do_finalize_commit(height)
+
+    def _do_finalize_commit(self, height: int) -> None:
+        rs = self.rs
         block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
         block, block_parts = rs.proposal_block, rs.proposal_block_parts
         if not ok:

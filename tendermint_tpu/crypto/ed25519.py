@@ -297,9 +297,11 @@ class Ed25519BatchVerifier(BatchVerifier):
 
                 def complete_msm():
                     if handle is not None and dev_msm.collect_rlc(handle):
+                        _observe_direct(KEY_TYPE, "two_phase_msm", n, n)
                         return True, [True] * n
                     pending = dispatched if dispatched is not None else bitmap_async()
                     bools = [bool(b) for b in dev.collect(pending)]
+                    _observe_direct(KEY_TYPE, "two_phase_msm", n, sum(bools))
                     return all(bools), bools
 
                 return complete_msm
@@ -308,9 +310,23 @@ class Ed25519BatchVerifier(BatchVerifier):
 
             def complete():
                 bools = [bool(b) for b in dev.collect(dispatched)]
+                _observe_direct(KEY_TYPE, "bitmap", n, sum(bools))
                 return all(bools), bools
 
             return complete
-        bools = [_single_verify(p, m, s) for p, m, s in zip(self._pks, self._msgs, self._sigs)]
+        from .. import trace as _trace
+
+        with _trace.span("verify.direct_host", "crypto", plane=KEY_TYPE, rows=n):
+            bools = [_single_verify(p, m, s) for p, m, s in zip(self._pks, self._msgs, self._sigs)]
+        _observe_direct(KEY_TYPE, "host", n, sum(bools))
         result = (all(bools), bools)
         return lambda: result
+
+
+def _observe_direct(plane: str, path: str, n: int, accepted: int) -> None:
+    """Fold a direct-dispatch (TM_TPU_ENGINE=off) launch into the
+    engine path counters; the direct_* labeling rule lives in
+    EngineMetrics.observe_direct."""
+    from ..metrics import engine_metrics
+
+    engine_metrics().observe_direct(plane, path, n, accepted)

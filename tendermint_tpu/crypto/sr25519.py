@@ -409,10 +409,14 @@ class Sr25519BatchVerifier(BatchVerifier):
                 dispatched = bitmap_async() if handle is None else None
 
                 def complete_msm():
+                    from ..metrics import engine_metrics
+
                     if handle is not None and dev_msm.collect_rlc(handle):
+                        engine_metrics().observe_direct(KEY_TYPE, "two_phase_msm", n, n)
                         return True, [True] * n
                     pending = dispatched if dispatched is not None else bitmap_async()
                     bools = [bool(b) for b in dev.collect(pending)]
+                    engine_metrics().observe_direct(KEY_TYPE, "two_phase_msm", n, sum(bools))
                     return all(bools), bools
 
                 return complete_msm
@@ -420,10 +424,18 @@ class Sr25519BatchVerifier(BatchVerifier):
             dispatched = bitmap_async()
 
             def complete():
+                from ..metrics import engine_metrics
+
                 bools = [bool(b) for b in dev.collect(dispatched)]
+                engine_metrics().observe_direct(KEY_TYPE, "bitmap", n, sum(bools))
                 return all(bools), bools
 
             return complete
-        oks = [verify(pk, msg, sig) for pk, msg, sig in self._jobs]
+        from .. import trace as _trace
+        from ..metrics import engine_metrics
+
+        with _trace.span("verify.direct_host", "crypto", plane=KEY_TYPE, rows=n):
+            oks = [verify(pk, msg, sig) for pk, msg, sig in self._jobs]
+        engine_metrics().observe_direct(KEY_TYPE, "host", n, sum(oks))
         result = (all(oks), oks)
         return lambda: result

@@ -40,12 +40,13 @@ def _free_ports(n: int) -> list[int]:
 
 
 class E2ENode:
-    def __init__(self, manifest: NodeManifest, home: str, p2p_port: int, rpc_port: int, abci_port: int):
+    def __init__(self, manifest: NodeManifest, home: str, p2p_port: int, rpc_port: int, abci_port: int, prom_port: int = 0):
         self.m = manifest
         self.home = home
         self.p2p_port = p2p_port
         self.rpc_port = rpc_port
         self.abci_port = abci_port
+        self.prom_port = prom_port
         self.node_id = ""
         self.proc: subprocess.Popen | None = None
         self.app_proc: subprocess.Popen | None = None
@@ -129,11 +130,14 @@ class Runner:
             if ambient:
                 self.faultnet.set_default_policy(**ambient)
             self.log(f"faultnet enabled (ambient policy: {ambient or 'pass-through'})")
-        ports = _free_ports(3 * len(ms))
+        ports = _free_ports(4 * len(ms))
         pvs = {}
         for i, nm in enumerate(ms):
             home = os.path.join(self.base_dir, nm.name)
-            node = E2ENode(nm, home, ports[3 * i], ports[3 * i + 1], ports[3 * i + 2])
+            node = E2ENode(
+                nm, home,
+                ports[4 * i], ports[4 * i + 1], ports[4 * i + 2], ports[4 * i + 3],
+            )
             os.makedirs(os.path.join(home, "config"), exist_ok=True)
             os.makedirs(os.path.join(home, "data"), exist_ok=True)
             cfg = default_config(home)
@@ -194,6 +198,11 @@ class Runner:
             cfg.rpc.laddr = f"tcp://127.0.0.1:{node.rpc_port}"
             # the runner drives partition fault injection over RPC
             cfg.rpc.unsafe = True
+            # every node exports /metrics; the runner scrapes the final
+            # exposition into the run dir at shutdown (observability
+            # artifact — ref: the reference e2e's prometheus flag)
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = f"127.0.0.1:{node.prom_port}"
             cfg.p2p.send_rate = node.m.send_rate
             seeds = [o for o in self.nodes if o.m.mode == "seed"]
             if node.m.mode == "seed":
@@ -737,7 +746,41 @@ class Runner:
 
     # ----------------------------------------------------------------- stop
 
+    def collect_artifacts(self) -> None:
+        """Persist each live node's final observability state into its
+        home dir before teardown: the /metrics exposition text
+        (metrics.txt) and, when span tracing is active in the nodes
+        (TM_TPU_TRACE in the runner env propagates), the Chrome-trace
+        snapshot from the dump_traces RPC (trace.json). Best-effort —
+        perturbed/killed nodes simply contribute no artifact."""
+        import urllib.request
+
+        for node in self.nodes:
+            if node.proc is None or node.proc.poll() is not None:
+                continue
+            if node.prom_port and node.m.mode != "seed":
+                try:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{node.prom_port}/metrics", timeout=5
+                    ).read()
+                    with open(os.path.join(node.home, "metrics.txt"), "wb") as f:
+                        f.write(body)
+                except Exception as e:  # noqa: BLE001 - artifact only
+                    self.log(f"metrics scrape failed for {node.m.name}: {e}")
+            if node.m.mode != "seed":
+                try:
+                    res = node.client().call("dump_traces")
+                    if res.get("events"):
+                        with open(os.path.join(node.home, "trace.json"), "w") as f:
+                            json.dump(res["trace"], f)
+                except Exception as e:  # noqa: BLE001 - artifact only
+                    self.log(f"trace dump failed for {node.m.name}: {e}")
+
     def cleanup(self) -> None:
+        try:
+            self.collect_artifacts()
+        except Exception as e:  # noqa: BLE001 - teardown must proceed
+            self.log(f"artifact collection failed: {e}")
         if self.faultnet is not None:
             self.faultnet.close()
         for node in self.nodes:

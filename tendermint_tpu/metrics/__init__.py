@@ -131,19 +131,33 @@ class Registry:
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for name, labels, value in m.samples():
                 if labels:
-                    lbl = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    lbl = ",".join(
+                        f'{k}="{_escape_label(v)}"' for k, v in labels.items()
+                    )
                     lines.append(f"{name}{{{lbl}}} {_num(value)}")
                 else:
                     lines.append(f"{name} {_num(value)}")
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" if lines else ""
 
 
 def _num(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _escape_label(v) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double-quote, and line feed. Faultnet link names ("a->b") and any
+    future free-form label would otherwise corrupt the exposition."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping: backslash and line feed (quotes are legal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 # ---------------------------------------------------------- subsystems
@@ -378,8 +392,150 @@ class FaultNetMetrics:
         )
 
 
+class EngineMetrics:
+    """Telemetry for the unified async verification engine
+    (ops/engine.py) and the TPU dispatch planes it fronts (ops/verify,
+    ops/msm, parallel/sharded_verify, the crypto batch verifiers).
+
+    No reference analog — the reference has no device dispatch plane.
+    Occupancy/latency visibility is what hardware verification engines
+    live by (FPGA ECDSA engine, arxiv 2112.02229), and signature
+    verification dominates committee-based consensus cost (arxiv
+    2302.00418); these series are the ground truth every perf PR
+    argues from. Registered on the process-global registry
+    (global_registry()) because the engine is process-wide, not
+    per-node."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_engine"
+        self.queue_depth = reg.gauge(
+            f"{ns}_queue_depth", "Jobs pending in the engine submission queue"
+        )
+        self.inflight_batches = reg.gauge(
+            f"{ns}_inflight_batches", "Dispatched batches awaiting collect"
+        )
+        self.submitted_jobs = reg.counter(
+            f"{ns}_submitted_jobs_total", "Jobs submitted to the engine", labels=("plane",)
+        )
+        self.submitted_sigs = reg.counter(
+            f"{ns}_submitted_sigs_total", "Signatures submitted to the engine", labels=("plane",)
+        )
+        self.coalesced_group_size = reg.histogram(
+            f"{ns}_coalesced_group_size",
+            "Caller jobs merged per coalesced launch",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+        )
+        self.coalesce_factor = reg.histogram(
+            f"{ns}_coalesce_factor_rows",
+            "Signature rows per coalesced launch",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 8192),
+        )
+        self.queue_wait = reg.histogram(
+            f"{ns}_queue_wait_seconds",
+            "submit-to-dispatch wait of the oldest job in each group",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+        )
+        self.launch_latency = reg.histogram(
+            f"{ns}_launch_latency_seconds",
+            "Dispatch-stage wall time per batch (host prep + async launch)",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        self.collect_latency = reg.histogram(
+            f"{ns}_collect_latency_seconds",
+            "Collect-stage wall time per batch (device block + demux)",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        self.overlap_seconds = reg.counter(
+            f"{ns}_overlap_seconds_total",
+            "Seconds the dispatch stage ran concurrently with a collect",
+        )
+        self.overlap_ratio = reg.gauge(
+            f"{ns}_overlap_ratio",
+            "Cumulative dispatch/collect overlap over cumulative collect time",
+        )
+        self.path_rows = reg.counter(
+            f"{ns}_path_rows_total",
+            "Signature rows by verification path and outcome",
+            labels=("plane", "path", "status"),
+        )
+        self.launches = reg.counter(
+            f"{ns}_launches_total",
+            "Verification launches by path",
+            labels=("plane", "path"),
+        )
+        self.device_batch_cutover = reg.gauge(
+            f"{ns}_device_batch_cutover",
+            "Live device-launch cutover (env pin or autotune result)",
+        )
+        self.msm_batch_cutover = reg.gauge(
+            f"{ns}_msm_batch_cutover",
+            "Live two-phase-MSM cutover (env pin or autotune result)",
+        )
+        self.autotuned = reg.gauge(
+            f"{ns}_autotuned", "1 after the autotune microprobe updated a cutover"
+        )
+        self.host_pool_active = reg.gauge(
+            f"{ns}_host_pool_active", "Host-plane verifies currently executing"
+        )
+        self.host_pool_busy_seconds = reg.counter(
+            f"{ns}_host_pool_busy_seconds_total", "Cumulative host-plane verify time"
+        )
+        self.sharded_launches = reg.counter(
+            f"{ns}_sharded_launches_total",
+            "Mesh-sharded launches by path",
+            labels=("path",),
+        )
+        self.kernel_launches = reg.counter(
+            f"{ns}_kernel_launches_total",
+            "Device kernel dispatches by kernel (cache fills included)",
+            labels=("kernel",),
+        )
+
+    def observe_path(self, plane: str, path: str, bools) -> None:
+        """Fold one launch's per-row outcomes into the path counters."""
+        self.observe_path_counts(plane, path, len(bools), sum(1 for b in bools if b))
+
+    def observe_path_counts(self, plane: str, path: str, n: int, accepted: int) -> None:
+        self.launches.add(1, plane, path)
+        if accepted:
+            self.path_rows.add(accepted, plane, path, "accept")
+        if n - accepted:
+            self.path_rows.add(n - accepted, plane, path, "reject")
+
+    def observe_direct(self, plane: str, path: str, n: int, accepted: int) -> None:
+        """A direct-dispatch (TM_TPU_ENGINE=off) launch, labeled
+        direct_* so the scheduler's coalesced launches stay
+        distinguishable from per-caller ones."""
+        self.observe_path_counts(plane, f"direct_{path}", n, accepted)
+
+
+# Process-global registry: subsystems that are process-wide rather than
+# per-node (the verification engine, the dispatch planes) register
+# here; PrometheusServer exports it alongside each node's registry.
+_GLOBAL_REGISTRY = Registry()
+_ENGINE_METRICS: EngineMetrics | None = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def global_registry() -> Registry:
+    return _GLOBAL_REGISTRY
+
+
+def engine_metrics() -> EngineMetrics:
+    """Lazy process-wide EngineMetrics singleton (mirrors the engine's
+    own lifetime: the families first appear on the scrape once any
+    verification plane is touched)."""
+    global _ENGINE_METRICS
+    if _ENGINE_METRICS is None:
+        with _ENGINE_LOCK:
+            if _ENGINE_METRICS is None:
+                _ENGINE_METRICS = EngineMetrics(_GLOBAL_REGISTRY)
+    return _ENGINE_METRICS
+
+
 class PrometheusServer:
-    """Minimal /metrics HTTP endpoint (ref: node/node.go:575)."""
+    """Minimal /metrics HTTP endpoint (ref: node/node.go:575). Serves
+    the node's registry plus the process-global one (engine plane)."""
 
     def __init__(self, registry: Registry, addr: str = "127.0.0.1:26660"):
         self.registry = registry
@@ -398,7 +554,10 @@ class PrometheusServer:
                 if self.path not in ("/metrics", "/"):
                     self.send_error(404)
                     return
-                body = registry.gather().encode()
+                text = registry.gather()
+                if registry is not _GLOBAL_REGISTRY:
+                    text += _GLOBAL_REGISTRY.gather()
+                body = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))

@@ -39,8 +39,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 
 import numpy as np
+
+from .. import trace as _trace
+from ..metrics import engine_metrics as _engine_metrics
 
 # Rows per coalesced launch. Jobs beyond this form the next batch (the
 # double buffer absorbs them); bounds both padding waste and the jit
@@ -135,6 +139,10 @@ def _autotune_probe(dev_pinned: bool, msm_pinned: bool) -> None:
             # launch cost; it amortizes ~4x past the point a plain
             # launch does
             ed.MSM_BATCH_CUTOVER = max(64, min(4 * cutover, 8192))
+        m = _engine_metrics()
+        m.autotuned.set(1)
+        m.device_batch_cutover.set(ed.DEVICE_BATCH_CUTOVER)
+        m.msm_batch_cutover.set(ed.MSM_BATCH_CUTOVER)
     except Exception:  # noqa: BLE001 - a failed probe keeps the defaults
         pass
 
@@ -143,7 +151,10 @@ def _autotune_probe(dev_pinned: bool, msm_pinned: bool) -> None:
 
 
 class _Job:
-    __slots__ = ("plane", "pks", "msgs", "sigs", "n", "event", "result", "error")
+    __slots__ = (
+        "plane", "pks", "msgs", "sigs", "n", "event", "result", "error",
+        "flow", "t_submit",
+    )
 
     def __init__(self, plane, pks, msgs, sigs):
         self.plane = plane
@@ -154,6 +165,11 @@ class _Job:
         self.event = threading.Event()
         self.result: list[bool] | None = None
         self.error: BaseException | None = None
+        # trace correlation id linking this job's submit span to the
+        # dispatch/collect spans of whichever coalesced launch carries
+        # it (0 when tracing is off — new_flow() skipped)
+        self.flow = 0
+        self.t_submit = 0.0
 
 
 class JobHandle:
@@ -266,9 +282,19 @@ class VerifyEngine:
         self._lock = threading.Lock()
         self._have_jobs = threading.Condition(self._lock)
         self._pending: list[_Job] = []
-        self._inflight: list = []  # (jobs, collect_thunk)
+        self._inflight: list = []  # (jobs, collect_thunk, path, t_dispatch)
         self._have_inflight = threading.Condition()
         self._started = False
+        # Pipeline-overlap accounting: dispatch-stage and host-verify
+        # wall intervals land here (bounded); each finished collect sums
+        # its own interval's intersection with them — the cumulative
+        # dispatch/collect overlap the double buffer exists to create.
+        from collections import deque
+
+        self._stage_ivs: deque = deque(maxlen=64)  # (batch_seq, t0, t1)
+        self._overlap_total = 0.0
+        self._collect_total = 0.0
+        self._seq = 0  # dispatch-thread-only batch counter
 
     # ------------------------------------------------------------ lifecycle
 
@@ -307,8 +333,21 @@ class VerifyEngine:
             return JobHandle(job)
         maybe_autotune()
         self._ensure_started()
+        job.t_submit = _time.monotonic()
+        if _trace.enabled():
+            job.flow = _trace.new_flow()
+            with _trace.span("engine.submit", "engine",
+                             plane=plane, rows=job.n, flow=job.flow):
+                pass
+        m = _engine_metrics()
+        m.submitted_jobs.add(1, plane)
+        m.submitted_sigs.add(job.n, plane)
         with self._lock:
             self._pending.append(job)
+            # gauge set under the lock: an unlocked set here can lose
+            # the race against the dispatch worker's set and leave a
+            # phantom backlog on the scrape
+            m.queue_depth.set(len(self._pending))
             self._have_jobs.notify()
         return JobHandle(job)
 
@@ -332,26 +371,50 @@ class VerifyEngine:
 
     def _dispatch_loop(self) -> None:
         while True:
+            m = _engine_metrics()
             with self._lock:
                 while not self._pending:
                     self._have_jobs.wait()
-                group = self._take_group()
+                with _trace.span("engine.coalesce", "engine"):
+                    group = self._take_group()
+                m.queue_depth.set(len(self._pending))
+            rows = sum(j.n for j in group)
+            m.coalesced_group_size.observe(len(group))
+            m.coalesce_factor.observe(rows)
+            t0 = _time.monotonic()
+            m.queue_wait.observe(t0 - group[0].t_submit)
+            self._seq += 1
+            seq = self._seq
+            sp = _trace.span(
+                "engine.dispatch", "engine",
+                plane=group[0].plane, jobs=len(group), rows=rows,
+                flow=group[0].flow,
+            )
             try:
-                thunk = self._dispatch_group(group)
+                with sp:
+                    thunk, path = self._dispatch_group(group, seq)
+                    sp.annotate(path=path)
             except BaseException as e:  # noqa: BLE001 - deliver, don't die
                 _fail_jobs(group, e)
                 continue
+            t1 = _time.monotonic()
+            m.launch_latency.observe(t1 - t0)
+            self._stage_ivs.append((seq, t0, t1))
             with self._have_inflight:
-                self._inflight.append((group, thunk))
+                self._inflight.append((group, thunk, path, seq))
+                m.inflight_batches.set(len(self._inflight))
                 self._have_inflight.notify()
 
-    def _dispatch_group(self, group):
+    def _dispatch_group(self, group, seq: int = 0):
         """Coalesce one group's rows, decide the plane (device bitmap /
         two-phase MSM / host C), run prep + the async launch NOW, and
-        return a collect thunk producing the combined (rows,) bools."""
+        return (collect thunk producing the combined (rows,) bools,
+        path name for telemetry). seq tags this batch's recorded stage
+        intervals so its own collect never counts them as overlap."""
         from ..crypto import ed25519 as ed
 
         plane = group[0].plane
+        flow = group[0].flow
         pks, msgs, sigs = [], [], []
         for j in group:
             pks += j.pks
@@ -360,8 +423,24 @@ class VerifyEngine:
         total = len(sigs)
 
         if not (ed._use_device() and total >= ed.DEVICE_BATCH_CUTOVER):
-            future = _host_pool().submit(_HOST_VERIFY[plane], pks, msgs, sigs)
-            return future.result  # raises the worker's exception, if any
+            host_fn = _HOST_VERIFY[plane]
+
+            def host_verify():
+                m = _engine_metrics()
+                m.host_pool_active.add(1)
+                t0 = _time.monotonic()
+                try:
+                    with _trace.span("engine.host_verify", "engine",
+                                     plane=plane, rows=total, flow=flow):
+                        return host_fn(pks, msgs, sigs)
+                finally:
+                    t1 = _time.monotonic()
+                    m.host_pool_active.add(-1)
+                    m.host_pool_busy_seconds.add(t1 - t0)
+                    self._stage_ivs.append((seq, t0, t1))
+
+            future = _host_pool().submit(host_verify)
+            return future.result, "host"  # .result raises the worker's exception
 
         if plane == "ed25519":
             from . import verify as dev
@@ -395,29 +474,78 @@ class VerifyEngine:
                 handle = dispatched if dispatched is not None else bitmap_async()
                 return [bool(b) for b in dev.collect(handle)]
 
-            return collect_two_phase
+            return collect_two_phase, "two_phase_msm"
 
         dispatched = bitmap_async()
-        return lambda: [bool(b) for b in dev.collect(dispatched)]
+        return (lambda: [bool(b) for b in dev.collect(dispatched)]), "bitmap"
 
     # ------------------------------------------------------------- collect
 
     def _collect_loop(self) -> None:
         while True:
+            m = _engine_metrics()
             with self._have_inflight:
                 while not self._inflight:
                     self._have_inflight.wait()
-                group, thunk = self._inflight.pop(0)
+                group, thunk, path, seq = self._inflight.pop(0)
+                # same lock discipline as queue_depth: serialize the
+                # gauge write with the list state it describes
+                m.inflight_batches.set(len(self._inflight))
+            t0 = _time.monotonic()
             try:
-                bools = thunk()
+                with _trace.span("engine.collect", "engine",
+                                 plane=group[0].plane, jobs=len(group),
+                                 rows=sum(j.n for j in group), path=path,
+                                 flow=group[0].flow):
+                    bools = thunk()
             except BaseException as e:  # noqa: BLE001
                 _fail_jobs(group, e)
                 continue
+            t1 = _time.monotonic()
+            m.collect_latency.observe(t1 - t0)
+            self._account_overlap(m, seq, t0, t1)
+            m.observe_path(group[0].plane, path, bools)
             lo = 0
             for j in group:
                 j.result = bools[lo : lo + j.n]
                 lo += j.n
                 j.event.set()
+
+    def _account_overlap(self, m, seq: int, c0: float, c1: float) -> None:
+        """Fold one collect interval's intersection with OTHER batches'
+        recorded dispatch/host-verify intervals into the overlap
+        telemetry (own-batch intervals excluded: blocking on your own
+        launch is latency, not pipeline overlap). The other-batch
+        intervals are unioned before measuring, so two host verifies
+        running inside the same collect window count once and the
+        ratio stays <= 1 ("fraction of collect time the pipeline was
+        also doing other work"). Stages still running when the collect
+        ends are not yet in _stage_ivs and go uncounted — overlap is a
+        floor, not a ceiling. Runs only on the collect worker, so the
+        accumulators need no lock; _stage_ivs appends from other
+        threads are safe (deque)."""
+        clipped = sorted(
+            (max(c0, s), min(c1, e))
+            for iv_seq, s, e in list(self._stage_ivs)
+            if iv_seq != seq and s < c1 and e > c0
+        )
+        overlap = 0.0
+        cur_s = cur_e = None
+        for s, e in clipped:
+            if cur_e is None or s > cur_e:
+                if cur_e is not None:
+                    overlap += cur_e - cur_s
+                cur_s, cur_e = s, e
+            elif e > cur_e:
+                cur_e = e
+        if cur_e is not None:
+            overlap += cur_e - cur_s
+        self._overlap_total += overlap
+        self._collect_total += c1 - c0
+        if overlap:
+            m.overlap_seconds.add(overlap)
+        if self._collect_total > 0:
+            m.overlap_ratio.set(self._overlap_total / self._collect_total)
 
 
 _ENGINE: VerifyEngine | None = None

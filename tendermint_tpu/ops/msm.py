@@ -52,6 +52,8 @@ from jax import lax
 
 from . import curve as C
 from . import field as F
+from .. import trace as _trace
+from ..metrics import engine_metrics as _engine_metrics
 from .verify import L, pad_pow2_rows, prepare_batch
 
 # Parallel point-streams. 128 fills the VPU lane axis for the table
@@ -351,16 +353,20 @@ def _dispatch_rlc(prepare, kernel, pubkeys, msgs, sigs, z_raw):
     n = len(sigs)
     if n == 0:
         return None
-    a_enc, r_enc, s_rows, k_rows, precheck = prepare(pubkeys, msgs, sigs)
-    if not precheck.all():
-        return None
-    z_raw = _ensure_z_raw(n, z_raw)
-    zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
-    a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
-    return kernel(
-        jnp.asarray(a_enc), jnp.asarray(r_enc),
-        jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
-    )
+    with _trace.span("ops.msm_dispatch", "ops", kernel="rlc", rows=n) as sp:
+        a_enc, r_enc, s_rows, k_rows, precheck = prepare(pubkeys, msgs, sigs)
+        if not precheck.all():
+            sp.annotate(refused="precheck")
+            return None
+        z_raw = _ensure_z_raw(n, z_raw)
+        zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
+        a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
+        handle = kernel(
+            jnp.asarray(a_enc), jnp.asarray(r_enc),
+            jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+        )
+    _engine_metrics().kernel_launches.add(1, "rlc")
+    return handle
 
 
 def verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw: bytes | None = None):
@@ -383,37 +389,44 @@ def verify_batch_rlc_cached_async(pubkeys, msgs, sigs, z_raw: bytes | None = Non
     cache = pubkey_cache()
     if cache.tables.ndim != 5:
         return verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw)
-    # prep/precheck BEFORE touching the cache: this path REFUSES any
-    # batch with a malformed row, so inserting its keys first would
-    # build zero-byte entries into the HBM cache (possibly evicting
-    # live validator keys) for a batch that never verifies. The bitmap
-    # cached path legitimately inserts first — it verifies malformed
-    # rows masked, not refused.
-    a_enc, r_enc, s_rows, k_rows, precheck = prepare_batch(pubkeys, msgs, sigs)
-    if not precheck.all():
-        return None
-    keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
-    slots, tables, oks = cache.ensure_snapshot(keys)
-    z_raw = _ensure_z_raw(n, z_raw)
-    zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
-    if slots is None:
-        # more distinct keys than the cache holds: take the uncached
-        # kernel, reusing the prep + scalar math already done instead
-        # of re-dispatching through verify_batch_rlc_async
-        a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
-        return msm_verify_kernel(
-            jnp.asarray(a_enc), jnp.asarray(r_enc),
-            jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+    with _trace.span("ops.msm_dispatch", "ops", kernel="rlc_cached", rows=n) as sp:
+        # prep/precheck BEFORE touching the cache: this path REFUSES any
+        # batch with a malformed row, so inserting its keys first would
+        # build zero-byte entries into the HBM cache (possibly evicting
+        # live validator keys) for a batch that never verifies. The bitmap
+        # cached path legitimately inserts first — it verifies malformed
+        # rows masked, not refused.
+        a_enc, r_enc, s_rows, k_rows, precheck = prepare_batch(pubkeys, msgs, sigs)
+        if not precheck.all():
+            sp.annotate(refused="precheck")
+            return None
+        keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
+        slots, tables, oks = cache.ensure_snapshot(keys)
+        z_raw = _ensure_z_raw(n, z_raw)
+        zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
+        if slots is None:
+            # more distinct keys than the cache holds: take the uncached
+            # kernel, reusing the prep + scalar math already done instead
+            # of re-dispatching through verify_batch_rlc_async
+            sp.annotate(cache="overflow")
+            a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
+            handle = msm_verify_kernel(
+                jnp.asarray(a_enc), jnp.asarray(r_enc),
+                jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+            )
+            _engine_metrics().kernel_launches.add(1, "rlc")
+            return handle
+        r_enc, zk, z_out = pad_pow2_rows([r_enc, zk, z_out], n)
+        # padded rows carry zero scalars (identity contributions), but their
+        # slot must point at a VALID cached key: slot 0 may hold a key whose
+        # encoding fails decode, which would sink all_ok for a valid batch
+        slots = np.pad(slots, (0, len(r_enc) - n), mode="edge")
+        handle = msm_verify_kernel_cached(
+            tables, oks, jnp.asarray(slots),
+            jnp.asarray(r_enc), jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
         )
-    r_enc, zk, z_out = pad_pow2_rows([r_enc, zk, z_out], n)
-    # padded rows carry zero scalars (identity contributions), but their
-    # slot must point at a VALID cached key: slot 0 may hold a key whose
-    # encoding fails decode, which would sink all_ok for a valid batch
-    slots = np.pad(slots, (0, len(r_enc) - n), mode="edge")
-    return msm_verify_kernel_cached(
-        tables, oks, jnp.asarray(slots),
-        jnp.asarray(r_enc), jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
-    )
+    _engine_metrics().kernel_launches.add(1, "rlc_cached")
+    return handle
 
 
 def collect_rlc(dispatched) -> bool:

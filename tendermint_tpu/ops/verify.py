@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 
 from . import curve as C
+from .. import trace as _trace
+from ..metrics import engine_metrics as _engine_metrics
 
 L = 2**252 + 27742317777372353535851937790883648493
 
@@ -213,7 +215,9 @@ class PubkeyCache:
             idx = np.fromiter((next(free_slots) for _ in missing), np.int32)
             enc = np.frombuffer(b"".join(missing), np.uint8).reshape(-1, 32)
             (enc_p,) = pad_pow2_rows([enc], len(missing))
-            new_tables, new_oks = self._build(jnp.asarray(enc_p))
+            with _trace.span("ops.pk_cache_fill", "ops", misses=len(missing)):
+                new_tables, new_oks = self._build(jnp.asarray(enc_p))
+            _engine_metrics().kernel_launches.add(1, "pk_table_build")
             m = len(missing)
             self.tables = self.tables.at[idx].set(new_tables[:m])
             self.oks = self.oks.at[idx].set(new_oks[:m])
@@ -342,12 +346,14 @@ def verify_batch_async(pubkeys, msgs, sigs):
     n = len(sigs)
     if n == 0:
         return None, np.zeros((0,), bool), 0
-    a_enc, r_enc, s_bytes, k_bytes, precheck = prepare_batch(pubkeys, msgs, sigs)
-    a_enc, r_enc, s_bytes, k_bytes = pad_pow2_rows([a_enc, r_enc, s_bytes, k_bytes], n)
-    ok_dev = verify_kernel(
-        jnp.asarray(a_enc), jnp.asarray(r_enc),
-        jnp.asarray(s_bytes), jnp.asarray(k_bytes),
-    )
+    with _trace.span("ops.verify_dispatch", "ops", kernel="bitmap", rows=n):
+        a_enc, r_enc, s_bytes, k_bytes, precheck = prepare_batch(pubkeys, msgs, sigs)
+        a_enc, r_enc, s_bytes, k_bytes = pad_pow2_rows([a_enc, r_enc, s_bytes, k_bytes], n)
+        ok_dev = verify_kernel(
+            jnp.asarray(a_enc), jnp.asarray(r_enc),
+            jnp.asarray(s_bytes), jnp.asarray(k_bytes),
+        )
+    _engine_metrics().kernel_launches.add(1, "bitmap")
     return ok_dev, precheck, n
 
 
@@ -378,17 +384,20 @@ def dispatch_cached(cache, prepare, cached_kernel, uncached_async, pubkeys, msgs
     n = len(sigs)
     if n == 0:
         return None, np.zeros((0,), bool), 0
-    keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
-    slots, tables, oks = cache.ensure_snapshot(keys)
-    if slots is None:
-        return uncached_async(pubkeys, msgs, sigs)
-    _, r_enc, s_bytes, k_bytes, precheck = prepare(pubkeys, msgs, sigs)
-    r_enc, s_bytes, k_bytes = pad_pow2_rows([r_enc, s_bytes, k_bytes], n)
-    slots = np.pad(slots, (0, len(r_enc) - n))
-    ok_dev = cached_kernel(
-        tables, oks, jnp.asarray(slots),
-        jnp.asarray(r_enc), jnp.asarray(s_bytes), jnp.asarray(k_bytes),
-    )
+    with _trace.span("ops.verify_dispatch", "ops", kernel="bitmap_cached", rows=n) as sp:
+        keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
+        slots, tables, oks = cache.ensure_snapshot(keys)
+        if slots is None:
+            sp.annotate(cache="overflow")
+            return uncached_async(pubkeys, msgs, sigs)
+        _, r_enc, s_bytes, k_bytes, precheck = prepare(pubkeys, msgs, sigs)
+        r_enc, s_bytes, k_bytes = pad_pow2_rows([r_enc, s_bytes, k_bytes], n)
+        slots = np.pad(slots, (0, len(r_enc) - n))
+        ok_dev = cached_kernel(
+            tables, oks, jnp.asarray(slots),
+            jnp.asarray(r_enc), jnp.asarray(s_bytes), jnp.asarray(k_bytes),
+        )
+    _engine_metrics().kernel_launches.add(1, "bitmap_cached")
     return ok_dev, precheck, n
 
 

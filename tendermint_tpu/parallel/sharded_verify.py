@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from .. import trace as _trace
+from ..metrics import engine_metrics as _engine_metrics
 from ..ops import verify as V
 from ..ops import verify_sr as VS
 
@@ -152,39 +154,42 @@ def verify_batch_sharded_cached(mesh: Mesh, pubkeys, msgs, sigs, key_type: str =
     slots, tables, oks = cache.ensure_snapshot(keys)
     if slots is None:
         return verify_batch_sharded(mesh, pubkeys, msgs, sigs, key_type)
-    _, r_enc, s_bytes, k_bytes, precheck = plane.prepare_batch(pubkeys, msgs, sigs)
-    n_dev = mesh.devices.size
-    per_dev = -(-n // n_dev)
-    if per_dev <= 256:
-        per_dev = V._pad_pow2(per_dev, floor=8)
-    else:
-        per_dev = -(-per_dev // 256) * 256
-    pad = per_dev * n_dev - n
-    if pad:
-        r_enc = np.pad(r_enc, ((0, pad), (0, 0)))
-        s_bytes = np.pad(s_bytes, ((0, pad), (0, 0)))
-        k_bytes = np.pad(k_bytes, ((0, pad), (0, 0)))
-    # Pad slots with THIS batch's last slot, not slot 0: padded rows
-    # (s = k = 0) verify true against any VALID key's table (the ladder
-    # selects only identity entries), and if that key's encoding is
-    # invalid its own real row already fails the verdict — whereas
-    # slot 0 may hold an unrelated invalid key, failing the psum
-    # verdict for an all-valid batch.
-    slots = np.pad(slots, (0, pad), mode="edge")
-    fn = sharded_cached_verify_fn(mesh, kern)
-    shard = NamedSharding(mesh, P(AXIS))
-    repl = NamedSharding(mesh, P())
-    args = [
-        jax.device_put(tables, repl),
-        jax.device_put(oks, repl),
-        jax.device_put(jnp.asarray(slots), shard),
-        jax.device_put(jnp.asarray(r_enc), shard),
-        jax.device_put(jnp.asarray(s_bytes), shard),
-        jax.device_put(jnp.asarray(k_bytes), shard),
-    ]
-    bitmap, device_all_valid = fn(*args)
-    bitmap = np.asarray(bitmap)[:n] & precheck
-    return bitmap, bool(device_all_valid) and bool(precheck.all())
+    _engine_metrics().sharded_launches.add(1, "cached")
+    with _trace.span("sharded.verify", "parallel", path="cached",
+                     rows=n, shards=mesh.devices.size):
+        _, r_enc, s_bytes, k_bytes, precheck = plane.prepare_batch(pubkeys, msgs, sigs)
+        n_dev = mesh.devices.size
+        per_dev = -(-n // n_dev)
+        if per_dev <= 256:
+            per_dev = V._pad_pow2(per_dev, floor=8)
+        else:
+            per_dev = -(-per_dev // 256) * 256
+        pad = per_dev * n_dev - n
+        if pad:
+            r_enc = np.pad(r_enc, ((0, pad), (0, 0)))
+            s_bytes = np.pad(s_bytes, ((0, pad), (0, 0)))
+            k_bytes = np.pad(k_bytes, ((0, pad), (0, 0)))
+        # Pad slots with THIS batch's last slot, not slot 0: padded rows
+        # (s = k = 0) verify true against any VALID key's table (the ladder
+        # selects only identity entries), and if that key's encoding is
+        # invalid its own real row already fails the verdict — whereas
+        # slot 0 may hold an unrelated invalid key, failing the psum
+        # verdict for an all-valid batch.
+        slots = np.pad(slots, (0, pad), mode="edge")
+        fn = sharded_cached_verify_fn(mesh, kern)
+        shard = NamedSharding(mesh, P(AXIS))
+        repl = NamedSharding(mesh, P())
+        args = [
+            jax.device_put(tables, repl),
+            jax.device_put(oks, repl),
+            jax.device_put(jnp.asarray(slots), shard),
+            jax.device_put(jnp.asarray(r_enc), shard),
+            jax.device_put(jnp.asarray(s_bytes), shard),
+            jax.device_put(jnp.asarray(k_bytes), shard),
+        ]
+        bitmap, device_all_valid = fn(*args)
+        bitmap = np.asarray(bitmap)[:n] & precheck
+        return bitmap, bool(device_all_valid) and bool(precheck.all())
 
 
 def sharded_rlc_fn(mesh: Mesh):
@@ -231,6 +236,7 @@ def verify_batch_sharded_rlc(mesh: Mesh, pubkeys, msgs, sigs, z_raw: bytes | Non
     a_enc, r_enc, s_rows, k_rows, precheck = V.prepare_batch(pubkeys, msgs, sigs)
     if not precheck.all():
         return False
+    _engine_metrics().sharded_launches.add(1, "rlc")
     z_raw = M._ensure_z_raw(n, z_raw)
     n_dev = mesh.devices.size
     per_dev = -(-n // n_dev)
@@ -275,7 +281,9 @@ def verify_batch_sharded_rlc(mesh: Mesh, pubkeys, msgs, sigs, z_raw: bytes | Non
         jax.device_put(jnp.asarray(x), sharding)
         for x in (a_enc, r_enc, zk, z_rows, zs_shards)
     ]
-    return bool(fn(*args))
+    with _trace.span("sharded.verify", "parallel", path="rlc",
+                     rows=n, shards=n_dev):
+        return bool(fn(*args))
 
 
 def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs, key_type: str = "ed25519"):
@@ -292,6 +300,7 @@ def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs, key_type: str = "ed255
             f"unsupported key_type {key_type!r} for sharded verification "
             f"(batch-capable: {sorted(_PLANES)})"
         ) from None
+    _engine_metrics().sharded_launches.add(1, "bitmap")
     a_enc, r_enc, s_bytes, k_bytes, precheck = plane.prepare_batch(pubkeys, msgs, sigs)
     n_dev = mesh.devices.size
     # Shard-size schedule: powers of two up to 256 per device, then
@@ -313,7 +322,9 @@ def verify_batch_sharded(mesh: Mesh, pubkeys, msgs, sigs, key_type: str = "ed255
     fn = sharded_verify_fn(mesh, kernel_impl)
     sharding = NamedSharding(mesh, P(AXIS))
     args = [jax.device_put(jnp.asarray(x), sharding) for x in (a_enc, r_enc, s_bytes, k_bytes)]
-    bitmap, device_all_valid = fn(*args)
+    with _trace.span("sharded.verify", "parallel", path="bitmap",
+                     rows=n, shards=n_dev):
+        bitmap, device_all_valid = fn(*args)
     bitmap = np.asarray(bitmap)[:n] & precheck
     # The ICI-reduced verdict covers device checks (padded rows verify
     # true by construction); AND with the host prechecks for the final

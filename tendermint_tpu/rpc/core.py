@@ -423,6 +423,40 @@ def build_routes(env: RPCEnvironment) -> dict:
             )
         return {"count": len(out), "threads": out}
 
+    def dump_traces(clear=False, enable=None):
+        """Snapshot the process-wide span tracer (tendermint_tpu.trace)
+        as Chrome-trace JSON — the timeline counterpart of
+        debug_threads. `enable` flips the tracer at runtime (a node
+        started without TM_TPU_TRACE can be instrumented live); `clear`
+        drops the ring after the snapshot so the next dump starts
+        fresh. The snapshot is read-only and always available; the
+        mutating params require rpc.unsafe, like the other
+        state-mutating debug routes. Save the `trace` object to a file
+        and open it in Perfetto (ui.perfetto.dev) or chrome://tracing."""
+        from .. import trace as _trace
+
+        # same token set the repo's env gates accept for "off" — the
+        # URI interface hands both params over as raw strings, so
+        # clear="no" must parse false, not truthy
+        def _truthy(v):
+            return str(v).lower() not in ("false", "0", "", "off", "no", "none")
+
+        clear = clear is not None and clear is not False and _truthy(clear)
+        if (clear or enable is not None) and not env.unsafe:
+            raise RPCError(
+                -32603, "dump_traces clear/enable require rpc.unsafe"
+            )
+        doc = _trace.export()
+        if clear:
+            _trace.clear()
+        if enable is not None:
+            _trace.set_enabled(_truthy(enable))
+        return {
+            "enabled": _trace.enabled(),
+            "events": len(doc["traceEvents"]),
+            "trace": doc,
+        }
+
     def block_results(height=None):
         """FinalizeBlock results (tx results, events, updates) at a height."""
         h = _height_or_latest(height)
@@ -768,6 +802,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         "header_by_hash": header_by_hash,
         "events": events,
         "debug_threads": debug_threads,
+        "dump_traces": dump_traces,
         "block_results": block_results,
         "commit": commit,
         "validators": validators,

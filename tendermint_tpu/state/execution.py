@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import time as _time
 
+from .. import trace as _trace
 from ..abci import types as abci
 from ..abci.client import Client
 from ..crypto.merkle import hash_from_byte_slices
@@ -208,21 +209,30 @@ class BlockExecutor:
     def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
         """ref: ApplyBlock (execution.go:199) — validate, FinalizeBlock,
         state.Update, Commit, prune, fire events."""
-        self.validate_block(state, block)
+        with _trace.span("state.apply_block", "state",
+                         height=block.header.height, txs=len(block.txs)):
+            return self._apply_block(state, block_id, block)
+
+    def _apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
+        with _trace.span("state.validate_block", "state",
+                         height=block.header.height):
+            self.validate_block(state, block)
 
         start = _time.perf_counter()
-        f_res = self.app.finalize_block(
-            abci.RequestFinalizeBlock(
-                hash=block.hash(),
-                height=block.header.height,
-                time_ns=block.header.time.unix_ns(),
-                txs=list(block.txs),
-                decided_last_commit=self.build_last_commit_info(block, state.initial_height),
-                misbehavior=evidence_to_abci(block.evidence),
-                proposer_address=block.header.proposer_address,
-                next_validators_hash=block.header.next_validators_hash,
+        with _trace.span("state.finalize_block", "state",
+                         height=block.header.height, txs=len(block.txs)):
+            f_res = self.app.finalize_block(
+                abci.RequestFinalizeBlock(
+                    hash=block.hash(),
+                    height=block.header.height,
+                    time_ns=block.header.time.unix_ns(),
+                    txs=list(block.txs),
+                    decided_last_commit=self.build_last_commit_info(block, state.initial_height),
+                    misbehavior=evidence_to_abci(block.evidence),
+                    proposer_address=block.header.proposer_address,
+                    next_validators_hash=block.header.next_validators_hash,
+                )
             )
-        )
         if self.metrics is not None:
             self.metrics.observe("block_processing_time", _time.perf_counter() - start)
 
@@ -259,7 +269,9 @@ class BlockExecutor:
         (ref: BlockExecutor.Commit, execution.go:342)."""
         self.mempool.lock()
         try:
-            res = self.app.commit()
+            with _trace.span("state.abci_commit", "state",
+                             height=block.header.height):
+                res = self.app.commit()
             # on-chain ConsensusParams may have changed this block:
             # refresh the admission gas cap (PostCheckMaxGas analog)
             self.mempool.max_gas = state.consensus_params.block.max_gas

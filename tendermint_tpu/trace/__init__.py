@@ -1,0 +1,270 @@
+"""tmtrace — in-process block-lifecycle span tracing.
+
+The verification engine (ops/engine.py) and the TPU dispatch path it
+fronts are the hottest code in the repo, and their scheduling behavior
+(coalescing, dispatch/collect overlap, host-vs-device path selection)
+is invisible from aggregate metrics alone. This module records named
+spans into a process-wide thread-safe ring buffer and exports them as
+Chrome-trace / Perfetto JSON ("trace event format"), so one block's
+wall-clock decomposes into spans across the consensus thread, the
+engine workers, the host pool, and blocksync.
+
+Design constraints:
+  - near-zero overhead when DISABLED (the default): span() returns a
+    shared no-op context manager after one dict lookup — no allocation,
+    no clock read, no lock. TM_TPU_TRACE=1 enables at import;
+    set_enabled() flips at runtime (tests, RPC).
+  - thread-safe bounded memory: events land in a deque(maxlen=N)
+    (TM_TPU_TRACE_BUF, default 65536) under a lock taken only on the
+    ENABLED path, at span exit.
+  - cross-thread correlation: spans accept a `flow` id (new_flow());
+    the engine stamps each submitted job with one, so the caller's
+    submit span, the dispatch worker's coalesce/launch spans, and the
+    collect worker's demux span share it. Export adds Chrome-trace
+    flow events (ph s/f) per flow id so Perfetto draws the arrows.
+
+Span catalog (docs/observability.md): consensus.step (instant) /
+consensus.finalize_commit, state.apply_block / state.validate_block /
+state.finalize_block / state.abci_commit, verify.commit_dispatch /
+verify.commit_collect / verify.direct_host, blocksync.verify_commit /
+blocksync.apply, engine.submit / engine.coalesce / engine.dispatch /
+engine.host_verify / engine.collect, ops.verify_dispatch /
+ops.msm_dispatch / ops.pk_cache_fill, sharded.verify.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "enabled",
+    "set_enabled",
+    "span",
+    "instant",
+    "annotate",
+    "new_flow",
+    "counter",
+    "clear",
+    "export",
+    "export_json",
+    "save",
+]
+
+_STATE = {
+    "on": os.environ.get("TM_TPU_TRACE", "").strip().lower() in ("1", "on", "true", "yes"),
+}
+_CAPACITY = int(os.environ.get("TM_TPU_TRACE_BUF", "65536"))
+
+# Ring of finished events. Each entry is a dict already shaped like a
+# Chrome-trace event minus pid (stamped at export). deque.append is
+# atomic, but the lock also guards clear()/export() snapshots.
+_EVENTS: deque = deque(maxlen=_CAPACITY)
+_LOCK = threading.Lock()
+_FLOW_IDS = itertools.count(1)
+_LOCAL = threading.local()
+
+
+def enabled() -> bool:
+    return _STATE["on"]
+
+
+def set_enabled(on: bool) -> None:
+    """Flip tracing at runtime (tests, bench stages, RPC debug)."""
+    _STATE["on"] = bool(on)
+
+
+def new_flow() -> int:
+    """Fresh correlation id for spans that cross threads."""
+    return next(_FLOW_IDS)
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1000.0
+
+
+def _stack() -> list:
+    st = getattr(_LOCAL, "stack", None)
+    if st is None:
+        st = _LOCAL.stack = []
+    return st
+
+
+class _NoopSpan:
+    """Shared disabled-path span: no state, no clock, no lock."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def annotate(self, **kv):
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_t0", "_tid", "_tname")
+
+    def __init__(self, name: str, cat: str, args: dict):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        t = threading.current_thread()
+        self._tid = t.ident or 0
+        self._tname = t.name
+        _stack().append(self)
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = _now_us()
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        ev = {
+            "name": self.name,
+            "cat": self.cat or "tm",
+            "ph": "X",
+            "ts": self._t0,
+            "dur": t1 - self._t0,
+            "tid": self._tid,
+            "tname": self._tname,
+        }
+        if self.args:
+            ev["args"] = self.args
+        with _LOCK:
+            _EVENTS.append(ev)
+        return False
+
+    def annotate(self, **kv):
+        self.args.update(kv)
+
+
+def span(name: str, cat: str = "", **args):
+    """Context manager recording one complete ("X") event. Disabled
+    path returns the shared no-op after a single dict lookup."""
+    if not _STATE["on"]:
+        return _NOOP
+    return _Span(name, cat, args)
+
+
+def annotate(**kv) -> None:
+    """Attach args to the innermost open span on THIS thread."""
+    if not _STATE["on"]:
+        return
+    st = _stack()
+    if st:
+        st[-1].args.update(kv)
+
+
+def instant(name: str, cat: str = "", **args) -> None:
+    """One instant ("i") event — step transitions, demux wakeups."""
+    if not _STATE["on"]:
+        return
+    t = threading.current_thread()
+    ev = {
+        "name": name,
+        "cat": cat or "tm",
+        "ph": "i",
+        "s": "t",  # thread-scoped instant
+        "ts": _now_us(),
+        "tid": t.ident or 0,
+        "tname": t.name,
+    }
+    if args:
+        ev["args"] = args
+    with _LOCK:
+        _EVENTS.append(ev)
+
+
+def counter(name: str, value: float, cat: str = "") -> None:
+    """One counter ("C") sample — queue depths over time."""
+    if not _STATE["on"]:
+        return
+    t = threading.current_thread()
+    with _LOCK:
+        _EVENTS.append({
+            "name": name,
+            "cat": cat or "tm",
+            "ph": "C",
+            "ts": _now_us(),
+            "tid": t.ident or 0,
+            "tname": t.name,
+            "args": {"value": value},
+        })
+
+
+def clear() -> None:
+    with _LOCK:
+        _EVENTS.clear()
+
+
+def export() -> dict:
+    """Snapshot the ring as a Chrome-trace JSON object (the
+    `traceEvents` array format Perfetto and chrome://tracing open
+    directly). Thread-name metadata events and per-flow s/f arrows are
+    synthesized here so the hot path never pays for them."""
+    pid = os.getpid()
+    with _LOCK:
+        events = list(_EVENTS)
+    out = []
+    tnames: dict[int, str] = {}
+    flows: dict[int, list] = {}
+    for ev in events:
+        e = dict(ev)
+        tname = e.pop("tname", None)
+        if tname and e["tid"] not in tnames:
+            tnames[e["tid"]] = tname
+        e["pid"] = pid
+        # fid 0 is the "tracing was off at submit" sentinel (jobs in
+        # flight across a live-enable): never synthesize arrows for it —
+        # it would draw one false causality chain across unrelated spans
+        fid = (e.get("args") or {}).get("flow")
+        if fid and e["ph"] == "X":
+            flows.setdefault(fid, []).append(e)
+        out.append(e)
+    for tid, name in tnames.items():
+        out.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": name},
+        })
+    # Flow arrows: one s at the first span's start, one f at the last
+    # span's end, binding the enclosing slices (bp: "e").
+    for fid, evs in flows.items():
+        if len(evs) < 2:
+            continue
+        evs.sort(key=lambda e: e["ts"])
+        first, last = evs[0], evs[-1]
+        out.append({
+            "name": "flow", "cat": "tm.flow", "ph": "s", "id": fid,
+            "pid": pid, "tid": first["tid"], "ts": first["ts"],
+        })
+        out.append({
+            "name": "flow", "cat": "tm.flow", "ph": "f", "bp": "e", "id": fid,
+            "pid": pid, "tid": last["tid"], "ts": last["ts"] + last.get("dur", 0),
+        })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_json() -> str:
+    return json.dumps(export())
+
+
+def save(path: str) -> int:
+    """Write the Chrome-trace JSON to path; returns the event count."""
+    doc = export()
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])

@@ -22,6 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable
 
+from .. import trace as _trace
 from ..crypto import batch as crypto_batch
 from .block import BlockID, Commit, CommitSig
 from .validator_set import NotEnoughVotingPowerError, ValidatorSet
@@ -191,10 +192,14 @@ def _verify_commit_batch(
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
 
-    pending = bv.verify_async()
+    with _trace.span("verify.commit_dispatch", "verify",
+                     height=commit.height, nsigs=len(batch_sig_idxs)):
+        pending = bv.verify_async()
 
     def complete() -> None:
-        ok, valid_sigs = pending()
+        with _trace.span("verify.commit_collect", "verify",
+                         height=commit.height, nsigs=len(batch_sig_idxs)):
+            ok, valid_sigs = pending()
         if ok:
             return
         for i, sig_ok in enumerate(valid_sigs):

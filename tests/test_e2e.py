@@ -79,6 +79,25 @@ def test_e2e_perturbed_testnet(tmp_path):
         assert int(res["response"]["last_block_height"]) >= 2
     finally:
         runner.cleanup()
+    # cleanup scraped each node's final /metrics exposition into its
+    # home dir; with the engine default-on (TM_TPU_ENGINE=auto) the
+    # commit-verify traffic must have surfaced the engine telemetry
+    # plane (ops/engine.py -> metrics.EngineMetrics via the process-
+    # global registry) on at least one node's scrape.
+    scraped = []
+    for node in runner.nodes:
+        path = os.path.join(node.home, "metrics.txt")
+        if os.path.exists(path):
+            with open(path) as f:
+                scraped.append(f.read())
+    assert scraped, "no node produced a metrics.txt artifact"
+    assert any("tendermint_consensus_height" in t for t in scraped)
+    from tendermint_tpu.ops.engine import engine_enabled
+
+    if engine_enabled():
+        assert any("tendermint_engine_submitted_jobs_total" in t for t in scraped), (
+            "engine telemetry series missing from every node's final scrape"
+        )
 
 
 PARTITION_MANIFEST = """

@@ -345,3 +345,120 @@ def test_bench_coalesced_smoke():
     pks, msgs, sigs = make_jobs(6)
     rate = bench.bench_coalesced((pks, msgs, sigs), n_callers=3, per_call=2, iters=2)
     assert rate > 0
+
+
+# ---------------------------------------------------------- observability
+
+
+def _counter_value(metric) -> float:
+    return sum(v for _, _, v in metric.samples())
+
+
+def test_engine_trace_and_telemetry_integration(monkeypatch):
+    """PR-4 acceptance: a multi-caller verify workload with TM_TPU_TRACE
+    on yields Chrome-trace spans covering submit -> coalesce -> dispatch
+    -> collect, flow-correlated across threads, with NONZERO
+    dispatch/collect overlap accounted; and the engine series (queue
+    depth, coalesce factor, launch latency, per-path counters) land on
+    the process-global registry."""
+    import time as _t
+
+    from tendermint_tpu import trace as T
+    from tendermint_tpu.metrics import engine_metrics, global_registry
+
+    if not E.engine_enabled():
+        pytest.skip("TM_TPU_ENGINE=off")
+    m = engine_metrics()
+    overlap_before = _counter_value(m.overlap_seconds)
+    launches_before = _counter_value(m.launches)
+
+    # Slow the host verify a little so consecutive coalesced batches
+    # PIPELINE: batch B's host_verify/dispatch runs while batch A's
+    # collect blocks — deterministic overlap on any box.
+    real = E._HOST_VERIFY["ed25519"]
+
+    def slow_verify(pks, msgs, sigs):
+        _t.sleep(0.02)
+        return real(pks, msgs, sigs)
+
+    monkeypatch.setitem(E._HOST_VERIFY, "ed25519", slow_verify)
+
+    was = T.enabled()
+    T.set_enabled(True)
+    T.clear()
+    try:
+        n_callers, iters = 4, 3
+        jobs = {c: make_jobs(8) for c in range(n_callers)}
+        errs = []
+        eng = E.get_engine()
+
+        def caller(c):
+            # Submit WITHOUT waiting (the blocksync verify-ahead shape):
+            # later submissions arrive while earlier batches are in
+            # flight, so the dispatch worker forms a new group per
+            # in-flight window and the double buffer actually pipelines.
+            try:
+                handles = []
+                for _ in range(iters):
+                    handles.append(eng.submit("ed25519", *jobs[c]))
+                    _t.sleep(0.005)  # land in distinct coalesce windows
+                for h in handles:
+                    assert all(h.result(timeout=120))
+            except Exception as e:  # noqa: BLE001 - surface after join
+                errs.append(e)
+
+        threads = [threading.Thread(target=caller, args=(c,)) for c in range(n_callers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        doc = T.export()
+    finally:
+        T.set_enabled(was)
+        T.clear()
+
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert {"engine.submit", "engine.coalesce", "engine.dispatch",
+            "engine.host_verify", "engine.collect"} <= names, names
+
+    # flow correlation: some flow id must link a caller's submit span to
+    # the collect span of the coalesced launch that carried it
+    def flows(name):
+        return {
+            (e.get("args") or {}).get("flow")
+            for e in spans
+            if e["name"] == name and (e.get("args") or {}).get("flow")
+        }
+
+    linked = flows("engine.submit") & flows("engine.collect")
+    assert linked, "no flow id links a submit span to a collect span"
+    # submit and collect happen on different threads (caller vs worker)
+    fid = next(iter(linked))
+    sub_tid = next(e["tid"] for e in spans
+                   if e["name"] == "engine.submit" and (e.get("args") or {}).get("flow") == fid)
+    col_tid = next(e["tid"] for e in spans
+                   if e["name"] == "engine.collect" and (e.get("args") or {}).get("flow") == fid)
+    assert sub_tid != col_tid
+
+    # telemetry: the workload moved the engine series
+    assert _counter_value(m.launches) > launches_before
+    assert _counter_value(m.overlap_seconds) > overlap_before, (
+        "pipelined workload recorded no dispatch/collect overlap"
+    )
+    text = global_registry().gather()
+    for series in (
+        "tendermint_engine_queue_depth",
+        "tendermint_engine_coalesce_factor_rows_bucket",
+        "tendermint_engine_coalesced_group_size_count",
+        "tendermint_engine_launch_latency_seconds_bucket",
+        "tendermint_engine_collect_latency_seconds_bucket",
+        "tendermint_engine_queue_wait_seconds_count",
+        "tendermint_engine_overlap_seconds_total",
+        "tendermint_engine_overlap_ratio",
+        'tendermint_engine_path_rows_total{plane="ed25519",path="host",status="accept"}',
+        'tendermint_engine_launches_total{plane="ed25519",path="host"}',
+        "tendermint_engine_host_pool_busy_seconds_total",
+    ):
+        assert series in text, f"{series} missing from engine telemetry"

@@ -212,7 +212,8 @@ def test_metricsgen_doc_in_sync():
     """docs/metrics.md is generated from the live registry
     (scripts/metricsgen.py --write) and must not drift from the code —
     the metricsdiff discipline of the reference's metricsgen, enforced
-    in CI instead of at codegen time."""
+    in CI instead of at codegen time. --check is byte-exact (catches
+    formatting/prose drift --diff's row comparison misses)."""
     import os
     import subprocess
     import sys
@@ -222,8 +223,99 @@ def test_metricsgen_doc_in_sync():
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
-        [sys.executable, os.path.join(root, "scripts", "metricsgen.py"),
-         "--diff", os.path.join(root, "docs", "metrics.md")],
+        [sys.executable, os.path.join(root, "scripts", "metricsgen.py"), "--check"],
         capture_output=True, text=True, env=env, timeout=120,
     )
     assert r.returncode == 0, f"metrics doc drifted from registry:\n{r.stdout}{r.stderr}"
+
+
+def test_label_value_escaping_round_trip():
+    """Exposition-format escaping (satellite of PR 4): backslash,
+    double-quote, and newline in a label VALUE must be escaped so the
+    line stays parseable; HELP lines escape backslash and newline.
+    Round-trip: unescaping the gathered text recovers the original."""
+    reg = Registry()
+    c = reg.counter("tm_esc_total", 'help with \\ backslash\nand newline', labels=("link",))
+    hostile = 'a->b" \\ drop\nrate'
+    c.add(1, hostile)
+    text = reg.gather()
+    line = next(ln for ln in text.splitlines() if ln.startswith("tm_esc_total{"))
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line  # literal newline would split the sample
+    inner = line[line.index('link="') + len('link="'):line.rindex('"}')]
+    unescaped = inner.replace("\\\\", "\x00").replace('\\"', '"').replace("\\n", "\n").replace("\x00", "\\")
+    assert unescaped == hostile
+    help_line = next(ln for ln in text.splitlines() if ln.startswith("# HELP tm_esc_total"))
+    assert "\\\\" in help_line and "\\n" in help_line
+
+
+def test_histogram_bucket_monotonicity():
+    """Cumulative bucket counts must be non-decreasing in le order and
+    the +Inf bucket must equal _count — the invariant Prometheus
+    clients assume when computing quantiles."""
+    import re
+
+    reg = Registry()
+    h = reg.histogram("tm_mono_seconds", "monotone", buckets=(0.001, 0.01, 0.1, 1, 10))
+    for v in (0.0005, 0.004, 0.02, 0.02, 0.5, 2, 50, 0.07):
+        h.observe(v)
+    text = reg.gather()
+    buckets = []
+    for ln in text.splitlines():
+        m = re.match(r'tm_mono_seconds_bucket\{le="([^"]+)"\} (\d+)', ln)
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            buckets.append((le, int(m.group(2))))
+    assert [b[0] for b in buckets] == sorted(b[0] for b in buckets)
+    counts = [b[1] for b in buckets]
+    assert counts == sorted(counts), f"bucket counts not monotone: {counts}"
+    count_line = next(ln for ln in text.splitlines() if ln.startswith("tm_mono_seconds_count"))
+    assert counts[-1] == int(count_line.split()[-1]) == 8
+
+
+def test_engine_metrics_served_with_node_registry():
+    """EngineMetrics lives on the process-global registry (the engine
+    is process-wide, not per-node); PrometheusServer must serve it
+    MERGED after any node registry — one scrape shows both planes."""
+    from tendermint_tpu.metrics import engine_metrics, global_registry
+
+    def sample(metric, *labels) -> float:
+        for _, lbls, v in metric.samples():
+            if tuple(lbls.values()) == labels:
+                return v
+        return 0.0
+
+    # the global plane is cumulative across the whole test process
+    # (engine traffic from earlier tests lands here too): assert DELTAS
+    m = engine_metrics()
+    accept0 = sample(m.path_rows, "ed25519", "host", "accept")
+    reject0 = sample(m.path_rows, "ed25519", "host", "reject")
+    m.submitted_jobs.add(1, "ed25519")
+    m.coalesced_group_size.observe(3)
+    m.launch_latency.observe(0.004)
+    m.observe_path("ed25519", "host", [True, True, False])
+    assert sample(m.path_rows, "ed25519", "host", "accept") == accept0 + 2
+    assert sample(m.path_rows, "ed25519", "host", "reject") == reject0 + 1
+
+    assert "tendermint_engine_submitted_jobs_total" in global_registry().gather()
+
+    reg = Registry()
+    reg.gauge("tm_node_up", "node registry side").set(1)
+    srv = PrometheusServer(reg, "127.0.0.1:0")
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+    finally:
+        srv.stop()
+    assert "tm_node_up 1" in body
+    for series in (
+        "tendermint_engine_submitted_jobs_total",
+        "tendermint_engine_queue_depth",
+        "tendermint_engine_coalesced_group_size_count",
+        "tendermint_engine_launch_latency_seconds_bucket",
+        'tendermint_engine_path_rows_total{plane="ed25519",path="host",status="accept"}',
+        'tendermint_engine_path_rows_total{plane="ed25519",path="host",status="reject"}',
+    ):
+        assert series in body, f"{series} missing from merged scrape"

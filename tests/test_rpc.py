@@ -155,6 +155,79 @@ def test_error_paths(live_node):
         client.tx(hash="ff" * 32)  # unknown tx
 
 
+def test_dump_traces_route(live_node):
+    """The tracer debug route (PR 4): read-only snapshot always
+    available with block-lifecycle spans; the mutating params
+    (enable/clear) are gated behind rpc.unsafe like the other
+    state-mutating debug routes."""
+    from tendermint_tpu import trace as T
+
+    node, client, _ = live_node
+    was = T.enabled()
+    try:
+        # live_node serves with unsafe=False: mutation refused
+        with pytest.raises(RPCClientError):
+            client.call("dump_traces", enable=True)
+        with pytest.raises(RPCClientError):
+            client.call("dump_traces", clear=True)
+        # the node runs in-process — flip the tracer directly; the
+        # single-validator net keeps committing, so consensus + state
+        # spans must show up within a few block intervals
+        T.set_enabled(True)
+        names: set = set()
+        res = {}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            res = client.call("dump_traces")
+            names = {e["name"] for e in res["trace"]["traceEvents"]}
+            if {"consensus.step", "consensus.finalize_commit",
+                "state.apply_block"} <= names:
+                break
+            time.sleep(0.2)
+        assert {"consensus.step", "consensus.finalize_commit",
+                "state.apply_block"} <= names, names
+        assert res["enabled"] is True
+        assert res["events"] == len(res["trace"]["traceEvents"]) > 0
+    finally:
+        T.set_enabled(was)
+        T.clear()
+
+
+def test_dump_traces_unsafe_mutations():
+    """With rpc.unsafe on, enable/clear work: flip the tracer on, drop
+    the ring after a snapshot, flip it back off."""
+    from tendermint_tpu import trace as T
+    from tendermint_tpu.rpc import RPCEnvironment, build_routes
+
+    routes = build_routes(RPCEnvironment(chain_id="unsafe-test", unsafe=True))
+    was = T.enabled()
+    try:
+        res = routes["dump_traces"](enable=True)
+        assert res["enabled"] is True
+        with T.span("unsafe.probe"):
+            pass
+        res = routes["dump_traces"](clear=True)
+        assert any(e["name"] == "unsafe.probe" for e in res["trace"]["traceEvents"])
+        res = routes["dump_traces"](enable=False)
+        assert res["enabled"] is False and res["events"] == 0
+        # the URI GET interface hands params over as raw strings:
+        # clear="no" must NOT drop the ring (and, being a no-op, must
+        # not require rpc.unsafe either)
+        T.set_enabled(True)
+        with T.span("unsafe.probe2"):
+            pass
+        res = routes["dump_traces"](clear="no")
+        assert any(e["name"] == "unsafe.probe2" for e in res["trace"]["traceEvents"])
+        res = routes["dump_traces"]()
+        assert any(e["name"] == "unsafe.probe2" for e in res["trace"]["traceEvents"])
+        safe = build_routes(RPCEnvironment(chain_id="safe-test", unsafe=False))
+        res = safe["dump_traces"](clear="no")
+        assert any(e["name"] == "unsafe.probe2" for e in res["trace"]["traceEvents"])
+    finally:
+        T.set_enabled(was)
+        T.clear()
+
+
 def test_uri_get_requests(live_node):
     import json
     import urllib.request

@@ -1,0 +1,194 @@
+"""tmtrace — the in-process span tracer (tendermint_tpu/trace/).
+
+Covers the PR-4 tentpole surface: enable/disable semantics, the
+Chrome-trace JSON export schema (what Perfetto/chrome://tracing
+require to open the file), cross-thread flow correlation, the ring
+bound, and the disabled-path overhead guard (the tracer rides the
+engine hot path, so "off" must stay free).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu import trace as T
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracer():
+    was = T.enabled()
+    T.set_enabled(False)
+    T.clear()
+    yield
+    T.set_enabled(was)
+    T.clear()
+
+
+def test_disabled_records_nothing():
+    assert not T.enabled()
+    with T.span("x", "test", a=1):
+        pass
+    T.instant("i")
+    T.counter("c", 1.0)
+    T.annotate(b=2)
+    assert T.export()["traceEvents"] == []
+
+
+def test_span_records_complete_event():
+    T.set_enabled(True)
+    with T.span("work", "test", rows=7) as sp:
+        time.sleep(0.002)
+        sp.annotate(extra="y")
+    doc = T.export()
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["name"] == "work" and ev["cat"] == "test"
+    assert ev["dur"] >= 2000  # microseconds
+    assert ev["args"] == {"rows": 7, "extra": "y"}
+
+
+def test_annotate_targets_innermost_open_span():
+    T.set_enabled(True)
+    with T.span("outer"):
+        with T.span("inner"):
+            T.annotate(who="inner")
+        T.annotate(who="outer")
+    by_name = {e["name"]: e for e in T.export()["traceEvents"] if e.get("ph") == "X"}
+    assert by_name["inner"]["args"] == {"who": "inner"}
+    assert by_name["outer"]["args"] == {"who": "outer"}
+
+
+def test_chrome_trace_schema():
+    """The export must be a valid trace-event-format object: a
+    traceEvents array where every event carries name/ph/pid/tid, X
+    events carry ts+dur, instants carry a scope, counters carry a
+    value, and thread_name metadata binds the tids."""
+    T.set_enabled(True)
+    with T.span("a", "s", flow=T.new_flow()):
+        pass
+    T.instant("blip", "s")
+    T.counter("depth", 3.0)
+    doc = json.loads(T.export_json())  # round-trips as strict JSON
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["displayTimeUnit"] in ("ms", "ns")
+    phs = set()
+    for ev in doc["traceEvents"]:
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] in ("X", "i", "C", "M", "s", "f")
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        phs.add(ev["ph"])
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] in ("t", "p", "g")
+        if ev["ph"] == "C":
+            assert "value" in ev["args"]
+        if ev["ph"] in ("s", "f"):
+            assert "id" in ev and "ts" in ev
+    assert {"X", "i", "C", "M"} <= phs
+    names = [e["args"]["name"] for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(names), "thread_name metadata missing"
+
+
+def test_flow_arrows_span_threads():
+    T.set_enabled(True)
+    fid = T.new_flow()
+
+    def worker():
+        with T.span("collect", "test", flow=fid):
+            pass
+
+    with T.span("submit", "test", flow=fid):
+        pass
+    t = threading.Thread(target=worker, name="flow-worker")
+    t.start()
+    t.join()
+    evs = T.export()["traceEvents"]
+    arrows = [e for e in evs if e["ph"] in ("s", "f") and e.get("id") == fid]
+    assert {e["ph"] for e in arrows} == {"s", "f"}
+    xtids = {e["tid"] for e in evs if e.get("ph") == "X"}
+    assert len(xtids) == 2, "spans should land on two distinct threads"
+    # the s arrow starts on the earlier span's thread, f ends on the later
+    s_ev = next(e for e in arrows if e["ph"] == "s")
+    f_ev = next(e for e in arrows if e["ph"] == "f")
+    assert s_ev["ts"] <= f_ev["ts"]
+
+
+def test_ring_buffer_bounds_memory():
+    T.set_enabled(True)
+    cap = T._EVENTS.maxlen
+    for i in range(cap + 100):
+        T.instant(f"e{i}")
+    evs = [e for e in T.export()["traceEvents"] if e["ph"] == "i"]
+    assert len(evs) == cap
+    # oldest events were dropped, newest survive
+    assert evs[-1]["name"] == f"e{cap + 99}"
+
+
+def test_save_writes_loadable_json(tmp_path):
+    T.set_enabled(True)
+    with T.span("persisted"):
+        pass
+    path = str(tmp_path / "out.trace.json")
+    n = T.save(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert n == len(doc["traceEvents"]) >= 1
+    assert any(e["name"] == "persisted" for e in doc["traceEvents"])
+
+
+def test_concurrent_spans_all_recorded():
+    T.set_enabled(True)
+    n_threads, per = 8, 200
+
+    def worker(k):
+        for i in range(per):
+            with T.span(f"t{k}", "mt", i=i):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = [e for e in T.export()["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == n_threads * per
+
+
+def test_disabled_overhead_guard():
+    """The disabled span() path must stay near-free: one dict lookup
+    and a shared no-op context manager — no allocation, clock read, or
+    lock. Budget is generous (shared CI box) but still catches an
+    accidental hot-path regression (e.g. allocating a Span or reading
+    the clock while disabled) which lands >10x over it."""
+    assert not T.enabled()
+    n = 200_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with T.span("hot", "guard", rows=1):
+                pass
+        best = min(best, time.perf_counter() - t0)
+    per_call_us = best / n * 1e6
+    assert per_call_us < 5.0, f"disabled span() costs {per_call_us:.2f}us/call"
+    assert T.export()["traceEvents"] == []
+
+
+def test_flow_zero_sentinel_gets_no_arrows():
+    """flow=0 marks 'tracing was off at submit' (jobs in flight across
+    a live enable): export must not group those spans into a fake flow
+    or draw arrows between unrelated work."""
+    T.set_enabled(True)
+    with T.span("a", "t", flow=0):
+        pass
+    with T.span("b", "t", flow=0):
+        pass
+    evs = T.export()["traceEvents"]
+    assert not [e for e in evs if e["ph"] in ("s", "f")]
