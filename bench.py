@@ -62,8 +62,9 @@ BUDGET = float(os.environ.get("BENCH_BUDGET", "840"))
 PIPELINE_ITERS = int(os.environ.get("BENCH_ITERS", "8"))
 # Per-stage Chrome-trace artifacts (tendermint_tpu.trace): each stage's
 # engine/dispatch spans land next to the numbers so BENCH rounds carry
-# a timeline, not just totals. BENCH_TRACE=off disables (e.g. when
-# hunting for the tracer's own overhead).
+# a timeline, not just totals. BENCH_TRACE=1 opts in; default is off so
+# published rates exclude the tracer's hot-path overhead and stay
+# comparable across rounds.
 TRACE_DIR = os.environ.get("BENCH_TRACE_DIR", os.path.join(_ROOT, ".bench_traces"))
 _T0 = time.monotonic()
 
@@ -264,10 +265,13 @@ def bench_fastsync(chain):
 
 def main():
     global BATCHES, PIPELINE_ITERS
-    if os.environ.get("BENCH_TRACE", "on").strip().lower() not in ("off", "0", "false", "no"):
-        from tendermint_tpu import trace as _tmtrace
+    from tendermint_tpu import trace as _tmtrace
 
+    if os.environ.get("BENCH_TRACE", "").strip().lower() in ("1", "on", "true", "yes"):
         _tmtrace.set_enabled(True)
+    if _tmtrace.enabled():  # TM_TPU_TRACE=1 alone also traces the run
+        _log("tracing active: stage timelines in "
+             f"{TRACE_DIR}; rates include tracer overhead")
     jobs = ([], [], [])
 
     # Stage 1 (no device): ALL job generation (pure-Python signing,

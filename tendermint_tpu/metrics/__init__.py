@@ -11,11 +11,40 @@ tiny threaded HTTP server for the `/metrics` endpoint.
 
 from __future__ import annotations
 
+import functools
+import sys
 import threading
 import time
 from typing import Sequence
 
 NAMESPACE = "tendermint"  # ref: config.Instrumentation.Namespace default
+
+# Metric writes sit on hot paths whose real work must never be failed
+# by telemetry (a metrics bug in the verify engine's dispatch/collect
+# workers would kill a daemon thread and hang every caller). The write
+# methods therefore swallow everything, logging once per metric
+# instance so a misuse bug is still visible without flooding. Read
+# paths (samples/gather) stay loud — a broken scrape should be seen at
+# the scraper.
+def _never_raise(fn):
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        try:
+            fn(self, *args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            # racing threads may warn twice for one instance; harmless
+            if getattr(self, "_warned_drop", False):
+                return
+            self._warned_drop = True
+            try:
+                sys.stderr.write(
+                    f"metrics: dropped {fn.__name__} on {self.name} "
+                    f"({type(e).__name__}: {e}); further errors for this "
+                    "metric are silent\n"
+                )
+            except Exception:  # noqa: BLE001
+                pass
+    return wrapped
 
 
 class _Metric:
@@ -44,6 +73,7 @@ class _Metric:
 class Counter(_Metric):
     kind = "counter"
 
+    @_never_raise
     def add(self, delta: float = 1.0, *label_values: str) -> None:
         k = self._key(label_values)
         with self._lock:
@@ -53,11 +83,13 @@ class Counter(_Metric):
 class Gauge(_Metric):
     kind = "gauge"
 
+    @_never_raise
     def set(self, value: float, *label_values: str) -> None:
         k = self._key(label_values)
         with self._lock:
             self._children[k] = float(value)
 
+    @_never_raise
     def add(self, delta: float, *label_values: str) -> None:
         k = self._key(label_values)
         with self._lock:
@@ -74,6 +106,7 @@ class Histogram(_Metric):
         self.buckets = tuple(buckets) if buckets is not None else self.DEFAULT_BUCKETS
         self._hist: dict[tuple, list] = {}  # key -> [bucket_counts, sum, count]
 
+    @_never_raise
     def observe(self, value: float, *label_values: str) -> None:
         k = self._key(label_values)
         with self._lock:

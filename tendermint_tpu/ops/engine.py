@@ -292,6 +292,11 @@ class VerifyEngine:
         from collections import deque
 
         self._stage_ivs: deque = deque(maxlen=64)  # (batch_seq, t0, t1)
+        # Guards _stage_ivs append/snapshot: the dispatch thread and
+        # host-pool workers append while the collect thread iterates,
+        # and CPython raises "deque mutated during iteration" on an
+        # unlocked snapshot.
+        self._stage_ivs_lock = threading.Lock()
         self._overlap_total = 0.0
         self._collect_total = 0.0
         self._seq = 0  # dispatch-thread-only batch counter
@@ -379,9 +384,11 @@ class VerifyEngine:
                     group = self._take_group()
                 m.queue_depth.set(len(self._pending))
             rows = sum(j.n for j in group)
+            t0 = _time.monotonic()
+            # metric writes never raise (metrics._never_raise), so none
+            # of these can kill the dispatch worker
             m.coalesced_group_size.observe(len(group))
             m.coalesce_factor.observe(rows)
-            t0 = _time.monotonic()
             m.queue_wait.observe(t0 - group[0].t_submit)
             self._seq += 1
             seq = self._seq
@@ -399,7 +406,8 @@ class VerifyEngine:
                 continue
             t1 = _time.monotonic()
             m.launch_latency.observe(t1 - t0)
-            self._stage_ivs.append((seq, t0, t1))
+            with self._stage_ivs_lock:
+                self._stage_ivs.append((seq, t0, t1))
             with self._have_inflight:
                 self._inflight.append((group, thunk, path, seq))
                 m.inflight_batches.set(len(self._inflight))
@@ -434,10 +442,13 @@ class VerifyEngine:
                                      plane=plane, rows=total, flow=flow):
                         return host_fn(pks, msgs, sigs)
                 finally:
+                    # metric writes never raise; nothing here can mask
+                    # a real host_fn error through future.result
                     t1 = _time.monotonic()
                     m.host_pool_active.add(-1)
                     m.host_pool_busy_seconds.add(t1 - t0)
-                    self._stage_ivs.append((seq, t0, t1))
+                    with self._stage_ivs_lock:
+                        self._stage_ivs.append((seq, t0, t1))
 
             future = _host_pool().submit(host_verify)
             return future.result, "host"  # .result raises the worker's exception
@@ -491,25 +502,42 @@ class VerifyEngine:
                 # same lock discipline as queue_depth: serialize the
                 # gauge write with the list state it describes
                 m.inflight_batches.set(len(self._inflight))
+            rows = sum(j.n for j in group)
             t0 = _time.monotonic()
             try:
                 with _trace.span("engine.collect", "engine",
                                  plane=group[0].plane, jobs=len(group),
-                                 rows=sum(j.n for j in group), path=path,
+                                 rows=rows, path=path,
                                  flow=group[0].flow):
                     bools = thunk()
+                # materialize + validate inside the guard: a None/
+                # generator/short bitmap from a buggy verify path must
+                # fail the group, not kill this worker — and a short
+                # slice-truncation below would make all([]) == True
+                # report unverified rows as accepted
+                bools = list(bools)
+                if len(bools) != rows:
+                    raise RuntimeError(
+                        f"verify path {path!r} returned {len(bools)} "
+                        f"results for {rows} rows")
             except BaseException as e:  # noqa: BLE001
                 _fail_jobs(group, e)
                 continue
             t1 = _time.monotonic()
-            m.collect_latency.observe(t1 - t0)
-            self._account_overlap(m, seq, t0, t1)
-            m.observe_path(group[0].plane, path, bools)
             lo = 0
             for j in group:
                 j.result = bools[lo : lo + j.n]
                 lo += j.n
                 j.event.set()
+            # Telemetry only after every caller is woken: a bookkeeping
+            # bug must neither strand an already-verified group nor kill
+            # this worker (which would hang every future submit).
+            try:
+                m.collect_latency.observe(t1 - t0)
+                self._account_overlap(m, seq, t0, t1)
+                m.observe_path(group[0].plane, path, bools)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _account_overlap(self, m, seq: int, c0: float, c1: float) -> None:
         """Fold one collect interval's intersection with OTHER batches'
@@ -522,11 +550,14 @@ class VerifyEngine:
         also doing other work"). Stages still running when the collect
         ends are not yet in _stage_ivs and go uncounted — overlap is a
         floor, not a ceiling. Runs only on the collect worker, so the
-        accumulators need no lock; _stage_ivs appends from other
-        threads are safe (deque)."""
+        accumulators need no lock; the _stage_ivs snapshot takes
+        _stage_ivs_lock because dispatch/host workers append
+        concurrently and deque iteration during mutation raises."""
+        with self._stage_ivs_lock:
+            ivs = list(self._stage_ivs)
         clipped = sorted(
             (max(c0, s), min(c1, e))
-            for iv_seq, s, e in list(self._stage_ivs)
+            for iv_seq, s, e in ivs
             if iv_seq != seq and s < c1 and e > c0
         )
         overlap = 0.0

@@ -58,7 +58,14 @@ __all__ = [
 _STATE = {
     "on": os.environ.get("TM_TPU_TRACE", "").strip().lower() in ("1", "on", "true", "yes"),
 }
-_CAPACITY = int(os.environ.get("TM_TPU_TRACE_BUF", "65536"))
+try:
+    _CAPACITY = int(os.environ.get("TM_TPU_TRACE_BUF", "65536"))
+    if _CAPACITY < 0:
+        raise ValueError(_CAPACITY)
+except ValueError:
+    # forgiving like TM_TPU_TRACE itself: a malformed observability
+    # knob must not stop the node from importing/booting
+    _CAPACITY = 65536
 
 # Ring of finished events. Each entry is a dict already shaped like a
 # Chrome-trace event minus pid (stamped at export). deque.append is

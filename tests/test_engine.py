@@ -203,6 +203,24 @@ def test_engine_collect_exception_propagates(monkeypatch):
     assert submit_and_wait(pks, msgs, sigs) == [True, True]
 
 
+def test_engine_short_result_fails_group(monkeypatch):
+    """A verify path returning fewer results than rows must fail the
+    group loudly — a silent slice-truncation would wake callers with
+    empty results and all([]) == True reports forged rows as accepted."""
+    monkeypatch.setitem(E._HOST_VERIFY, "ed25519", lambda pks, msgs, sigs: [])
+    pks, msgs, sigs = make_jobs(2)
+    handle = E.get_engine().submit("ed25519", pks, msgs, sigs)
+    with pytest.raises(RuntimeError, match="returned 0 results for 2 rows"):
+        handle.result(timeout=120)
+    # non-sized result (None) must also fail the group, not the worker
+    monkeypatch.setitem(E._HOST_VERIFY, "ed25519", lambda pks, msgs, sigs: None)
+    handle = E.get_engine().submit("ed25519", pks, msgs, sigs)
+    with pytest.raises(TypeError):
+        handle.result(timeout=120)
+    monkeypatch.undo()
+    assert submit_and_wait(pks, msgs, sigs) == [True, True]
+
+
 # ------------------------------------------------------------- autotune
 
 

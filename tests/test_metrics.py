@@ -38,6 +38,32 @@ def test_counter_gauge_histogram_exposition():
     assert "tm_test_dur_count 3" in text
 
 
+def test_metric_writes_never_raise(capsys):
+    """Instrument writes sit on verify-engine worker threads where an
+    escaped exception kills the daemon and hangs every caller — misuse
+    must drop the sample (warning once), never raise."""
+    reg = Registry()
+    c = reg.counter("tm_test_nr_total", "c", labels=("kind",))
+    g = reg.gauge("tm_test_nr_gauge", "g", labels=("kind",))
+    h = reg.histogram("tm_test_nr_dur", "h", labels=("kind",))
+    c.add(1)          # missing label value
+    c.add(1, "x", "y")  # extra label value
+    g.set(1)
+    g.add(1)
+    h.observe(0.1)
+    err = capsys.readouterr().err
+    # two bad writes to the counter, but only one warning line for it
+    assert err.count("dropped add on tm_test_nr_total") == 1
+    # good writes after bad ones still land
+    c.add(3, "x")
+    g.set(7, "x")
+    h.observe(0.05, "x")
+    text = reg.gather()
+    assert 'tm_test_nr_total{kind="x"} 3' in text
+    assert 'tm_test_nr_gauge{kind="x"} 7' in text
+    assert 'tm_test_nr_dur_count{kind="x"} 1' in text
+
+
 def test_consensus_metrics_mark_step():
     reg = Registry()
     m = ConsensusMetrics(reg)
