@@ -244,6 +244,182 @@ def bench_coalesced(jobs, n_callers=4, per_call=256, iters=4):
     return n_callers * per_call * iters / dt
 
 
+def _rate(fn, min_time=0.25, min_iters=3):
+    """Calls/sec of fn, warmed, at least min_iters and min_time."""
+    fn()
+    iters = 0
+    t0 = time.perf_counter()
+    while True:
+        fn()
+        iters += 1
+        dt = time.perf_counter() - t0
+        if dt >= min_time and iters >= min_iters:
+            return iters / dt
+
+
+def bench_hash():
+    """The host structural-hash plane (no device needed; runs before
+    the claim): merkle root at 64/1024/16384 leaves through the native
+    C builder, the iterative Python fallback, and the seed's recursive
+    builder (the pre-plane baseline, kept inline here); ValidatorSet
+    .hash @1000 validators cold vs cached; Header.hash cold vs cached.
+    Emits header_hash_per_sec as a NON-final JSON line, once per
+    backend (native plane enabled vs TM_TPU_NATIVE=0 fallback)."""
+    import hashlib
+    import random
+
+    from tendermint_tpu import native as N
+    from tendermint_tpu.crypto import merkle as MK
+    from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.utils.tmtime import Time
+
+    def seed_recursive_root(items):
+        # the seed tree builder (recursive, list-slice copies) — the
+        # baseline every plane rate is quoted against
+        n = len(items)
+        if n == 0:
+            return hashlib.sha256(b"").digest()
+        if n == 1:
+            return MK.leaf_hash(items[0])
+        k = MK._split_point(n)
+        return MK.inner_hash(seed_recursive_root(items[:k]), seed_recursive_root(items[k:]))
+
+    rng = random.Random(1234)
+    lib = N.load_prep()
+    native_ok = lib is not None and hasattr(lib, "tm_merkle_root")
+    merkle_rates = {}
+    for n in (64, 1024, 16384):
+        items = [rng.randbytes(40) for _ in range(n)]
+        r_seed = _rate(lambda: seed_recursive_root(items))
+        r_py = _rate(lambda: MK._hash_from_byte_slices_py(items))
+        r_nat = _rate(lambda: N.merkle_root(items)) if native_ok else 0.0
+        merkle_rates[n] = (r_nat, r_py, r_seed)
+        _log(
+            f"merkle root n={n}: native {r_nat:,.0f}/s, python-iter "
+            f"{r_py:,.0f}/s, seed-recursive {r_seed:,.0f}/s"
+            + (f" (native {r_nat / r_seed:.1f}x seed)" if native_ok else "")
+        )
+
+    from tendermint_tpu.crypto import encoding as _enc
+    from tendermint_tpu.proto import messages as _pb
+
+    vals = [
+        Validator.new(Ed25519PubKey(bytes([i & 0xFF, i >> 8]) + bytes(30)), 10 + i)
+        for i in range(1000)
+    ]
+    vs = ValidatorSet.new(vals)
+
+    def valset_seed():
+        # seed behavior: re-encode every SimpleValidator + recursive
+        # merkle, every call — what each of the 4+ per-block hash()
+        # sites used to pay
+        seed_recursive_root([
+            _pb.SimpleValidator(
+                pub_key=_enc.pubkey_to_proto(v.pub_key), voting_power=v.voting_power
+            ).encode()
+            for v in vs.validators
+        ])
+
+    def valset_cold():
+        vs._hash_cache = None  # set-level memo off; per-leaf encodes stay warm
+        vs.hash()
+
+    # seed recompute is pure Python by definition and the cached path
+    # never touches merkle, so both are backend-independent; the COLD
+    # rate (1000-leaf rebuild) is backend-dependent and is re-measured
+    # inside the backend loop below
+    r_vs_seed = _rate(valset_seed)
+    r_vs_cached = _rate(vs.hash, min_iters=10000)
+    _log(
+        f"ValidatorSet.hash @1000: seed-recompute {r_vs_seed:,.0f}/s, "
+        f"cached {r_vs_cached:,.0f}/s "
+        f"(cached {r_vs_cached / r_vs_seed:,.0f}x seed)"
+    )
+
+    hd = Header(
+        chain_id="bench", height=12345, time=Time(1700000000, 42),
+        last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
+        validators_hash=b"\x03" * 32, next_validators_hash=b"\x04" * 32,
+        consensus_hash=b"\x05" * 32, app_hash=b"\x06" * 32,
+        last_results_hash=b"\x07" * 32, evidence_hash=b"\x08" * 32,
+        proposer_address=b"\x09" * 20,
+    )
+
+    def header_cold():
+        hd.height = 12345  # any field write invalidates the memo
+        hd.hash()
+
+    from tendermint_tpu.proto import messages as pb
+    from tendermint_tpu.types.block import cdc_encode
+
+    def header_seed():
+        # seed behavior: recursive tree over the 14 encodes, no memo
+        hd.height = 12345
+        version_bz = pb.Consensus(block=hd.version_block, app=hd.version_app).encode()
+        time_bz = pb.Timestamp(seconds=hd.time.seconds, nanos=hd.time.nanos).encode()
+        seed_recursive_root([
+            version_bz, cdc_encode(hd.chain_id), cdc_encode(hd.height), time_bz,
+            hd.last_block_id.to_proto().encode(), cdc_encode(hd.last_commit_hash),
+            cdc_encode(hd.data_hash), cdc_encode(hd.validators_hash),
+            cdc_encode(hd.next_validators_hash), cdc_encode(hd.consensus_hash),
+            cdc_encode(hd.app_hash), cdc_encode(hd.last_results_hash),
+            cdc_encode(hd.evidence_hash), cdc_encode(hd.proposer_address),
+        ])
+
+    r_hd_seed = _rate(header_seed)
+    backends = ["native", "python"] if native_ok else ["python"]
+    # NOTE on labels: `backend` is the PLANE CONFIG the iteration ran
+    # under (native enabled vs TM_TPU_NATIVE=0). The 14-leaf header
+    # tree sits below the native cutover by design (crypto/merkle.py
+    # _NATIVE_MIN_LEAVES), so the header rates are backend-independent
+    # — any delta between the two lines is timing noise. The
+    # backend-DEPENDENT evidence in each line is valset1000_cold
+    # (re-measured under the config) and merkle1024 (per-builder).
+    for backend in backends:
+        prior = os.environ.pop("TM_TPU_NATIVE", None)
+        try:
+            if backend == "python":
+                os.environ["TM_TPU_NATIVE"] = "0"
+            r_hd_cold = _rate(header_cold)
+            r_hd_cached = _rate(hd.hash, min_iters=10000)
+            r_vs_cold = _rate(valset_cold)
+        finally:
+            if prior is not None:
+                os.environ["TM_TPU_NATIVE"] = prior
+            else:
+                os.environ.pop("TM_TPU_NATIVE", None)
+        _log(
+            f"Header.hash [{backend}]: cold {r_hd_cold:,.0f}/s (14 leaves "
+            f"< native cutover: same code path both backends), cached "
+            f"{r_hd_cached:,.0f}/s, seed {r_hd_seed:,.0f}/s; "
+            f"ValidatorSet cold [{backend}]: {r_vs_cold:,.0f}/s"
+        )
+        r_nat, r_py, r_seed = merkle_rates[1024]
+        print(
+            json.dumps(
+                {
+                    "metric": "header_hash_per_sec",
+                    "value": round(r_hd_cold, 1),
+                    "unit": "headers/sec (cold recompute; 14-leaf tree is below the native cutover, so backend-independent)",
+                    "vs_baseline": round(r_hd_cold / r_hd_seed, 3),
+                    "backend": backend,
+                    "cached_per_sec": round(r_hd_cached, 1),
+                    "valset1000_seed_per_sec": round(r_vs_seed, 1),
+                    "valset1000_cold_per_sec": round(r_vs_cold, 1),
+                    "valset1000_cached_per_sec": round(r_vs_cached, 1),
+                    "valset1000_cached_vs_seed": round(r_vs_cached / r_vs_seed, 1),
+                    "merkle1024_per_sec": round(r_nat if backend == "native" else r_py, 1),
+                    "merkle1024_vs_seed_recursive": round(
+                        (r_nat if backend == "native" else r_py) / r_seed, 3
+                    ),
+                }
+            ),
+            flush=True,
+        )
+
+
 def bench_fastsync(chain):
     """Sequential verify_commit_light over the prebuilt chain — the
     per-block work of blocksync replay (reactor.go:582) on the device
@@ -287,6 +463,19 @@ def main():
             _log(f"fast-sync chain built: {len(fastsync_chain)} blocks x 1000 validators")
         except Exception as e:  # noqa: BLE001 - aux metric must not sink the run
             _log(f"fast-sync prep failed: {type(e).__name__}: {e}")
+
+    # Stage 1.5 (no device): the host structural-hash plane. Cheap
+    # (~30s) and device-independent, so it runs before the claim;
+    # failures never sink the run.
+    if os.environ.get("BENCH_HASH", "on") != "off":
+        try:
+            with stage_deadline(min(max(_remaining() - 60, 20), 120)):
+                bench_hash()
+            _save_stage_trace("hash")
+        except StageTimeout:
+            _log("hash stage hit deadline; continuing")
+        except Exception as e:  # noqa: BLE001
+            _log(f"hash stage failed: {type(e).__name__}: {e}")
     # trace-time host constants (fixed-base comb tables, ~2s of Python
     # scalar mults) the kernels need — pay before the device claim
     from tendermint_tpu.ops import curve as _curve

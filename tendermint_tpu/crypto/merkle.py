@@ -3,14 +3,53 @@ crypto/merkle/proof.go).
 
 Leaf hash = SHA256(0x00 || leaf); inner hash = SHA256(0x01 || left || right).
 Trees over n items split at the largest power of two < n.
+
+Two byte-identical builders serve every tree:
+
+  - native (default): one GIL-released ctypes call into prep.c
+    (tm_merkle_root / tm_merkle_proofs / tm_sha256_batch) — contiguous
+    buffer per level, no recursion, libcrypto's asm SHA-256, threaded
+    leaf hashing for big part sets.
+  - pure Python (fallback, and the oracle the native plane is
+    property-tested against): LEVEL-ITERATIVE pairing with odd-node
+    promotion. Bottom-up pairing with promotion builds exactly the
+    split-at-largest-power-of-two-below-n tree (both place 2^k leaves
+    in every maximal left subtree), without the recursion and the
+    O(n log n) list-slice copies the seed's recursive builder paid.
+
+Every build lands in HashMetrics (site/backend counters, leaf-count and
+latency histograms) and a `hash.merkle_build` tmtrace span, so the
+block lifecycle's hashing tax is visible in /metrics and Perfetto
+(docs/observability.md). `TM_TPU_NATIVE=0` pins the Python path.
 """
 
 from __future__ import annotations
 
 import hashlib
+import time as _time
+
+from .. import native as _native
+from .. import trace as _trace
 
 LEAF_PREFIX = b"\x00"
 INNER_PREFIX = b"\x01"
+
+# Below this leaf count the ctypes call overhead (bytes join + offsets
+# array) beats the native win; the Python loop is faster for the tiny
+# trees (a 14-leaf header sits right at the measured crossover on the
+# 2-core dev box, so it stays on the Python side).
+_NATIVE_MIN_LEAVES = 16
+
+_HM = None
+
+
+def _hash_metrics():
+    global _HM
+    if _HM is None:
+        from ..metrics import hash_metrics
+
+        _HM = hash_metrics()
+    return _HM
 
 
 def _sha256(data: bytes) -> bytes:
@@ -33,16 +72,63 @@ def _split_point(n: int) -> int:
     return k
 
 
-def hash_from_byte_slices(items: list[bytes]) -> bytes:
-    """Merkle root (ref: HashFromByteSlices, crypto/merkle/tree.go:11).
-    Empty list hashes to SHA256 of the empty string."""
+def sha256_batch(items: list[bytes]) -> list[bytes]:
+    """SHA-256 of each item — native single-call when available, else
+    one hashlib pass (types/tx.go Tx.Hash feeding txs_hash)."""
+    if len(items) >= _NATIVE_MIN_LEAVES:
+        out = _native.sha256_batch(items)
+        if out is not None:
+            _hash_metrics().sha256_batches.add(1, "native")
+            return out
+    _hash_metrics().sha256_batches.add(1, "python")
+    sha = hashlib.sha256
+    return [sha(it).digest() for it in items]
+
+
+def _hash_level(level: list[bytes]) -> list[bytes]:
+    """One pairing pass; an odd tail node is promoted unchanged."""
+    sha = hashlib.sha256
+    nxt = [
+        sha(INNER_PREFIX + level[i] + level[i + 1]).digest()
+        for i in range(0, len(level) - 1, 2)
+    ]
+    if len(level) & 1:
+        nxt.append(level[-1])
+    return nxt
+
+
+def _hash_from_byte_slices_py(items: list[bytes]) -> bytes:
     n = len(items)
     if n == 0:
         return _sha256(b"")
-    if n == 1:
-        return leaf_hash(items[0])
-    k = _split_point(n)
-    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+    sha = hashlib.sha256
+    level = [sha(LEAF_PREFIX + it).digest() for it in items]
+    while len(level) > 1:
+        level = _hash_level(level)
+    return level[0]
+
+
+def hash_from_byte_slices(items: list[bytes], site: str = "merkle") -> bytes:
+    """Merkle root (ref: HashFromByteSlices, crypto/merkle/tree.go:11).
+    Empty list hashes to SHA256 of the empty string. `site` labels the
+    build in HashMetrics/tmtrace (header, txs, commit, ...)."""
+    n = len(items)
+    t0 = _time.perf_counter()
+    with _trace.span("hash.merkle_build", "hash", site=site, n=n) as sp:
+        root = None
+        backend = "python"
+        if n >= _NATIVE_MIN_LEAVES:
+            root = _native.merkle_root(items)
+            if root is not None:
+                backend = "native"
+        if root is None:
+            root = _hash_from_byte_slices_py(items)
+        sp.annotate(backend=backend)
+    m = _hash_metrics()
+    m.merkle_builds.add(1, site, backend)
+    m.merkle_leaves.observe(n, site)
+    m.merkle_build_seconds.observe(_time.perf_counter() - t0, backend)
+    return root
 
 
 class Proof:
@@ -97,51 +183,51 @@ def _compute_hash_from_aunts(index: int, total: int, leaf: bytes, aunts: list[by
     return inner_hash(aunts[-1], right)
 
 
-def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+def _proofs_from_byte_slices_py(items: list[bytes]):
+    """(root, leaf hashes, per-item aunt lists), level-iterative. At
+    each level item i's ancestor sits at index idx; its sibling (idx^1,
+    when present) is the next aunt, bottom-up; a promoted odd tail
+    contributes no aunt at that level (matches the recursive builder's
+    flatten_aunts skipping parents with no sibling pointer)."""
+    n = len(items)
+    sha = hashlib.sha256
+    leaves = [sha(LEAF_PREFIX + it).digest() for it in items]
+    aunts: list[list[bytes]] = [[] for _ in range(n)]
+    if n == 0:
+        return _sha256(b""), leaves, aunts
+    idxs = list(range(n))
+    level = leaves
+    while len(level) > 1:
+        count = len(level)
+        for i in range(n):
+            idx = idxs[i]
+            sib = idx ^ 1
+            if sib < count:
+                aunts[i].append(level[sib])
+            idxs[i] = idx >> 1
+        level = _hash_level(level)
+    return level[0], leaves, aunts
+
+
+def proofs_from_byte_slices(items: list[bytes], site: str = "merkle") -> tuple[bytes, list[Proof]]:
     """Root plus one inclusion proof per item
     (ref: ProofsFromByteSlices, crypto/merkle/proof.go:82)."""
-    trails, root = _trails_from_byte_slices(items)
-    root_hash = root.hash
-    proofs = []
-    for i, trail in enumerate(trails):
-        proofs.append(Proof(len(items), i, trail.hash, trail.flatten_aunts()))
-    return root_hash, proofs
-
-
-class _Node:
-    __slots__ = ("hash", "parent", "left", "right")
-
-    def __init__(self, h: bytes):
-        self.hash = h
-        self.parent = None
-        self.left = None  # sibling pointers while walking up
-        self.right = None
-
-    def flatten_aunts(self) -> list[bytes]:
-        aunts = []
-        node = self
-        while node is not None:
-            if node.left is not None:
-                aunts.append(node.left.hash)
-            elif node.right is not None:
-                aunts.append(node.right.hash)
-            node = node.parent
-        return aunts
-
-
-def _trails_from_byte_slices(items: list[bytes]):
     n = len(items)
-    if n == 0:
-        return [], _Node(_sha256(b""))
-    if n == 1:
-        node = _Node(leaf_hash(items[0]))
-        return [node], node
-    k = _split_point(n)
-    lefts, left_root = _trails_from_byte_slices(items[:k])
-    rights, right_root = _trails_from_byte_slices(items[k:])
-    root = _Node(inner_hash(left_root.hash, right_root.hash))
-    left_root.parent = root
-    left_root.right = right_root
-    right_root.parent = root
-    right_root.left = left_root
-    return lefts + rights, root
+    t0 = _time.perf_counter()
+    with _trace.span("hash.merkle_build", "hash", site=site, n=n, proofs=True) as sp:
+        res = None
+        backend = "python"
+        if n >= 1:  # the batched plane pays off even for small part sets
+            res = _native.merkle_proofs(items)
+            if res is not None:
+                backend = "native"
+        if res is None:
+            res = _proofs_from_byte_slices_py(items)
+        sp.annotate(backend=backend)
+    root, leaves, aunt_lists = res
+    proofs = [Proof(n, i, leaves[i], aunt_lists[i]) for i in range(n)]
+    m = _hash_metrics()
+    m.merkle_builds.add(1, site, backend)
+    m.merkle_leaves.observe(n, site)
+    m.merkle_build_seconds.observe(_time.perf_counter() - t0, backend)
+    return root, proofs

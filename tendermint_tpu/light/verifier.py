@@ -78,10 +78,11 @@ def _verify_new_header_and_vals(
         raise ErrInvalidHeader(
             f"new header has a time from the future {untrusted_header.header.time} (now: {now})"
         )
-    if untrusted_header.header.validators_hash != untrusted_vals.hash():
+    untrusted_vals_hash = untrusted_vals.hash()  # memoized (types/validator_set.py)
+    if untrusted_header.header.validators_hash != untrusted_vals_hash:
         raise ErrInvalidHeader(
             f"expected new header validators ({untrusted_header.header.validators_hash.hex()}) to match "
-            f"those that were supplied ({untrusted_vals.hash().hex()}) at height {untrusted_header.header.height}"
+            f"those that were supplied ({untrusted_vals_hash.hex()}) at height {untrusted_header.header.height}"
         )
 
 

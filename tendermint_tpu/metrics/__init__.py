@@ -542,11 +542,59 @@ class EngineMetrics:
         self.observe_path_counts(plane, f"direct_{path}", n, accepted)
 
 
+class HashMetrics:
+    """Telemetry for the structural-hash plane: the batched SHA-256 +
+    merkle builders (native/prep.c tm_merkle_root/tm_sha256_batch and
+    the iterative crypto/merkle fallback) and the memoized hashes that
+    sit on the block lifecycle (ValidatorSet.hash, Header.hash,
+    Commit.hash).
+
+    No reference analog — the reference recomputes these hashes per
+    call and has no native/fallback split to observe. Per-site build
+    counters show WHERE blocks spend hash work (header / txs / commit /
+    validator_set / part_set / tx_results / evidence); the backend
+    label proves which plane served it (native vs python); the cache
+    counters make memoization wins (and invalidation storms) visible
+    in /metrics. Registered on the process-global registry because the
+    types layer is process-wide, not per-node."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_hash"
+        self.merkle_builds = reg.counter(
+            f"{ns}_merkle_builds_total",
+            "Merkle tree builds by call site and backend",
+            labels=("site", "backend"),
+        )
+        self.merkle_leaves = reg.histogram(
+            f"{ns}_merkle_leaves",
+            "Leaves per merkle build",
+            labels=("site",),
+            buckets=(1, 2, 4, 8, 16, 64, 256, 1024, 4096, 16384),
+        )
+        self.merkle_build_seconds = reg.histogram(
+            f"{ns}_merkle_build_seconds",
+            "Wall time per merkle build (leaf hashing included)",
+            labels=("backend",),
+            buckets=(0.000005, 0.00002, 0.0001, 0.0005, 0.002, 0.01, 0.05, 0.25, 1),
+        )
+        self.sha256_batches = reg.counter(
+            f"{ns}_sha256_batches_total",
+            "Batched leaf/tx SHA-256 calls by backend",
+            labels=("backend",),
+        )
+        self.cache_events = reg.counter(
+            f"{ns}_cache_events_total",
+            "Structural-hash memo events (hit/miss/invalidate) by site",
+            labels=("site", "event"),
+        )
+
+
 # Process-global registry: subsystems that are process-wide rather than
 # per-node (the verification engine, the dispatch planes) register
 # here; PrometheusServer exports it alongside each node's registry.
 _GLOBAL_REGISTRY = Registry()
 _ENGINE_METRICS: EngineMetrics | None = None
+_HASH_METRICS: HashMetrics | None = None
 _ENGINE_LOCK = threading.Lock()
 
 
@@ -564,6 +612,17 @@ def engine_metrics() -> EngineMetrics:
             if _ENGINE_METRICS is None:
                 _ENGINE_METRICS = EngineMetrics(_GLOBAL_REGISTRY)
     return _ENGINE_METRICS
+
+
+def hash_metrics() -> HashMetrics:
+    """Lazy process-wide HashMetrics singleton (first merkle build or
+    structural-hash memo event registers the families)."""
+    global _HASH_METRICS
+    if _HASH_METRICS is None:
+        with _ENGINE_LOCK:
+            if _HASH_METRICS is None:
+                _HASH_METRICS = HashMetrics(_GLOBAL_REGISTRY)
+    return _HASH_METRICS
 
 
 class PrometheusServer:

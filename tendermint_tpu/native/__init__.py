@@ -1,9 +1,16 @@
 """Native runtime components (C, built on demand with the system gcc).
 
 `prep` — the batch-prep hot path feeding the TPU verify kernel
-(SHA-512 challenges + mod-L reduction + uint8 shaping). Loaded via
-ctypes from a .so compiled next to the source on first use; falls back
-to the pure-Python path if no compiler is available.
+(SHA-512 challenges + mod-L reduction + uint8 shaping), libcrypto EVP
+host verify, and the batched SHA-256 / RFC-6962 merkle plane the block
+lifecycle hashes through. Loaded via ctypes from a .so compiled next to
+the source on first use; falls back to the pure-Python paths if no
+compiler is available.
+
+`TM_TPU_NATIVE=0` (also `off`/`false`/`no`) disables the loader
+entirely — every caller takes its pure-Python fallback — for A/B runs
+of the native planes (docs/observability.md). The flag is read on
+every load_prep() call so tests can flip it per-case.
 """
 
 from __future__ import annotations
@@ -11,6 +18,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -20,33 +28,74 @@ _SO = os.path.join(_DIR, "prep.so")
 _lock = threading.Lock()
 _lib = None
 _load_failed = False
+_warned_fallback = False
+
+
+def native_disabled() -> bool:
+    """The documented A/B opt-out: TM_TPU_NATIVE=0 forces every native
+    consumer onto its pure-Python fallback."""
+    return os.environ.get("TM_TPU_NATIVE", "").strip().lower() in ("0", "off", "false", "no")
+
+
+def _warn_fallback_once(reason: str) -> None:
+    """One stderr line, first failure only (the metrics `_never_raise`
+    pattern): the pure-Python fallback is silent-correct but 10-100x
+    slower, so running on it unknowingly should be visible exactly
+    once, never per call."""
+    global _warned_fallback
+    if _warned_fallback:
+        return
+    _warned_fallback = True
+    try:
+        sys.stderr.write(
+            f"native: prep library unavailable ({reason}); pure-Python "
+            "fallbacks active for batch prep, host verify, and the "
+            "SHA-256/merkle plane (set TM_TPU_NATIVE=0 to silence by "
+            "opting out explicitly)\n"
+        )
+    except Exception:  # noqa: BLE001 - a warning must never break a caller
+        pass
 
 
 def _build() -> bool:
+    tmp = _SO + ".tmp"
     try:
         src_mtime = os.path.getmtime(_SRC)
         if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
             return True
         subprocess.run(
-            ["cc", "-O3", "-march=native", "-shared", "-fPIC", "-pthread", "-o", _SO + ".tmp", _SRC],
+            ["cc", "-O3", "-march=native", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC],
             check=True, capture_output=True,
         )
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
         return True
     except Exception:
         return False
+    finally:
+        # a failed/killed cc leaves the partial .tmp behind; it is never
+        # loaded (os.replace is atomic) but must not accumulate
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def load_prep():
     """ctypes handle to the prep library, or None (fallback to Python)."""
     global _lib, _load_failed
-    if _lib is not None or _load_failed:
+    if native_disabled():
+        return None
+    if _lib is not None:
         return _lib
+    if _load_failed:
+        return None
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
         if not _build():
             _load_failed = True
+            _warn_fallback_once("cc build failed or no compiler")
             return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -63,11 +112,12 @@ def load_prep():
                 ctypes.c_char_p,  # precheck
             ]
             lib.prepare_batch.restype = None
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i64p = ctypes.POINTER(ctypes.c_int64)
             # a stale .so may predate tm_rlc_scalars; its absence must
             # degrade only the RLC path (msm.py falls back per-call),
             # not poison the whole native prep load
             if hasattr(lib, "tm_rlc_scalars"):
-                u8p = ctypes.POINTER(ctypes.c_uint8)
                 lib.tm_rlc_scalars.argtypes = [
                     ctypes.c_char_p,  # z_raw (n*16)
                     u8p,  # s_rows (n*32)
@@ -81,20 +131,144 @@ def load_prep():
             # only the host-path batch verify (callers fall back to the
             # per-signature Python chain)
             if hasattr(lib, "tm_host_verify"):
-                u8p = ctypes.POINTER(ctypes.c_uint8)
                 lib.tm_host_verify.argtypes = [
                     ctypes.c_char_p,  # pks (n*32)
                     ctypes.c_char_p,  # sigs (n*64)
                     ctypes.c_char_p,  # msgs (concatenated)
-                    ctypes.POINTER(ctypes.c_int64),  # offsets (n+1)
+                    i64p,  # offsets (n+1)
                     ctypes.c_int64,  # n
                     u8p,  # out (n)
                 ]
                 lib.tm_host_verify.restype = ctypes.c_int
+            # hash plane (absence degrades to crypto/merkle's iterative
+            # Python path, byte-identical)
+            if hasattr(lib, "tm_sha256_batch"):
+                lib.tm_sha256_batch.argtypes = [
+                    ctypes.c_char_p,  # items (concatenated)
+                    i64p,  # offsets (n+1)
+                    ctypes.c_int64,  # n
+                    u8p,  # out (n*32)
+                ]
+                lib.tm_sha256_batch.restype = None
+            if hasattr(lib, "tm_merkle_root"):
+                lib.tm_merkle_root.argtypes = [
+                    ctypes.c_char_p,  # items (concatenated)
+                    i64p,  # offsets (n+1)
+                    ctypes.c_int64,  # n
+                    u8p,  # out (32)
+                ]
+                lib.tm_merkle_root.restype = None
+            if hasattr(lib, "tm_merkle_proofs"):
+                lib.tm_merkle_proofs.argtypes = [
+                    ctypes.c_char_p,  # items (concatenated)
+                    i64p,  # offsets (n+1)
+                    ctypes.c_int64,  # n
+                    ctypes.c_int64,  # stride (max aunts per item)
+                    u8p,  # root_out (32)
+                    u8p,  # leaves_out (n*32)
+                    u8p,  # aunts_out (n*stride*32)
+                    ctypes.POINTER(ctypes.c_int32),  # counts_out (n)
+                ]
+                lib.tm_merkle_proofs.restype = None
             _lib = lib
         except Exception:
             _load_failed = True
+            _warn_fallback_once("ctypes load failed")
     return _lib
+
+
+def _concat_offsets(items):
+    import numpy as np
+
+    n = len(items)
+    offsets = np.zeros(n + 1, np.int64)
+    if n:
+        # fromiter(map(len, ...)) skips the intermediate Python list —
+        # this marshaling is the dominant per-call cost for mid-size
+        # trees, ahead of the C hashing itself
+        np.cumsum(np.fromiter(map(len, items), np.int64, count=n), out=offsets[1:])
+    return b"".join(items), offsets
+
+
+def sha256_batch(items) -> list[bytes] | None:
+    """SHA-256 of each item in ONE GIL-released native call (threaded
+    across cores inside C for large totals), or None when the native
+    library is unavailable (callers take the hashlib loop)."""
+    lib = load_prep()
+    if lib is None or not hasattr(lib, "tm_sha256_batch"):
+        return None
+    import numpy as np
+
+    n = len(items)
+    if n == 0:
+        return []
+    blob, offsets = _concat_offsets(items)
+    out = np.empty(n * 32, np.uint8)
+    lib.tm_sha256_batch(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    buf = out.tobytes()
+    return [buf[32 * i : 32 * i + 32] for i in range(n)]
+
+
+def merkle_root(items) -> bytes | None:
+    """RFC-6962 merkle root in one native call, or None (fallback)."""
+    lib = load_prep()
+    if lib is None or not hasattr(lib, "tm_merkle_root"):
+        return None
+    n = len(items)
+    blob, offsets = _concat_offsets(items)
+    out = (ctypes.c_uint8 * 32)()
+    lib.tm_merkle_root(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out,
+    )
+    return bytes(out)
+
+
+def merkle_proofs(items) -> tuple[bytes, list[bytes], list[list[bytes]]] | None:
+    """(root, per-item leaf hashes, per-item aunt lists) in one native
+    call, or None (fallback). Requires len(items) >= 1 — the n == 0
+    shape (empty root, no proofs) is trivial in Python."""
+    lib = load_prep()
+    if lib is None or not hasattr(lib, "tm_merkle_proofs"):
+        return None
+    import numpy as np
+
+    n = len(items)
+    if n == 0:
+        return None
+    stride = max(1, (n - 1).bit_length())  # ceil(log2(n)) = max aunts/item
+    blob, offsets = _concat_offsets(items)
+    root = (ctypes.c_uint8 * 32)()
+    leaves = np.empty(n * 32, np.uint8)
+    aunts = np.empty(n * stride * 32, np.uint8)
+    counts = np.zeros(n, np.int32)
+    lib.tm_merkle_proofs(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        stride,
+        root,
+        leaves.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        aunts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    leaf_buf = leaves.tobytes()
+    aunt_buf = aunts.tobytes()
+    leaf_hashes = [leaf_buf[32 * i : 32 * i + 32] for i in range(n)]
+    aunt_lists = []
+    for i in range(n):
+        base = i * stride * 32
+        aunt_lists.append(
+            [aunt_buf[base + 32 * j : base + 32 * j + 32] for j in range(int(counts[i]))]
+        )
+    return bytes(root), leaf_hashes, aunt_lists
 
 
 def host_verify_batch(pubkeys, msgs, sigs):
@@ -121,7 +295,6 @@ def host_verify_batch(pubkeys, msgs, sigs):
     lib = load_prep()
     if lib is None or not hasattr(lib, "tm_host_verify"):
         return None
-    import ctypes
 
     offsets = np.zeros(n + 1, np.int64)
     np.cumsum([len(m) for m in msgs], out=offsets[1:])

@@ -41,7 +41,7 @@ def tx_results_hash(tx_results: list[abci.ExecTxResult]) -> bytes:
         if r.gas_used:
             buf += wire.encode_tag(6, wire.WIRE_VARINT) + wire.encode_varint(r.gas_used & (2**64 - 1))
         items.append(buf)
-    return hash_from_byte_slices(items)
+    return hash_from_byte_slices(items, site="tx_results")
 
 
 def validator_updates_from_abci(updates: list[abci.ValidatorUpdate]) -> list[Validator]:

@@ -54,14 +54,16 @@ def validate_block(state: State, block: Block) -> None:
             f"wrong Block.Header.LastResultsHash. Expected {state.last_results_hash.hex().upper()}, "
             f"got {block.header.last_results_hash.hex().upper()}"
         )
-    if block.header.validators_hash != state.validators.hash():
+    vals_hash = state.validators.hash()  # memoized (types/validator_set.py)
+    if block.header.validators_hash != vals_hash:
         raise InvalidBlockError(
-            f"wrong Block.Header.ValidatorsHash. Expected {state.validators.hash().hex().upper()}, "
+            f"wrong Block.Header.ValidatorsHash. Expected {vals_hash.hex().upper()}, "
             f"got {block.header.validators_hash.hex().upper()}"
         )
-    if block.header.next_validators_hash != state.next_validators.hash():
+    next_vals_hash = state.next_validators.hash()
+    if block.header.next_validators_hash != next_vals_hash:
         raise InvalidBlockError(
-            f"wrong Block.Header.NextValidatorsHash. Expected {state.next_validators.hash().hex().upper()}, "
+            f"wrong Block.Header.NextValidatorsHash. Expected {next_vals_hash.hex().upper()}, "
             f"got {block.header.next_validators_hash.hex().upper()}"
         )
 

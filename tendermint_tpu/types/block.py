@@ -11,7 +11,8 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field
 
-from ..crypto.merkle import hash_from_byte_slices
+from ..crypto.merkle import hash_from_byte_slices, sha256_batch
+from ..metrics import hash_metrics
 from ..proto import messages as pb
 from ..proto import wire
 from ..utils.tmtime import Time
@@ -56,8 +57,10 @@ def tx_hash(tx: bytes) -> bytes:
 
 
 def txs_hash(txs: list[bytes]) -> bytes:
-    """Merkle root of transaction hashes (ref: types/tx.go:36)."""
-    return hash_from_byte_slices([tx_hash(tx) for tx in txs])
+    """Merkle root of transaction hashes (ref: types/tx.go:36). Both
+    stages run on the batched plane: one native call hashes every tx,
+    a second merkles the digests."""
+    return hash_from_byte_slices(sha256_batch(txs), site="txs")
 
 
 def validate_hash(h: bytes) -> None:
@@ -149,15 +152,33 @@ class Header:
     evidence_hash: bytes = b""
     proposer_address: bytes = b""
 
+    # Memoized root. Class attribute (NOT a dataclass field: stays out
+    # of __init__/__eq__/__repr__); the instance slot is written through
+    # __setattr__ below, which clears it on EVERY field write — so
+    # fill_header's lazy writes, from_proto round-trips, and test
+    # mutations all invalidate without auditing call sites.
+    _hash_cache = None
+
+    def __setattr__(self, name, value):
+        if name != "_hash_cache":
+            object.__setattr__(self, "_hash_cache", None)
+        object.__setattr__(self, name, value)
+
     def hash(self) -> bytes | None:
         """Merkle root of the 14 encoded fields (ref: types/block.go:447).
-        Returns None until the header is fully populated."""
+        Returns None until the header is fully populated. Memoized: 14
+        protobuf encodes + a merkle build per call adds up at four-plus
+        hash() calls per block; any field write invalidates."""
         if not self.validators_hash:
             return None
+        h = self._hash_cache
+        if h is not None:
+            hash_metrics().cache_events.add(1, "header", "hit")
+            return h
         version_bz = pb.Consensus(block=self.version_block, app=self.version_app).encode()
         time_bz = pb.Timestamp(seconds=self.time.seconds, nanos=self.time.nanos).encode()
         bid_bz = self.last_block_id.to_proto().encode()
-        return hash_from_byte_slices(
+        h = hash_from_byte_slices(
             [
                 version_bz,
                 cdc_encode(self.chain_id),
@@ -173,8 +194,12 @@ class Header:
                 cdc_encode(self.last_results_hash),
                 cdc_encode(self.evidence_hash),
                 cdc_encode(self.proposer_address),
-            ]
+            ],
+            site="header",
         )
+        self._hash_cache = h
+        hash_metrics().cache_events.add(1, "header", "miss")
+        return h
 
     def validate_basic(self) -> None:
         """ref: Header.ValidateBasic (types/block.go:405)."""
@@ -370,7 +395,12 @@ class Commit:
     def hash(self) -> bytes:
         """Merkle root of CommitSig encodings (ref: types/block.go:900)."""
         if self._hash is None:
-            self._hash = hash_from_byte_slices([cs.to_proto().encode() for cs in self.signatures])
+            self._hash = hash_from_byte_slices(
+                [cs.to_proto().encode() for cs in self.signatures], site="commit"
+            )
+            hash_metrics().cache_events.add(1, "commit", "miss")
+        else:
+            hash_metrics().cache_events.add(1, "commit", "hit")
         return self._hash
 
     def validate_basic(self) -> None:
@@ -490,4 +520,4 @@ class Block:
 
 def evidence_list_hash(evidence: list) -> bytes:
     """Merkle root of evidence encodings (ref: types/evidence.go:667)."""
-    return hash_from_byte_slices([e.bytes() for e in evidence])
+    return hash_from_byte_slices([e.bytes() for e in evidence], site="evidence")

@@ -61,7 +61,9 @@ class PartSet:
         if total == 0:
             total = 1
         chunks = [data[i * part_size : (i + 1) * part_size] for i in range(total)]
-        root, proofs = proofs_from_byte_slices(chunks)
+        # one native call leaf-hashes every 64 KiB part and builds the
+        # proof tree — the proposer-side cost of splitting a large block
+        root, proofs = proofs_from_byte_slices(chunks, site="part_set")
         ps = cls(PartSetHeader(total=total, hash=root))
         for i, chunk in enumerate(chunks):
             ps.parts[i] = Part(index=i, bytes_=chunk, proof=proofs[i])

@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 
 from ..crypto import PubKey, encoding
 from ..crypto.merkle import hash_from_byte_slices
+from ..metrics import hash_metrics
 from ..proto import messages as pb
 
 # ref: types/validator_set.go:25 — cap so priority arithmetic can't overflow.
@@ -51,13 +52,23 @@ class Validator:
     pub_key: PubKey
     voting_power: int
     proposer_priority: int = 0
+    # Guarded memo of the SimpleValidator leaf encoding: the cached
+    # tuple re-checks (pub_key identity, voting_power) on every read,
+    # so direct field writes can never serve a stale encode. Carried
+    # through copy() — priorities change every height but the leaf
+    # encoding does not, so the encode survives the per-block
+    # State.copy() churn.
+    _bytes_cache: tuple | None = field(default=None, compare=False, repr=False)
 
     @classmethod
     def new(cls, pub_key: PubKey, voting_power: int) -> "Validator":
         return cls(address=pub_key.address(), pub_key=pub_key, voting_power=voting_power)
 
     def copy(self) -> "Validator":
-        return Validator(self.address, self.pub_key, self.voting_power, self.proposer_priority)
+        return Validator(
+            self.address, self.pub_key, self.voting_power, self.proposer_priority,
+            self._bytes_cache,
+        )
 
     def validate_basic(self) -> None:
         if self.pub_key is None:
@@ -82,10 +93,16 @@ class Validator:
 
     def bytes(self) -> bytes:
         """SimpleValidator proto encoding — the merkle leaf for
-        ValidatorSet.Hash (ref: types/validator.go:154)."""
-        return pb.SimpleValidator(
+        ValidatorSet.Hash (ref: types/validator.go:154). Memoized with
+        an input guard (see _bytes_cache)."""
+        c = self._bytes_cache
+        if c is not None and c[0] is self.pub_key and c[1] == self.voting_power:
+            return c[2]
+        enc = pb.SimpleValidator(
             pub_key=encoding.pubkey_to_proto(self.pub_key), voting_power=self.voting_power
         ).encode()
+        self._bytes_cache = (self.pub_key, self.voting_power, enc)
+        return enc
 
     def to_proto(self) -> pb.Validator:
         return pb.Validator(
@@ -120,6 +137,16 @@ class ValidatorSet:
     validators: list[Validator] = field(default_factory=list)
     proposer: Validator | None = None
     _total_voting_power: int = 0
+    # Memoized merkle root of the SimpleValidator encodings. hash() is
+    # called at least four times per block (state validation x2,
+    # make_block x2, plus blocksync/light paths) and re-encoding +
+    # re-merkling 1000 validators each time was the single biggest
+    # structural-hash tax in the lifecycle. Cleared by EVERY mutating
+    # method below (update / priority rotation / rescale), and never
+    # carried across copy() — each copy rehashes once. Direct external
+    # mutation of Validator objects bypasses the memo (nothing in-tree
+    # does that; tests pin the invalidation paths).
+    _hash_cache: bytes | None = field(default=None, compare=False, repr=False)
 
     @classmethod
     def new(cls, vals: list[Validator]) -> "ValidatorSet":
@@ -191,9 +218,22 @@ class ValidatorSet:
             result = v if result is None else result.compare_proposer_priority(v)
         return result
 
+    def _invalidate_hash(self) -> None:
+        if self._hash_cache is not None:
+            self._hash_cache = None
+            hash_metrics().cache_events.add(1, "validator_set", "invalidate")
+
     def hash(self) -> bytes:
-        """Merkle root of SimpleValidator encodings (ref: types/validator_set.go:344)."""
-        return hash_from_byte_slices([v.bytes() for v in self.validators])
+        """Merkle root of SimpleValidator encodings (ref: types/validator_set.go:344).
+        Memoized; every mutating method clears the cache."""
+        h = self._hash_cache
+        if h is not None:
+            hash_metrics().cache_events.add(1, "validator_set", "hit")
+            return h
+        h = hash_from_byte_slices([v.bytes() for v in self.validators], site="validator_set")
+        self._hash_cache = h
+        hash_metrics().cache_events.add(1, "validator_set", "miss")
+        return h
 
     def validate_basic(self) -> None:
         if not self.validators:
@@ -214,6 +254,10 @@ class ValidatorSet:
             raise ValueError("empty validator set")
         if times <= 0:
             raise ValueError("cannot call increment_proposer_priority with non-positive times")
+        # priorities are not part of the leaf encoding, but the memo is
+        # cleared on every mutation path by contract (cheap vs auditing
+        # which mutations are hash-neutral)
+        self._invalidate_hash()
         diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
         self.rescale_priorities(diff_max)
         self._shift_by_avg_proposer_priority()
@@ -241,6 +285,7 @@ class ValidatorSet:
             raise ValueError("empty validator set")
         if diff_max <= 0:
             return
+        self._invalidate_hash()
         diff = self._max_min_priority_diff()
         ratio = (diff + diff_max - 1) // diff_max
         if diff > diff_max:
@@ -276,6 +321,7 @@ class ValidatorSet:
     def _update_with_change_set(self, changes: list[Validator], allow_deletes: bool) -> None:
         if not changes:
             return
+        self._invalidate_hash()
         updates, deletes = _process_changes(changes)
         if not allow_deletes and deletes:
             raise ValueError(f"cannot process validators with voting power 0: {deletes}")

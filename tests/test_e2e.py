@@ -98,6 +98,15 @@ def test_e2e_perturbed_testnet(tmp_path):
         assert any("tendermint_engine_submitted_jobs_total" in t for t in scraped), (
             "engine telemetry series missing from every node's final scrape"
         )
+    # the structural-hash plane (crypto/merkle + the memoized
+    # ValidatorSet/Header hashes) rides the same process-global
+    # registry; any committed block must have produced builds and memo
+    # events with nonzero values
+    assert any(
+        "tendermint_hash_merkle_builds_total" in t
+        and "tendermint_hash_cache_events_total" in t
+        for t in scraped
+    ), "hash-plane telemetry series missing from every node's final scrape"
 
 
 PARTITION_MANIFEST = """

@@ -1,0 +1,209 @@
+"""Structural-hash memoization: the caches on ValidatorSet.hash,
+Validator.bytes, Header.hash, and Commit.hash must be invisible —
+every mutation path yields exactly the hash a fresh recompute would
+(the consensus-critical property), and the caches actually serve
+repeats (the perf property the PR exists for)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+from tendermint_tpu.types.block import Block, BlockID, Commit, CommitSig, Header
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.utils.tmtime import Time
+
+
+def _pk(i: int) -> Ed25519PubKey:
+    return Ed25519PubKey(bytes([i & 0xFF, i >> 8]) + bytes(30))
+
+
+def _vals(n: int, power: int = 10) -> list[Validator]:
+    return [Validator.new(_pk(i), power + i) for i in range(n)]
+
+
+def _fresh_hash(vs: ValidatorSet) -> bytes:
+    """What a cache-free implementation would return."""
+    from tendermint_tpu.crypto import encoding
+    from tendermint_tpu.crypto.merkle import hash_from_byte_slices
+    from tendermint_tpu.proto import messages as pb
+
+    return hash_from_byte_slices([
+        pb.SimpleValidator(
+            pub_key=encoding.pubkey_to_proto(v.pub_key), voting_power=v.voting_power
+        ).encode()
+        for v in vs.validators
+    ])
+
+
+# ------------------------------------------------------- ValidatorSet
+
+
+def test_valset_hash_cached_and_correct():
+    vs = ValidatorSet.new(_vals(10))
+    h = vs.hash()
+    assert h == _fresh_hash(vs)
+    assert vs._hash_cache == h
+    assert vs.hash() == h  # served from cache
+
+
+def test_valset_update_invalidates():
+    vs = ValidatorSet.new(_vals(10))
+    before = vs.hash()
+    # power change
+    vs.update_with_change_set([Validator.new(_pk(0), 999)])
+    assert vs._hash_cache is None
+    assert vs.hash() != before
+    assert vs.hash() == _fresh_hash(vs)
+    # addition
+    prev = vs.hash()
+    vs.update_with_change_set([Validator.new(_pk(77), 5)])
+    assert vs.hash() != prev
+    assert vs.hash() == _fresh_hash(vs)
+    # removal (power 0)
+    prev = vs.hash()
+    vs.update_with_change_set([Validator(_pk(77).address(), _pk(77), 0)])
+    assert vs.hash() != prev
+    assert vs.hash() == _fresh_hash(vs)
+
+
+def test_valset_priority_rotation_invalidates_but_preserves_hash():
+    """Proposer-priority changes clear the memo by contract (every
+    mutation path does) even though priorities are not in the leaf
+    encoding — the recompute must land on the identical root."""
+    vs = ValidatorSet.new(_vals(7))
+    before = vs.hash()
+    vs.increment_proposer_priority(3)
+    assert vs._hash_cache is None
+    assert vs.hash() == before == _fresh_hash(vs)
+    vs.rescale_priorities(1)
+    assert vs._hash_cache is None
+    assert vs.hash() == before
+
+
+def test_valset_copy_starts_cold_and_diverges_independently():
+    vs = ValidatorSet.new(_vals(6))
+    h = vs.hash()
+    c = vs.copy()
+    assert c._hash_cache is None  # never carried across copy()
+    assert c.hash() == h
+    c.update_with_change_set([Validator.new(_pk(0), 12345)])
+    assert c.hash() != h
+    assert vs.hash() == h  # original untouched (deep-copied validators)
+
+
+def test_validator_bytes_guard_rechecks_inputs():
+    """The per-validator leaf-encode memo re-checks (pub_key identity,
+    voting_power) on every read: even a DIRECT field write — bypassing
+    every ValidatorSet mutation path — cannot serve a stale encode."""
+    v = Validator.new(_pk(1), 10)
+    b1 = v.bytes()
+    assert v.bytes() is b1  # memo hit returns the same object
+    v.voting_power = 11
+    b2 = v.bytes()
+    assert b2 != b1
+    v.pub_key = _pk(2)
+    assert v.bytes() != b2
+    # copy carries the memo; the guard still holds after mutation
+    c = v.copy()
+    assert c.bytes() == v.bytes()
+    c.voting_power = 99
+    assert c.bytes() != v.bytes()
+
+
+def test_valset_proto_roundtrip_hash_matches():
+    vs = ValidatorSet.new(_vals(5))
+    vs.hash()
+    rt = ValidatorSet.from_proto(vs.to_proto())
+    assert rt.hash() == vs.hash()
+
+
+# ------------------------------------------------------------ Header
+
+
+def _header(**overrides) -> Header:
+    kw = dict(
+        chain_id="cache-test", height=7, time=Time(1700000000, 5),
+        last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
+        validators_hash=b"\x03" * 32, next_validators_hash=b"\x04" * 32,
+        consensus_hash=b"\x05" * 32, app_hash=b"\x06" * 32,
+        last_results_hash=b"\x07" * 32, evidence_hash=b"\x08" * 32,
+        proposer_address=b"\x09" * 20,
+    )
+    kw.update(overrides)
+    return Header(**kw)
+
+
+def test_header_hash_cached_and_every_field_write_invalidates():
+    hd = _header()
+    h = hd.hash()
+    assert hd._hash_cache == h and hd.hash() == h
+    # every dataclass field: a write invalidates, and (field being part
+    # of the 14 hashed encodes) changes the root
+    mutations = dict(
+        version_block=12, version_app=3, chain_id="other", height=8,
+        time=Time(1700000001, 6), last_block_id=BlockID(hash=b"\x0a" * 32),
+        last_commit_hash=b"\x11" * 32, data_hash=b"\x12" * 32,
+        validators_hash=b"\x13" * 32, next_validators_hash=b"\x14" * 32,
+        consensus_hash=b"\x15" * 32, app_hash=b"\x16" * 32,
+        last_results_hash=b"\x17" * 32, evidence_hash=b"\x18" * 32,
+        proposer_address=b"\x19" * 20,
+    )
+    assert set(mutations) == {f.name for f in dataclasses.fields(Header)}
+    for name, value in mutations.items():
+        hd = _header()
+        before = hd.hash()
+        setattr(hd, name, value)
+        assert hd._hash_cache is None, name
+        after = hd.hash()
+        assert after != before, name
+        assert after == _header(**{name: value}).hash(), name
+
+
+def test_header_unpopulated_returns_none_and_never_caches():
+    hd = Header(chain_id="x", height=1)
+    assert hd.hash() is None
+    hd.validators_hash = b"\x03" * 32
+    assert hd.hash() is not None
+
+
+def test_block_fill_header_then_hash_stable():
+    commit = Commit(
+        height=6, round=0, block_id=BlockID(hash=b"\x21" * 32),
+        signatures=[CommitSig.new_commit(b"\x22" * 20, Time(1, 2), b"\x23" * 64)],
+    )
+    blk = Block(header=_header(last_commit_hash=b"", data_hash=b"", evidence_hash=b""),
+                txs=[b"tx1", b"tx2"], last_commit=commit)
+    h1 = blk.hash()
+    assert h1 is not None
+    # repeated hashing is a pure cache hit: fill_header writes nothing
+    # once populated, so the memo survives
+    assert blk.header._hash_cache == h1
+    assert blk.hash() == h1
+    # commit hash memo: same object served
+    assert commit.hash() is commit.hash()
+    # and the filled fields match a from-scratch recompute
+    from tendermint_tpu.types.block import evidence_list_hash, txs_hash
+
+    assert blk.header.data_hash == txs_hash(blk.txs)
+    assert blk.header.evidence_hash == evidence_list_hash([])
+    assert blk.header.last_commit_hash == commit.hash()
+
+
+def test_hash_metrics_cache_events_flow():
+    from tendermint_tpu.metrics import hash_metrics
+
+    def count(event):
+        return sum(
+            v for _, labels, v in hash_metrics().cache_events.samples()
+            if labels == {"site": "validator_set", "event": event}
+        )
+
+    vs = ValidatorSet.new(_vals(4))
+    miss0, hit0, inv0 = count("miss"), count("hit"), count("invalidate")
+    vs.hash()
+    vs.hash()
+    vs.update_with_change_set([Validator.new(_pk(0), 77)])
+    assert count("miss") == miss0 + 1
+    assert count("hit") == hit0 + 1
+    assert count("invalidate") == inv0 + 1

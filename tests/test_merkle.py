@@ -1,8 +1,15 @@
 """Merkle tests, including the reference's known-answer structure checks
-(ref: crypto/merkle/tree_test.go)."""
+(ref: crypto/merkle/tree_test.go) and the three-way property sweep
+pinning the native batched plane (prep.c tm_merkle_root /
+tm_merkle_proofs / tm_sha256_batch) and the iterative Python fallback
+byte-identical to the RFC-6962 recursive definition."""
 
 import hashlib
+import random
 
+import pytest
+
+from tendermint_tpu import native
 from tendermint_tpu.crypto import merkle
 
 
@@ -47,3 +54,119 @@ def test_proof_proto_roundtrip():
     root, proofs = merkle.proofs_from_byte_slices(items)
     p = merkle.Proof.from_proto(proofs[1].to_proto())
     assert p.verify(root, b"b")
+
+
+# --------------------------- batched-plane property sweep ----------------
+
+# n sweep per the RFC-6962 edge zoo: empty, singletons, odd counts,
+# powers of two and both neighbors, plus a large non-power.
+SWEEP_NS = [0, 1, 2, 3, 5, 7, 8, 9, 15, 16, 17, 31, 32, 33, 63, 64, 65,
+            127, 128, 129, 255, 256, 257, 1000]
+
+
+def _recursive_reference_root(items):
+    """The RFC-6962 definition verbatim (the seed's recursive builder),
+    kept here as the oracle both production builders must match."""
+    n = len(items)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n == 1:
+        return merkle.leaf_hash(items[0])
+    k = merkle._split_point(n)
+    return merkle.inner_hash(
+        _recursive_reference_root(items[:k]), _recursive_reference_root(items[k:])
+    )
+
+
+def _sweep_items(n, rng):
+    # varied lengths, 0-length items included; one >4096 item per list
+    # exercises the C heap path for leaf hashing
+    items = [rng.randbytes(rng.randrange(0, 200)) for _ in range(n)]
+    if n >= 3:
+        items[1] = b""
+        items[2] = rng.randbytes(5000)
+    return items
+
+
+def test_iterative_python_matches_recursive_reference():
+    rng = random.Random(11)
+    for n in SWEEP_NS:
+        items = _sweep_items(n, rng)
+        assert merkle._hash_from_byte_slices_py(items) == _recursive_reference_root(items), n
+        root, leaves, aunts = merkle._proofs_from_byte_slices_py(items)
+        assert root == _recursive_reference_root(items), n
+        for i in range(n):
+            assert leaves[i] == merkle.leaf_hash(items[i]), (n, i)
+            assert merkle.Proof(n, i, leaves[i], aunts[i]).verify(root, items[i]), (n, i)
+
+
+_lib = native.load_prep()
+_native_hash_plane = _lib is not None and hasattr(_lib, "tm_merkle_root")
+
+
+@pytest.mark.skipif(not _native_hash_plane, reason="native hash plane unavailable")
+def test_native_merkle_root_matches_python():
+    rng = random.Random(12)
+    for n in SWEEP_NS:
+        items = _sweep_items(n, rng)
+        assert native.merkle_root(items) == _recursive_reference_root(items), n
+
+
+@pytest.mark.skipif(not _native_hash_plane, reason="native hash plane unavailable")
+def test_native_merkle_proofs_match_python():
+    rng = random.Random(13)
+    for n in SWEEP_NS:
+        if n == 0:
+            assert native.merkle_proofs([]) is None  # n=0 stays in Python
+            continue
+        items = _sweep_items(n, rng)
+        nat_root, nat_leaves, nat_aunts = native.merkle_proofs(items)
+        py_root, py_leaves, py_aunts = merkle._proofs_from_byte_slices_py(items)
+        assert nat_root == py_root, n
+        assert nat_leaves == py_leaves, n
+        assert nat_aunts == py_aunts, n
+
+
+@pytest.mark.skipif(not _native_hash_plane, reason="native hash plane unavailable")
+def test_native_sha256_batch_matches_hashlib():
+    rng = random.Random(14)
+    # SHA-256 block-boundary lengths: 55/56 flip the one-vs-two-block
+    # padding, 63/64/65 straddle the block size; plus empty and large
+    lens = [0, 1, 31, 32, 55, 56, 57, 63, 64, 65, 127, 128, 129, 1000, 5000]
+    items = [rng.randbytes(ln) for ln in lens]
+    assert native.sha256_batch(items) == [hashlib.sha256(x).digest() for x in items]
+    assert native.sha256_batch([]) == []
+
+
+def test_proof_roundtrip_against_batched_builder():
+    """Proof.verify / compute_root_hash (the recursive aunt-consumer the
+    gossip path runs) must accept every proof the batched builders
+    emit, and reject cross-item and tampered-leaf forgeries."""
+    rng = random.Random(15)
+    for n in [1, 2, 3, 5, 8, 13, 100, 257]:
+        items = _sweep_items(n, rng)
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        for i, item in enumerate(items):
+            assert proofs[i].compute_root_hash() == root, (n, i)
+            assert proofs[i].verify(root, item), (n, i)
+            assert not proofs[i].verify(root, item + b"x")
+            if n > 1:
+                assert not proofs[i].verify(root, items[(i + 1) % n])
+
+
+def test_tm_tpu_native_opt_out(monkeypatch):
+    """TM_TPU_NATIVE=0 pins every builder to the Python fallback and is
+    read per-call (A/B runs flip it live, docs/observability.md)."""
+    monkeypatch.setenv("TM_TPU_NATIVE", "0")
+    assert native.load_prep() is None
+    assert native.merkle_root([b"a"] * 64) is None
+    assert native.sha256_batch([b"a"] * 64) is None
+    assert native.merkle_proofs([b"a"] * 64) is None
+    items = [bytes([i]) * 40 for i in range(64)]
+    assert merkle.hash_from_byte_slices(items) == _recursive_reference_root(items)
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == _recursive_reference_root(items)
+    assert all(p.verify(root, it) for p, it in zip(proofs, items))
+    monkeypatch.delenv("TM_TPU_NATIVE")
+    if _native_hash_plane:
+        assert native.merkle_root(items) == root  # plane live again

@@ -133,16 +133,37 @@ def test_broadcast_tx_sync_and_mempool_endpoints(live_node):
 
 def test_broadcast_tx_alias_and_remove_tx(live_node):
     """broadcast_tx aliases the sync variant (routes.go:62); remove_tx
-    evicts by tx key (mempool.go:190)."""
+    evicts by tx key (mempool.go:190).
+
+    Deterministic form: the single-validator net commits continuously,
+    so "remove right after broadcast" races the commit (on 2-core boxes
+    the tx is usually committed — and so gone from the mempool — before
+    remove_tx runs; the seed fails this 3/3). Instead wait for the
+    commit, then assert the terminal state: remove_tx on a committed
+    (mempool-evicted) key errors, every time. The mempool-resident
+    success path is covered race-free at the unit level
+    (test_mempool.py::test_remove_tx_by_key)."""
     from tendermint_tpu.types.block import tx_hash
 
     node, client, _ = live_node
     raw = b"removeme=1"
     res = client.call("broadcast_tx", tx=raw.hex())
     assert res["code"] == 0 and res["hash"]
-    assert client.call("remove_tx", txKey=tx_hash(raw).hex()) == {}
+    key = tx_hash(raw).hex()
+    # wait-for-commit: the tx is queryable once indexed (committed)
+    deadline = time.monotonic() + 60
+    committed = None
+    while time.monotonic() < deadline:
+        try:
+            committed = client.tx(hash=key)
+            break
+        except RPCClientError:
+            time.sleep(0.2)
+    assert committed is not None, "broadcast tx never committed"
+    assert committed["hash"].lower() == key
+    # committed => mempool.update evicted it => removal by key errors
     with pytest.raises(RPCClientError):
-        client.call("remove_tx", txKey=tx_hash(raw).hex())  # already gone
+        client.call("remove_tx", txKey=key)
 
 
 def test_error_paths(live_node):
