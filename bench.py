@@ -420,6 +420,184 @@ def bench_hash():
         )
 
 
+def bench_mempool(floods=(1000, 10000, 50000)):
+    """Device-free mempool admission stage (runs under JAX_PLATFORMS=cpu
+    like the hash stage — BENCH_r02/r03 flaky-device note): admitted
+    tx/s at 1k/10k/50k-tx floods, batched (check_tx_batch: native batch
+    hashing + one pipelined ABCI round + single-lock settle) vs the
+    seed per-tx path (one blocking check_tx per tx), over BOTH
+    transports — the in-process LocalClient and an EXTERNAL socket app
+    (one subprocess, the production shape for non-builtin apps, where
+    per-tx admission pays a full round trip per tx) — plus an
+    engine-on/off signed flood through the pre-verification hook.
+
+    Emits one admitted_tx_per_sec JSON line per (flood, mode);
+    vs_baseline is the ratio against the per-tx path on the SAME
+    transport/flood. The 50k socket ratio is the ISSUE-6 acceptance
+    number. Also asserts batched outcomes == sequential outcomes on a
+    mixed flood (dups, oversize, rejects) before timing anything."""
+    import re
+    import subprocess
+
+    from tendermint_tpu import native as N
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.socket import SocketClient
+    from tendermint_tpu.mempool.mempool import TxMempool
+    from tendermint_tpu.mempool.preverify import EngineTxPreVerifier, make_sig_tx
+
+    N.sha256_batch([b"warm"])  # build/load the native hash plane once
+
+    def mk_pool(client, flood, **kw):
+        return TxMempool(
+            client, size=flood + flood // 4, cache_size=2 * flood + 1000, **kw
+        )
+
+    def outcome_sig(o):
+        if isinstance(o, Exception):
+            return type(o).__name__
+        return ("ok", o.code)
+
+    # -- equivalence gate: batched == sequential on a mixed flood
+    mixed = [b"m%d=%d" % (i, i) for i in range(64)]
+    mixed[10] = mixed[3]          # intra-batch duplicate
+    mixed.insert(20, b"x" * 2048)  # oversize (max_tx_bytes below)
+    seq_pool = TxMempool(LocalClient(KVStoreApplication()), size=40, max_tx_bytes=1024)
+    bat_pool = TxMempool(LocalClient(KVStoreApplication()), size=40, max_tx_bytes=1024)
+    seq_out = []
+    for tx in mixed:
+        try:
+            seq_out.append(seq_pool.check_tx(tx))
+        except Exception as e:  # noqa: BLE001
+            seq_out.append(e)
+    bat_out = bat_pool.check_tx_batch(mixed)
+    assert [outcome_sig(o) for o in seq_out] == [outcome_sig(o) for o in bat_out], \
+        "batched admission diverged from sequential outcomes"
+    assert seq_pool.reap_max_txs(-1) == bat_pool.reap_max_txs(-1)
+    _log("mempool equivalence gate: batched == sequential (65-tx mixed flood)")
+
+    # -- external socket app (the production external-app transport)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.abci.socket", "--addr", "tcp://127.0.0.1:0"],
+        cwd=_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    sock_cli = None
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"tcp://[\d.]+:\d+", line)
+        if m:
+            sock_cli = SocketClient(m.group(0))
+            sock_cli.start()
+        else:
+            _log(f"mempool stage: external app failed to start ({line!r}); socket modes skipped")
+
+        last = {}
+        for flood in floods:
+            txs = [b"f%d-%d=%d" % (flood, i, i) for i in range(flood)]
+            per_tx_sample = txs[: min(3000, flood)]
+            transports = [("local", lambda: LocalClient(KVStoreApplication()))]
+            if sock_cli is not None:
+                transports.append(("socket", lambda: sock_cli))
+            for tname, mk_client in transports:
+                # per-tx baseline (seed path), measured on a sample —
+                # the rate is per-tx constant and the full 50k loop
+                # would burn a minute of budget per transport
+                pool = mk_pool(mk_client(), flood)
+                t0 = time.perf_counter()
+                for tx in per_tx_sample:
+                    pool.check_tx(tx)
+                per_tx_rate = len(per_tx_sample) / (time.perf_counter() - t0)
+
+                pool = mk_pool(mk_client(), flood)
+                t0 = time.perf_counter()
+                out = pool.check_tx_batch(txs)
+                batched_rate = flood / (time.perf_counter() - t0)
+                ok = sum(1 for o in out if not isinstance(o, Exception) and o.is_ok)
+                assert ok == flood, f"flood admitted {ok}/{flood}"
+                ratio = batched_rate / per_tx_rate
+                _log(
+                    f"mempool flood {flood} [{tname}]: per-tx {per_tx_rate:,.0f} tx/s, "
+                    f"batched {batched_rate:,.0f} tx/s ({ratio:.1f}x)"
+                )
+                last[tname] = (flood, batched_rate, ratio)
+                print(
+                    json.dumps(
+                        {
+                            "metric": "admitted_tx_per_sec",
+                            "value": round(batched_rate, 1),
+                            "unit": f"tx/sec admitted ({tname} transport, {flood}-tx flood)",
+                            "vs_baseline": round(ratio, 3),
+                            "flood": flood,
+                            "mode": f"batched_{tname}",
+                            "per_tx_baseline": round(per_tx_rate, 1),
+                        }
+                    ),
+                    flush=True,
+                )
+    finally:
+        if sock_cli is not None:
+            sock_cli.stop()
+        proc.terminate()
+
+    # -- engine-routed signed flood (pre-verification hook): batched
+    # admission submits ONE coalesced engine batch; the per-tx path
+    # verifies one signature per admission. 1024 txs keeps the
+    # pure-Python signing prep (~2.5ms/sig) off the critical budget.
+    n_signed = 1024
+    signed = [make_sig_tx(b"\x42" * 32, b"s%d=%d" % (i, i)) for i in range(n_signed)]
+    # warm the engine outside the timed region (first submit pays the
+    # one-shot accelerator probe's jax import + worker thread startup)
+    EngineTxPreVerifier()([signed[0]])
+    rates = {}
+    for mode, env_val in (("engine_on", "auto"), ("engine_off", "off")):
+        prior = os.environ.get("TM_TPU_ENGINE")
+        os.environ["TM_TPU_ENGINE"] = env_val
+        try:
+            pool = mk_pool(
+                LocalClient(KVStoreApplication()), n_signed,
+                pre_verify=EngineTxPreVerifier(),
+            )
+            t0 = time.perf_counter()
+            out = pool.check_tx_batch(signed)
+            rates[f"batched_{mode}"] = n_signed / (time.perf_counter() - t0)
+            assert all(not isinstance(o, Exception) and o.is_ok for o in out)
+            pool = mk_pool(
+                LocalClient(KVStoreApplication()), n_signed,
+                pre_verify=EngineTxPreVerifier(),
+            )
+            sample = signed[:256]
+            t0 = time.perf_counter()
+            for tx in sample:
+                pool.check_tx(tx)
+            rates[f"per_tx_{mode}"] = len(sample) / (time.perf_counter() - t0)
+        finally:
+            if prior is None:
+                os.environ.pop("TM_TPU_ENGINE", None)
+            else:
+                os.environ["TM_TPU_ENGINE"] = prior
+    _log(
+        "mempool signed flood (1024 sig-txs): "
+        + ", ".join(f"{k} {v:,.0f} tx/s" for k, v in sorted(rates.items()))
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "admitted_tx_per_sec",
+                "value": round(rates["batched_engine_on"], 1),
+                "unit": "tx/sec admitted (signed flood, engine-coalesced pre-verify)",
+                "vs_baseline": round(
+                    rates["batched_engine_on"] / rates["per_tx_engine_off"], 3
+                ),
+                "flood": n_signed,
+                "mode": "batched_engine_on",
+                "per_tx_baseline": round(rates["per_tx_engine_off"], 1),
+            }
+        ),
+        flush=True,
+    )
+    return last
+
+
 def bench_fastsync(chain):
     """Sequential verify_commit_light over the prebuilt chain — the
     per-block work of blocksync replay (reactor.go:582) on the device
@@ -441,6 +619,11 @@ def bench_fastsync(chain):
 
 def main():
     global BATCHES, PIPELINE_ITERS
+    if len(sys.argv) > 1 and sys.argv[1] == "mempool":
+        # targeted device-free run: `python bench.py mempool`
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        bench_mempool()
+        sys.exit(0)
     from tendermint_tpu import trace as _tmtrace
 
     if os.environ.get("BENCH_TRACE", "").strip().lower() in ("1", "on", "true", "yes"):
@@ -476,6 +659,18 @@ def main():
             _log("hash stage hit deadline; continuing")
         except Exception as e:  # noqa: BLE001
             _log(f"hash stage failed: {type(e).__name__}: {e}")
+    # Stage 1.6 (no device): the coalesced tx-admission pipeline —
+    # device-free like the hash stage; failures never sink the run.
+    if os.environ.get("BENCH_MEMPOOL", "on") != "off":
+        try:
+            with stage_deadline(min(max(_remaining() - 60, 20), 150)):
+                bench_mempool()
+            _save_stage_trace("mempool")
+        except StageTimeout:
+            _log("mempool stage hit deadline; continuing")
+        except Exception as e:  # noqa: BLE001
+            _log(f"mempool stage failed: {type(e).__name__}: {e}")
+
     # trace-time host constants (fixed-base comb tables, ~2s of Python
     # scalar mults) the kernels need — pay before the device claim
     from tendermint_tpu.ops import curve as _curve

@@ -22,6 +22,19 @@ class Client:
     def info(self, req: abci.RequestInfo) -> abci.ResponseInfo: ...
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery: ...
     def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx: ...
+
+    def check_tx_batch(
+        self, reqs: list[abci.RequestCheckTx]
+    ) -> list[abci.ResponseCheckTx]:
+        """Run a batch of CheckTx calls, responses in request order.
+
+        The base implementation is a plain loop (any Client works);
+        transports override it where batching genuinely pays:
+        LocalClient takes the app mutex once for the whole batch,
+        SocketClient pipelines all N requests on the wire before
+        collecting the N responses (socket_client.go's reqQueue shape),
+        turning N round-trip latencies into one."""
+        return [self.check_tx(r) for r in reqs]
     def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain: ...
     def prepare_proposal(self, req: abci.RequestPrepareProposal) -> abci.ResponsePrepareProposal: ...
     def process_proposal(self, req: abci.RequestProcessProposal) -> abci.ResponseProcessProposal: ...
@@ -62,6 +75,21 @@ class LocalClient(Client):
 
     def check_tx(self, req):
         return self._call(self._app.check_tx, req)
+
+    # Mutex-hold granularity for batched CheckTx: large enough that a
+    # flood stops paying a lock handoff per tx, small enough that a
+    # consensus-critical call (finalize_block/commit on the shared
+    # client) waits at most this many CheckTx executions — the
+    # sequential path bounded that wait at ONE.
+    CHECK_TX_BATCH_STRIDE = 64
+
+    def check_tx_batch(self, reqs):
+        out = []
+        stride = self.CHECK_TX_BATCH_STRIDE
+        for lo in range(0, len(reqs), stride):
+            with self._mu:
+                out.extend(self._app.check_tx(r) for r in reqs[lo : lo + stride])
+        return out
 
     def init_chain(self, req):
         return self._call(self._app.init_chain, req)

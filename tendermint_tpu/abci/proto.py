@@ -866,3 +866,148 @@ def response_from_pb(pb: ResponsePB):
             consensus_param_updates=p.consensus_param_updates,
             app_hash=p.app_hash or b"")
     raise ValueError(f"empty or unknown response oneof: {kind}")
+
+
+# ---------------------------------------------------- CheckTx fast path
+#
+# CheckTx is the one ABCI message a tx flood sends tens of thousands of
+# times per second; the generic reflection-driven Message codec above
+# costs ~25us per encode/decode of even this 2-field message, which
+# dominates the pipelined socket transport's per-tx budget. These
+# hand-rolled encoders/decoders emit the exact same bytes (same field
+# numbers, same varint wire types) and are used by both the socket
+# client and server whenever the frame IS a CheckTx; anything else
+# falls back to the generic path. Round-trip equality with the generic
+# codec is pinned by tests/test_abci_socket.py.
+
+from ..utils.varint import encode_uvarint as _fp_uvarint  # noqa: E402
+from ..utils.varint import read_uvarint as _fp_read_uvarint  # noqa: E402
+
+_CHECK_TX_REQ_TAG = 0x3A   # RequestPB field 7, wire type 2
+_CHECK_TX_RESP_TAG = 0x42  # ResponsePB field 8, wire type 2
+
+
+def _fp_i64(v: int) -> int:
+    """Interpret an unsigned varint as a signed int64."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def encode_check_tx_request(req) -> bytes:
+    """RequestPB(check_tx=...).encode(), hand-rolled (proto3 default
+    skipping: empty tx / zero type are omitted, like the generic
+    encoder)."""
+    inner = b""
+    if req.tx:
+        inner = b"\x0a" + _fp_uvarint(len(req.tx)) + req.tx
+    if req.type:
+        inner += b"\x10" + _fp_uvarint(req.type)
+    return b"\x3a" + _fp_uvarint(len(inner)) + inner
+
+
+def encode_check_tx_response(res) -> bytes:
+    """ResponsePB(check_tx=...).encode(), hand-rolled (the proto3
+    default-skipping rules the generic encoder applies: zero/empty
+    fields are omitted)."""
+    inner = b""
+    if res.code:
+        inner += b"\x08" + _fp_uvarint(res.code)
+    if res.data:
+        inner += b"\x12" + _fp_uvarint(len(res.data)) + res.data
+    if res.gas_wanted:
+        inner += b"\x28" + _fp_uvarint(res.gas_wanted & 0xFFFFFFFFFFFFFFFF)
+    if res.codespace:
+        b = res.codespace.encode()
+        inner += b"\x42" + _fp_uvarint(len(b)) + b
+    if res.sender:
+        b = res.sender.encode()
+        inner += b"\x4a" + _fp_uvarint(len(b)) + b
+    if res.priority:
+        inner += b"\x50" + _fp_uvarint(res.priority & 0xFFFFFFFFFFFFFFFF)
+    return b"\x42" + _fp_uvarint(len(inner)) + inner
+
+
+def try_decode_check_tx_request(body: bytes):
+    """body -> RequestCheckTx, or None when the frame is not a plain
+    CheckTx request (caller falls back to the generic decoder)."""
+    if not body or body[0] != _CHECK_TX_REQ_TAG:
+        return None
+    try:
+        size, pos = _fp_read_uvarint(body, 1)
+        if pos + size != len(body):
+            return None  # trailing fields: not a pure check_tx oneof
+        end = pos + size
+        tx = b""
+        typ = 0
+        while pos < end:
+            tag = body[pos]
+            pos += 1
+            if tag == 0x0A:
+                ln, pos = _fp_read_uvarint(body, pos)
+                if pos + ln > end:
+                    return None  # truncated field: let the generic decoder raise
+                tx = body[pos : pos + ln]
+                pos += ln
+            elif tag == 0x10:
+                typ, pos = _fp_read_uvarint(body, pos)
+            else:
+                return None
+        if pos != end:
+            return None
+        return T.RequestCheckTx(tx=tx, type=typ)
+    except (IndexError, ValueError):
+        return None
+
+
+def try_decode_check_tx_response(body: bytes):
+    """body -> ResponseCheckTx, or None when the frame is not a plain
+    CheckTx response (exception frames and every other oneof arm fall
+    back to the generic decoder, which raises ABCIRemoteError etc.)."""
+    if not body or body[0] != _CHECK_TX_RESP_TAG:
+        return None
+    try:
+        size, pos = _fp_read_uvarint(body, 1)
+        if pos + size != len(body):
+            return None
+        end = pos + size
+        code = gas_wanted = priority = 0
+        data = b""
+        codespace = sender = ""
+        while pos < end:
+            tag = body[pos]
+            pos += 1
+            if tag == 0x08:
+                code, pos = _fp_read_uvarint(body, pos)
+            elif tag == 0x12:
+                ln, pos = _fp_read_uvarint(body, pos)
+                if pos + ln > end:
+                    return None  # truncated field: let the generic decoder raise
+                data = body[pos : pos + ln]
+                pos += ln
+            elif tag == 0x28:
+                v, pos = _fp_read_uvarint(body, pos)
+                gas_wanted = _fp_i64(v)
+            elif tag == 0x42:
+                ln, pos = _fp_read_uvarint(body, pos)
+                if pos + ln > end:
+                    return None
+                codespace = body[pos : pos + ln].decode()
+                pos += ln
+            elif tag == 0x4A:
+                ln, pos = _fp_read_uvarint(body, pos)
+                if pos + ln > end:
+                    return None
+                sender = body[pos : pos + ln].decode()
+                pos += ln
+            elif tag == 0x50:
+                v, pos = _fp_read_uvarint(body, pos)
+                priority = _fp_i64(v)
+            else:
+                return None
+        if pos != end:
+            return None
+        return T.ResponseCheckTx(
+            code=code, data=data, gas_wanted=gas_wanted,
+            codespace=codespace, sender=sender, priority=priority,
+        )
+    except (IndexError, ValueError):
+        return None

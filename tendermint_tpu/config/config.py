@@ -105,6 +105,11 @@ class MempoolConfig:
     # 0 disables; otherwise txs expire after this many seconds / blocks.
     ttl_duration: float = 0.0
     ttl_num_blocks: int = 0
+    # Opt-in engine-routed tx signature pre-verification
+    # (mempool/preverify.py): admission batch-verifies signed-tx
+    # envelopes through ops/engine before the app's CheckTx. Off by
+    # default — kvstore txs are unsigned. No reference analog.
+    precheck_sigs: bool = False
 
 
 @dataclass

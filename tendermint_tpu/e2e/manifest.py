@@ -77,6 +77,10 @@ class Manifest:
     chain_id: str = "e2e-chain"
     nodes: list[NodeManifest] = field(default_factory=list)
     load_tx_rate: int = 10  # txs/sec injected during the run
+    # burst flood size for Runner.inject_flood (0 = no flood): txs
+    # submitted as fast as broadcast_tx_async accepts them, exercising
+    # the coalesced admission pipeline + batched gossip under load
+    flood_txs: int = 0
     initial_height: int = 1
     # validator key type for the whole testnet: ed25519 | sr25519 |
     # secp256k1 (ref: manifest.go KeyType)
@@ -107,6 +111,7 @@ class Manifest:
         m = cls(
             chain_id=doc.get("chain_id", "e2e-chain"),
             load_tx_rate=int(doc.get("load_tx_rate", 10)),
+            flood_txs=int(doc.get("flood_txs", 0)),
             initial_height=int(doc.get("initial_height", 1)),
             key_type=doc.get("key_type", "ed25519"),
             snapshot_interval=int(doc.get("snapshot_interval", 0)),

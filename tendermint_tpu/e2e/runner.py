@@ -392,6 +392,45 @@ class Runner:
             time.sleep(interval)
         return sent
 
+    def inject_flood(
+        self, n_txs: int = 0, batch: int = 200, timeout: float = 300.0
+    ) -> list[bytes]:
+        """Burst-flood kvstore txs through broadcast_tx_async — the
+        bounded admission queue draining into check_tx_batch — as fast
+        as the RPC accepts them, round-robin across nodes (vs
+        inject_load's paced one-tx-per-interval drip). Backpressure
+        (code 1, admission queue full) retries the tx after a short
+        pause instead of dropping it; the deadline bounds the whole
+        flood so dead RPC endpoints fail the run loudly instead of
+        hanging it. Returns the tx bytes submitted."""
+        n_txs = n_txs or self.manifest.flood_txs
+        targets = self._rpc_nodes()
+        sent: list[bytes] = []
+        i = 0
+        deadline = time.monotonic() + timeout
+        while len(sent) < n_txs:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"flood stalled: {len(sent)}/{n_txs} txs submitted in {timeout}s"
+                )
+            node = targets[i % len(targets)]
+            i += 1
+            for _ in range(batch):
+                if len(sent) >= n_txs:
+                    break
+                tx = f"flood-{os.getpid()}-{len(sent)}={len(sent)}".encode()
+                try:
+                    res = node.client().call("broadcast_tx_async", tx=tx.hex())
+                except Exception:
+                    time.sleep(0.1)
+                    continue
+                if int(res.get("code", 0)) == 0:
+                    sent.append(tx)
+                else:
+                    time.sleep(0.05)  # queue full: let the worker drain
+        self.log(f"flooded {len(sent)} txs via broadcast_tx_async")
+        return sent
+
     def apply_validator_updates(self, timeout: float = 90.0) -> None:
         """Apply the manifest's validator_update schedule: at each
         listed height, submit the kvstore's val-change tx for the named
@@ -813,6 +852,8 @@ def run_manifest(manifest_path: str, base_dir: str, duration: float = 10.0) -> d
 
         load_thread = threading.Thread(target=runner.inject_load, args=(duration,), daemon=True)
         load_thread.start()
+        if manifest.flood_txs:
+            runner.inject_flood()
         runner.apply_validator_updates()
         runner.run_perturbations()
         load_thread.join(timeout=duration + 10)

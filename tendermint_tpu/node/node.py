@@ -315,6 +315,11 @@ class Node:
             )
 
         # ---- pools + executor (node/setup.go:142,177; node/node.go:276)
+        pre_verify = None
+        if config.mempool.precheck_sigs:
+            from ..mempool.preverify import EngineTxPreVerifier
+
+            pre_verify = EngineTxPreVerifier()
         self.mempool = TxMempool(
             self.app_client,
             size=config.mempool.size,
@@ -328,6 +333,7 @@ class Node:
             # PostCheckMaxGas analog (node.go wires it from consensus
             # params); refreshed after each commit in BlockExecutor
             max_gas=state.consensus_params.block.max_gas,
+            pre_verify=pre_verify,
         )
         self.evidence_pool = EvidencePool(
             _make_db(config, "evidence"), self.state_store, self.block_store,

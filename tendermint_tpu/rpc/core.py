@@ -8,7 +8,6 @@ base64, heights as strings.
 from __future__ import annotations
 
 import base64
-import threading
 import time as _time
 
 from ..abci import types as abci
@@ -574,17 +573,34 @@ def build_routes(env: RPCEnvironment) -> dict:
 
     # ------------------------------------------------------------- txs
 
-    def broadcast_tx_async(tx=None):
-        """Fire-and-forget CheckTx; returns immediately."""
-        raw = _as_bytes_hex(tx, "tx")
-        threading.Thread(target=lambda: _check_tx_quiet(raw), daemon=True).start()
-        return {"code": 0, "data": "", "log": "", "hash": _hex(tx_hash(raw))}
+    # Fire-and-forget admissions drain through ONE bounded queue and
+    # worker (mempool.AsyncBatchAdmitter -> check_tx_batch): a flood of
+    # async submissions coalesces into pipelined CheckTx batches with
+    # backpressure, instead of spawning an unbounded daemon thread per
+    # request. Created lazily so route construction stays side-effect
+    # free for nodes that never see async traffic.
+    _admitter: list = []
 
-    def _check_tx_quiet(raw):
-        try:
-            env.mempool.check_tx(raw, sender="")
-        except Exception:
-            pass
+    def _get_admitter():
+        if not _admitter:
+            from ..mempool.mempool import AsyncBatchAdmitter
+
+            _admitter.append(AsyncBatchAdmitter(env.mempool))
+        return _admitter[0]
+
+    def broadcast_tx_async(tx=None):
+        """Fire-and-forget CheckTx; returns immediately. Queue-full is
+        surfaced as a nonzero code (backpressure, like the reference's
+        mempool-full CheckTx error) rather than silently dropped."""
+        raw = _as_bytes_hex(tx, "tx")
+        if not _get_admitter().submit(raw):
+            return {
+                "code": 1,
+                "data": "",
+                "log": "async admission queue full",
+                "hash": _hex(tx_hash(raw)),
+            }
+        return {"code": 0, "data": "", "log": "", "hash": _hex(tx_hash(raw))}
 
     def broadcast_tx_sync(tx=None):
         """Run CheckTx, return its result (alias: broadcast_tx)."""

@@ -29,7 +29,8 @@ state.finalize_block / state.abci_commit, verify.commit_dispatch /
 verify.commit_collect / verify.direct_host, blocksync.verify_commit /
 blocksync.apply, engine.submit / engine.coalesce / engine.dispatch /
 engine.host_verify / engine.collect, ops.verify_dispatch /
-ops.msm_dispatch / ops.pk_cache_fill, sharded.verify.
+ops.msm_dispatch / ops.pk_cache_fill, sharded.verify,
+mempool.admit_batch (coalesced tx admission: n/admitted/failed).
 """
 
 from __future__ import annotations

@@ -21,8 +21,10 @@ from contextlib import contextmanager
 # tm-engine-*: the process-wide verification engine's dispatch/collect
 # workers (ops/engine.py) — started lazily on first batch verify and
 # alive for the remainder of the process by design.
+# mempool-admit: the async-RPC admission queue worker
+# (mempool.AsyncBatchAdmitter) — lazy daemon, process lifetime.
 _ALLOWED_PREFIXES = (
-    "pydev", "ThreadPoolExecutor", "asyncio_", "tm-engine",
+    "pydev", "ThreadPoolExecutor", "asyncio_", "tm-engine", "mempool-admit",
 )
 
 

@@ -232,3 +232,90 @@ def test_node_with_external_app_process(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+# --------------------------------------------- CheckTx wire fast path
+
+
+def test_check_tx_fast_codec_byte_identical_to_generic():
+    """The hand-rolled CheckTx encoders/decoders (the flood hot path)
+    must emit the generic reflection codec's exact bytes and decode
+    its output exactly — including proto3 default skipping, negative
+    int64s, and fall-through on non-CheckTx frames."""
+    reqs = [
+        abci.RequestCheckTx(tx=b"", type=0),
+        abci.RequestCheckTx(tx=b"k=v", type=0),
+        abci.RequestCheckTx(tx=b"x" * 5000, type=1),
+    ]
+    for req in reqs:
+        fast = apb.encode_check_tx_request(req)
+        generic = apb.request_to_pb("check_tx", req).encode()
+        assert fast == generic
+        assert apb.try_decode_check_tx_request(generic) == req
+    resps = [
+        abci.ResponseCheckTx(),
+        abci.ResponseCheckTx(code=3, data=b"d", gas_wanted=77, codespace="cs",
+                             sender="s", priority=12),
+        abci.ResponseCheckTx(gas_wanted=-1, priority=-5),
+    ]
+    for res in resps:
+        fast = apb.encode_check_tx_response(res)
+        generic = apb.response_to_pb("check_tx", res).encode()
+        assert fast == generic
+        assert apb.try_decode_check_tx_response(generic) == res
+    # non-CheckTx frames fall through to the generic decoder
+    assert apb.try_decode_check_tx_request(
+        apb.request_to_pb("echo", "hi").encode()) is None
+    assert apb.try_decode_check_tx_response(
+        apb.ResponsePB(exception=apb.ResponseExceptionPB(error="x")).encode()) is None
+    # corrupt frames (inner length overrunning the frame) must NOT be
+    # silently truncated — fall through so the generic decoder raises
+    good_req = apb.encode_check_tx_request(abci.RequestCheckTx(tx=b"abcdef"))
+    assert apb.try_decode_check_tx_request(good_req[:-2]) is None
+    good_res = apb.encode_check_tx_response(abci.ResponseCheckTx(data=b"abcdef"))
+    assert apb.try_decode_check_tx_response(good_res[:-2]) is None
+    # consistent outer size but inner field length overruns the frame
+    evil = b"\x3a\x05" + b"\x0a\x0a" + b"abc"  # tx declares 10 bytes, has 3
+    assert apb.try_decode_check_tx_request(evil) is None
+
+
+def test_socket_check_tx_batch_pipelined(socket_pair):
+    """check_tx_batch pipelines N requests (one write burst, FIFO
+    response matching) and returns responses in request order,
+    identical to N sequential calls."""
+    _, _, client = socket_pair
+    reqs = [abci.RequestCheckTx(tx=b"b%d=%d" % (i, i), type=0) for i in range(300)]
+    batched = client.check_tx_batch(reqs)
+    sequential = [client.check_tx(r) for r in reqs]
+    assert batched == sequential
+    assert all(r.is_ok for r in batched)
+    # interleaves safely with other traffic on the same connection
+    assert client.echo("after-batch") == "after-batch"
+
+
+def test_socket_check_tx_batch_remote_error(socket_pair):
+    """An app exception inside a pipelined batch fails that request
+    with ABCIRemoteError and leaves the connection usable."""
+    app, _, client = socket_pair
+    orig = app.check_tx
+
+    def flaky(req):
+        if req.tx == b"boom":
+            raise RuntimeError("checktx exploded")
+        return orig(req)
+
+    app.check_tx = flaky
+    try:
+        reqs = [abci.RequestCheckTx(tx=t) for t in (b"ok1", b"boom", b"ok2")]
+        slots = client._submit_batch("check_tx", reqs)
+        results = []
+        for s in slots:
+            try:
+                results.append(client._await("check_tx", s))
+            except apb.ABCIRemoteError as e:
+                results.append(e)
+        assert results[0].is_ok and results[2].is_ok
+        assert isinstance(results[1], apb.ABCIRemoteError)
+        assert client.echo("alive") == "alive"
+    finally:
+        app.check_tx = orig

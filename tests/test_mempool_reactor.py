@@ -52,3 +52,117 @@ def test_tx_gossips_across_three_nodes():
         for _, _, r, reactor, _ in nodes:
             reactor.stop()
             r.stop()
+
+
+# ------------------------------------------------------ multi-tx frames
+
+
+def test_txs_frame_roundtrip():
+    from tendermint_tpu.mempool.reactor import (
+        TXS_FRAME_MAGIC,
+        decode_txs_frame,
+        encode_txs_frame,
+    )
+
+    for txs in ([b"a=1"], [b"a=1", b"b=2", b""], [b"x" * 1000] * 50, []):
+        frame = encode_txs_frame(txs)
+        assert frame.startswith(TXS_FRAME_MAGIC)
+        assert decode_txs_frame(frame) == txs
+
+
+def test_txs_frame_legacy_single_tx_interop():
+    """A frame without the magic is the legacy one-tx-per-frame wire
+    format and must decode to that single tx, byte-identical."""
+    from tendermint_tpu.mempool.reactor import decode_txs_frame
+
+    for legacy in (b"key=value", b"\x00\x01\x02", b"="):
+        assert decode_txs_frame(legacy) == [legacy]
+    # bytearray (wire buffers) normalizes to bytes
+    assert decode_txs_frame(bytearray(b"k=v")) == [b"k=v"]
+
+
+def test_txs_frame_truncated_raises():
+    import pytest
+
+    from tendermint_tpu.mempool.reactor import decode_txs_frame, encode_txs_frame
+
+    frame = encode_txs_frame([b"aaaa", b"bbbb"])
+    with pytest.raises(ValueError):
+        decode_txs_frame(frame[:-2])
+    with pytest.raises(ValueError):
+        decode_txs_frame(frame + b"junk")
+
+
+def test_channel_codec_encodes_lists_and_legacy_bytes():
+    desc = mempool_channel_descriptor()
+    from tendermint_tpu.mempool.reactor import TXS_FRAME_MAGIC
+
+    wire = desc.encode([b"a=1", b"b=2"])
+    assert wire.startswith(TXS_FRAME_MAGIC)
+    assert desc.decode(wire) == [b"a=1", b"b=2"]
+    # legacy passthrough both ways
+    assert desc.encode(b"raw-tx") == b"raw-tx"
+    assert desc.decode(b"raw-tx") == [b"raw-tx"]
+
+
+def test_batch_gossips_in_multi_tx_frames():
+    """A burst admitted via check_tx_batch at node a reaches node c
+    through b — whole batches, condition-driven (no 20ms sweep)."""
+    net = MemoryNetwork()
+    nodes = [_mk(net, s) for s in (0x81, 0x82, 0x83)]
+    try:
+        for (a, b) in [(0, 1), (1, 2)]:
+            nodes[a][1].add(Endpoint(protocol="memory", host=nodes[b][0], node_id=nodes[b][0]))
+        assert wait_until(lambda: all(len(n[1].peers()) >= 1 for n in nodes))
+        txs = [b"burst-%d=%d" % (i, i) for i in range(40)]
+        out = nodes[0][4].check_tx_batch(txs)
+        assert all(o.is_ok for o in out)
+        assert wait_until(lambda: nodes[2][4].size() == len(txs), timeout=15), (
+            f"sizes: {[n[4].size() for n in nodes]}"
+        )
+        for tx in txs:
+            assert nodes[2][4].get_tx(tx_key(tx)) == tx
+    finally:
+        for _, _, r, reactor, _ in nodes:
+            reactor.stop()
+            r.stop()
+
+
+def test_txs_frame_decode_caps_tx_count():
+    """Receive-side DoS guard: a frame declaring more txs than
+    MAX_DECODE_TXS is a protocol fault, not an unbounded batch."""
+    import pytest
+
+    from tendermint_tpu.mempool.reactor import (
+        MAX_DECODE_TXS,
+        TXS_FRAME_MAGIC,
+        decode_txs_frame,
+    )
+    from tendermint_tpu.utils.varint import encode_uvarint
+
+    evil = TXS_FRAME_MAGIC + encode_uvarint(MAX_DECODE_TXS + 1)
+    with pytest.raises(ValueError, match="max"):
+        decode_txs_frame(evil)
+
+
+def test_channel_decoder_never_raises():
+    """The router runs the channel decoder before the reactor sees the
+    envelope; an exception there would tear down the whole multiplexed
+    peer connection. Malformed frames must decode to the in-band
+    MalformedTxsFrame marker instead."""
+    from tendermint_tpu.mempool.reactor import (
+        MalformedTxsFrame,
+        TXS_FRAME_MAGIC,
+        encode_txs_frame,
+    )
+    from tendermint_tpu.utils.varint import encode_uvarint
+
+    desc = mempool_channel_descriptor()
+    for bad in (
+        encode_txs_frame([b"aaaa", b"bbbb"])[:-2],      # truncated
+        TXS_FRAME_MAGIC + encode_uvarint(1 << 30),      # absurd count
+        TXS_FRAME_MAGIC,                                 # missing count
+    ):
+        out = desc.decode(bad)
+        assert isinstance(out, MalformedTxsFrame), bad
+    assert desc.decode(encode_txs_frame([b"ok"])) == [b"ok"]
