@@ -77,6 +77,58 @@ def _log(msg):
     print(f"# [{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+def _write_bench_report() -> None:
+    """Persist a tmlens-style fleet report for THIS bench process:
+    dump the process-global registry (engine/hash/mempool telemetry the
+    stages populated) into a one-node artifact dir and run the analyzer
+    over it, so every bench run leaves the same fleet_report.json shape
+    an e2e run does (with latency quantiles estimated from the live
+    histograms). BENCH_REPORT=off disables; failures never sink the
+    banked numbers."""
+    if os.environ.get("BENCH_REPORT", "on") == "off":
+        return
+    try:
+        from tendermint_tpu.lens.prom import parse_exposition
+        from tendermint_tpu.metrics import global_registry
+
+        out_dir = os.environ.get("BENCH_REPORT_DIR", os.path.join(_ROOT, ".bench_runs"))
+        os.makedirs(out_dir, exist_ok=True)
+        text = global_registry().gather()
+        exp = parse_exposition(text)
+        hists = {}
+        for base in (
+            "tendermint_engine_queue_wait_seconds",
+            "tendermint_engine_launch_latency_seconds",
+            "tendermint_engine_collect_latency_seconds",
+            "tendermint_engine_coalesced_group_size",
+            "tendermint_hash_merkle_build_seconds",
+            "tendermint_mempool_admit_seconds",
+            "tendermint_mempool_admit_batch_size",
+        ):
+            h = exp.histogram(base)
+            if h is not None and h.count:
+                hists[base] = {
+                    "p50": h.quantile(0.5),
+                    "p99": h.quantile(0.99),
+                    "mean": h.mean(),
+                    "count": h.count,
+                }
+        report = {
+            "kind": "bench",
+            "elapsed_s": round(time.monotonic() - _T0, 1),
+            "series": len(exp.names()),
+            "histograms": hists,
+        }
+        path = os.path.join(out_dir, "fleet_report.json")
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
+            f.write(text)
+        _log(f"bench lens report: {path} ({len(hists)} histogram families)")
+    except Exception as e:  # noqa: BLE001 - reporting must not sink the run
+        _log(f"bench lens report failed: {type(e).__name__}: {e}")
+
+
 def _save_stage_trace(stage: str) -> None:
     """Flush the span ring into TRACE_DIR/<stage>.trace.json (Perfetto/
     chrome://tracing format) and clear it so the next stage's artifact
@@ -623,6 +675,7 @@ def main():
         # targeted device-free run: `python bench.py mempool`
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         bench_mempool()
+        _write_bench_report()
         sys.exit(0)
     from tendermint_tpu import trace as _tmtrace
 
@@ -902,6 +955,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             _log(f"coalesced stage failed: {type(e).__name__}: {e}")
 
+    _write_bench_report()
     if best:
         # Re-emit so the final stdout line is the best banked number
         # regardless of any later stderr interleaving in the driver's

@@ -1,0 +1,119 @@
+"""tmlens CLI — cross-node fleet analysis over an e2e run directory
+(docs/observability.md#tmlens).
+
+Usage:
+  python scripts/tmlens.py analyze <run-dir>
+      Parse every node's metrics.txt/trace.json, print the fleet
+      summary + gate results, and write <run-dir>/fleet_report.json.
+      When any node left a trace, also writes the clock-aligned
+      Perfetto fleet timeline to <run-dir>/fleet_trace.json.
+      Exit code: 0 = verdict pass, 1 = verdict fail, 2 = usage/IO.
+
+  --gates <json-or-path>
+      Gate threshold overrides: inline JSON ('{"max_height_spread": 2}')
+      or a path to a JSON file. Keys: tendermint_tpu/lens/gates.py
+      DEFAULT_GATES.
+
+  --merged-trace <path>
+      Write the merged fleet trace here instead of the default
+      <run-dir>/fleet_trace.json.
+
+  --report <path>
+      Write fleet_report.json here instead of inside the run dir.
+
+  --json
+      Print the full report JSON to stdout instead of the human
+      summary (the verdict exit code is unchanged).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tendermint_tpu.lens import (  # noqa: E402
+    REPORT_NAME,
+    analyze_run,
+    render_summary,
+    write_merged_trace,
+)
+
+
+def _load_gates(spec: str) -> dict:
+    if os.path.exists(spec):
+        with open(spec) as f:
+            return json.load(f)
+    return json.loads(spec)
+
+
+def main(argv) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    if argv[0] != "analyze":
+        print(f"unknown command {argv[0]!r} (try: analyze <run-dir>)", file=sys.stderr)
+        return 2
+    args = argv[1:]
+    run_dir = None
+    gates = None
+    merged_path = None
+    report_path = None
+    as_json = False
+    i = 0
+    try:
+        while i < len(args):
+            a = args[i]
+            if a == "--gates":
+                gates = _load_gates(args[i + 1])
+                i += 2
+            elif a == "--merged-trace":
+                merged_path = args[i + 1]
+                i += 2
+            elif a == "--report":
+                report_path = args[i + 1]
+                i += 2
+            elif a == "--json":
+                as_json = True
+                i += 1
+            elif a.startswith("-"):
+                print(f"unknown flag {a!r}", file=sys.stderr)
+                return 2
+            elif run_dir is None:
+                run_dir = a
+                i += 1
+            else:
+                print(f"unexpected argument {a!r}", file=sys.stderr)
+                return 2
+    except (IndexError, ValueError) as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    if run_dir is None or not os.path.isdir(run_dir):
+        print(f"not a run directory: {run_dir!r}", file=sys.stderr)
+        return 2
+
+    try:
+        report = analyze_run(run_dir, gates=gates)
+    except ValueError as e:  # unknown gate keys etc.
+        print(f"analysis failed: {e}", file=sys.stderr)
+        return 2
+    report_path = report_path or os.path.join(run_dir, REPORT_NAME)
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1)
+    merged = write_merged_trace(run_dir, merged_path)
+
+    if as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_summary(report))
+        print(f"  report: {report_path}")
+        print(f"  fleet trace: {merged}" if merged
+              else "  fleet trace: (no node left a trace.json — run with TM_TPU_TRACE=1)")
+    return 0 if report["verdict"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

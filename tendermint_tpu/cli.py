@@ -90,7 +90,14 @@ def cmd_start(args) -> int:
     """ref: commands/run_node.go:97 NewRunNodeCmd (seed mode dispatches
     to the pex-only seed node, node/seed.go)."""
     from .config import load_config
+    from .lens.profiler import maybe_start_profiler
     from .node import Node
+
+    # TM_TPU_PROF=1 (the e2e runner's env passthrough sets it fleet-
+    # wide): sample this process's stacks for the whole node lifetime
+    # and persist them next to the other observability artifacts at
+    # shutdown, so tmlens-flagged soak regressions come with a profile.
+    profiler = maybe_start_profiler()
 
     # Install fault-injection handlers BEFORE construction: the e2e
     # runner may deliver a `disconnect` SIGUSR1 while the node is still
@@ -134,6 +141,10 @@ def cmd_start(args) -> int:
             time.sleep(0.2)
     finally:
         node.stop()
+        if profiler is not None:
+            profiler.stop()
+            n = profiler.save(os.path.join(args.home, "profile.collapsed"))
+            print(f"wrote {n}-sample profile to {args.home}/profile.collapsed")
     return 0
 
 

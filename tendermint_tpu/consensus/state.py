@@ -914,6 +914,7 @@ class ConsensusState:
                         byz.add(v.address)
             m.byzantine_validators.set(len(byz))
             m.byzantine_validators_power.set(sum(power_by_addr.get(a, 0) for a in byz))
+            m.last_block_age.mark()
             m.mark_round()
         self.logger.info(
             "finalized block", height=height, hash=block_id.hash, txs=len(block.txs), round=rs.commit_round

@@ -80,6 +80,9 @@ class Runner:
         # then carried through a per-link proxy named "dialer->target"
         self.faultnet = None
         self.faultnet_registry = None
+        # tmlens verdict from the last analyze_artifacts() (cleanup
+        # runs it); slow e2e tests assert on this after cleanup
+        self.last_report: dict | None = None
 
     # ----------------------------------------------------------------- setup
 
@@ -559,6 +562,16 @@ class Runner:
     def perturb(self, node: E2ENode, kind: str) -> None:
         """ref: runner/perturb.go:40-72 (disconnect/kill/pause/restart)."""
         self.log(f"perturb {node.m.name}: {kind}")
+        if kind in ("kill", "restart"):
+            # The dying process takes its in-memory trace ring and
+            # /metrics state with it; snapshot them FIRST (suffixed so
+            # the final collection doesn't overwrite the evidence) —
+            # a run that aborts after this perturbation still leaves
+            # the victim's pre-death state for tmlens.
+            try:
+                self.collect_artifacts(nodes=[node], suffix=f".pre-{kind}")
+            except Exception as e:  # noqa: BLE001 - evidence only
+                self.log(f"pre-{kind} artifact snapshot failed for {node.m.name}: {e}")
         if kind == "kill":
             # node AND its out-of-process app are one failure domain —
             # the reference's kill is `docker kill` of the container
@@ -738,6 +751,20 @@ class Runner:
         deadline = time.monotonic() + timeout
         h0 = -1
         while time.monotonic() < deadline:
+            if node.proc is not None and node.proc.poll() is not None:
+                # The node DIED mid-scenario rather than stalling:
+                # grab evidence from the survivors NOW (their state at
+                # the moment of death, not after another 90s of
+                # drift), then fail fast — a dead process will never
+                # advance out this loop.
+                try:
+                    self.collect_artifacts(suffix=".on-death")
+                except Exception as e:  # noqa: BLE001 - evidence only
+                    self.log(f"on-death artifact sweep failed: {e}")
+                raise RuntimeError(
+                    f"{node.m.name} exited (rc={node.proc.returncode}) during "
+                    f"the scenario; survivor artifacts in *.on-death files"
+                )
             h = node.height()
             if h0 < 0 and h >= 0:
                 h0 = h
@@ -785,24 +812,29 @@ class Runner:
 
     # ----------------------------------------------------------------- stop
 
-    def collect_artifacts(self) -> None:
-        """Persist each live node's final observability state into its
-        home dir before teardown: the /metrics exposition text
-        (metrics.txt) and, when span tracing is active in the nodes
-        (TM_TPU_TRACE in the runner env propagates), the Chrome-trace
-        snapshot from the dump_traces RPC (trace.json). Best-effort —
-        perturbed/killed nodes simply contribute no artifact."""
+    def collect_artifacts(self, nodes=None, suffix: str = "") -> None:
+        """Persist each live node's observability state into its home
+        dir: the /metrics exposition text (metrics{suffix}.txt) and,
+        when span tracing is active in the nodes (TM_TPU_TRACE in the
+        runner env propagates), the Chrome-trace snapshot from the
+        dump_traces RPC (trace{suffix}.json). Best-effort — a node that
+        is already dead cannot be scraped and simply contributes no
+        artifact (its previous life may have left a .pre-* snapshot via
+        perturb()). Callable mid-run: `nodes` restricts the sweep,
+        `suffix` keeps a snapshot from being overwritten by the final
+        collection."""
         import urllib.request
 
-        for node in self.nodes:
+        for node in nodes if nodes is not None else self.nodes:
             if node.proc is None or node.proc.poll() is not None:
+                self.log(f"{node.m.name}: dead ({'never started' if node.proc is None else 'exited'}); no artifacts to collect")
                 continue
             if node.prom_port and node.m.mode != "seed":
                 try:
                     body = urllib.request.urlopen(
                         f"http://127.0.0.1:{node.prom_port}/metrics", timeout=5
                     ).read()
-                    with open(os.path.join(node.home, "metrics.txt"), "wb") as f:
+                    with open(os.path.join(node.home, f"metrics{suffix}.txt"), "wb") as f:
                         f.write(body)
                 except Exception as e:  # noqa: BLE001 - artifact only
                     self.log(f"metrics scrape failed for {node.m.name}: {e}")
@@ -810,10 +842,34 @@ class Runner:
                 try:
                     res = node.client().call("dump_traces")
                     if res.get("events"):
-                        with open(os.path.join(node.home, "trace.json"), "w") as f:
+                        with open(os.path.join(node.home, f"trace{suffix}.json"), "w") as f:
                             json.dump(res["trace"], f)
                 except Exception as e:  # noqa: BLE001 - artifact only
                     self.log(f"trace dump failed for {node.m.name}: {e}")
+
+    def analyze_artifacts(self, gates: dict | None = None):
+        """Run tmlens over the collected run directory: write
+        fleet_report.json (+ fleet_trace.json when any node left a
+        trace), log the human summary, and return the report. This is
+        the ROADMAP-4 gate: the slow e2e tests assert
+        `runner.last_report["verdict"]`. Never raises — a broken
+        analyzer must not mask the run's own failure in a finally
+        block."""
+        try:
+            from ..lens import REPORT_NAME, analyze_run, render_summary, write_merged_trace
+
+            report = analyze_run(self.base_dir, gates=gates)
+            with open(os.path.join(self.base_dir, REPORT_NAME), "w") as f:
+                json.dump(report, f, indent=1)
+            merged = write_merged_trace(self.base_dir)
+            if merged:
+                self.log(f"merged fleet trace: {merged}")
+            self.log(render_summary(report))
+            self.last_report = report
+            return report
+        except Exception as e:  # noqa: BLE001 - verdict is advisory here
+            self.log(f"tmlens analysis failed: {type(e).__name__}: {e}")
+            return None
 
     def cleanup(self) -> None:
         try:
@@ -836,6 +892,10 @@ class Runner:
                     proc.wait(timeout=max(0.1, deadline - time.monotonic()))
                 except subprocess.TimeoutExpired:
                     proc.kill()
+        # analysis runs AFTER the processes exit so profile.collapsed
+        # files (TM_TPU_PROF=1 nodes write them on shutdown) are on disk
+        if self.nodes and os.path.isdir(self.base_dir):
+            self.analyze_artifacts()
 
 
 def run_manifest(manifest_path: str, base_dir: str, duration: float = 10.0) -> dict:

@@ -1,0 +1,46 @@
+"""tmlens — cross-node fleet analysis over persisted observability
+artifacts.
+
+PR 4 made every node emit a /metrics exposition and a Chrome-trace span
+ring, and the e2e runner persists both per node; tmlens is the plane
+that READS them (ROADMAP item 4's gate): it merges per-node artifacts
+into one cross-node picture, renders a machine-checkable health
+verdict, and — via the sampling profiler — attaches a CPU profile to
+every run so a failed gate arrives with evidence, not just a red X.
+
+    prom.py      Prometheus exposition parser + histogram snapshots
+                 (quantiles via metrics.bucket_quantile)
+    traces.py    per-node Chrome-trace load, block-commit clock
+                 alignment, merged Perfetto fleet timeline
+    analyze.py   per-node + fleet summaries over a run directory
+    gates.py     declarative health gates -> pass/fail verdict
+    profiler.py  TM_TPU_PROF=1 collapsed-stack sampling profiler
+
+Entry points: `scripts/tmlens.py analyze <run-dir>` (CLI), and the e2e
+Runner which analyzes every run after artifact collection and writes
+`fleet_report.json` / `fleet_trace.json` into the run dir. Docs:
+docs/observability.md#tmlens.
+
+This package must stay importable without jax (and must never be
+imported by node-runtime modules): it runs on artifact-reading CI
+boxes and its import cost is pinned to ~zero by
+tests/test_lens.py::test_lens_never_touches_node_hot_path.
+"""
+
+from .analyze import (  # noqa: F401
+    FLEET_TRACE_NAME,
+    REPORT_NAME,
+    analyze_node,
+    analyze_run,
+    discover_nodes,
+    render_summary,
+    write_merged_trace,
+)
+from .gates import DEFAULT_GATES, evaluate  # noqa: F401
+from .profiler import (  # noqa: F401
+    SamplingProfiler,
+    maybe_start_profiler,
+    profiling_requested,
+)
+from .prom import Exposition, HistogramSnapshot, parse_exposition  # noqa: F401
+from .traces import align_offsets, commit_anchors, merge_traces  # noqa: F401

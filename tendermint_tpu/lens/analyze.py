@@ -1,0 +1,277 @@
+"""Fleet analyzer: per-node artifacts → one cross-node picture.
+
+A run directory is the e2e runner's base_dir: one subdirectory per
+node, each holding the artifacts the runner persisted (`metrics.txt`,
+optionally `trace.json` and `profile.collapsed`). The analyzer parses
+every node's exposition, estimates latency quantiles from histogram
+buckets, aligns per-node trace clocks on shared block-commit anchors,
+and emits:
+
+  - per-node summaries (p50/p99 consensus step/round durations, block
+    intervals, rounds-per-height, chain-head age, engine coalesce
+    factor, mempool admission rate, peer churn, send-queue backlog)
+  - a fleet summary (height spread, fleet-wide merged step p99, worst
+    chain-head age)
+  - optionally a merged Perfetto-loadable fleet trace
+
+`tendermint_tpu.lens.gates.evaluate` turns the report into a verdict;
+`scripts/tmlens.py` is the CLI; the e2e Runner calls `analyze_run`
+after artifact collection (docs/observability.md#tmlens).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .prom import Exposition, parse_exposition
+from .traces import load_trace_events, merge_traces
+
+__all__ = [
+    "discover_nodes",
+    "analyze_node",
+    "analyze_run",
+    "write_merged_trace",
+    "render_summary",
+    "REPORT_NAME",
+    "FLEET_TRACE_NAME",
+]
+
+NS = "tendermint"
+REPORT_NAME = "fleet_report.json"
+FLEET_TRACE_NAME = "fleet_trace.json"
+
+# Series every healthy node's scrape must carry; the missing-series
+# gate reads per-node `missing_series` from the summaries built here.
+REQUIRED_SERIES = (
+    f"{NS}_consensus_height",
+    f"{NS}_consensus_step_duration_seconds_bucket",
+    f"{NS}_consensus_last_block_age_seconds",
+)
+
+
+def discover_nodes(run_dir: str) -> list[tuple[str, str]]:
+    """[(node_name, node_dir)] — any subdirectory holding at least one
+    known artifact. Seeds (no /metrics) and unrelated entries simply
+    don't appear."""
+    out = []
+    for entry in sorted(os.listdir(run_dir)):
+        d = os.path.join(run_dir, entry)
+        if not os.path.isdir(d):
+            continue
+        if any(
+            os.path.exists(os.path.join(d, f))
+            for f in ("metrics.txt", "trace.json", "profile.collapsed")
+        ):
+            out.append((entry, d))
+    return out
+
+
+def _round(v, nd=6):
+    return None if v is None else round(v, nd)
+
+
+def _hist_stats(exp: Exposition, base: str, **labels) -> dict | None:
+    h = exp.histogram(base, **labels)
+    if h is None or not h.count:
+        return None
+    return {
+        "p50_s": _round(h.quantile(0.5)),
+        "p99_s": _round(h.quantile(0.99)),
+        "mean_s": _round(h.mean()),
+        "count": h.count,
+    }
+
+
+def _load_exposition(node_dir: str) -> Exposition | None:
+    mpath = os.path.join(node_dir, "metrics.txt")
+    if not os.path.exists(mpath):
+        return None
+    with open(mpath) as f:
+        return parse_exposition(f.read())
+
+
+def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -> dict:
+    """One node's summary from its persisted artifacts. `exp` lets the
+    fleet pass hand in an already-parsed exposition (analyze_run reads
+    each metrics.txt exactly once)."""
+    name = name or os.path.basename(node_dir.rstrip("/"))
+    summary: dict = {"name": name, "dir": node_dir, "artifacts": []}
+    tpath = os.path.join(node_dir, "trace.json")
+    ppath = os.path.join(node_dir, "profile.collapsed")
+    if os.path.exists(ppath):
+        summary["artifacts"].append("profile.collapsed")
+
+    if exp is None:
+        exp = _load_exposition(node_dir)
+    if exp is not None:
+        summary["artifacts"].append("metrics.txt")
+        summary["missing_series"] = sorted(
+            s for s in REQUIRED_SERIES if not exp.has(s)
+        )
+        height = exp.value(f"{NS}_consensus_height")
+        summary["height"] = int(height) if height is not None else None
+        summary["last_block_age_s"] = _round(
+            exp.value(f"{NS}_consensus_last_block_age_seconds"), 3
+        )
+        summary["step_duration"] = _hist_stats(
+            exp, f"{NS}_consensus_step_duration_seconds"
+        )
+        summary["step_p99_by_step"] = {
+            step: _round(
+                exp.histogram(f"{NS}_consensus_step_duration_seconds", step=step)
+                .quantile(0.99)
+            )
+            for step in sorted(
+                exp.label_values(f"{NS}_consensus_step_duration_seconds_bucket", "step")
+            )
+        }
+        summary["round_duration"] = _hist_stats(
+            exp, f"{NS}_consensus_round_duration_seconds"
+        )
+        summary["block_interval"] = _hist_stats(
+            exp, f"{NS}_consensus_block_interval_seconds"
+        )
+        rounds = exp.histogram(f"{NS}_consensus_round_duration_seconds")
+        if rounds is not None and rounds.count and summary["height"]:
+            summary["rounds_per_height"] = _round(rounds.count / summary["height"], 3)
+        eng = exp.histogram(f"{NS}_engine_coalesced_group_size")
+        summary["engine_coalesce_factor"] = _round(eng.mean(), 3) if eng else None
+        admit = exp.histogram(f"{NS}_mempool_admit_batch_size")
+        admit_t = exp.histogram(f"{NS}_mempool_admit_seconds")
+        summary["mempool"] = {
+            "admitted_txs": admit.sum if admit else 0.0,
+            "admit_batches": admit.count if admit else 0.0,
+            "admit_tx_per_sec": _round(admit.sum / admit_t.sum, 1)
+            if admit and admit_t and admit_t.sum
+            else None,
+        }
+        peers = exp.value(f"{NS}_p2p_peers")
+        connects = exp.total(f"{NS}_p2p_peer_connections_total")
+        summary["p2p"] = {
+            "peers": peers,
+            "connections_total": connects,
+            # reconnects beyond the steady-state peer count = churn the
+            # run accumulated (perturbations, evictions, flaps)
+            "churn": max(0.0, connects - peers) if peers is not None else connects,
+            "max_send_queue_depth": max(
+                (v for _l, v in exp.samples(f"{NS}_p2p_peer_send_queue_depth")),
+                default=None,
+            ),
+            "queue_dropped_msgs": exp.total(f"{NS}_p2p_peer_queue_dropped_msgs"),
+        }
+    else:
+        summary["missing_series"] = ["<no metrics.txt artifact>"]
+
+    if os.path.exists(tpath):
+        summary["artifacts"].append("trace.json")
+        try:
+            from .traces import commit_anchors
+
+            anchors = commit_anchors(load_trace_events(tpath))
+            summary["trace"] = {
+                "commit_anchors": len(anchors),
+                "anchor_heights": [min(anchors), max(anchors)] if anchors else [],
+            }
+        except (ValueError, KeyError, TypeError) as e:
+            summary["trace"] = {"error": f"{type(e).__name__}: {e}"}
+    return summary
+
+
+def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
+    """Analyze a whole run directory and attach the gate verdict.
+
+    Returns the report dict (also the shape written to
+    fleet_report.json): {run_dir, nodes: [...], fleet: {...},
+    gates: [...], verdict: "pass"|"fail"}."""
+    from .gates import evaluate
+
+    nodes = discover_nodes(run_dir)
+    exps = [_load_exposition(d) for _name, d in nodes]
+    summaries = [
+        analyze_node(d, name, exp=exp) for (name, d), exp in zip(nodes, exps)
+    ]
+
+    heights = [s["height"] for s in summaries if s.get("height") is not None]
+    ages = [s["last_block_age_s"] for s in summaries if s.get("last_block_age_s") is not None]
+    fleet: dict = {
+        "nodes": len(summaries),
+        "nodes_with_metrics": sum(1 for s in summaries if "height" in s),
+        "nodes_with_traces": sum(1 for s in summaries if "trace" in s),
+        "max_height": max(heights) if heights else None,
+        "min_height": min(heights) if heights else None,
+        "height_spread": (max(heights) - min(heights)) if heights else None,
+        "worst_last_block_age_s": max(ages) if ages else None,
+    }
+    # fleet-wide step p99: merge every node's (already step-merged)
+    # histogram — identical bucket layouts by construction
+    merged = None
+    for exp in exps:
+        h = exp.histogram(f"{NS}_consensus_step_duration_seconds") if exp else None
+        if h is None:
+            continue
+        try:
+            merged = h if merged is None else merged.merge(h)
+        except ValueError:
+            pass  # foreign bucket layout (mixed-version fleet): skip
+    fleet["step_p99_s"] = _round(merged.quantile(0.99)) if merged else None
+    fleet["step_p50_s"] = _round(merged.quantile(0.5)) if merged else None
+
+    report = {"run_dir": os.path.abspath(run_dir), "nodes": summaries, "fleet": fleet}
+    report["gates"], report["verdict"] = evaluate(report, gates)
+    return report
+
+
+def write_merged_trace(run_dir: str, out_path: str | None = None) -> str | None:
+    """Merge every node's trace.json onto one clock and write the fleet
+    trace. Returns the output path, or None when no node left a trace."""
+    node_events = []
+    for name, d in discover_nodes(run_dir):
+        tpath = os.path.join(d, "trace.json")
+        if os.path.exists(tpath):
+            try:
+                node_events.append((name, load_trace_events(tpath)))
+            except (ValueError, OSError):
+                continue
+    if not node_events:
+        return None
+    # reference node = the one with the most commit anchors (longest
+    # uninterrupted view of the chain)
+    from .traces import commit_anchors
+
+    ref = max(
+        range(len(node_events)),
+        key=lambda i: len(commit_anchors(node_events[i][1])),
+    )
+    doc, _offsets = merge_traces(node_events, ref=ref)
+    out_path = out_path or os.path.join(run_dir, FLEET_TRACE_NAME)
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    return out_path
+
+
+def render_summary(report: dict) -> str:
+    """Human-readable digest of a report (the CLI's stdout; also logged
+    by the e2e runner)."""
+    lines = [f"tmlens: {report['run_dir']}"]
+    f = report["fleet"]
+    lines.append(
+        f"  fleet: {f['nodes']} nodes, heights "
+        f"{f['min_height']}..{f['max_height']} (spread {f['height_spread']}), "
+        f"step p99 {f['step_p99_s']}s, worst head age {f['worst_last_block_age_s']}s"
+    )
+    for s in report["nodes"]:
+        sd = s.get("step_duration") or {}
+        bi = s.get("block_interval") or {}
+        lines.append(
+            f"  {s['name']}: h={s.get('height')} age={s.get('last_block_age_s')}s "
+            f"step_p99={sd.get('p99_s')}s block_interval_p50={bi.get('p50_s')}s "
+            f"churn={(s.get('p2p') or {}).get('churn')}"
+        )
+        if s.get("missing_series"):
+            lines.append(f"    missing series: {', '.join(s['missing_series'])}")
+    for g in report["gates"]:
+        mark = "PASS" if g["ok"] else "FAIL"
+        lines.append(f"  gate {g['name']}: {mark} — {g['detail']}")
+    lines.append(f"  verdict: {report['verdict'].upper()}")
+    return "\n".join(lines)

@@ -1,0 +1,131 @@
+"""Verdict engine: declarative health gates over a fleet report.
+
+Each gate is a named predicate over the analyzer's report with a
+threshold from the gate config; the verdict is "pass" only when every
+gate holds. The defaults are deliberately lenient enough for a
+perturbed 4-node e2e run on a 2-core CI box (p99 budgets sized above
+the consensus timeouts the e2e genesis configures, head-age above the
+longest perturbation stall) — a soak harness that wants tighter SLOs
+overrides per-run:
+
+    report = analyze_run(run_dir, gates={"p99_step_budget_s": 2.0})
+
+Gate catalog (the names appear verbatim in fleet_report.json and in
+test assertions):
+
+  liveness_stall     a node's chain head was older than
+                     `max_last_block_age_s` at scrape time
+  p99_step_duration  fleet-merged consensus step p99 over
+                     `p99_step_budget_s`
+  height_spread      max-min committed height over `max_height_spread`
+  missing_series     a node's scrape lacks a required series (or a node
+                     left no metrics artifact at all while
+                     `require_metrics_from_all` is set)
+"""
+
+from __future__ import annotations
+
+__all__ = ["DEFAULT_GATES", "evaluate"]
+
+DEFAULT_GATES = {
+    # no height progress for this long at scrape time = a stall, not
+    # cadence jitter (e2e commit timeouts are sub-second; faultnet
+    # blackhole holds a victim out for ~10s)
+    "max_last_block_age_s": 60.0,
+    # fleet-merged consensus step p99. The step histogram's top finite
+    # bucket is 10s and quantile estimates CLAMP there, so a budget of
+    # 10 could never fail; just under it, the gate fails exactly when
+    # >=1% of step mass spilled into the overflow bucket. Real
+    # (perturbed, 2-core) e2e runs sit around 1-3s.
+    "p99_step_budget_s": 9.5,
+    "max_height_spread": 5,
+    # every node that left a metrics.txt must carry the REQUIRED_SERIES
+    # (analyze.py); flip this on to ALSO fail nodes that left no
+    # metrics artifact at all
+    "require_metrics_from_all": False,
+}
+
+
+def _gate(name: str, ok: bool, detail: str) -> dict:
+    return {"name": name, "ok": bool(ok), "detail": detail}
+
+
+def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]:
+    """(gates, verdict) for a report produced by analyze_run. Unknown
+    config keys fail loudly — a typoed threshold silently reverting to
+    the default is exactly the kind of gate rot this module exists to
+    prevent."""
+    cfg = dict(DEFAULT_GATES)
+    if config:
+        unknown = set(config) - set(DEFAULT_GATES)
+        if unknown:
+            raise ValueError(f"unknown gate config keys: {sorted(unknown)}")
+        cfg.update(config)
+    nodes = report["nodes"]
+    fleet = report["fleet"]
+    gates: list[dict] = []
+
+    # liveness_stall
+    stalled = [
+        (s["name"], s["last_block_age_s"])
+        for s in nodes
+        if s.get("last_block_age_s") is not None
+        and s["last_block_age_s"] > cfg["max_last_block_age_s"]
+    ]
+    if not any(s.get("last_block_age_s") is not None for s in nodes):
+        gates.append(_gate(
+            "liveness_stall", False,
+            "no node exposed last_block_age_seconds — liveness is unverifiable",
+        ))
+    else:
+        gates.append(_gate(
+            "liveness_stall",
+            not stalled,
+            f"stalled nodes (head age > {cfg['max_last_block_age_s']}s): {stalled}"
+            if stalled
+            else f"all heads fresher than {cfg['max_last_block_age_s']}s "
+            f"(worst {fleet.get('worst_last_block_age_s')}s)",
+        ))
+
+    # p99_step_duration
+    p99 = fleet.get("step_p99_s")
+    if p99 is None:
+        gates.append(_gate(
+            "p99_step_duration", False,
+            "no step-duration histogram in any node's scrape",
+        ))
+    else:
+        gates.append(_gate(
+            "p99_step_duration",
+            p99 <= cfg["p99_step_budget_s"],
+            f"fleet step p99 {p99}s vs budget {cfg['p99_step_budget_s']}s",
+        ))
+
+    # height_spread
+    spread = fleet.get("height_spread")
+    if spread is None:
+        gates.append(_gate("height_spread", False, "no node reported a height"))
+    else:
+        gates.append(_gate(
+            "height_spread",
+            spread <= cfg["max_height_spread"],
+            f"heights {fleet['min_height']}..{fleet['max_height']} "
+            f"(spread {spread}, max {cfg['max_height_spread']})",
+        ))
+
+    # missing_series
+    problems = []
+    for s in nodes:
+        missing = s.get("missing_series") or []
+        if missing == ["<no metrics.txt artifact>"] and not cfg["require_metrics_from_all"]:
+            continue
+        if missing:
+            problems.append((s["name"], missing))
+    gates.append(_gate(
+        "missing_series",
+        not problems,
+        f"incomplete scrapes: {problems}" if problems else "all required series present",
+    ))
+
+    verdict = "pass" if all(g["ok"] for g in gates) else "fail"
+    return gates, verdict

@@ -1,0 +1,145 @@
+"""Low-overhead sampling profiler (TM_TPU_PROF=1).
+
+A daemon thread walks `sys._current_frames()` at ~50 Hz and folds every
+thread's stack into collapsed-stack counts (the flamegraph.pl /
+speedscope input format: `frame;frame;frame count` per line, root
+first). Soak regressions flagged by the tmlens gates then come with a
+profile attached instead of a "reproduce it locally with cProfile"
+chore.
+
+Why sampling and not cProfile/sys.setprofile: tracing profilers tax
+EVERY function call on every thread (the consensus and engine hot
+paths make millions), while a 50 Hz sampler costs one frame walk per
+thread per 20 ms regardless of call volume — and nothing at all when
+disabled, which is the default. The GIL makes the snapshot itself
+consistent; it also means samples measure where Python *holds* the GIL,
+which is exactly the contended resource on a 2-core e2e box.
+
+Usage:
+    prof = SamplingProfiler(hz=50); prof.start()
+    ...
+    prof.stop(); prof.save("profile.collapsed")
+
+or ambiently via the env gate the node CLI and e2e runner use:
+    prof = maybe_start_profiler()        # None unless TM_TPU_PROF=1
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+__all__ = ["SamplingProfiler", "maybe_start_profiler", "profiling_requested"]
+
+_MAX_DEPTH = 64
+
+
+def _frame_token(frame) -> str:
+    code = frame.f_code
+    fname = os.path.basename(code.co_filename)
+    return f"{code.co_name} ({fname}:{code.co_firstlineno})"
+
+
+class SamplingProfiler:
+    def __init__(self, hz: float = 50.0, max_depth: int = _MAX_DEPTH):
+        if hz <= 0:
+            raise ValueError(f"hz must be positive, got {hz}")
+        self.interval = 1.0 / hz
+        self.max_depth = max_depth
+        self.samples = 0
+        self.started_at: float | None = None
+        self.wall_s = 0.0
+        self._counts: dict[tuple[str, ...], int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None:
+            raise RuntimeError("profiler already started")
+        self._stop.clear()
+        self.started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tmlens-profiler"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=2.0)
+        self._thread = None
+        if self.started_at is not None:
+            self.wall_s = time.monotonic() - self.started_at
+
+    def _run(self) -> None:
+        my_ident = threading.get_ident()
+        names = {}
+        while not self._stop.wait(self.interval):
+            names = {t.ident: t.name for t in threading.enumerate()}
+            for ident, frame in sys._current_frames().items():
+                if ident == my_ident:
+                    continue
+                stack = []
+                depth = 0
+                while frame is not None and depth < self.max_depth:
+                    stack.append(_frame_token(frame))
+                    frame = frame.f_back
+                    depth += 1
+                stack.append(names.get(ident, f"thread-{ident}"))
+                key = tuple(reversed(stack))  # root (thread name) first
+                with self._lock:
+                    self._counts[key] = self._counts.get(key, 0) + 1
+                    self.samples += 1
+
+    # -------------------------------------------------------------- output
+
+    def collapsed(self) -> str:
+        """Collapsed-stack text, heaviest stacks first."""
+        with self._lock:
+            items = sorted(self._counts.items(), key=lambda kv: -kv[1])
+        return "\n".join(f"{';'.join(stack)} {n}" for stack, n in items)
+
+    def save(self, path: str) -> int:
+        """Write collapsed stacks (+ a comment header with sampling
+        stats); returns the sample count."""
+        body = self.collapsed()
+        with open(path, "w") as f:
+            f.write(
+                f"# tmlens sampling profile: {self.samples} samples "
+                f"@ {1.0 / self.interval:.0f} Hz over {self.wall_s:.1f}s wall\n"
+            )
+            if body:
+                f.write(body + "\n")
+        return self.samples
+
+
+def profiling_requested(env=None) -> bool:
+    v = (env if env is not None else os.environ).get("TM_TPU_PROF", "")
+    return v.strip().lower() in ("1", "on", "true", "yes")
+
+
+def maybe_start_profiler(env=None) -> SamplingProfiler | None:
+    """Start a profiler iff TM_TPU_PROF asks for one. The disabled path
+    is one env read — safe to call unconditionally at process start
+    (the node CLI does; the e2e runner's env passthrough makes
+    TM_TPU_PROF=1 profile every node in a run)."""
+    if not profiling_requested(env):
+        return None
+    hz = 50.0
+    raw = (env if env is not None else os.environ).get("TM_TPU_PROF_HZ", "")
+    if raw.strip():
+        try:
+            hz = float(raw)
+            if hz <= 0:
+                raise ValueError(raw)
+        except ValueError:
+            hz = 50.0  # malformed knob must not stop the node (cf. TM_TPU_TRACE_BUF)
+    return SamplingProfiler(hz=hz).start()

@@ -1,0 +1,198 @@
+"""Prometheus text-exposition reader for persisted artifacts.
+
+The e2e runner scrapes each node's final `/metrics` into
+`<node>/metrics.txt` (PR 4); tmlens turns those snapshots back into
+queryable samples — including histogram reconstruction, so p50/p99 can
+be estimated from bucket counts long after the node that observed the
+raw values is gone. The quantile math itself lives in
+`tendermint_tpu.metrics.bucket_quantile` so the offline estimate and a
+live `Histogram.quantile()` agree bucket-for-bucket.
+
+Deliberately dependency-free (stdlib only): the analyzer must be
+importable on a bare CI box and must never pull jax into a process that
+only wants to read artifacts.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..metrics import bucket_quantile
+
+__all__ = ["Exposition", "HistogramSnapshot", "parse_exposition"]
+
+
+def _parse_label_block(block: str) -> dict:
+    """`k="v",k2="v2"` with exposition escapes (\\\\, \\", \\n)."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(block)
+    while i < n:
+        eq = block.find("=", i)
+        if eq < 0:
+            break
+        key = block[i:eq].strip().lstrip(",").strip()
+        j = block.find('"', eq)
+        if j < 0:
+            break
+        j += 1
+        out = []
+        while j < n:
+            c = block[j]
+            if c == "\\" and j + 1 < n:
+                nxt = block[j + 1]
+                out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, "\\" + nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            out.append(c)
+            j += 1
+        labels[key] = "".join(out)
+        i = j + 1
+    return labels
+
+
+def _parse_value(s: str) -> float:
+    s = s.strip()
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    return float(s)
+
+
+class HistogramSnapshot:
+    """One labeled histogram child reconstructed from `_bucket`/`_sum`/
+    `_count` samples. Bucket counts are cumulative, exactly as exposed."""
+
+    __slots__ = ("labels", "bounds", "cumulative", "sum", "count")
+
+    def __init__(self, labels: dict):
+        self.labels = labels
+        self.bounds: list[float] = []        # finite upper bounds, ascending
+        self.cumulative: list[float] = []    # matching cumulative counts
+        self.sum = 0.0
+        self.count = 0.0
+
+    def quantile(self, q: float) -> float | None:
+        return bucket_quantile(q, self.bounds, self.cumulative, self.count)
+
+    def mean(self) -> float | None:
+        return (self.sum / self.count) if self.count else None
+
+    def merge(self, other: "HistogramSnapshot") -> "HistogramSnapshot":
+        """Fold another child with IDENTICAL bounds into this one —
+        how per-step (and per-node) histograms combine into an overall
+        distribution. Mismatched bucket layouts refuse loudly; a silent
+        union would fabricate counts."""
+        if other.bounds != self.bounds:
+            raise ValueError(
+                f"cannot merge histograms with different buckets: "
+                f"{self.bounds} vs {other.bounds}"
+            )
+        merged = HistogramSnapshot({})
+        merged.bounds = list(self.bounds)
+        merged.cumulative = [a + b for a, b in zip(self.cumulative, other.cumulative)]
+        merged.sum = self.sum + other.sum
+        merged.count = self.count + other.count
+        return merged
+
+
+class Exposition:
+    """Parsed exposition text: flat samples plus histogram snapshots."""
+
+    def __init__(self, samples: list[tuple[str, dict, float]]):
+        self.raw = samples
+        self._by_name: dict[str, list[tuple[dict, float]]] = {}
+        for name, labels, value in samples:
+            self._by_name.setdefault(name, []).append((labels, value))
+
+    def names(self) -> set[str]:
+        return set(self._by_name)
+
+    def has(self, name: str) -> bool:
+        return name in self._by_name
+
+    def samples(self, name: str) -> list[tuple[dict, float]]:
+        return list(self._by_name.get(name, ()))
+
+    def value(self, name: str, **labels) -> float | None:
+        """First sample matching the given label subset, else None."""
+        for lbl, v in self._by_name.get(name, ()):
+            if all(lbl.get(k) == v2 for k, v2 in labels.items()):
+                return v
+        return None
+
+    def total(self, name: str, **labels) -> float:
+        """Sum over every sample matching the label subset (collapses a
+        labeled counter family to one number)."""
+        return sum(
+            v
+            for lbl, v in self._by_name.get(name, ())
+            if all(lbl.get(k) == v2 for k, v2 in labels.items())
+        )
+
+    def histogram(self, base: str, **labels) -> HistogramSnapshot | None:
+        """Reassemble the histogram children of `base` matching the
+        label subset, merged into ONE snapshot (merging across a label
+        like `step` sums per-bucket counts — the layouts are identical
+        within a family). None when no buckets match."""
+        children: dict[tuple, HistogramSnapshot] = {}
+        for lbl, v in self._by_name.get(base + "_bucket", ()):
+            if not all(lbl.get(k) == v2 for k, v2 in labels.items()):
+                continue
+            key = tuple(sorted((k, v2) for k, v2 in lbl.items() if k != "le"))
+            h = children.get(key)
+            if h is None:
+                h = children[key] = HistogramSnapshot(
+                    {k: v2 for k, v2 in lbl.items() if k != "le"}
+                )
+            ub = _parse_value(lbl.get("le", "+Inf"))
+            if math.isinf(ub):
+                h.count = v
+            else:
+                h.bounds.append(ub)
+                h.cumulative.append(v)
+        if not children:
+            return None
+        for key, h in children.items():
+            order = sorted(range(len(h.bounds)), key=lambda i: h.bounds[i])
+            h.bounds = [h.bounds[i] for i in order]
+            h.cumulative = [h.cumulative[i] for i in order]
+            for lbl, v in self._by_name.get(base + "_sum", ()):
+                if tuple(sorted(lbl.items())) == key:
+                    h.sum = v
+            for lbl, v in self._by_name.get(base + "_count", ()):
+                if tuple(sorted(lbl.items())) == key:
+                    h.count = v
+        merged = None
+        for h in children.values():
+            merged = h if merged is None else merged.merge(h)
+        return merged
+
+    def label_values(self, name: str, label: str) -> set[str]:
+        return {
+            lbl[label] for lbl, _ in self._by_name.get(name, ()) if label in lbl
+        }
+
+
+def parse_exposition(text: str) -> Exposition:
+    """Parse exposition text as written by `Registry.gather` (HELP/TYPE
+    comments skipped; malformed lines dropped rather than raised — a
+    truncated scrape from a dying node should still yield its prefix)."""
+    samples: list[tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                block, value_s = rest.rsplit("}", 1)
+                samples.append((name.strip(), _parse_label_block(block), _parse_value(value_s)))
+            else:
+                name, value_s = line.rsplit(None, 1)
+                samples.append((name.strip(), {}, _parse_value(value_s)))
+        except ValueError:
+            continue
+    return Exposition(samples)

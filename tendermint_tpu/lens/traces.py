@@ -1,0 +1,120 @@
+"""Cross-node trace merge: per-node Chrome-trace JSON → one fleet
+timeline.
+
+Each node's tmtrace ring stamps events with `time.perf_counter_ns()`,
+whose epoch is process-private — concatenating the per-node
+`trace.json` artifacts raw would scatter the fleet across unrelated
+time axes. The alignment anchor is consensus itself: a block at height
+h commits on every correct node within roughly one commit timeout, and
+every node records a `consensus.finalize_commit` span carrying that
+height. For each node the offset to the reference node is estimated as
+the MEDIAN over common heights of (ref commit ts − node commit ts);
+median because a node that committed a few heights late (catch-up after
+a perturbation) contributes outliers that a mean would smear into every
+span.
+
+The merged document is standard Chrome-trace JSON: one pid per node
+with `process_name` metadata, thread names preserved, all timestamps
+shifted onto the reference clock — Perfetto renders the whole fleet as
+parallel process tracks.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+
+__all__ = [
+    "load_trace_events",
+    "commit_anchors",
+    "align_offsets",
+    "merge_traces",
+]
+
+COMMIT_SPAN = "consensus.finalize_commit"
+
+
+def load_trace_events(path: str) -> list[dict]:
+    """Events from a trace artifact — either the full Chrome-trace
+    object ({"traceEvents": [...]}) the dump_traces RPC emits, or a
+    bare event array."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return list(doc.get("traceEvents", ()))
+    return list(doc)
+
+
+def commit_anchors(events: list[dict]) -> dict[int, float]:
+    """height → commit-span END timestamp (µs, node-local clock). The
+    end is the anchor — span start varies with how much finalize work
+    the node did, while the end marks the same chain event everywhere."""
+    anchors: dict[int, float] = {}
+    for ev in events:
+        if ev.get("name") != COMMIT_SPAN or ev.get("ph") != "X":
+            continue
+        h = (ev.get("args") or {}).get("height")
+        if h is None:
+            continue
+        anchors[int(h)] = ev["ts"] + ev.get("dur", 0)
+    return anchors
+
+
+def align_offsets(anchor_maps: list[dict[int, float]], ref: int = 0) -> list[float | None]:
+    """Per-node µs offsets onto node `ref`'s clock (add offset to a
+    node's ts). None for a node sharing no commit height with the
+    reference — its events cannot be placed honestly and the merge
+    leaves them out rather than inventing an epoch."""
+    offsets: list[float | None] = []
+    ref_map = anchor_maps[ref] if anchor_maps else {}
+    for i, m in enumerate(anchor_maps):
+        if i == ref:
+            offsets.append(0.0)
+            continue
+        common = sorted(set(ref_map) & set(m))
+        if not common:
+            offsets.append(None)
+            continue
+        offsets.append(statistics.median(ref_map[h] - m[h] for h in common))
+    return offsets
+
+
+def merge_traces(
+    node_events: list[tuple[str, list[dict]]], ref: int = 0
+) -> tuple[dict, list[float | None]]:
+    """[(node_name, events)] → (merged Chrome-trace doc, offsets).
+
+    Nodes become pids 1..n (process_name = node name, process_sort_index
+    = node order); per-event pids from the source docs are discarded —
+    they were OS pids, meaningless across homes. Metadata events
+    (ph "M") keep thread names; flow events and everything else shift
+    by the node's offset. Unalignable nodes contribute only a
+    process_name marked unaligned, so their absence is visible in the
+    UI instead of silent."""
+    anchor_maps = [commit_anchors(evs) for _name, evs in node_events]
+    offsets = align_offsets(anchor_maps, ref=ref)
+    out: list[dict] = []
+    for i, (name, events) in enumerate(node_events):
+        pid = i + 1
+        off = offsets[i]
+        label = name if off is not None else f"{name} (unaligned, omitted)"
+        out.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                    "args": {"name": label}})
+        out.append({"name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+                    "args": {"sort_index": i}})
+        if off is None:
+            continue
+        for ev in events:
+            e = dict(ev)
+            e["pid"] = pid
+            if "ts" in e:
+                e["ts"] = e["ts"] + off
+            if "id" in e:
+                # Flow/async event ids are process-private counters, but
+                # the trace-event format binds endpoints globally by
+                # (cat, id) — unnamespaced, node A's flow 1 would bind
+                # to node B's flow 1 and Perfetto would draw false
+                # cross-node arrows.
+                e["id"] = f"{pid}:{e['id']}"
+            out.append(e)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}, offsets

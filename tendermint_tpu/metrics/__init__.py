@@ -69,6 +69,14 @@ class _Metric:
                 for k, v in self._children.items()
             ]
 
+    @_never_raise
+    def remove(self, *label_values: str) -> None:
+        """Drop one labeled child (a disconnected peer's gauge would
+        otherwise linger on the scrape forever)."""
+        k = self._key(label_values)
+        with self._lock:
+            self._children.pop(k, None)
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -94,6 +102,59 @@ class Gauge(_Metric):
         k = self._key(label_values)
         with self._lock:
             self._children[k] = self._children.get(k, 0.0) + delta
+
+
+class AgeGauge(Gauge):
+    """Gauge whose exported value is the seconds since the last mark().
+
+    The freshness-at-scrape-time problem: a plain "last block committed
+    at T" gauge needs the scraper to know its own wall clock AND trust
+    the node's, while "seconds since" computed at sample time needs
+    neither — tmlens reads a persisted exposition long after the run
+    and still sees how stale the chain head was when the scrape
+    happened (the liveness-stall gate keys off exactly this)."""
+
+    @_never_raise
+    def mark(self, ts: float | None = None) -> None:
+        """Record the event (default: now, wall clock)."""
+        with self._lock:
+            self._children[()] = float(ts if ts is not None else time.time())
+
+    def samples(self):
+        with self._lock:
+            marked = self._children.get(())
+        if marked is None:
+            return []
+        return [(self.name, {}, max(0.0, time.time() - marked))]
+
+
+def bucket_quantile(q: float, bounds, cumulative, total) -> float | None:
+    """Estimate the q-quantile from cumulative histogram bucket counts
+    (Prometheus `histogram_quantile` semantics: linear interpolation
+    inside the first bucket whose cumulative count reaches rank q*total;
+    ranks past the last finite bound clamp to that bound — the estimate
+    can never exceed the histogram's top bucket).
+
+    `bounds` are the FINITE upper bounds in ascending order, `cumulative`
+    the matching cumulative counts (each bucket counts every observation
+    <= its bound), `total` the +Inf count. Returns None on an empty
+    histogram. Both the live `Histogram.quantile` method and the tmlens
+    exposition analyzer route through here so a p99 computed from a
+    node's in-memory state and one computed from its scraped metrics.txt
+    agree."""
+    if total <= 0 or not bounds:
+        return None
+    rank = q * total
+    prev_ub, prev_cum = 0.0, 0.0
+    for ub, cum in zip(bounds, cumulative):
+        if cum >= rank:
+            if ub <= prev_ub:  # degenerate/negative bounds: no interpolation
+                return float(ub)
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span > 0 else 1.0
+            return float(prev_ub + (ub - prev_ub) * frac)
+        prev_ub, prev_cum = ub, cum
+    return float(bounds[-1])
 
 
 class Histogram(_Metric):
@@ -140,6 +201,22 @@ class Histogram(_Metric):
                 total += value
             h[1] += total
             h[2] += len(values)
+
+    def quantile(self, q: float, *label_values: str) -> float | None:
+        """Bucket-interpolated quantile estimate for one labeled child
+        (observe() keeps per-bucket counts cumulative, so they feed
+        bucket_quantile directly). None for an empty/unknown child or a
+        q outside [0, 1] — a read path, so bad args raise like
+        samples() does."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        k = self._key(label_values)
+        with self._lock:
+            h = self._hist.get(k)
+            if h is None:
+                return None
+            counts, _total, n = list(h[0]), h[1], h[2]
+        return bucket_quantile(q, self.buckets, counts, n)
 
     def samples(self):
         out = []
@@ -275,6 +352,14 @@ class ConsensusMetrics:
             "Precommit vote extensions received",
             labels=("status",),
         )
+        # Chain-head freshness at scrape time (no reference analog; the
+        # tmlens liveness-stall gate reads this from persisted
+        # artifacts — docs/observability.md). Marked at every
+        # finalize_commit; the exported value is seconds-since.
+        self.last_block_age = reg.register(AgeGauge(
+            f"{ns}_last_block_age_seconds",
+            "Seconds since this node last committed a block (computed at scrape)",
+        ))
         self._step_start = time.monotonic()
         self._round_start = time.monotonic()
         self._last_step: str | None = None
@@ -350,6 +435,20 @@ class P2PMetrics:
             f"{ns}_peer_queue_dropped_msgs",
             "Envelopes dropped from full per-peer send queues",
             labels=("chID",),
+        )
+        # Backpressure + churn visibility for tmlens (no reference
+        # analog): a peer whose send queue stays deep is the slow
+        # consumer stalling gossip; connects minus the peers gauge is
+        # the reconnect churn a soak run accumulated.
+        self.peer_send_queue_depth = reg.gauge(
+            f"{ns}_peer_send_queue_depth",
+            "Envelopes queued toward one peer (child removed on disconnect)",
+            labels=("peer",),
+        )
+        self.peer_connections = reg.counter(
+            f"{ns}_peer_connections_total",
+            "Peer connections registered since boot",
+            labels=("dir",),
         )
 
 

@@ -71,6 +71,9 @@ class _PeerQueue:
             return None
         return None if item is self._SENTINEL else item
 
+    def qsize(self) -> int:
+        return self.q.qsize()
+
     def close(self) -> None:
         self.closed.set()
         try:
@@ -131,6 +134,10 @@ class _PriorityPeerQueue:
             if not self._heap:
                 return None
             return heapq.heappop(self._heap)[2]
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._heap)
 
     def close(self) -> None:
         with self._cv:
@@ -493,6 +500,7 @@ class Router:
             self._peer_channels[peer_id] = peer_channels & self.channel_ids()
             if self.metrics is not None:
                 self.metrics.peers.set(len(self._peer_conns))
+                self.metrics.peer_connections.add(1, "out" if outgoing else "in")
         if old is not None:
             old.close()
 
@@ -515,6 +523,8 @@ class Router:
                     del self._peer_conns[peer_id]
                     self._peer_queues.pop(peer_id, None)
                     self._peer_channels.pop(peer_id, None)
+                    if self.metrics is not None:
+                        self.metrics.peer_send_queue_depth.remove(peer_id)
                 if self.metrics is not None:
                     self.metrics.peers.set(len(self._peer_conns))
             self.peer_manager.disconnected(peer_id)
@@ -588,6 +598,15 @@ class Router:
         """ref: router.go:791 sendPeer."""
         while not done.is_set() and not self._stop.is_set():
             envelope = pq.get(timeout=0.2)
+            if self.metrics is not None and not pq.closed.is_set():
+                # Per-peer backlog gauge, updated ONLY from this thread
+                # (joined before the disconnect path calls
+                # peer_send_queue_depth.remove(), so a set here cannot
+                # resurrect a removed child and leak stale peer labels
+                # under churn; the closed check narrows the
+                # join-timeout edge). A slow peer shows its backlog at
+                # every send; an idle one decays to 0 each poll tick.
+                self.metrics.peer_send_queue_depth.set(pq.qsize(), peer_id)
             if envelope is None:
                 if pq.closed.is_set():
                     return

@@ -107,6 +107,23 @@ def test_e2e_perturbed_testnet(tmp_path):
         and "tendermint_hash_cache_events_total" in t
         for t in scraped
     ), "hash-plane telemetry series missing from every node's final scrape"
+    # ROADMAP-4 gate (tmlens, PR 8): cleanup ran the fleet analyzer over
+    # the collected artifacts. A perturbed-but-recovered run must yield
+    # a PASSING verdict — fresh chain heads, bounded height spread, step
+    # p99 within budget, all required series present — and the machine-
+    # checkable report must be on disk next to the node dirs.
+    assert runner.last_report is not None, "tmlens analysis did not run in cleanup"
+    assert runner.last_report["verdict"] == "pass", runner.last_report["gates"]
+    assert os.path.exists(os.path.join(runner.base_dir, "fleet_report.json"))
+    gate_names = {g["name"] for g in runner.last_report["gates"]}
+    assert gate_names == {
+        "liveness_stall", "p99_step_duration", "height_spread", "missing_series"
+    }
+    # the kill perturbation snapshotted the victim's pre-death state
+    killed = next(n for n in runner.nodes if "kill" in n.m.perturb)
+    assert os.path.exists(os.path.join(killed.home, "metrics.pre-kill.txt")), (
+        "perturb(kill) left no pre-death artifact snapshot"
+    )
 
 
 PARTITION_MANIFEST = """

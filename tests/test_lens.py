@@ -1,0 +1,484 @@
+"""tmlens — fleet analysis over persisted observability artifacts
+(tendermint_tpu/lens/, docs/observability.md#tmlens).
+
+All tier-1: the synthetic fixtures are REAL expositions (rendered by
+the same Registry.gather the nodes serve) and real Chrome-trace event
+lists, so the analyzer is exercised against the exact byte formats the
+e2e runner persists — deterministic and node-free.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu.lens import (
+    DEFAULT_GATES,
+    REPORT_NAME,
+    SamplingProfiler,
+    align_offsets,
+    analyze_run,
+    commit_anchors,
+    maybe_start_profiler,
+    merge_traces,
+    parse_exposition,
+    render_summary,
+    write_merged_trace,
+)
+from tendermint_tpu.metrics import (
+    ConsensusMetrics,
+    Histogram,
+    MempoolMetrics,
+    P2PMetrics,
+    Registry,
+    bucket_quantile,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- quantiles
+
+
+def test_bucket_quantile_interpolation():
+    # 100 observations: 50 in (0,1], 40 in (1,2], 10 in (2,5]
+    bounds, cum, total = [1.0, 2.0, 5.0], [50, 90, 100], 100
+    assert bucket_quantile(0.5, bounds, cum, total) == pytest.approx(1.0)
+    # rank 75 -> 25/40 through the (1,2] bucket
+    assert bucket_quantile(0.75, bounds, cum, total) == pytest.approx(1.625)
+    # rank 99 -> 9/10 through the (2,5] bucket
+    assert bucket_quantile(0.99, bounds, cum, total) == pytest.approx(4.7)
+    # first bucket interpolates from 0
+    assert bucket_quantile(0.25, bounds, cum, total) == pytest.approx(0.5)
+
+
+def test_bucket_quantile_edges():
+    assert bucket_quantile(0.5, [], [], 0) is None
+    assert bucket_quantile(0.5, [1.0], [0], 0) is None
+    # mass beyond the last finite bound clamps to it (Prometheus
+    # histogram_quantile semantics)
+    assert bucket_quantile(0.99, [1.0, 2.0], [10, 10], 100) == 2.0
+
+
+def test_histogram_quantile_live_matches_exposition():
+    """The live Histogram.quantile and the offline exposition-based
+    estimate must agree exactly — both route through bucket_quantile."""
+    reg = Registry()
+    h = reg.histogram("t_q_seconds", "", buckets=(0.1, 0.5, 1.0, 5.0))
+    for v in [0.05] * 30 + [0.3] * 50 + [2.0] * 20:
+        h.observe(v)
+    exp = parse_exposition(reg.gather())
+    snap = exp.histogram("t_q_seconds")
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert h.quantile(q) == pytest.approx(snap.quantile(q))
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_quantile_labeled_child():
+    reg = Registry()
+    h = reg.histogram("t_lbl_seconds", "", labels=("step",), buckets=(1.0, 10.0))
+    for _ in range(10):
+        h.observe(0.5, "propose")
+    assert h.quantile(0.5, "propose") == pytest.approx(0.5)
+    assert h.quantile(0.5, "prevote") is None
+
+
+def test_exposition_parse_label_escapes():
+    reg = Registry()
+    g = reg.gauge("t_esc", "", labels=("link",))
+    g.set(7, 'a->b "x"\n\\end')
+    exp = parse_exposition(reg.gather())
+    (labels, value), = exp.samples("t_esc")
+    assert labels["link"] == 'a->b "x"\n\\end'
+    assert value == 7
+
+
+# --------------------------------------------------------------- fixtures
+
+
+def node_exposition(
+    height=50,
+    age_s=1.5,
+    steps=100,
+    step_s=0.2,
+    slow_steps=0,
+    drop_series=(),
+):
+    """Render one node's metrics.txt through the real registry (the
+    same gather() a live node's /metrics serves)."""
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    cm.height.set(height)
+    for _ in range(steps):
+        cm.step_duration.observe(step_s, "propose")
+        cm.step_duration.observe(step_s / 2, "prevote")
+        cm.round_duration.observe(step_s * 3)
+        cm.block_interval.observe(1.0)
+    for _ in range(slow_steps):
+        cm.step_duration.observe(30.0, "propose")  # overflow bucket
+    cm.last_block_age.mark(time.time() - age_s)
+    MempoolMetrics(reg)
+    pm = P2PMetrics(reg)
+    pm.peers.set(3)
+    pm.peer_connections.add(4, "out")
+    pm.peer_connections.add(1, "in")
+    pm.peer_send_queue_depth.set(2, "aa" * 20)
+    text = reg.gather()
+    if drop_series:
+        text = "\n".join(
+            ln for ln in text.splitlines()
+            if not any(ln.startswith(s) for s in drop_series)
+        )
+    return text
+
+
+def node_trace(epoch_us, heights=range(1, 8), extra=()):
+    evs = []
+    for h in heights:
+        evs.append({
+            "name": "consensus.finalize_commit", "cat": "consensus", "ph": "X",
+            "ts": epoch_us + h * 1_000_000.0, "dur": 800.0, "tid": 1,
+            "args": {"height": h, "round": 0},
+        })
+    evs.extend(extra)
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def write_fleet(tmp_path, expositions, traces=None):
+    run = tmp_path / "net"
+    run.mkdir(parents=True, exist_ok=True)
+    for i, text in enumerate(expositions):
+        d = run / f"validator{i + 1:02d}"
+        d.mkdir(exist_ok=True)
+        (d / "metrics.txt").write_text(text)
+        if traces and traces[i] is not None:
+            (d / "trace.json").write_text(json.dumps(traces[i]))
+    return str(run)
+
+
+# --------------------------------------------------------- analyzer+gates
+
+
+def test_healthy_fleet_passes(tmp_path):
+    run = write_fleet(tmp_path, [node_exposition(height=50 + (i % 2)) for i in range(4)])
+    report = analyze_run(run)
+    assert report["verdict"] == "pass", report["gates"]
+    assert report["fleet"]["nodes"] == 4
+    assert report["fleet"]["height_spread"] == 1
+    # per-node p99s estimated from buckets (0.2s propose observations
+    # land in the (0.1, 0.5] default bucket)
+    for s in report["nodes"]:
+        assert 0.1 < s["step_duration"]["p99_s"] <= 0.5
+        assert s["p2p"]["churn"] == 2.0  # 5 connects - 3 live peers
+        assert s["mempool"]["admitted_txs"] == 0.0
+    summary = render_summary(report)
+    assert "verdict: PASS" in summary
+
+
+def test_stalled_fleet_fails_liveness_gate(tmp_path):
+    """One node's chain head is 300s old at scrape — the liveness gate
+    (and ONLY it) must fail, naming the node."""
+    run = write_fleet(
+        tmp_path,
+        [node_exposition()] * 3 + [node_exposition(age_s=300.0)],
+    )
+    report = analyze_run(run)
+    assert report["verdict"] == "fail"
+    failing = [g["name"] for g in report["gates"] if not g["ok"]]
+    assert failing == ["liveness_stall"], report["gates"]
+    (gate,) = [g for g in report["gates"] if g["name"] == "liveness_stall"]
+    assert "validator04" in gate["detail"]
+
+
+def test_missing_series_fleet_fails_named_gate(tmp_path):
+    run = write_fleet(
+        tmp_path,
+        [node_exposition()] * 3
+        + [node_exposition(drop_series=("tendermint_consensus_step_duration_seconds",))],
+    )
+    report = analyze_run(run)
+    assert report["verdict"] == "fail"
+    failing = {g["name"] for g in report["gates"] if not g["ok"]}
+    assert "missing_series" in failing, report["gates"]
+    (gate,) = [g for g in report["gates"] if g["name"] == "missing_series"]
+    assert "validator04" in gate["detail"]
+    assert "step_duration" in gate["detail"]
+
+
+def test_height_divergence_fails_spread_gate(tmp_path):
+    run = write_fleet(
+        tmp_path,
+        [node_exposition(height=50)] * 3 + [node_exposition(height=30)],
+    )
+    report = analyze_run(run)
+    failing = [g["name"] for g in report["gates"] if not g["ok"]]
+    assert failing == ["height_spread"], report["gates"]
+
+
+def test_p99_regression_fails_step_gate(tmp_path):
+    """2% of one node's steps in the overflow bucket pushes the
+    fleet-merged p99 estimate to the 10s clamp — over budget."""
+    run = write_fleet(
+        tmp_path,
+        [node_exposition()] * 3 + [node_exposition(steps=100, slow_steps=20)],
+    )
+    report = analyze_run(run)
+    failing = [g["name"] for g in report["gates"] if not g["ok"]]
+    assert failing == ["p99_step_duration"], report["gates"]
+
+
+def test_gate_overrides_and_unknown_keys(tmp_path):
+    run = write_fleet(tmp_path, [node_exposition(height=50), node_exposition(height=48)])
+    assert analyze_run(run)["verdict"] == "pass"
+    tightened = analyze_run(run, gates={"max_height_spread": 1})
+    assert tightened["verdict"] == "fail"
+    with pytest.raises(ValueError, match="max_heigt_spread"):
+        analyze_run(run, gates={"max_heigt_spread": 1})
+    # defaults are not mutated by overrides
+    assert DEFAULT_GATES["max_height_spread"] == 5
+
+
+def test_empty_run_dir_fails_all_unverifiable_gates(tmp_path):
+    run = tmp_path / "empty"
+    run.mkdir()
+    report = analyze_run(str(run))
+    assert report["verdict"] == "fail"
+    assert all(not g["ok"] for g in report["gates"] if g["name"] != "missing_series")
+
+
+# ------------------------------------------------------------ trace merge
+
+
+def test_commit_anchor_alignment_recovers_offsets():
+    """Two nodes whose perf_counter epochs differ by 7s align onto one
+    timeline via same-height commit anchors; a node sharing no heights
+    is omitted rather than guessed."""
+    a = node_trace(0.0)["traceEvents"]
+    b = node_trace(7_000_000.0)["traceEvents"]
+    doc, offsets = merge_traces([("n1", a), ("n2", b)])
+    assert offsets[0] == 0.0
+    assert offsets[1] == pytest.approx(-7_000_000.0)
+    n2 = [e for e in doc["traceEvents"]
+          if e.get("pid") == 2 and e.get("name") == "consensus.finalize_commit"]
+    n1 = [e for e in doc["traceEvents"]
+          if e.get("pid") == 1 and e.get("name") == "consensus.finalize_commit"]
+    assert n2[0]["ts"] == pytest.approx(n1[0]["ts"])
+    procs = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert procs == {1: "n1", 2: "n2"}
+
+
+def test_alignment_median_rejects_catchup_outliers():
+    """A node that committed some heights late (blocksync catch-up
+    burst) must not smear the offset: the median over anchors holds."""
+    ref = {h: h * 1_000_000.0 for h in range(1, 10)}
+    skewed = {h: h * 1_000_000.0 - 500_000.0 for h in range(1, 10)}
+    # heights 8,9 committed 30s late in a catch-up burst
+    skewed[8] += 30_000_000.0
+    skewed[9] += 30_000_000.0
+    offsets = align_offsets([ref, skewed])
+    assert offsets[1] == pytest.approx(500_000.0)
+
+
+def test_flow_ids_namespaced_per_node():
+    """tmtrace flow ids come from a process-private counter, and the
+    trace-event format binds flow endpoints globally by (cat, id): two
+    nodes both emitting flow id 1 would render a false cross-node
+    arrow in the merged doc unless the merge namespaces them."""
+    flow = [
+        {"name": "flow", "cat": "tm.flow", "ph": "s", "id": 1, "tid": 1, "ts": 100.0},
+        {"name": "flow", "cat": "tm.flow", "ph": "f", "bp": "e", "id": 1, "tid": 2,
+         "ts": 200.0},
+    ]
+    a = node_trace(0.0, extra=flow)["traceEvents"]
+    b = node_trace(0.0, extra=flow)["traceEvents"]
+    doc, _ = merge_traces([("n1", a), ("n2", b)])
+    ids = {(e["pid"], e["id"]) for e in doc["traceEvents"] if "id" in e}
+    assert ids == {(1, "1:1"), (2, "2:1")}
+
+
+def test_unalignable_node_omitted():
+    a = node_trace(0.0)["traceEvents"]
+    lone = [{"name": "x", "ph": "X", "ts": 5.0, "dur": 1.0, "tid": 1}]
+    doc, offsets = merge_traces([("n1", a), ("n2", lone)])
+    assert offsets[1] is None
+    assert not [e for e in doc["traceEvents"] if e.get("pid") == 2 and e.get("ph") != "M"]
+    procs = [e["args"]["name"] for e in doc["traceEvents"] if e.get("name") == "process_name"]
+    assert any("unaligned" in p for p in procs)
+
+
+def test_commit_anchors_reads_span_end():
+    evs = node_trace(0.0, heights=[3])["traceEvents"]
+    assert commit_anchors(evs) == {3: 3_000_000.0 + 800.0}
+
+
+def test_write_merged_trace_roundtrip(tmp_path):
+    run = write_fleet(
+        tmp_path,
+        [node_exposition() for _ in range(3)],
+        traces=[node_trace(0.0), node_trace(4_000_000.0), None],
+    )
+    out = write_merged_trace(run)
+    assert out and os.path.exists(out)
+    with open(out) as f:
+        doc = json.load(f)
+    assert {e.get("pid") for e in doc["traceEvents"] if e.get("ph") == "X"} == {1, 2}
+    # no traces at all -> None, no file
+    run2 = write_fleet(tmp_path / "b", [node_exposition()])
+    assert write_merged_trace(run2) is None
+
+
+# ---------------------------------------------------------------- the CLI
+
+
+def _tmlens_main():
+    spec = importlib.util.spec_from_file_location(
+        "tmlens_cli", os.path.join(_ROOT, "scripts", "tmlens.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_cli_analyze_pass_fail_and_artifacts(tmp_path, capsys):
+    main = _tmlens_main()
+    run = write_fleet(tmp_path, [node_exposition() for _ in range(4)],
+                      traces=[node_trace(i * 1e6) for i in range(4)])
+    assert main(["analyze", run]) == 0
+    out = capsys.readouterr().out
+    assert "verdict: PASS" in out
+    assert os.path.exists(os.path.join(run, REPORT_NAME))
+    assert os.path.exists(os.path.join(run, "fleet_trace.json"))
+
+    stalled = write_fleet(tmp_path / "s", [node_exposition(age_s=500.0)])
+    assert main(["analyze", stalled]) == 1
+    assert "liveness_stall: FAIL" in capsys.readouterr().out
+
+    assert main(["analyze", str(tmp_path / "nope")]) == 2
+    assert main(["bogus"]) == 2
+
+
+def test_cli_gates_flag_inline_and_file(tmp_path, capsys):
+    main = _tmlens_main()
+    run = write_fleet(tmp_path, [node_exposition(height=50), node_exposition(height=47)])
+    assert main(["analyze", run]) == 0
+    assert main(["analyze", run, "--gates", '{"max_height_spread": 2}']) == 1
+    gfile = tmp_path / "gates.json"
+    gfile.write_text('{"max_height_spread": 2}')
+    assert main(["analyze", run, "--gates", str(gfile)]) == 1
+    assert main(["analyze", run, "--gates", '{"bogus_key": 1}']) == 2
+    capsys.readouterr()
+    assert main(["analyze", run, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == "pass"
+
+
+# --------------------------------------------------------------- profiler
+
+
+def test_profiler_samples_busy_thread(tmp_path):
+    stop = threading.Event()
+
+    def busy_loop_for_profile():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    th = threading.Thread(target=busy_loop_for_profile, name="lens-busy")
+    th.start()
+    prof = SamplingProfiler(hz=200).start()
+    try:
+        time.sleep(0.4)
+    finally:
+        prof.stop()
+        stop.set()
+        th.join()
+    assert prof.samples >= 10
+    out = prof.collapsed()
+    assert "busy_loop_for_profile" in out
+    # root frame of every stack is the thread name
+    assert any(ln.startswith("lens-busy;") for ln in out.splitlines())
+    # collapsed format: `frame;frame value` per line
+    for ln in out.splitlines():
+        stack, count = ln.rsplit(" ", 1)
+        assert int(count) > 0 and stack
+    path = tmp_path / "profile.collapsed"
+    n = prof.save(str(path))
+    text = path.read_text()
+    assert n == prof.samples
+    assert text.startswith("# tmlens sampling profile:")
+
+
+def test_profiler_double_start_refused():
+    prof = SamplingProfiler(hz=100).start()
+    try:
+        with pytest.raises(RuntimeError):
+            prof.start()
+    finally:
+        prof.stop()
+    # stop is idempotent
+    prof.stop()
+
+
+def test_maybe_start_profiler_env_gate():
+    assert maybe_start_profiler(env={}) is None
+    assert maybe_start_profiler(env={"TM_TPU_PROF": "0"}) is None
+    assert not any(t.name == "tmlens-profiler" for t in threading.enumerate())
+    prof = maybe_start_profiler(env={"TM_TPU_PROF": "1", "TM_TPU_PROF_HZ": "250"})
+    try:
+        assert prof is not None and prof.interval == pytest.approx(1 / 250)
+    finally:
+        prof.stop()
+    # malformed hz falls back instead of failing node boot (the
+    # TM_TPU_TRACE_BUF discipline)
+    prof = maybe_start_profiler(env={"TM_TPU_PROF": "yes", "TM_TPU_PROF_HZ": "wat"})
+    try:
+        assert prof is not None and prof.interval == pytest.approx(1 / 50)
+    finally:
+        prof.stop()
+
+
+# -------------------------------------------------------- overhead guards
+
+
+def test_lens_never_touches_node_hot_path():
+    """Two-way import isolation, pinned in a clean interpreter:
+    node-runtime modules must not import lens (zero cost on the node
+    hot path), and lens must not drag in jax/ops (artifact readers run
+    on bare CI boxes)."""
+    code = (
+        "import sys\n"
+        "import tendermint_tpu.e2e.runner, tendermint_tpu.p2p.router\n"
+        "import tendermint_tpu.metrics, tendermint_tpu.trace\n"
+        "assert 'tendermint_tpu.lens' not in sys.modules, 'lens on the node path'\n"
+        "import tendermint_tpu.lens\n"
+        "assert not any(m == 'jax' or m.startswith('jax.') for m in sys.modules), 'lens pulled jax'\n"
+        "assert 'tendermint_tpu.ops' not in sys.modules, 'lens pulled the ops plane'\n"
+        "print('CLEAN')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=_ROOT, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0 and "CLEAN" in r.stdout, r.stdout + r.stderr
+
+
+def test_profiler_disabled_is_free():
+    """TM_TPU_PROF unset: the gate is one dict lookup, no thread, no
+    state — cheap enough to sit in process startup unconditionally."""
+    t0 = time.perf_counter()
+    for _ in range(1000):
+        assert maybe_start_profiler(env={}) is None
+    dt = time.perf_counter() - t0
+    assert dt < 0.5, f"disabled profiler gate cost {dt:.3f}s per 1000 calls"
+    assert not any(t.name == "tmlens-profiler" for t in threading.enumerate())
