@@ -77,6 +77,45 @@ def _log(msg):
     print(f"# [{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+# Flight recorder over the whole bench run (metrics/flight.py): the
+# process-global registry (engine/hash/mempool telemetry) is sampled
+# every BENCH_FLIGHT_INTERVAL seconds into .bench_runs/timeseries.jsonl
+# with a mark() per stage, so a bench regression arrives with a rate
+# timeline (which stage, and when within it, the rate fell off) instead
+# of one end-of-run total. BENCH_FLIGHT=off disables.
+_FLIGHT = None
+
+
+def _start_bench_flight() -> None:
+    global _FLIGHT
+    if os.environ.get("BENCH_FLIGHT", "on") == "off":
+        return
+    try:
+        from tendermint_tpu.metrics import global_registry
+        from tendermint_tpu.metrics.flight import FlightRecorder
+
+        out_dir = os.environ.get("BENCH_REPORT_DIR", os.path.join(_ROOT, ".bench_runs"))
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "timeseries.jsonl")
+        try:
+            os.remove(path)  # one timeline per bench run
+        except OSError:
+            pass
+        _FLIGHT = FlightRecorder(
+            [global_registry()], path,
+            interval=float(os.environ.get("BENCH_FLIGHT_INTERVAL", "0.5")),
+        )
+        _FLIGHT.start()
+        _log(f"flight recorder: {path} @ {_FLIGHT.interval}s")
+    except Exception as e:  # noqa: BLE001 - telemetry must not sink the run
+        _log(f"flight recorder failed to start: {type(e).__name__}: {e}")
+
+
+def _flight_mark(stage: str) -> None:
+    if _FLIGHT is not None:
+        _FLIGHT.mark(stage)
+
+
 def _write_bench_report() -> None:
     """Persist a tmlens-style fleet report for THIS bench process:
     dump the process-global registry (engine/hash/mempool telemetry the
@@ -119,6 +158,14 @@ def _write_bench_report() -> None:
             "series": len(exp.names()),
             "histograms": hists,
         }
+        global _FLIGHT
+        if _FLIGHT is not None:
+            _FLIGHT.stop()
+            from tendermint_tpu.lens.series import parse_timeseries, summarize_timeseries
+
+            report["timeline"] = summarize_timeseries(parse_timeseries(_FLIGHT.path))
+            report["timeseries"] = _FLIGHT.path
+            _FLIGHT = None
         path = os.path.join(out_dir, "fleet_report.json")
         with open(path, "w") as f:
             json.dump(report, f, indent=1)
@@ -647,6 +694,49 @@ def bench_mempool(floods=(1000, 10000, 50000)):
         ),
         flush=True,
     )
+
+    # -- flight-recorder overhead (acceptance: enabled <= 1% of this
+    # stage; disabled is zero-cost by construction — no object, no
+    # thread). One sample tick against the NOW fully-populated global
+    # registry (every engine/hash/mempool family the floods above
+    # touched), amortized over the default 1s e2e cadence: the steady-
+    # state fraction of wall time the recorder costs a busy node is
+    # per_sample / interval regardless of stage length.
+    import tempfile
+
+    from tendermint_tpu.metrics import global_registry
+    from tendermint_tpu.metrics.flight import FlightRecorder
+
+    tmp = tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False)
+    tmp.close()
+    fr = FlightRecorder([global_registry()], tmp.name, interval=1.0)
+    fr.sample_once()  # warm: file open + full anchor
+    n_ticks = 200
+    t0 = time.perf_counter()
+    for _ in range(n_ticks):
+        fr.sample_once()
+    per_sample_s = (time.perf_counter() - t0) / n_ticks
+    fr.stop()
+    os.unlink(tmp.name)
+    overhead_pct = 100.0 * per_sample_s / 1.0
+    _log(
+        f"flight recorder: {per_sample_s * 1e6:,.0f}us/sample vs 1s cadence "
+        f"= {overhead_pct:.3f}% steady-state overhead"
+    )
+    assert overhead_pct <= 1.0, (
+        f"flight recorder overhead {overhead_pct:.2f}% exceeds the 1% budget"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "flight_sample_overhead_pct",
+                "value": round(overhead_pct, 4),
+                "unit": "% of wall time at the default 1s cadence",
+                "per_sample_us": round(per_sample_s * 1e6, 1),
+            }
+        ),
+        flush=True,
+    )
     return last
 
 
@@ -674,6 +764,8 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "mempool":
         # targeted device-free run: `python bench.py mempool`
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _start_bench_flight()
+        _flight_mark("mempool")
         bench_mempool()
         _write_bench_report()
         sys.exit(0)
@@ -684,6 +776,7 @@ def main():
     if _tmtrace.enabled():  # TM_TPU_TRACE=1 alone also traces the run
         _log("tracing active: stage timelines in "
              f"{TRACE_DIR}; rates include tracer overhead")
+    _start_bench_flight()
     jobs = ([], [], [])
 
     # Stage 1 (no device): ALL job generation (pure-Python signing,
@@ -705,6 +798,7 @@ def main():
     # failures never sink the run.
     if os.environ.get("BENCH_HASH", "on") != "off":
         try:
+            _flight_mark("hash")
             with stage_deadline(min(max(_remaining() - 60, 20), 120)):
                 bench_hash()
             _save_stage_trace("hash")
@@ -716,6 +810,7 @@ def main():
     # device-free like the hash stage; failures never sink the run.
     if os.environ.get("BENCH_MEMPOOL", "on") != "off":
         try:
+            _flight_mark("mempool")
             with stage_deadline(min(max(_remaining() - 60, 20), 150)):
                 bench_mempool()
             _save_stage_trace("mempool")
@@ -827,6 +922,7 @@ def main():
             _log(f"budget exhausted ({rem:.0f}s left); stopping at banked result")
             break
         try:
+            _flight_mark(f"device_b{batch}")
             with stage_deadline(rem - 15 if best else rem):
                 rate = bench_device(jobs, batch)
         except StageTimeout:
@@ -847,6 +943,7 @@ def main():
     # Only ever improves the banked line; failures change nothing.
     if best and _remaining() > 75:
         try:
+            _flight_mark("cached")
             with stage_deadline(min(_remaining() - 15, 240)):
                 rate = bench_device(jobs, best_batch, cached=True)
             _log(f"batch {best_batch} cached: {rate:,.0f} sigs/s pipelined")
@@ -877,6 +974,7 @@ def main():
         else:
             dispatch_msm = M.verify_batch_rlc_async
         try:
+            _flight_mark("msm")
             with stage_deadline(min(_remaining() - 15, 300)):
                 h = dispatch_msm(pks, msgs, sigs)
                 assert M.collect_rlc(h), "MSM rejected valid batch (warm-up)"
@@ -905,6 +1003,7 @@ def main():
     # of the same ~667-sig commits.
     if best and fastsync_chain is not None and _remaining() > 60:
         try:
+            _flight_mark("fastsync")
             with stage_deadline(min(_remaining() - 15, 240)):
                 blocks_rate = bench_fastsync(fastsync_chain)
             cpu_blocks = cpu_rate / 667.0
@@ -935,6 +1034,7 @@ def main():
 
     if _engine.engine_enabled() and _remaining() > 45:
         try:
+            _flight_mark("coalesced")
             with stage_deadline(min(_remaining() - 15, 240)):
                 rate = bench_coalesced(jobs)
             _log(f"coalesced 4-caller engine throughput: {rate:,.0f} sigs/s")

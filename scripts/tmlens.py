@@ -3,11 +3,33 @@
 
 Usage:
   python scripts/tmlens.py analyze <run-dir>
-      Parse every node's metrics.txt/trace.json, print the fleet
-      summary + gate results, and write <run-dir>/fleet_report.json.
-      When any node left a trace, also writes the clock-aligned
-      Perfetto fleet timeline to <run-dir>/fleet_trace.json.
+      Parse every node's metrics.txt/trace.json/timeseries.jsonl,
+      print the fleet summary + gate results, and write
+      <run-dir>/fleet_report.json. When any node left a trace, also
+      writes the clock-aligned Perfetto fleet timeline to
+      <run-dir>/fleet_trace.json.
       Exit code: 0 = verdict pass, 1 = verdict fail, 2 = usage/IO.
+
+  python scripts/tmlens.py watch <run-dir>
+  python scripts/tmlens.py watch --addrs host:port,host:port
+      Live terminal view with the SAME rolling gates the e2e collector
+      runs (lens/series.py RollingGates): each tick scrapes every
+      node's /metrics (--addrs, bare host:port means
+      http://host:port/metrics) or re-reads each node dir's growing
+      timeseries.jsonl (<run-dir>), prints one status line per node,
+      and evaluates liveness-stall / height-spread / windowed-step-p99
+      / churn-storm live. Exits 1 the moment a gate trips; exits 2
+      when a --once tick could observe NOTHING (every scrape failed /
+      no timeseries artifacts) — a dead fleet must not probe healthy.
+      Run-dir mode trips the timeline gates (rate_stall/churn_storm)
+      at the LIVE `stall_after_s` threshold (30s) — deliberately
+      tighter than `analyze`'s post-mortem `rate_stall_tail_s` (60s):
+      a monitor flags earlier than an autopsy condemns.
+      --interval S   scrape/refresh cadence (default 2)
+      --duration S   stop after S seconds (default: run until ^C)
+      --once         one tick, then exit (scriptable health probe)
+      --gates ...    watch-gate overrides (series.py WATCH_DEFAULTS),
+                     inline JSON or a file path
 
   --gates <json-or-path>
       Gate threshold overrides: inline JSON ('{"max_height_spread": 2}')
@@ -50,12 +72,165 @@ def _load_gates(spec: str) -> dict:
     return json.loads(spec)
 
 
+def _watch(args) -> int:
+    import time
+
+    from tendermint_tpu.lens.series import (
+        TIMESERIES_NAME,
+        RollingGates,
+        parse_timeseries,
+        scrape_metrics,
+        summarize_timeseries,
+        timeline_trips,
+    )
+
+    run_dir = None
+    addrs: list[str] = []
+    interval = 2.0
+    duration = None
+    once = False
+    gates_cfg = None
+    i = 0
+    try:
+        while i < len(args):
+            a = args[i]
+            if a == "--addrs":
+                addrs = [s.strip() for s in args[i + 1].split(",") if s.strip()]
+                i += 2
+            elif a == "--interval":
+                interval = float(args[i + 1])
+                i += 2
+            elif a == "--duration":
+                duration = float(args[i + 1])
+                i += 2
+            elif a == "--once":
+                once = True
+                i += 1
+            elif a == "--gates":
+                gates_cfg = _load_gates(args[i + 1])
+                i += 2
+            elif a.startswith("-"):
+                print(f"unknown watch flag {a!r}", file=sys.stderr)
+                return 2
+            elif run_dir is None:
+                run_dir = a
+                i += 1
+            else:
+                print(f"unexpected argument {a!r}", file=sys.stderr)
+                return 2
+    except (IndexError, ValueError) as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    if not addrs and (run_dir is None or not os.path.isdir(run_dir)):
+        print(f"watch needs --addrs or a run directory (got {run_dir!r})", file=sys.stderr)
+        return 2
+
+    try:
+        gates = RollingGates(gates_cfg)
+    except ValueError as e:
+        print(f"bad gate config: {e}", file=sys.stderr)
+        return 2
+    cfg = gates.cfg
+    targets = [
+        (a, a if "://" in a else f"http://{a}/metrics") for a in addrs
+    ]
+    deadline = time.monotonic() + duration if duration is not None else None
+    # run-dir mode: (path -> (size, timeline)) so an unchanged file is
+    # not re-parsed + re-summarized every tick
+    tl_cache: dict = {}
+    ever_observed = False
+    while True:
+        now = time.time()
+        print(f"-- tmlens watch @ {time.strftime('%H:%M:%S')} --")
+        tripped: list[dict] = []
+        observed = 0
+        if targets:  # live /metrics mode: the full rolling gate set
+            for name, url in targets:
+                try:
+                    _text, exp = scrape_metrics(url)
+                except Exception as e:  # noqa: BLE001 - a dead node is a data point
+                    print(f"  {name}: scrape failed ({type(e).__name__})")
+                    continue
+                observed += 1
+                gates.observe(name, exp, t=now)
+                w = gates.nodes[name]
+                print(f"  {name}: h={w.height} age={round(w.age, 1) if w.age is not None else None}s")
+            tripped = gates.evaluate(now=time.time())
+        else:  # run-dir mode: judge each node's growing timeseries.jsonl
+            for entry in sorted(os.listdir(run_dir)):
+                path = os.path.join(run_dir, entry, TIMESERIES_NAME)
+                if not os.path.exists(path):
+                    continue
+                size = os.path.getsize(path)
+                cached = tl_cache.get(path)
+                if cached is not None and cached[0] == size:
+                    tl = cached[1]  # unchanged file: skip the re-parse
+                else:
+                    tl = summarize_timeseries(parse_timeseries(path))
+                    tl_cache[path] = (size, tl)
+                if tl is None:
+                    continue
+                observed += 1
+                h = tl.get("height") or {}
+                ch = tl.get("churn") or {}
+                age = (tl.get("head_age") or {}).get("last_s")
+                # a stream that stopped GROWING is its own stall: the
+                # recorder flushes every interval, so silence means the
+                # node (or its recorder) is dead — stalled_tail_s alone
+                # can't see it because the last records looked healthy
+                silent_for = max(0.0, now - tl["t_end"])
+                print(
+                    f"  {entry}: h={h.get('last')} ({h.get('rate_per_s')}/s, "
+                    f"tail stall {h.get('stalled_tail_s')}s) age={age}s "
+                    f"churn {ch.get('last_window_per_s')}/s "
+                    f"[{tl['records']} records, silent {round(silent_for, 1)}s]"
+                )
+                # the trip conditions are the shared timeline_trips —
+                # the SAME gate names/shapes the post-mortem verdict
+                # uses; live differences: trailing-window churn (a
+                # healed burst must not trip a monitor forever) and
+                # silence detection (`now` given), at the tighter live
+                # stall threshold
+                for trip in timeline_trips(
+                    tl, cfg["stall_after_s"], cfg["max_connects_per_s"], now=now
+                ):
+                    tripped.append({
+                        "name": trip["name"],
+                        "detail": f"{entry}: {trip['detail']}",
+                    })
+        ever_observed = ever_observed or observed > 0
+        if tripped:
+            for g in tripped:
+                print(f"  GATE TRIPPED {g['name']}: {g['detail']}")
+            return 1
+        if observed == 0:
+            # nothing answered/left records: "ok" would be a lie — a
+            # health probe must distinguish healthy from unobservable
+            print("  gates: UNOBSERVABLE (no node scraped / no timeseries)")
+            if once:
+                return 2
+        else:
+            print("  gates: ok")
+        if once or (deadline is not None and time.monotonic() >= deadline):
+            # a bounded probe that observed NOTHING for its whole
+            # duration is unobservable, not healthy — same rule as
+            # --once
+            return 0 if ever_observed else 2
+        time.sleep(interval)
+
+
 def main(argv) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0 if argv else 2
+    if argv[0] == "watch":
+        try:
+            return _watch(argv[1:])
+        except KeyboardInterrupt:
+            return 0
     if argv[0] != "analyze":
-        print(f"unknown command {argv[0]!r} (try: analyze <run-dir>)", file=sys.stderr)
+        print(f"unknown command {argv[0]!r} (try: analyze <run-dir> | watch ...)",
+              file=sys.stderr)
         return 2
     args = argv[1:]
     run_dir = None

@@ -162,6 +162,12 @@ class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
     namespace: str = "tendermint"
+    # In-run flight recorder (metrics/flight.py): sample the node's
+    # registries every this-many seconds into <home>/timeseries.jsonl
+    # (flushed per record — rates-over-time survive SIGKILL). 0
+    # disables (the production default: zero threads, zero cost); the
+    # e2e runner turns it on fleet-wide. No reference analog.
+    flight_interval: float = 0.0
 
 
 @dataclass

@@ -35,6 +35,12 @@ class NewValidBlockMessage:
 @dataclass
 class ProposalMessage:
     proposal: Proposal
+    # origin wall-clock (unix ns) stamped by the sending reactor's
+    # encoder; 0 = unstamped (locally constructed / WAL replay). The
+    # receive side turns now - origin_ns into the
+    # consensus_msg_propagation_seconds histogram (shared-clock
+    # testnets; docs/observability.md#flight).
+    origin_ns: int = 0
 
 
 @dataclass
@@ -49,11 +55,13 @@ class BlockPartMessage:
     height: int
     round: int
     part: Part
+    origin_ns: int = 0  # see ProposalMessage.origin_ns
 
 
 @dataclass
 class VoteMessage:
     vote: Vote
+    origin_ns: int = 0  # see ProposalMessage.origin_ns
 
 
 @dataclass

@@ -74,7 +74,14 @@ def _ba_from_proto(p: pb.BitArrayProto | None) -> BitArray | None:
 
 
 def encode_consensus_msg(msg) -> bytes:
-    """ref: internal/consensus/msgs.go MsgToProto."""
+    """ref: internal/consensus/msgs.go MsgToProto.
+
+    Data-plane frames (proposal / block part / vote) additionally carry
+    an origin wall-clock stamp (ConsensusMessage.origin_ns, a local
+    field-1000 extension): the encoder runs once per peer send, so the
+    stamp is the FRAME's origin time, and the receive side's
+    now - origin is pure network propagation — what splits a slow step
+    into network vs compute on shared-clock testnets."""
     if isinstance(msg, NewRoundStepMessage):
         wrapped = pb.ConsensusMessage(new_round_step=pb.CsNewRoundStep(
             height=msg.height, round=msg.round, step=msg.step,
@@ -86,16 +93,19 @@ def encode_consensus_msg(msg) -> bytes:
             block_part_set_header=(msg.block_part_set_header or PartSetHeader()).to_proto(),
             block_parts=_ba_to_proto(msg.block_parts), is_commit=msg.is_commit))
     elif isinstance(msg, ProposalMessage):
-        wrapped = pb.ConsensusMessage(proposal=pb.CsProposal(proposal=msg.proposal.to_proto()))
+        wrapped = pb.ConsensusMessage(proposal=pb.CsProposal(proposal=msg.proposal.to_proto()),
+                                      origin_ns=time.time_ns())
     elif isinstance(msg, ProposalPOLMessage):
         wrapped = pb.ConsensusMessage(proposal_pol=pb.CsProposalPOL(
             height=msg.height, proposal_pol_round=msg.proposal_pol_round,
             proposal_pol=_ba_to_proto(msg.proposal_pol)))
     elif isinstance(msg, BlockPartMessage):
         wrapped = pb.ConsensusMessage(block_part=pb.CsBlockPart(
-            height=msg.height, round=msg.round, part=msg.part.to_proto()))
+            height=msg.height, round=msg.round, part=msg.part.to_proto()),
+            origin_ns=time.time_ns())
     elif isinstance(msg, VoteMessage):
-        wrapped = pb.ConsensusMessage(vote=pb.CsVote(vote=msg.vote.to_proto()))
+        wrapped = pb.ConsensusMessage(vote=pb.CsVote(vote=msg.vote.to_proto()),
+                                      origin_ns=time.time_ns())
     elif isinstance(msg, HasVoteMessage):
         wrapped = pb.ConsensusMessage(has_vote=pb.CsHasVote(
             height=msg.height, round=msg.round, type=msg.type, index=msg.index))
@@ -125,16 +135,18 @@ def decode_consensus_msg(data: bytes):
             p.height or 0, p.round or 0, PartSetHeader.from_proto(p.block_part_set_header),
             _ba_from_proto(p.block_parts), bool(p.is_commit))
     if w.proposal is not None:
-        return ProposalMessage(Proposal.from_proto(w.proposal.proposal))
+        return ProposalMessage(Proposal.from_proto(w.proposal.proposal),
+                               origin_ns=w.origin_ns or 0)
     if w.proposal_pol is not None:
         p = w.proposal_pol
         return ProposalPOLMessage(p.height or 0, p.proposal_pol_round or 0,
                                   _ba_from_proto(p.proposal_pol))
     if w.block_part is not None:
         p = w.block_part
-        return BlockPartMessage(p.height or 0, p.round or 0, Part.from_proto(p.part))
+        return BlockPartMessage(p.height or 0, p.round or 0, Part.from_proto(p.part),
+                                origin_ns=w.origin_ns or 0)
     if w.vote is not None:
-        return VoteMessage(Vote.from_proto(w.vote.vote))
+        return VoteMessage(Vote.from_proto(w.vote.vote), origin_ns=w.origin_ns or 0)
     if w.has_vote is not None:
         p = w.has_vote
         return HasVoteMessage(p.height or 0, p.round or 0, p.type or 0, p.index or 0)
@@ -172,6 +184,11 @@ class ConsensusReactor:
 
     GOSSIP_SLEEP = 0.05  # ref: gossipSleepDuration (100ms in reference)
     QUERY_MAJ23_SLEEP = 2.0
+    # origin stamps farther than this from our clock are cross-host
+    # clock skew, not latency — recording them would poison the
+    # propagation histogram (stamps are only meaningful on the
+    # shared-clock local testnets the e2e/bench planes run)
+    PROPAGATION_MAX_S = 60.0
 
     def __init__(self, cs, state_ch, data_ch, vote_ch, bits_ch, peer_manager, block_store):
         self.cs = cs
@@ -321,9 +338,11 @@ class ConsensusReactor:
                 continue
             try:
                 if isinstance(msg, ProposalMessage):
+                    self._observe_propagation(msg, "proposal")
                     ps.set_has_proposal(msg.proposal)
                     self.cs.add_peer_message(msg, nid)
                 elif isinstance(msg, BlockPartMessage):
+                    self._observe_propagation(msg, "block_part")
                     ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
                     self.cs.add_peer_message(msg, nid)
                 elif isinstance(msg, ProposalPOLMessage):
@@ -343,6 +362,7 @@ class ConsensusReactor:
                 continue
             try:
                 if isinstance(msg, VoteMessage):
+                    self._observe_propagation(msg, "vote")
                     height = self.cs.rs.height
                     val_size = self.cs.state.validators.size()
                     last_size = self.cs.state.last_validators.size()
@@ -378,6 +398,20 @@ class ConsensusReactor:
     def _peer_state(self, nid: str) -> PeerState | None:
         with self._lock:
             return self.peers.get(nid)
+
+    def _observe_propagation(self, msg, type_label: str) -> None:
+        """Record origin-to-receive latency of a stamped gossip frame
+        (consensus_msg_propagation_seconds{type}). Unstamped frames
+        (origin_ns 0: legacy peer, WAL replay) and stamps outside the
+        skew window are skipped; a small negative dt (same-host clock
+        step) clamps to 0."""
+        metrics = getattr(self.cs, "metrics", None)
+        origin = getattr(msg, "origin_ns", 0)
+        if metrics is None or not origin:
+            return
+        dt = (time.time_ns() - origin) / 1e9
+        if -1.0 <= dt <= self.PROPAGATION_MAX_S:
+            metrics.msg_propagation.observe(max(0.0, dt), type_label)
 
     # ---------------------------------------------------------- gossip data
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _pytime
 import traceback
 from typing import Callable
 
@@ -143,6 +144,11 @@ class ConsensusState:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._n_steps = 0
+        # quorum-assembly timing (consensus_quorum_assembly_seconds):
+        # first vote seen for (height, round, type) -> 2/3 majority.
+        # Cleared at every height transition (update_to_state).
+        self._quorum_clock: dict[tuple, float] = {}
+        self._quorum_done: set[tuple] = set()
 
         self.update_to_state(state)
         # Boot-time reconstruction is best-effort: a statesync-restored
@@ -429,6 +435,8 @@ class ConsensusState:
         rs.last_commit = last_commit
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
+        self._quorum_clock.clear()
+        self._quorum_done.clear()
         self.state = state
         if self.metrics is not None:
             self.metrics.validators.set(state.validators.size())
@@ -1079,11 +1087,19 @@ class ConsensusState:
             if self.metrics is not None:
                 self.metrics.duplicate_vote.add(1)
             return False
+        if self.metrics is not None and not self.replay_mode:
+            # start the quorum-assembly clock on the FIRST vote of this
+            # (height, round, type) — our own votes flow through here too
+            self._quorum_clock.setdefault(
+                (vote.height, vote.round, vote.type), _pytime.monotonic()
+            )
         self.broadcast(HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index))
 
         if vote.type == PREVOTE:
             prevotes = rs.votes.prevotes(vote.round)
             block_id, ok = prevotes.two_thirds_majority()
+            if ok:
+                self._mark_quorum(vote)
             if ok and not block_id.is_nil():
                 if rs.valid_round < vote.round and vote.round == rs.round:
                     if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
@@ -1109,6 +1125,7 @@ class ConsensusState:
             precommits = rs.votes.precommits(vote.round)
             block_id, ok = precommits.two_thirds_majority()
             if ok:
+                self._mark_quorum(vote)
                 self._enter_new_round(height, vote.round)
                 self._enter_precommit(height, vote.round)
                 if not block_id.is_nil():
@@ -1123,6 +1140,23 @@ class ConsensusState:
         else:
             raise ConsensusError(f"unexpected vote type {vote.type}")
         return True
+
+    def _mark_quorum(self, vote: Vote) -> None:
+        """First 2/3 majority for (height, round, type): observe the
+        assembly time since that slot's first vote
+        (consensus_quorum_assembly_seconds{type}) exactly once."""
+        if self.metrics is None or self.replay_mode:
+            return
+        key = (vote.height, vote.round, vote.type)
+        if key in self._quorum_done:
+            return
+        self._quorum_done.add(key)
+        t0 = self._quorum_clock.get(key)
+        if t0 is not None:
+            self.metrics.quorum_assembly.observe(
+                _pytime.monotonic() - t0,
+                "prevote" if vote.type == PREVOTE else "precommit",
+            )
 
     # -------------------------------------------------------------- votes
 

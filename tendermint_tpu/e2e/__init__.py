@@ -7,6 +7,6 @@ checks, and block-cadence benchmarking over RPC.
 """
 
 from .manifest import Manifest, NodeManifest
-from .runner import Runner
+from .runner import Runner, WatchTripped
 
-__all__ = ["Manifest", "NodeManifest", "Runner"]
+__all__ = ["Manifest", "NodeManifest", "Runner", "WatchTripped"]

@@ -104,6 +104,12 @@ class Manifest:
     finalize_block_delay_ms: int = 0
     # packet-level fault plane for every link (docs/faultnet.md)
     faultnet: FaultNetManifest = field(default_factory=FaultNetManifest)
+    # flight-recorder sample cadence for every node
+    # (instrumentation.flight-interval, metrics/flight.py): ON by
+    # default in e2e — rates-over-time are exactly the evidence a
+    # perturbed run needs, and the per-tick cost is sub-millisecond.
+    # 0 turns it off.
+    flight_interval: float = 1.0
 
     @classmethod
     def parse(cls, text: str) -> "Manifest":
@@ -120,6 +126,7 @@ class Manifest:
             process_proposal_delay_ms=int(doc.get("process_proposal_delay_ms", 0)),
             check_tx_delay_ms=int(doc.get("check_tx_delay_ms", 0)),
             finalize_block_delay_ms=int(doc.get("finalize_block_delay_ms", 0)),
+            flight_interval=float(doc.get("flight_interval", 1.0)),
         )
         fn = doc.get("faultnet") or {}
         m.faultnet = FaultNetManifest(

@@ -27,6 +27,17 @@ from ..utils.tmtime import Time
 from .manifest import Manifest, NodeManifest
 
 
+class WatchTripped(RuntimeError):
+    """A live watch gate fired mid-run: the runner aborts instead of
+    burning the remaining timeout. cleanup() still sweeps artifacts and
+    the fleet report's verdict names this gate."""
+
+    def __init__(self, gate: str, detail: str):
+        super().__init__(f"live watch gate tripped: {gate} — {detail}")
+        self.gate = gate
+        self.detail = detail
+
+
 def _free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):
@@ -83,6 +94,19 @@ class Runner:
         # tmlens verdict from the last analyze_artifacts() (cleanup
         # runs it); slow e2e tests assert on this after cleanup
         self.last_report: dict | None = None
+        # live watch collector (start_watch): a daemon thread scrapes
+        # every node's /metrics on a rolling cadence, keeps the last
+        # scrape per node (persisted as metrics.last-watch.txt when a
+        # node dies), and evaluates sliding-window gates
+        # (lens/series.py RollingGates). First trip -> watch_tripped
+        # is set, the wait loops raise WatchTripped, and the run
+        # aborts with a full artifact sweep.
+        self.watch_tripped: dict | None = None
+        self._watch_thread = None
+        self._watch_stop = None
+        self._watch_hold = None
+        self._watch_gates = None
+        self._last_scrapes: dict[str, str] = {}
 
     # ----------------------------------------------------------------- setup
 
@@ -206,6 +230,10 @@ class Runner:
             # artifact — ref: the reference e2e's prometheus flag)
             cfg.instrumentation.prometheus = True
             cfg.instrumentation.prometheus_listen_addr = f"127.0.0.1:{node.prom_port}"
+            # flight recorder ON in e2e (manifest default 1.0s): each
+            # node streams delta records to <home>/timeseries.jsonl so
+            # a SIGKILL'd node still leaves its rate timeline
+            cfg.instrumentation.flight_interval = self.manifest.flight_interval
             cfg.p2p.send_rate = node.m.send_rate
             seeds = [o for o in self.nodes if o.m.mode == "seed"]
             if node.m.mode == "seed":
@@ -367,10 +395,132 @@ class Runner:
         deadline = time.monotonic() + timeout
         pending = self._rpc_nodes(nodes)
         while pending and time.monotonic() < deadline:
+            self.check_watch()
             pending = [n for n in pending if n.height() < 0]
             time.sleep(0.2)
         if pending:
             raise TimeoutError(f"nodes never became ready: {[n.m.name for n in pending]}")
+
+    # ----------------------------------------------------------------- watch
+
+    def start_watch(self, interval: float = 2.0, gates: dict | None = None) -> None:
+        """Start the live collector thread (lens/series.py
+        RollingGates over every node's /metrics). Gate keys:
+        WATCH_DEFAULTS; a trip aborts the run at the next wait loop
+        (check_watch) instead of timing out minutes later."""
+        import threading
+
+        from ..lens.series import RollingGates
+
+        if self._watch_thread is not None:
+            return
+        self._watch_gates = RollingGates(gates)
+        self._watch_stop = threading.Event()
+        self._watch_hold = threading.Event()
+        self._watch_interval = interval
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, daemon=True, name="e2e-watch"
+        )
+        self._watch_thread.start()
+        self.log(f"live watch started ({interval}s cadence)")
+
+    def stop_watch(self) -> None:
+        if self._watch_stop is not None:
+            self._watch_stop.set()
+        t = self._watch_thread
+        if t is not None:
+            t.join(timeout=5)
+            self._watch_thread = None
+
+    def hold_watch(self) -> None:
+        """Suspend gate EVALUATION (scraping continues, so last-watch
+        snapshots stay fresh) around intentional perturbations — a
+        deliberately partitioned node must not trip the stall gate."""
+        if self._watch_hold is not None:
+            self._watch_hold.set()
+
+    def resume_watch(self) -> None:
+        if self._watch_hold is not None and self._watch_hold.is_set():
+            if self._watch_gates is not None:
+                # windows carry pre-perturbation progress clocks;
+                # judging recovery against them would false-trip.
+                # Reset BEFORE releasing the hold: while held the watch
+                # thread never enters evaluate(), so clearing the node
+                # map here cannot race its dict iteration.
+                self._watch_gates.reset()
+            self._watch_hold.clear()
+
+    def check_watch(self) -> None:
+        """Raise WatchTripped if the collector tripped a gate — called
+        from every wait loop so the run aborts within one poll tick."""
+        if self.watch_tripped is not None:
+            raise WatchTripped(self.watch_tripped["gate"], self.watch_tripped["detail"])
+
+    def _watch_loop(self) -> None:
+        from ..lens.series import scrape_metrics
+
+        while not self._watch_stop.wait(self._watch_interval):
+            now = time.time()
+            for node in self.nodes:
+                if node.m.mode == "seed" or not node.prom_port:
+                    continue
+                if node.proc is None or node.proc.poll() is not None:
+                    continue  # dead: its last scrape is already held
+                try:
+                    body, exp = scrape_metrics(
+                        f"http://127.0.0.1:{node.prom_port}/metrics", timeout=2.0
+                    )
+                except Exception:  # noqa: BLE001 - scrape gaps are data, not faults
+                    continue
+                self._last_scrapes[node.m.name] = body
+                try:
+                    self._watch_gates.observe(node.m.name, exp, t=now)
+                except Exception as e:  # noqa: BLE001
+                    self.log(f"watch observe failed for {node.m.name}: {e}")
+            if self._watch_hold is not None and self._watch_hold.is_set():
+                continue
+            if self._watch_stop.is_set():
+                # stop_watch() fired mid-sweep (a sweep can take seconds
+                # against unresponsive nodes and outlive the 5s join):
+                # a teardown-time "trip" would flip a passing run's
+                # verdict and race cleanup's own artifact sweep
+                return
+            try:
+                tripped = self._watch_gates.evaluate(now=time.time())
+            except Exception as e:  # noqa: BLE001 - the watch must outlive bugs
+                self.log(f"watch evaluate failed: {type(e).__name__}: {e}")
+                continue
+            if tripped:
+                g = tripped[0]
+                self.watch_tripped = {
+                    "gate": g["name"],
+                    "detail": g["detail"],
+                    "t": time.time(),
+                    "all": tripped,
+                }
+                self.log(f"WATCH TRIPPED: {g['name']} — {g['detail']}")
+                # sweep NOW: the state at trip time is the evidence
+                # (cleanup's final sweep still runs later)
+                try:
+                    self.collect_artifacts(suffix=".on-trip")
+                except Exception as e:  # noqa: BLE001 - evidence only
+                    self.log(f"on-trip artifact sweep failed: {e}")
+                return
+
+    def _persist_last_watch(self, node: E2ENode) -> None:
+        """Persist the collector's most recent scrape of this node as
+        metrics.last-watch.txt — the freshest telemetry a node that is
+        about to be (or already was) SIGKILL'd can leave, alongside the
+        perturb() pre-kill snapshot (which covers runner-initiated
+        kills only)."""
+        body = self._last_scrapes.get(node.m.name)
+        if not body:
+            return
+        try:
+            with open(os.path.join(node.home, "metrics.last-watch.txt"), "w") as f:
+                f.write(body)
+        except OSError as e:
+            self.log(f"last-watch persist failed for {node.m.name}: {e}")
 
     # ------------------------------------------------------------------ load
 
@@ -412,6 +562,7 @@ class Runner:
         i = 0
         deadline = time.monotonic() + timeout
         while len(sent) < n_txs:
+            self.check_watch()
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"flood stalled: {len(sent)}/{n_txs} txs submitted in {timeout}s"
@@ -572,6 +723,9 @@ class Runner:
                 self.collect_artifacts(nodes=[node], suffix=f".pre-{kind}")
             except Exception as e:  # noqa: BLE001 - evidence only
                 self.log(f"pre-{kind} artifact snapshot failed for {node.m.name}: {e}")
+            # the collector's cadence scrape too: its timestamp dates
+            # the telemetry independently of this perturb call
+            self._persist_last_watch(node)
         if kind == "kill":
             # node AND its out-of-process app are one failure domain —
             # the reference's kill is `docker kill` of the container
@@ -713,25 +867,34 @@ class Runner:
     def _wait_heights(self, nodes, target: int, timeout: float) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            self.check_watch()
             if self._max_height(nodes) >= target:
                 return
             time.sleep(0.25)
         raise TimeoutError(f"majority never reached height {target} during partition")
 
     def run_perturbations(self) -> None:
-        for node in self.nodes:
-            for kind in node.m.perturb:
-                self.perturb(node, kind)
-                if node.m.mode == "seed":
-                    # seeds serve no RPC: "recovered" = the (possibly
-                    # freshly restarted) process stays alive for a grace
-                    # period
-                    time.sleep(2)
-                    assert node.proc is not None and node.proc.poll() is None, (
-                        f"{node.m.name} did not survive {kind}"
-                    )
-                else:
-                    self.wait_progress(node, timeout=90)
+        # gate evaluation pauses for the whole perturbation phase: a
+        # deliberately partitioned/blackholed node IS stalled, and its
+        # recovery is judged by wait_progress's own timeout. Scraping
+        # continues so metrics.last-watch.txt stays fresh.
+        self.hold_watch()
+        try:
+            for node in self.nodes:
+                for kind in node.m.perturb:
+                    self.perturb(node, kind)
+                    if node.m.mode == "seed":
+                        # seeds serve no RPC: "recovered" = the (possibly
+                        # freshly restarted) process stays alive for a grace
+                        # period
+                        time.sleep(2)
+                        assert node.proc is not None and node.proc.poll() is None, (
+                            f"{node.m.name} did not survive {kind}"
+                        )
+                    else:
+                        self.wait_progress(node, timeout=90)
+        finally:
+            self.resume_watch()
 
     # ------------------------------------------------------------------ wait
 
@@ -739,6 +902,7 @@ class Runner:
         deadline = time.monotonic() + timeout
         nodes = self._rpc_nodes(nodes)
         while time.monotonic() < deadline:
+            self.check_watch()
             if all(n.height() >= height for n in nodes):
                 return
             time.sleep(0.2)
@@ -751,12 +915,17 @@ class Runner:
         deadline = time.monotonic() + timeout
         h0 = -1
         while time.monotonic() < deadline:
+            self.check_watch()
             if node.proc is not None and node.proc.poll() is not None:
                 # The node DIED mid-scenario rather than stalling:
                 # grab evidence from the survivors NOW (their state at
                 # the moment of death, not after another 90s of
                 # drift), then fail fast — a dead process will never
-                # advance out this loop.
+                # advance out this loop. The victim itself can't be
+                # scraped anymore; its collector-cached last scrape is
+                # the freshest telemetry it left (kills the runner
+                # didn't initiate have no pre-kill snapshot).
+                self._persist_last_watch(node)
                 try:
                     self.collect_artifacts(suffix=".on-death")
                 except Exception as e:  # noqa: BLE001 - evidence only
@@ -852,13 +1021,37 @@ class Runner:
         fleet_report.json (+ fleet_trace.json when any node left a
         trace), log the human summary, and return the report. This is
         the ROADMAP-4 gate: the slow e2e tests assert
-        `runner.last_report["verdict"]`. Never raises — a broken
-        analyzer must not mask the run's own failure in a finally
-        block."""
+        `runner.last_report["verdict"]`. A live-watch abort is folded
+        in: the tripped gate's entry is forced to FAIL (the final
+        scrapes may look healthy — they were taken seconds into the
+        failure, before the post-mortem thresholds could accumulate)
+        and the verdict names it. Never raises — a broken analyzer
+        must not mask the run's own failure in a finally block."""
         try:
             from ..lens import REPORT_NAME, analyze_run, render_summary, write_merged_trace
 
             report = analyze_run(self.base_dir, gates=gates)
+            if self.watch_tripped is not None:
+                report["live_abort"] = {
+                    k: v for k, v in self.watch_tripped.items() if k != "all"
+                }
+                live_by_name = {
+                    g["name"]: g for g in self.watch_tripped.get("all", [])
+                } or {self.watch_tripped["gate"]: self.watch_tripped}
+                matched = set()
+                for g in report["gates"]:
+                    live = live_by_name.get(g["name"])
+                    if live is not None:
+                        g["ok"] = False
+                        g["detail"] = f"live watch abort: {live['detail']}"
+                        matched.add(g["name"])
+                for name, live in live_by_name.items():
+                    if name not in matched:  # live-only gate name
+                        report["gates"].append({
+                            "name": name, "ok": False,
+                            "detail": f"live watch abort: {live['detail']}",
+                        })
+                report["verdict"] = "fail"
             with open(os.path.join(self.base_dir, REPORT_NAME), "w") as f:
                 json.dump(report, f, indent=1)
             merged = write_merged_trace(self.base_dir)
@@ -872,6 +1065,12 @@ class Runner:
             return None
 
     def cleanup(self) -> None:
+        self.stop_watch()
+        # nodes that are already dead can't serve the final scrape
+        # below; their collector-cached last scrape is the fallback
+        for node in self.nodes:
+            if node.proc is not None and node.proc.poll() is not None:
+                self._persist_last_watch(node)
         try:
             self.collect_artifacts()
         except Exception as e:  # noqa: BLE001 - teardown must proceed
@@ -907,6 +1106,9 @@ def run_manifest(manifest_path: str, base_dir: str, duration: float = 10.0) -> d
     runner.setup()
     try:
         runner.start()
+        # live rolling gates for the rest of the run: a stall/storm
+        # aborts here (WatchTripped) instead of timing out downstream
+        runner.start_watch()
         runner.wait_for_height(2)
         import threading
 

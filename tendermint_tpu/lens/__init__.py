@@ -12,6 +12,8 @@ every run so a failed gate arrives with evidence, not just a red X.
                  (quantiles via metrics.bucket_quantile)
     traces.py    per-node Chrome-trace load, block-commit clock
                  alignment, merged Perfetto fleet timeline
+    series.py    flight-recorder timeseries.jsonl parsing, windowed
+                 rates/change-points, live RollingGates (watch plane)
     analyze.py   per-node + fleet summaries over a run directory
     gates.py     declarative health gates -> pass/fail verdict
     profiler.py  TM_TPU_PROF=1 collapsed-stack sampling profiler
@@ -43,4 +45,15 @@ from .profiler import (  # noqa: F401
     profiling_requested,
 )
 from .prom import Exposition, HistogramSnapshot, parse_exposition  # noqa: F401
+from .series import (  # noqa: F401
+    TIMESERIES_NAME,
+    WATCH_DEFAULTS,
+    RollingGates,
+    change_points,
+    parse_timeseries,
+    scrape_metrics,
+    stalled_tail_s,
+    summarize_timeseries,
+    window_rate,
+)
 from .traces import align_offsets, commit_anchors, merge_traces  # noqa: F401

@@ -61,7 +61,8 @@ def discover_nodes(run_dir: str) -> list[tuple[str, str]]:
             continue
         if any(
             os.path.exists(os.path.join(d, f))
-            for f in ("metrics.txt", "trace.json", "profile.collapsed")
+            for f in ("metrics.txt", "trace.json", "profile.collapsed",
+                      "timeseries.jsonl")
         ):
             out.append((entry, d))
     return out
@@ -129,6 +130,15 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
         summary["round_duration"] = _hist_stats(
             exp, f"{NS}_consensus_round_duration_seconds"
         )
+        # network-vs-compute split of step time (origin-stamped gossip,
+        # consensus/reactor.py): propagation latency of received
+        # proposal/vote/block-part frames + quorum assembly time
+        summary["msg_propagation"] = _hist_stats(
+            exp, f"{NS}_consensus_msg_propagation_seconds"
+        )
+        summary["quorum_assembly"] = _hist_stats(
+            exp, f"{NS}_consensus_quorum_assembly_seconds"
+        )
         summary["block_interval"] = _hist_stats(
             exp, f"{NS}_consensus_block_interval_seconds"
         )
@@ -162,6 +172,21 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
         }
     else:
         summary["missing_series"] = ["<no metrics.txt artifact>"]
+
+    # flight-recorder timeline (timeseries.jsonl, metrics/flight.py):
+    # windowed rates + change-points survive a SIGKILL because each
+    # record was flushed as the run progressed — this is the evidence
+    # the rate_stall/churn_storm gates read
+    spath = os.path.join(node_dir, "timeseries.jsonl")
+    if os.path.exists(spath):
+        summary["artifacts"].append("timeseries.jsonl")
+        try:
+            from .series import parse_timeseries, summarize_timeseries
+
+            summary["timeline"] = summarize_timeseries(parse_timeseries(spath))
+        except (ValueError, KeyError, TypeError) as e:
+            summary["timeline"] = None
+            summary["timeline_error"] = f"{type(e).__name__}: {e}"
 
     if os.path.exists(tpath):
         summary["artifacts"].append("trace.json")
@@ -203,6 +228,21 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
         "height_spread": (max(heights) - min(heights)) if heights else None,
         "worst_last_block_age_s": max(ages) if ages else None,
     }
+    # fleet view of the flight-recorder timelines (rate_stall /
+    # churn_storm read the per-node blocks; this is the digest)
+    timelines = [s["timeline"] for s in summaries if s.get("timeline")]
+    fleet["nodes_with_timeseries"] = len(timelines)
+    if timelines:
+        tails = [
+            tl["height"]["stalled_tail_s"] for tl in timelines if tl.get("height")
+        ]
+        peaks = [
+            tl["churn"]["peak_connects_per_s"] for tl in timelines if tl.get("churn")
+        ]
+        fleet["timeline"] = {
+            "worst_height_stall_tail_s": max(tails) if tails else None,
+            "peak_connects_per_s": max(peaks) if peaks else None,
+        }
     # fleet-wide step p99: merge every node's (already step-merged)
     # histogram — identical bucket layouts by construction
     merged = None
@@ -268,6 +308,21 @@ def render_summary(report: dict) -> str:
             f"step_p99={sd.get('p99_s')}s block_interval_p50={bi.get('p50_s')}s "
             f"churn={(s.get('p2p') or {}).get('churn')}"
         )
+        prop = s.get("msg_propagation")
+        if prop:
+            lines.append(
+                f"    gossip propagation p50={prop.get('p50_s')}s "
+                f"p99={prop.get('p99_s')}s over {prop.get('count')} frames"
+            )
+        tl = s.get("timeline")
+        if tl:
+            h = tl.get("height") or {}
+            ch = tl.get("churn") or {}
+            lines.append(
+                f"    timeline: {tl['records']} records / {tl['span_s']}s, "
+                f"height {h.get('rate_per_s')}/s (tail stall {h.get('stalled_tail_s')}s), "
+                f"peak churn {ch.get('peak_connects_per_s')}/s"
+            )
         if s.get("missing_series"):
             lines.append(f"    missing series: {', '.join(s['missing_series'])}")
     for g in report["gates"]:

@@ -21,6 +21,19 @@ test assertions):
   missing_series     a node's scrape lacks a required series (or a node
                      left no metrics artifact at all while
                      `require_metrics_from_all` is set)
+  rate_stall         a node's flight-recorder timeline (timeseries.jsonl,
+                     metrics/flight.py) shows height flat for the
+                     trailing `rate_stall_tail_s` — catches stalls the
+                     final scrape can't see (SIGKILL'd nodes) and dates
+                     when progress stopped
+  churn_storm        a node's timeline shows a connect+dial rate above
+                     `max_connects_per_s` over any 30s window — the
+                     redial-storm signature, as a rate instead of a
+                     post-hoc total
+
+rate_stall / churn_storm pass vacuously when no node left a
+timeseries.jsonl (flight recorder off): absence of the artifact is not
+evidence of a failure.
 """
 
 from __future__ import annotations
@@ -43,6 +56,14 @@ DEFAULT_GATES = {
     # (analyze.py); flip this on to ALSO fail nodes that left no
     # metrics artifact at all
     "require_metrics_from_all": False,
+    # flight-recorder timeline gates (lens/series.py summaries): height
+    # flat for this long at the end of a node's record stream = a
+    # stall, even when the node was SIGKILL'd before the final scrape
+    "rate_stall_tail_s": 60.0,
+    # peak (connects + dial attempts)/s over any 30s window — a
+    # healthy 4-node run reconnects a handful of times total; the
+    # ci.toml redial storm ran hundreds of connects per node
+    "max_connects_per_s": 5.0,
 }
 
 
@@ -111,6 +132,51 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
             spread <= cfg["max_height_spread"],
             f"heights {fleet['min_height']}..{fleet['max_height']} "
             f"(spread {spread}, max {cfg['max_height_spread']})",
+        ))
+
+    # rate_stall + churn_storm (flight-recorder timelines; vacuous
+    # pass when no node ran the recorder)
+    timelines = [(s["name"], s["timeline"]) for s in nodes if s.get("timeline")]
+    if not timelines:
+        gates.append(_gate(
+            "rate_stall", True,
+            "no timeseries.jsonl artifacts (flight recorder off)",
+        ))
+        gates.append(_gate(
+            "churn_storm", True,
+            "no timeseries.jsonl artifacts (flight recorder off)",
+        ))
+    else:
+        # the trip CONDITIONS live in lens/series.py timeline_trips —
+        # one copy shared with the live run-dir watch, so the two
+        # surfaces can't drift apart on identical evidence (only the
+        # thresholds differ: post-mortem judges the whole-run churn
+        # peak and has no wall clock for silence)
+        from .series import timeline_trips
+
+        rate_stalled: list[tuple] = []
+        storms: list[tuple] = []
+        for name, tl in timelines:
+            for trip in timeline_trips(
+                tl, cfg["rate_stall_tail_s"], cfg["max_connects_per_s"],
+                whole_run_churn=True,
+            ):
+                (rate_stalled if trip["name"] == "rate_stall" else storms).append(
+                    (name, trip["detail"])
+                )
+        gates.append(_gate(
+            "rate_stall",
+            not rate_stalled,
+            f"stalled timelines (budget {cfg['rate_stall_tail_s']}s): {rate_stalled}"
+            if rate_stalled
+            else f"all timelines show height progress within {cfg['rate_stall_tail_s']}s of stream end",
+        ))
+        gates.append(_gate(
+            "churn_storm",
+            not storms,
+            f"connect+dial rate over {cfg['max_connects_per_s']}/s: {storms}"
+            if storms
+            else f"peak connect+dial rates within {cfg['max_connects_per_s']}/s",
         ))
 
     # missing_series

@@ -1,0 +1,564 @@
+"""Time-series plane: flight-recorder parsing, windowed rates,
+change-point detection, and live rolling health gates.
+
+Two consumers share this module:
+
+  - **Post-mortem** (analyze.py): each node's `timeseries.jsonl`
+    (metrics/flight.py) is reconstructed into cumulative series, then
+    summarized into the `timeline` section of fleet_report.json —
+    height rate with its trailing stall, churn/dial rates with their
+    storm peaks, detected rate change-points. The `rate_stall` and
+    `churn_storm` gates (gates.py) read those summaries, so a run that
+    died by SIGKILL is judged from the record stream it left behind,
+    not just the final scrape it never produced.
+
+  - **Live** (e2e runner collector thread, `scripts/tmlens.py watch`):
+    `RollingGates` is fed one parsed /metrics exposition per node per
+    scrape tick and evaluates sliding-window gates — liveness stall,
+    height spread, windowed step p99 (bucket-delta quantile over the
+    window, not the run-cumulative one), churn storm — so a soak run
+    aborts seconds after the failure starts instead of timing out at
+    the end.
+
+Stdlib-only like the rest of lens; never imported by node-runtime
+modules (the flight recorder itself lives in metrics/flight.py for
+exactly that reason — pinned by the import-isolation test).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..metrics import bucket_quantile
+from .prom import Exposition, _parse_label_block
+
+__all__ = [
+    "TIMESERIES_NAME",
+    "WATCH_DEFAULTS",
+    "RollingGates",
+    "change_points",
+    "parse_timeseries",
+    "rates",
+    "reconstruct",
+    "scrape_metrics",
+    "split_key",
+    "stalled_tail_s",
+    "summarize_timeseries",
+    "window_rate",
+]
+
+TIMESERIES_NAME = "timeseries.jsonl"  # == metrics.flight.TIMESERIES_NAME
+NS = "tendermint"
+
+
+# ------------------------------------------------------------- parsing
+
+
+def parse_timeseries(path: str) -> list[dict]:
+    """Records from one timeseries.jsonl, in file order. Tolerates a
+    truncated tail: a SIGKILL mid-append leaves at most one partial
+    last line, which is dropped (any OTHER undecodable line is dropped
+    too — a recorder restart appending after a torn write must not
+    poison the whole file)."""
+    records: list[dict] = []
+    try:
+        f = open(path, encoding="utf-8", errors="replace")
+    except OSError:
+        return records
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "t" in rec:
+                records.append(rec)
+    return records
+
+
+def split_key(key: str) -> tuple[str, dict]:
+    """`name{k="v",...}` -> (name, labels) via the exposition label
+    parser (flight keys use the exact exposition sample prefix)."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    return name, _parse_label_block(rest.rstrip("}"))
+
+
+def reconstruct(records, dense: bool = False, names=None) -> tuple[dict[str, list[tuple[float, float]]], list[tuple[float, str]]]:
+    """(series, marks) from a record stream. `series` maps each key to
+    [(t, value)] — cumulative totals for counter/histogram keys, raw
+    values for gauges; `marks` is [(t, label)] in order. Full anchors
+    ("c" + complete "g") REPLACE the running state, so streams spanning
+    a recorder restart reconstruct correctly and a labeled child that
+    was removed from the registry (a disconnected peer's gauge) stops
+    being carried forward at the next anchor instead of reading as a
+    constant forever.
+
+    The recorder only emits a key when it CHANGED, so by default a
+    frozen series simply stops appearing. `dense=True` re-expands the
+    compaction: every known key gets a point at every data record
+    (carrying its last value forward) — what rate/stall/change-point
+    math needs to see flatness as flatness. `names` (a set of metric
+    names, labels stripped) restricts which keys materialize — dense
+    expansion of every series is real money when a watcher re-reads a
+    growing file every tick."""
+    series: dict[str, list[tuple[float, float]]] = {}
+    marks: list[tuple[float, str]] = []
+    totals: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    _want_cache: dict[str, bool] = {}
+
+    def want(k: str) -> bool:
+        if names is None:
+            return True
+        ok = _want_cache.get(k)
+        if ok is None:
+            ok = _want_cache[k] = split_key(k)[0] in names
+        return ok
+
+    for rec in records:
+        t = rec.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        if "mark" in rec:
+            marks.append((float(t), str(rec["mark"])))
+            continue
+        if "c" in rec:  # full anchor: complete snapshot, replaces state
+            totals = {k: float(v) for k, v in rec["c"].items()}
+            gauges = {k: float(v) for k, v in rec.get("g", {}).items()}
+        else:
+            for k, v in rec.get("d", {}).items():  # delta tick
+                totals[k] = totals.get(k, 0.0) + float(v)
+            for k, v in rec.get("g", {}).items():
+                gauges[k] = float(v)
+        if dense:
+            for k, v in totals.items():
+                if want(k):
+                    series.setdefault(k, []).append((float(t), v))
+            for k, v in gauges.items():
+                if want(k):
+                    series.setdefault(k, []).append((float(t), v))
+        else:
+            changed = set(rec.get("c", ())) | set(rec.get("d", ())) | set(rec.get("g", ()))
+            for k in changed:
+                if want(k) and (k in totals or k in gauges):
+                    series.setdefault(k, []).append(
+                        (float(t), totals[k] if k in totals else gauges[k])
+                    )
+    return series, marks
+
+
+# ---------------------------------------------------------- series math
+
+
+def rates(points) -> list[tuple[float, float]]:
+    """Pairwise per-second rates of a cumulative series: [(t_mid,
+    rate)]. Negative deltas (a counter reset across an anchor) clamp
+    to 0 rather than reporting a negative rate."""
+    out = []
+    for (t0, v0), (t1, v1) in zip(points, points[1:]):
+        dt = t1 - t0
+        if dt <= 0:
+            continue
+        out.append(((t0 + t1) / 2.0, max(0.0, v1 - v0) / dt))
+    return out
+
+
+def window_rate(points, window_s: float, now: float | None = None) -> float | None:
+    """Increase per second over the trailing `window_s` of a cumulative
+    series (None with <2 points in the window)."""
+    if not points:
+        return None
+    end = now if now is not None else points[-1][0]
+    cut = end - window_s
+    inside = [(t, v) for t, v in points if t >= cut]
+    if len(inside) < 2:
+        return None
+    dt = inside[-1][0] - inside[0][0]
+    if dt <= 0:
+        return None
+    return max(0.0, inside[-1][1] - inside[0][1]) / dt
+
+
+def stalled_tail_s(points, eps: float = 0.0) -> float:
+    """Seconds at the END of the series with no increase: the gap
+    between the last sample and the most recent sample where the value
+    still grew. 0 for <2 points; whole span when it never grew."""
+    if len(points) < 2:
+        return 0.0
+    for i in range(len(points) - 1, 0, -1):
+        if points[i][1] - points[i - 1][1] > eps:
+            return points[-1][0] - points[i][0]
+    return points[-1][0] - points[0][0]
+
+
+def change_points(points, window: int = 5, factor: float = 3.0,
+                  min_rate: float = 1e-9) -> list[dict]:
+    """Sustained rate-regime shifts in a cumulative series: slide two
+    adjacent `window`-sized rate windows and report boundaries where
+    the mean rate jumps by `factor` (or collapses to ~zero from
+    nonzero). Adjacent detections of one shift are deduped by skipping
+    a full window past each report."""
+    rs = rates(points)
+    out: list[dict] = []
+    i = window
+    while i + window <= len(rs):
+        before = sum(r for _t, r in rs[i - window:i]) / window
+        after = sum(r for _t, r in rs[i:i + window]) / window
+        hi, lo = max(before, after), min(before, after)
+        if hi > min_rate and (lo <= min_rate or hi / max(lo, min_rate) >= factor):
+            out.append({
+                "t": round(rs[i][0], 3),
+                "before_per_s": round(before, 6),
+                "after_per_s": round(after, 6),
+            })
+            # skip clear past the transition: the boundary straddles up
+            # to a window of mixed rates on each side, and re-testing
+            # inside that smear would report the SAME shift twice
+            i += 2 * window
+        else:
+            i += 1
+    return out
+
+
+# ----------------------------------------------------------- summaries
+
+# summary keys pulled from a node's record stream (churn = transport
+# connects + outbound dial attempts, the redial-storm signature)
+_HEIGHT = f"{NS}_consensus_height"
+_AGE = f"{NS}_consensus_last_block_age_seconds"
+_TXS = f"{NS}_consensus_total_txs"
+_CONNECT_PREFIXES = (
+    f"{NS}_p2p_peer_connections_total",
+    f"{NS}_p2p_dial_attempts_total",
+)
+# sliding window used for storm peaks in the post-mortem summary —
+# matches the live watch default so the two views agree
+STORM_WINDOW_S = 30.0
+
+
+def _merge_labeled(series: dict, prefixes) -> list[tuple[float, float]]:
+    """Sum every labeled child of the given families into one
+    cumulative series (children tick at different times; carry each
+    child's last value forward)."""
+    children = [
+        pts for key, pts in series.items()
+        if split_key(key)[0] in prefixes and pts
+    ]
+    if not children:
+        return []
+    events = sorted({t for pts in children for t, _v in pts})
+    idx = [0] * len(children)
+    last = [0.0] * len(children)
+    out = []
+    for t in events:
+        for ci, pts in enumerate(children):
+            while idx[ci] < len(pts) and pts[idx[ci]][0] <= t:
+                last[ci] = pts[idx[ci]][1]
+                idx[ci] += 1
+        out.append((t, sum(last)))
+    return out
+
+
+def _peak_window_rate(points, window_s: float) -> float:
+    """Max increase-per-second over any trailing window ending at a
+    sample point. One forward pass with a sliding window start (the
+    naive per-point window_rate() rescan is quadratic in run length —
+    real money for an hour-long soak watched every 2s). Windows
+    spanning less than half of `window_s` are skipped — the same rule
+    the live gate applies — because a handful of boot-time connects
+    divided by the stream's first second is an 8/s "rate" that no 30s
+    window ever sustained."""
+    peak = 0.0
+    j = 0
+    for i in range(1, len(points)):
+        t_i = points[i][0]
+        while points[j][0] < t_i - window_s:
+            j += 1
+        if j < i:
+            dt = t_i - points[j][0]
+            if dt >= window_s / 2:
+                peak = max(peak, max(0.0, points[i][1] - points[j][1]) / dt)
+    return peak
+
+
+def summarize_timeseries(records) -> dict | None:
+    """The per-node `timeline` block of fleet_report.json. None when
+    the record stream is empty (no flight recorder, or nothing
+    decodable survived)."""
+    # dense: flat periods must exist as points for rate/stall math;
+    # names: only the families the summary reads get materialized
+    series, marks = reconstruct(
+        records, dense=True,
+        names={_HEIGHT, _AGE, _TXS, *_CONNECT_PREFIXES},
+    )
+    data_recs = [r for r in records if "mark" not in r]
+    if not data_recs:
+        return None
+    t0 = data_recs[0]["t"]
+    t1 = data_recs[-1]["t"]
+    span = max(0.0, t1 - t0)
+    out: dict = {
+        "records": len(data_recs),
+        "span_s": round(span, 3),
+        # absolute end of the stream: a LIVE watcher compares this to
+        # the wall clock — a stream that stopped growing is a dead
+        # recorder (or node), which stalled_tail_s alone can't see
+        "t_end": round(t1, 3),
+        "interval_s_est": round(span / (len(data_recs) - 1), 3) if len(data_recs) > 1 else None,
+        "marks": [{"t": t, "label": lbl} for t, lbl in marks],
+    }
+    h = series.get(_HEIGHT, [])
+    if h:
+        out["height"] = {
+            "first": h[0][1],
+            "last": h[-1][1],
+            "rate_per_s": round(window_rate(h, span + 1.0) or 0.0, 4),
+            "stalled_tail_s": round(stalled_tail_s(h), 3),
+            "change_points": change_points(h),
+        }
+    age = series.get(_AGE, [])
+    if age:
+        out["head_age"] = {"last_s": round(age[-1][1], 3),
+                           "max_s": round(max(v for _t, v in age), 3)}
+    txs = series.get(_TXS, [])
+    if txs:
+        out["txs"] = {
+            "total": txs[-1][1],
+            "rate_per_s": round(window_rate(txs, span + 1.0) or 0.0, 3),
+            "change_points": change_points(txs),
+        }
+    churn = _merge_labeled(series, _CONNECT_PREFIXES)
+    if churn:
+        out["churn"] = {
+            "connects_total": churn[-1][1],
+            # whole-run peak (the post-mortem churn_storm gate's input)
+            "peak_connects_per_s": round(_peak_window_rate(churn, STORM_WINDOW_S), 4),
+            # trailing window only — what a LIVE watcher judges, so a
+            # healed historical burst doesn't trip it forever
+            "last_window_per_s": round(window_rate(churn, STORM_WINDOW_S) or 0.0, 4),
+        }
+    return out
+
+
+def timeline_trips(tl: dict, stall_after_s: float, max_connects_per_s: float,
+                   now: float | None = None, whole_run_churn: bool = False) -> list[dict]:
+    """Trip records for ONE node's timeline summary — the single copy
+    of the rate_stall/churn_storm conditions shared by the post-mortem
+    gates (gates.py: `whole_run_churn=True`, no wall clock) and the
+    live run-dir watch (`scripts/tmlens.py`: trailing-window churn,
+    plus silence — with `now` given, a stream that stopped GROWING
+    trips rate_stall even when its recorded tail looked healthy; the
+    recorder flushes every interval, so silence means the node or its
+    recorder died)."""
+    trips: list[dict] = []
+    h = tl.get("height") or {}
+    stall = h.get("stalled_tail_s")
+    if (
+        stall is not None
+        and stall >= stall_after_s
+        # a stream shorter than the stall budget can't prove a stall
+        and tl["span_s"] >= stall_after_s
+    ):
+        trips.append({"name": "rate_stall", "detail": f"height flat for {stall}s"})
+    elif now is not None and max(0.0, now - tl["t_end"]) >= stall_after_s:
+        trips.append({
+            "name": "rate_stall",
+            "detail": f"record stream silent for {round(now - tl['t_end'], 1)}s "
+                      "(node or recorder dead)",
+        })
+    ch = tl.get("churn") or {}
+    rate = ch.get("peak_connects_per_s") if whole_run_churn else ch.get("last_window_per_s")
+    if rate is not None and rate > max_connects_per_s:
+        which = "peak" if whole_run_churn else "trailing-window"
+        trips.append({
+            "name": "churn_storm",
+            "detail": f"{which} connect+dial rate {rate}/s",
+        })
+    return trips
+
+
+# ------------------------------------------------------------ live gates
+
+
+WATCH_DEFAULTS = {
+    # sliding window every live gate judges over
+    "watch_window_s": 30.0,
+    # no height progress (and a chain head at least this stale) for
+    # this long = stall; well under the e2e runner's 90-870s timeouts
+    "stall_after_s": 30.0,
+    # windowed fleet step p99 (delta of bucket counts over the window;
+    # same clamp logic as the post-mortem gate, gates.py)
+    "p99_step_budget_s": 9.5,
+    "min_step_samples": 20,  # don't judge a p99 on a trickle
+    "max_height_spread": 5,
+    # per-node (connects + dial attempts)/s over the window: the
+    # redial-storm signature (a healthy 4-node net reconnects a
+    # handful of times across a whole run)
+    "max_connects_per_s": 5.0,
+}
+
+
+class _NodeWindow:
+    __slots__ = ("first_t", "progress_t", "height", "age", "samples")
+
+    def __init__(self):
+        self.first_t: float | None = None
+        self.progress_t: float | None = None  # last time height grew
+        self.height: float | None = None
+        self.age: float | None = None
+        self.samples: list = []  # (t, step_hist_snapshot|None, connects)
+
+
+class RollingGates:
+    """Sliding-window live health gates over per-node /metrics scrapes.
+
+    Feed one parsed exposition per node per tick via `observe`; call
+    `evaluate` after each sweep. Returns tripped gates as
+    [{"name", "detail"}] — same gate names as the post-mortem verdict
+    (gates.py) so a live abort and an offline analysis read the same.
+    Unknown config keys raise, like gates.evaluate."""
+
+    def __init__(self, config: dict | None = None):
+        cfg = dict(WATCH_DEFAULTS)
+        if config:
+            unknown = set(config) - set(WATCH_DEFAULTS)
+            if unknown:
+                raise ValueError(f"unknown watch config keys: {sorted(unknown)}")
+            cfg.update(config)
+        self.cfg = cfg
+        self.nodes: dict[str, _NodeWindow] = {}
+
+    def reset(self) -> None:
+        """Forget every window (config kept). The e2e runner calls this
+        when resuming after an INTENTIONAL perturbation phase —
+        judging a freshly-healed node against its pre-partition
+        progress clock would trip the stall gate on the recovery."""
+        self.nodes.clear()
+
+    def observe(self, node: str, exp: Exposition, t: float | None = None) -> None:
+        t = time.time() if t is None else t
+        w = self.nodes.setdefault(node, _NodeWindow())
+        if w.first_t is None:
+            w.first_t = t
+        height = exp.value(f"{NS}_consensus_height")
+        if height is not None and (w.height is None or height > w.height):
+            w.height = height
+            w.progress_t = t
+        w.age = exp.value(_AGE)
+        h = exp.histogram(f"{NS}_consensus_step_duration_seconds")
+        connects = sum(exp.total(name) for name in _CONNECT_PREFIXES)
+        snap = (tuple(h.bounds), tuple(h.cumulative), h.count) if h is not None else None
+        w.samples.append((t, snap, connects))
+        cut = t - self.cfg["watch_window_s"] - 1e-9
+        while len(w.samples) > 2 and w.samples[1][0] <= cut:
+            w.samples.pop(0)
+
+    # ---------------------------------------------------------- verdicts
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        now = time.time() if now is None else now
+        cfg = self.cfg
+        tripped: list[dict] = []
+
+        # liveness_stall: height flat AND head stale for stall_after_s.
+        # Both conditions must POSITIVELY hold: height-flat alone would
+        # false-trip a node whose scrape briefly failed, and a missing
+        # age series (a node that hasn't committed its FIRST block yet
+        # never marked the AgeGauge) is unknown, not stale — a slow
+        # fleet start is the wait loops' explicit timeout budget to
+        # judge, not this gate's.
+        stalled = []
+        for name, w in self.nodes.items():
+            if w.first_t is None:
+                continue
+            base = w.progress_t if w.progress_t is not None else w.first_t
+            flat_for = now - base
+            if flat_for >= cfg["stall_after_s"] and (
+                w.age is not None and w.age >= cfg["stall_after_s"]
+            ):
+                stalled.append((name, round(flat_for, 1)))
+        if stalled:
+            tripped.append({
+                "name": "liveness_stall",
+                "detail": f"no height progress for >= {cfg['stall_after_s']}s: {stalled}",
+            })
+
+        # height_spread over the latest observations
+        heights = [w.height for w in self.nodes.values() if w.height is not None]
+        if len(heights) >= 2:
+            spread = max(heights) - min(heights)
+            if spread > cfg["max_height_spread"]:
+                tripped.append({
+                    "name": "height_spread",
+                    "detail": f"live heights {min(heights)}..{max(heights)} "
+                              f"(spread {spread} > {cfg['max_height_spread']})",
+                })
+
+        # windowed step p99: fleet-merged DELTA of bucket counts over
+        # the window (the cumulative histogram would average the storm
+        # away against the healthy head of the run)
+        bounds = None
+        delta_cum = None
+        delta_n = 0.0
+        for w in self.nodes.values():
+            first = next((s for s in w.samples if s[1] is not None), None)
+            last = next((s for s in reversed(w.samples) if s[1] is not None), None)
+            if first is None or last is None or first is last:
+                continue
+            (b0, c0, n0), (b1, c1, n1) = first[1], last[1]
+            if b0 != b1:
+                continue  # mid-run restart with foreign buckets: skip
+            if bounds is None:
+                bounds = list(b1)
+                delta_cum = [0.0] * len(bounds)
+            if list(b1) != bounds:
+                continue
+            for i in range(len(bounds)):
+                delta_cum[i] += max(0.0, c1[i] - c0[i])
+            delta_n += max(0.0, n1 - n0)
+        if bounds is not None and delta_n >= cfg["min_step_samples"]:
+            p99 = bucket_quantile(0.99, bounds, delta_cum, delta_n)
+            if p99 is not None and p99 > cfg["p99_step_budget_s"]:
+                tripped.append({
+                    "name": "p99_step_duration",
+                    "detail": f"windowed fleet step p99 {round(p99, 3)}s over "
+                              f"{int(delta_n)} samples vs budget {cfg['p99_step_budget_s']}s",
+                })
+
+        # churn_storm: per-node connect+dial rate over the window
+        storms = []
+        for name, w in self.nodes.items():
+            pts = [(t, c) for t, _s, c in w.samples]
+            if len(pts) < 2:
+                continue
+            span = pts[-1][0] - pts[0][0]
+            if span < cfg["watch_window_s"] / 2:
+                continue  # not enough window to call it a storm
+            rate = max(0.0, pts[-1][1] - pts[0][1]) / span
+            if rate > cfg["max_connects_per_s"]:
+                storms.append((name, round(rate, 2)))
+        if storms:
+            tripped.append({
+                "name": "churn_storm",
+                "detail": f"connect+dial rate over {cfg['max_connects_per_s']}/s: {storms}",
+            })
+        return tripped
+
+
+def scrape_metrics(url: str, timeout: float = 3.0) -> tuple[str, Exposition]:
+    """(raw text, parsed exposition) from one /metrics endpoint —
+    shared by the e2e collector thread and `tmlens watch`."""
+    import urllib.request
+
+    from .prom import parse_exposition
+
+    body = urllib.request.urlopen(url, timeout=timeout).read().decode(
+        "utf-8", errors="replace"
+    )
+    return body, parse_exposition(body)

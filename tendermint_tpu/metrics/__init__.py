@@ -202,6 +202,16 @@ class Histogram(_Metric):
             h[1] += total
             h[2] += len(values)
 
+    def totals(self) -> list[tuple[dict, float, float]]:
+        """[(labels, sum, count)] per child — the flight recorder's
+        compact cumulative view of a histogram (windowed rates need
+        sums/counts over time, not the bucket vector)."""
+        with self._lock:
+            return [
+                (dict(zip(self.label_names, k)), h[1], float(h[2]))
+                for k, h in self._hist.items()
+            ]
+
     def quantile(self, q: float, *label_values: str) -> float | None:
         """Bucket-interpolated quantile estimate for one labeled child
         (observe() keeps per-bucket counts cumulative, so they feed
@@ -246,6 +256,13 @@ class Registry:
         with self._lock:
             self._metrics.append(metric)
         return metric
+
+    def metrics(self) -> list[_Metric]:
+        """Snapshot of the registered metric objects (the flight
+        recorder walks these directly instead of re-parsing gather()
+        text every sample tick)."""
+        with self._lock:
+            return list(self._metrics)
 
     def counter(self, name, help_="", labels=()) -> Counter:
         return self.register(Counter(name, help_, labels))
@@ -352,6 +369,28 @@ class ConsensusMetrics:
             "Precommit vote extensions received",
             labels=("status",),
         )
+        # Gossip propagation latency (no reference analog): senders
+        # stamp origin wall-clock on proposal/vote/block-part frames
+        # (consensus/reactor.py) and the receive side observes
+        # now - origin here. Meaningful on shared-clock local testnets
+        # (e2e/bench); splits a slow consensus step into network
+        # propagation vs local compute (docs/observability.md#flight).
+        self.msg_propagation = reg.histogram(
+            f"{ns}_msg_propagation_seconds",
+            "Origin-to-receive latency of gossiped consensus messages (shared-clock testnets)",
+            labels=("type",),
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+        )
+        # First vote seen for (height, round, type) -> 2/3 majority
+        # assembled — the quorum-formation half of a step's wall time
+        # (the other half is msg_propagation + verify compute).
+        self.quorum_assembly = reg.histogram(
+            f"{ns}_quorum_assembly_seconds",
+            "First vote to 2/3 majority per (height, round, vote type)",
+            labels=("type",),
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
         # Chain-head freshness at scrape time (no reference analog; the
         # tmlens liveness-stall gate reads this from persisted
         # artifacts — docs/observability.md). Marked at every
@@ -449,6 +488,16 @@ class P2PMetrics:
             f"{ns}_peer_connections_total",
             "Peer connections registered since boot",
             labels=("dir",),
+        )
+        # Outbound dial outcomes (no reference analog): a redial storm
+        # against vetoed/failing peers shows up as a failed-dial RATE
+        # here while it is happening — peer_connections_total only
+        # counts the handshakes that succeeded, so a storm of expensive
+        # failed handshakes was invisible until the post-run totals.
+        self.dial_attempts = reg.counter(
+            f"{ns}_dial_attempts_total",
+            "Outbound dial attempts by outcome (ok = handshake registered)",
+            labels=("result",),
         )
 
 
@@ -727,6 +776,33 @@ class HashMetrics:
             f"{ns}_cache_events_total",
             "Structural-hash memo events (hit/miss/invalidate) by site",
             labels=("site", "event"),
+        )
+
+
+class FlightMetrics:
+    """Self-telemetry for the in-run flight recorder
+    (metrics/flight.py): how many timeseries.jsonl records this node
+    appended and what one sample tick costs. The sample-cost histogram
+    is the overhead evidence — docs/observability.md#flight documents
+    the enabled-cost budget (<=1% of a bench mempool stage) against it.
+
+    No reference analog — the reference has no in-process recorder;
+    operators scrape externally. Registered on the NODE registry (the
+    recorder is per-node state, not process-global)."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_flight"
+        self.records = reg.counter(
+            f"{ns}_records_total", "Timeseries records appended since boot"
+        )
+        self.sample_seconds = reg.histogram(
+            f"{ns}_sample_seconds",
+            "Wall time of one flight-recorder sample tick (gather + diff + append)",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25),
+        )
+        self.dropped_samples = reg.counter(
+            f"{ns}_dropped_samples_total",
+            "Sample ticks that failed to append (I/O errors; recorder keeps running)",
         )
 
 

@@ -152,6 +152,21 @@ class Node:
             if config.instrumentation.prometheus
             else None
         )
+        # In-run flight recorder (metrics/flight.py): streams delta
+        # records to <home>/timeseries.jsonl so rates-over-time survive
+        # a SIGKILL. Disabled (the default) nothing is constructed —
+        # the zero-cost path really is zero.
+        self.flight_recorder = None
+        if config.instrumentation.flight_interval > 0 and config.base.home:
+            from ..metrics import FlightMetrics, global_registry
+            from ..metrics.flight import TIMESERIES_NAME, FlightRecorder
+
+            self.flight_recorder = FlightRecorder(
+                [self.metrics_registry, global_registry()],
+                os.path.join(config.base.home, TIMESERIES_NAME),
+                interval=config.instrumentation.flight_interval,
+                metrics=FlightMetrics(self.metrics_registry),
+            )
         self.logger = Logger(level=parse_level(config.base.log_level),
                              fmt=config.base.log_format).with_fields(
             module="node"
@@ -272,6 +287,7 @@ class Node:
                 private_peers=set(filter(None, config.p2p.private_peer_ids.split(","))),
             ),
             db=_make_db(config, "peerstore"),
+            metrics=self.p2p_metrics,
         )
         for ep in persistent:
             self.peer_manager.add(ep)
@@ -429,6 +445,7 @@ class Node:
                 pub_key=self.priv_validator.get_pub_key() if self.priv_validator else None,
                 router=self.router,
                 unsafe=self.config.rpc.unsafe,
+                flight_recorder=self.flight_recorder,
             )
             self.rpc_server = JSONRPCServer(
                 build_routes(env),
@@ -481,6 +498,8 @@ class Node:
             self.indexer_service.start()
         if self.prometheus_server is not None:
             self.prometheus_server.start()
+        if self.flight_recorder is not None:
+            self.flight_recorder.start()
 
         # ABCI handshake: sync the app to the stores (node/node.go:430)
         hs = Handshaker(
@@ -636,6 +655,8 @@ class Node:
             self.rpc_server.stop()
         if self.indexer_service is not None:
             self.indexer_service.stop()
+        if self.flight_recorder is not None:
+            self.flight_recorder.stop()  # final sample lands in the timeline
         if self.prometheus_server is not None:
             self.prometheus_server.stop()
         for sink in self.sql_sinks:

@@ -42,6 +42,19 @@ class PeerManagerOptions:
     max_retry_time: float = 30.0
     max_retry_time_persistent: float = 5.0
     retry_time_jitter: float = 0.1
+    # Redial-storm guards (no reference analog — the reference's dial
+    # failures are cheap TCP errors; here every dial that reaches a
+    # vetoed/filtering peer burns a full pure-python Noise handshake,
+    # and a partition that vetoes N-1 persistent peers turns the 5s
+    # persistent retry cap into a CPU storm that starves consensus on
+    # small boxes; see docs/faultnet.md):
+    #   - after this many consecutive failures to one address, the
+    #     retry cap ESCALATES (doubles per further failure) toward
+    #     max_retry_time even for persistent peers; one success resets
+    #   - at most this many dials may be in flight at once, bounding
+    #     concurrent handshake CPU no matter how many peers are down
+    storm_backoff_after: int = 8
+    max_dial_concurrency: int = 8
     disconnect_cooldown: float = 0.0
     peer_scores: dict[str, int] = field(default_factory=dict)
     private_peers: set[str] = field(default_factory=set)
@@ -157,10 +170,15 @@ class _PeerStore:
 class PeerManager:
     """ref: internal/p2p/peermanager.go PeerManager."""
 
-    def __init__(self, self_id: str, options: PeerManagerOptions | None = None, db=None):
+    def __init__(self, self_id: str, options: PeerManagerOptions | None = None, db=None,
+                 metrics=None):
         self.self_id = self_id
         self.options = options or PeerManagerOptions()
         self.options.self_id = self_id
+        # P2PMetrics (or None): dial outcomes land on
+        # p2p_dial_attempts_total{result} so a redial storm is visible
+        # as a failed-dial RATE while it happens, not a post-hoc total
+        self.metrics = metrics
         self.store = _PeerStore(db)
         self._lock = threading.RLock()
         self._dialing: set[str] = set()  # dialing in progress
@@ -255,6 +273,13 @@ class PeerManager:
         with self._lock:
             if len(self._connected) + len(self._dialing) >= self.options.max_connected + self.options.max_connected_upgrade:
                 return None
+            # bounded concurrent dials: each dial may cost a full
+            # handshake; a wide outage must not run them all at once
+            if (
+                self.options.max_dial_concurrency > 0
+                and len(self._dialing) >= self.options.max_dial_concurrency
+            ):
+                return None
             now = time.time()
             for info in self.store.ranked():
                 nid = info.node_id
@@ -276,10 +301,21 @@ class PeerManager:
             return None
 
     def _retry_at(self, info: PeerInfo, ai: PeerAddressInfo) -> float:
-        """Exponential backoff with jitter (ref: peermanager.go retryDelay)."""
+        """Exponential backoff with jitter (ref: peermanager.go
+        retryDelay), plus a storm escalation: past
+        `storm_backoff_after` consecutive failures the persistent-peer
+        cap stops protecting the peer and doubles per further failure
+        up to max_retry_time — a peer that vetoes/fails every
+        handshake for minutes is a partition, not a blip, and redialing
+        it at the 5s persistent cadence burns a handshake's CPU each
+        time. One successful dial resets dial_failures and with it the
+        escalation."""
         if ai.dial_failures == 0:
             return 0.0
         cap = self.options.max_retry_time_persistent if info.persistent else self.options.max_retry_time
+        over = ai.dial_failures - self.options.storm_backoff_after
+        if self.options.storm_backoff_after > 0 and over > 0:
+            cap = min(self.options.max_retry_time, cap * (2 ** min(over, 16)))
         delay = min(self.options.min_retry_time * (2 ** min(ai.dial_failures - 1, 16)), cap)
         delay += random.random() * self.options.retry_time_jitter
         return ai.last_dial_failure + delay
@@ -309,6 +345,8 @@ class PeerManager:
                     ai.dial_failures += 1
                     self.store.set(info)
             self._dial_waker.set()
+        if self.metrics is not None:
+            self.metrics.dial_attempts.add(1, "failed")
 
     def dialed(self, endpoint: Endpoint) -> None:
         """Outgoing connection established (ref: peermanager.go Dialed).
@@ -336,6 +374,11 @@ class PeerManager:
                 ai.dial_failures = 0
             self.store.set(info)
             self._connected[nid] = True
+            # a dial slot freed up (max_dial_concurrency): wake the
+            # dial loop for the next candidate
+            self._dial_waker.set()
+        if self.metrics is not None:
+            self.metrics.dial_attempts.add(1, "ok")
 
     def accepted(self, node_id: str) -> None:
         """Incoming connection (ref: peermanager.go Accepted)."""

@@ -592,6 +592,16 @@ class ConsensusMessage(Message):
         Field(7, "message", "has_vote", msg_cls=CsHasVote),
         Field(8, "message", "vote_set_maj23", msg_cls=CsVoteSetMaj23),
         Field(9, "message", "vote_set_bits", msg_cls=CsVoteSetBits),
+        # Local extension (no reference analog): origin wall-clock in
+        # unix nanoseconds, stamped at encode time on data-plane frames
+        # (proposal / block part / vote) so the receive side can record
+        # gossip propagation latency on shared-clock testnets
+        # (consensus/reactor.py, docs/observability.md#flight). Field
+        # number far above the reference oneof (1-9); proto3 decoders
+        # that don't know it skip it, and a zero value is omitted from
+        # the wire entirely, so unstamped frames stay byte-identical to
+        # the reference schema.
+        Field(1000, "fixed64", "origin_ns"),
     ]
 
 

@@ -174,6 +174,7 @@ class RPCEnvironment:
         pub_key=None,
         router=None,
         unsafe=False,
+        flight_recorder=None,
     ):
         self.chain_id = chain_id
         self.state_store = state_store
@@ -190,6 +191,7 @@ class RPCEnvironment:
         self.pub_key = pub_key
         self.router = router
         self.unsafe = unsafe
+        self.flight_recorder = flight_recorder
         self.start_time = _time.time()
 
 
@@ -454,6 +456,27 @@ def build_routes(env: RPCEnvironment) -> dict:
             "enabled": _trace.enabled(),
             "events": len(doc["traceEvents"]),
             "trace": doc,
+        }
+
+    def flight_recorder(tail=None):
+        """State + recent records of the in-run flight recorder
+        (metrics/flight.py): whether it is sampling, its interval and
+        artifact path, and the last `tail` (default 32, max 256)
+        timeseries records straight from the in-memory ring — a live
+        tail for `tmlens watch` without touching the node's disk.
+        Read-only; enabled/disabled is node config
+        (instrumentation.flight-interval)."""
+        fr = env.flight_recorder
+        n = _as_int(tail, "tail")
+        n = 32 if n is None else max(0, min(n, 256))
+        if fr is None:
+            return {"enabled": False, "records": 0, "tail": []}
+        return {
+            "enabled": True,
+            "interval_s": fr.interval,
+            "path": fr.path,
+            "records": fr.records_written,
+            "tail": fr.tail(n),
         }
 
     def block_results(height=None):
@@ -819,6 +842,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         "events": events,
         "debug_threads": debug_threads,
         "dump_traces": dump_traces,
+        "flight_recorder": flight_recorder,
         "block_results": block_results,
         "commit": commit,
         "validators": validators,

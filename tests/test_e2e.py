@@ -11,7 +11,7 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(__file__))
 
-from tendermint_tpu.e2e import Manifest, Runner
+from tendermint_tpu.e2e import Manifest, Runner, WatchTripped
 
 MANIFEST = """
 chain_id = "e2e-test"
@@ -117,12 +117,114 @@ def test_e2e_perturbed_testnet(tmp_path):
     assert os.path.exists(os.path.join(runner.base_dir, "fleet_report.json"))
     gate_names = {g["name"] for g in runner.last_report["gates"]}
     assert gate_names == {
-        "liveness_stall", "p99_step_duration", "height_spread", "missing_series"
+        "liveness_stall", "p99_step_duration", "height_spread", "missing_series",
+        "rate_stall", "churn_storm",
     }
     # the kill perturbation snapshotted the victim's pre-death state
     killed = next(n for n in runner.nodes if "kill" in n.m.perturb)
     assert os.path.exists(os.path.join(killed.home, "metrics.pre-kill.txt")), (
         "perturb(kill) left no pre-death artifact snapshot"
+    )
+    # origin-stamped gossip: every node must have recorded nonzero
+    # propagation samples (consensus_msg_propagation_seconds) — a
+    # healthy net gossips proposals/votes continuously
+    for text in scraped:
+        assert "tendermint_consensus_msg_propagation_seconds_count" in text, (
+            "a node's scrape lacks gossip-propagation samples"
+        )
+    # flight recorder (manifest default 1s): each node streamed delta
+    # records as the run progressed; the record count must be of the
+    # same order as run duration / flight-interval (the kill victim's
+    # first life and SIGSTOP pauses cost some ticks)
+    from tendermint_tpu.lens.series import parse_timeseries
+
+    for node in runner.nodes:
+        ts = os.path.join(node.home, "timeseries.jsonl")
+        assert os.path.exists(ts), f"{node.m.name} left no timeseries.jsonl"
+        assert len(parse_timeseries(ts)) >= 5, f"{node.m.name} timeline too short"
+    # the per-node timelines made it into the fleet report
+    assert runner.last_report["fleet"]["nodes_with_timeseries"] >= 1
+
+
+STALL_MANIFEST = """
+chain_id = "e2e-stall"
+load_tx_rate = 5
+
+[node.validator01]
+
+[node.validator02]
+
+[node.validator03]
+
+[node.validator04]
+"""
+
+
+@pytest.mark.slow
+def test_e2e_watch_aborts_on_injected_stall(tmp_path):
+    """The tmwatch acceptance run: a liveness stall injected mid-run
+    (SIGSTOP of half the validator set -> no quorum, heights freeze)
+    must be detected by the LIVE collector and abort the run in well
+    under half the old do-nothing timeout, with a full artifact sweep
+    and a fleet report whose FAIL verdict names the gate."""
+    import signal as _signal
+    import time as _time
+
+    m = Manifest.parse(STALL_MANIFEST)
+    runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
+    runner.setup()
+    frozen = []
+    try:
+        runner.start(timeout=120)
+        runner.wait_for_height(3, timeout=120)
+        runner.start_watch(
+            interval=1.0, gates={"stall_after_s": 12.0, "watch_window_s": 20.0}
+        )
+        # injected stall: freeze 2 of 4 validators — the survivors
+        # cannot assemble a quorum, so the whole fleet's head goes stale
+        frozen = runner.nodes[:2]
+        for node in frozen:
+            node.proc.send_signal(_signal.SIGSTOP)
+        t0 = _time.monotonic()
+        old_timeout = 120.0  # what a watchless run would burn
+        with pytest.raises(WatchTripped) as ei:
+            runner.wait_for_height(10_000, timeout=old_timeout)
+        detect_s = _time.monotonic() - t0
+        assert ei.value.gate == "liveness_stall", ei.value
+        assert detect_s < old_timeout / 2, (
+            f"abort took {detect_s:.0f}s, not under half the {old_timeout:.0f}s timeout"
+        )
+    finally:
+        for node in frozen:
+            try:
+                node.proc.send_signal(_signal.SIGCONT)
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        runner.cleanup()
+    report = runner.last_report
+    assert report is not None, "no fleet report after aborted run"
+    assert report["verdict"] == "fail"
+    assert report["live_abort"]["gate"] == "liveness_stall"
+    gate = next(g for g in report["gates"] if g["name"] == "liveness_stall")
+    assert not gate["ok"] and "live watch abort" in gate["detail"]
+    # the trip-time sweep captured the survivors' state at the moment
+    assert any(
+        os.path.exists(os.path.join(n.home, "metrics.on-trip.txt"))
+        for n in runner.nodes
+    ), "watch trip left no on-trip artifact sweep"
+    # flight recorders were on (e2e default): the stall is also in the
+    # on-disk timelines, so a SIGKILL'd runner would still have dated it
+    from tendermint_tpu.lens.series import parse_timeseries, summarize_timeseries
+
+    tails = []
+    for n in runner.nodes:
+        ts = os.path.join(n.home, "timeseries.jsonl")
+        if os.path.exists(ts):
+            tl = summarize_timeseries(parse_timeseries(ts))
+            if tl and tl.get("height"):
+                tails.append(tl["height"]["stalled_tail_s"])
+    assert tails and max(tails) >= 10.0, (
+        f"stall not visible in flight-recorder timelines: {tails}"
     )
 
 

@@ -1,0 +1,696 @@
+"""tmwatch — in-run flight recorder + live rolling health gates
+(metrics/flight.py, lens/series.py, the e2e watch collector;
+docs/observability.md#flight).
+
+All tier-1 and node-free: flight fixtures are written by the REAL
+FlightRecorder against real registries, live-gate fixtures are real
+expositions rendered by Registry.gather, and the early-abort test
+drives the REAL Runner watch collector against real PrometheusServer
+endpoints — no node processes anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu.lens.prom import parse_exposition
+from tendermint_tpu.lens.series import (
+    RollingGates,
+    WATCH_DEFAULTS,
+    change_points,
+    parse_timeseries,
+    rates,
+    reconstruct,
+    stalled_tail_s,
+    summarize_timeseries,
+    window_rate,
+)
+from tendermint_tpu.metrics import (
+    ConsensusMetrics,
+    FlightMetrics,
+    P2PMetrics,
+    PrometheusServer,
+    Registry,
+)
+from tendermint_tpu.metrics.flight import FlightRecorder
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def _tick(fr, t):
+    """sample_once with a pinned wall clock (records carry `t`)."""
+    real = time.time
+    time.time = lambda: t
+    try:
+        return fr.sample_once()
+    finally:
+        time.time = real
+
+
+def test_flight_recorder_full_then_deltas(tmp_path):
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    path = str(tmp_path / "timeseries.jsonl")
+    fm = FlightMetrics(Registry())
+    fr = FlightRecorder([reg], path, interval=1.0, metrics=fm)
+    cm.height.set(1)
+    cm.total_txs.add(5)
+    cm.step_duration.observe(0.1, "propose")
+    r0 = fr.sample_once()
+    assert r0["seq"] == 0 and "c" in r0  # full anchor first
+    assert r0["g"]["tendermint_consensus_height"] == 1.0
+    assert r0["c"]["tendermint_consensus_total_txs"] == 5.0
+    assert r0["c"]['tendermint_consensus_step_duration_seconds_count{step="propose"}'] == 1.0
+    cm.total_txs.add(3)
+    r1 = fr.sample_once()
+    assert r1["seq"] == 1 and "c" not in r1
+    assert r1["d"]["tendermint_consensus_total_txs"] == 3.0  # delta, not total
+    assert "tendermint_consensus_height" not in r1.get("g", {})  # unchanged gauge deduped
+    r2 = fr.sample_once()  # nothing moved: no d, no g
+    assert "d" not in r2 and "g" not in r2
+    fr.stop()
+    # everything decodes back, cumulative totals reconstruct
+    series, _marks = reconstruct(parse_timeseries(path))
+    assert series["tendermint_consensus_total_txs"][-1][1] == 8.0
+
+
+def test_flight_recorder_survives_truncated_tail_and_marks(tmp_path):
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    path = str(tmp_path / "timeseries.jsonl")
+    fr = FlightRecorder([reg], path, interval=1.0)
+    for i in range(5):
+        cm.height.set(i + 1)
+        fr.sample_once()
+    fr.mark("perturb-start")
+    fr.stop()
+    n = len(parse_timeseries(path))
+    # SIGKILL mid-append: a torn last line must drop silently
+    with open(path, "a") as f:
+        f.write('{"t": 1.0, "d": {"tendermint_cons')
+    recs = parse_timeseries(path)
+    assert len(recs) == n
+    _series, marks = reconstruct(recs)
+    assert marks and marks[0][1] == "perturb-start"
+
+
+def test_flight_recorder_restart_appends_new_anchor(tmp_path):
+    """A restarted node appends to the same file; the new process's
+    full anchor resets the cumulative baseline so totals never go
+    negative across the restart."""
+    path = str(tmp_path / "timeseries.jsonl")
+    for life, total in ((1, 50), (2, 10)):  # second life restarts from 10
+        reg = Registry()
+        cm = ConsensusMetrics(reg)
+        cm.total_txs.add(total)
+        fr = FlightRecorder([reg], path, interval=1.0)
+        fr.sample_once()
+        cm.total_txs.add(2)
+        fr.sample_once()
+        fr.stop()
+    series, _ = reconstruct(parse_timeseries(path))
+    values = [v for _t, v in series["tendermint_consensus_total_txs"]]
+    assert values[0] == 50.0 and values[-1] == 12.0  # anchor reset, no negatives
+
+
+def test_flight_recorder_rejects_disabled_interval(tmp_path):
+    with pytest.raises(ValueError):
+        FlightRecorder([Registry()], str(tmp_path / "x.jsonl"), interval=0)
+    # disabled is a call-site gate (node.py constructs nothing): no
+    # recorder threads may exist without an explicit start()
+    assert not any(t.name == "flight-recorder" for t in threading.enumerate())
+
+
+def test_flight_recorder_thread_samples_on_interval(tmp_path):
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    cm.height.set(1)
+    path = str(tmp_path / "timeseries.jsonl")
+    fr = FlightRecorder([reg], path, interval=0.05)
+    fr.start()
+    time.sleep(0.6)
+    fr.stop()
+    recs = parse_timeseries(path)
+    # ~12 ticks expected in 0.6s at 50ms; demand at least half plus the
+    # final stop() sample (CI jitter tolerance) — this is the
+    # "record count matches duration / interval" acceptance shape
+    assert len(recs) >= 6, recs
+    assert not any(t.name == "flight-recorder" for t in threading.enumerate())
+
+
+# ---------------------------------------------------------- series math
+
+
+def test_rates_and_window_rate():
+    pts = [(0.0, 0.0), (10.0, 100.0), (20.0, 100.0), (30.0, 160.0)]
+    rs = rates(pts)
+    assert [r for _t, r in rs] == [10.0, 0.0, 6.0]
+    assert window_rate(pts, 10.0, now=30.0) == pytest.approx(6.0)
+    assert window_rate(pts, 1000.0) == pytest.approx(160.0 / 30.0)
+    assert window_rate(pts[:1], 10.0) is None
+    # counter reset across an anchor clamps to 0, never negative
+    assert rates([(0.0, 10.0), (1.0, 3.0)]) == [(0.5, 0.0)]
+
+
+def test_stalled_tail():
+    assert stalled_tail_s([]) == 0.0
+    assert stalled_tail_s([(0.0, 1.0)]) == 0.0
+    grew = [(0.0, 1.0), (10.0, 2.0), (20.0, 3.0)]
+    assert stalled_tail_s(grew) == 0.0
+    stalled = [(0.0, 1.0), (10.0, 2.0), (60.0, 2.0), (90.0, 2.0)]
+    assert stalled_tail_s(stalled) == 80.0
+    flat = [(0.0, 5.0), (50.0, 5.0)]
+    assert stalled_tail_s(flat) == 50.0
+
+
+def test_change_point_detection():
+    # steady 10/s for 20 ticks, then collapse to 0: one change point
+    pts = [(float(i), 10.0 * min(i, 20)) for i in range(40)]
+    cps = change_points(pts, window=5)
+    assert len(cps) == 1
+    assert 15 <= cps[0]["t"] <= 25
+    assert cps[0]["before_per_s"] > cps[0]["after_per_s"]
+    # steady rate: no change points
+    assert change_points([(float(i), 10.0 * i) for i in range(40)], window=5) == []
+    # 4x acceleration: detected
+    accel = [(float(i), float(i if i < 20 else 20 + (i - 20) * 4)) for i in range(40)]
+    assert len(change_points(accel, window=5)) == 1
+
+
+def test_summarize_timeseries_stall_and_storm(tmp_path):
+    """End-to-end through the real recorder: a height that freezes and
+    a connect-rate burst must surface as stalled_tail_s and
+    peak_connects_per_s — the exact fields the rate_stall/churn_storm
+    gates read."""
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    pm = P2PMetrics(reg)
+    path = str(tmp_path / "timeseries.jsonl")
+    fr = FlightRecorder([reg], path, interval=1.0)
+    base = 1_000_000.0
+    for i in range(60):  # 2s cadence, 120s span
+        t = base + i * 2.0
+        if i < 20:
+            cm.height.set(i + 1)  # progress stops at t=38
+        if 40 <= i < 50:
+            pm.peer_connections.add(20, "out")  # 10/s storm for 20s
+            pm.dial_attempts.add(20, "failed")
+        _tick(fr, t)
+    tl = summarize_timeseries(parse_timeseries(path))
+    assert tl["records"] == 60
+    assert tl["height"]["last"] == 20.0
+    assert tl["height"]["stalled_tail_s"] == pytest.approx(80.0, abs=2.1)
+    assert tl["churn"]["connects_total"] == 400.0
+    assert tl["churn"]["peak_connects_per_s"] > 5.0
+    assert tl["height"]["change_points"], "height collapse not detected"
+
+
+def test_fleet_report_timeline_and_rate_stall_gate(tmp_path):
+    """analyze_run folds timeseries.jsonl into the report and the
+    rate_stall gate fails on a stalled timeline even when the final
+    scrape looks healthy (the SIGKILL scenario: no fresh metrics.txt
+    at all)."""
+    from tendermint_tpu.lens import analyze_run
+
+    run = tmp_path / "net"
+    for name, stall in (("validator01", False), ("validator02", True)):
+        nd = run / name
+        nd.mkdir(parents=True)
+        reg = Registry()
+        cm = ConsensusMetrics(reg)
+        fr = FlightRecorder([reg], str(nd / "timeseries.jsonl"), interval=1.0)
+        base = 1_000_000.0
+        for i in range(80):
+            if not stall or i < 10:
+                cm.height.set(i + 1)
+            _tick(fr, base + i * 2.0)
+    report = analyze_run(str(run))
+    assert report["fleet"]["nodes_with_timeseries"] == 2
+    gate = next(g for g in report["gates"] if g["name"] == "rate_stall")
+    assert not gate["ok"] and "validator02" in gate["detail"]
+    assert report["verdict"] == "fail"
+    ok_gate = next(g for g in report["gates"] if g["name"] == "churn_storm")
+    assert ok_gate["ok"]
+
+
+# ------------------------------------------------------------ live gates
+
+
+def _exposition(height=50, age_s=1.0, steps=0, step_s=0.2, connects=5.0):
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    pm = P2PMetrics(reg)
+    cm.height.set(height)
+    cm.last_block_age.mark(time.time() - age_s)
+    for _ in range(steps):
+        cm.step_duration.observe(step_s, "propose")
+    pm.peer_connections.add(connects, "out")
+    return parse_exposition(reg.gather())
+
+
+def test_rolling_gates_healthy_and_unknown_keys():
+    g = RollingGates()
+    t0 = 1000.0
+    for i in range(20):
+        t = t0 + i * 2.0
+        for n in ("a", "b"):
+            g.observe(n, _exposition(height=50 + i, age_s=1.0), t=t)
+    assert g.evaluate(now=t0 + 40.0) == []
+    with pytest.raises(ValueError, match="stall_after"):
+        RollingGates({"stall_afterr_s": 1})
+    assert WATCH_DEFAULTS["stall_after_s"] > 0  # defaults not mutated
+
+
+def test_rolling_gates_liveness_stall_trips():
+    g = RollingGates({"stall_after_s": 10.0})
+    t0 = 1000.0
+    for i in range(8):
+        t = t0 + i * 2.0
+        g.observe("a", _exposition(height=50, age_s=2.0 + i * 2.0), t=t)
+    tripped = g.evaluate(now=t0 + 14.0)
+    assert [x["name"] for x in tripped] == ["liveness_stall"]
+    assert "a" in tripped[0]["detail"]
+    # reset() forgets the stalled window (perturbation resume)
+    g.reset()
+    assert g.evaluate(now=t0 + 14.0) == []
+
+
+def test_rolling_gates_no_trip_before_first_block():
+    """Pre-first-commit the AgeGauge was never marked, so the age
+    series is ABSENT: unknown must not count as stale (a slow fleet
+    start is the wait loops' timeout budget, not a live stall)."""
+    reg = Registry()
+    cm = ConsensusMetrics(reg)  # no height.set, no age mark
+    P2PMetrics(reg)
+    exp = parse_exposition(reg.gather())
+    g = RollingGates({"stall_after_s": 5.0})
+    for i in range(8):
+        g.observe("a", exp, t=1000.0 + i * 2.0)
+    assert g.evaluate(now=1030.0) == []
+
+
+def test_rolling_gates_stall_needs_stale_age_too():
+    """Height flat but the head age says blocks ARE committing (e.g.
+    the scrape hit a node whose height gauge wedged): no trip — both
+    signals must agree."""
+    g = RollingGates({"stall_after_s": 10.0})
+    t0 = 1000.0
+    for i in range(8):
+        g.observe("a", _exposition(height=50, age_s=0.5), t=t0 + i * 2.0)
+    assert g.evaluate(now=t0 + 14.0) == []
+
+
+def test_rolling_gates_height_spread_trips():
+    g = RollingGates({"max_height_spread": 3})
+    g.observe("a", _exposition(height=50, age_s=0.1), t=1000.0)
+    g.observe("b", _exposition(height=40, age_s=0.1), t=1000.0)
+    tripped = g.evaluate(now=1000.1)
+    assert [x["name"] for x in tripped] == ["height_spread"]
+
+
+def test_rolling_gates_windowed_p99_trips_on_fresh_regression():
+    """The run-cumulative p99 hides a late regression (1000 fast steps
+    drown 30 slow ones); the WINDOWED delta must catch it."""
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    pm = P2PMetrics(reg)
+    cm.last_block_age.mark()
+    for _ in range(1000):
+        cm.step_duration.observe(0.1, "propose")  # healthy history
+
+    def snap(height):
+        cm.height.set(height)
+        return parse_exposition(reg.gather())
+
+    for _ in range(3000):
+        cm.step_duration.observe(0.1, "propose")  # more healthy history
+    g = RollingGates({"min_step_samples": 20, "watch_window_s": 30.0})
+    g.observe("a", snap(50), t=1000.0)
+    for _ in range(30):
+        cm.step_duration.observe(30.0, "propose")  # overflow bucket
+    g.observe("a", snap(51), t=1010.0)
+    tripped = g.evaluate(now=1010.0)
+    assert [x["name"] for x in tripped] == ["p99_step_duration"], tripped
+    # sanity: the cumulative estimate would NOT have tripped
+    h = parse_exposition(reg.gather()).histogram(
+        "tendermint_consensus_step_duration_seconds"
+    )
+    assert h.quantile(0.99) < 9.5
+
+
+def test_rolling_gates_churn_storm_trips():
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    pm = P2PMetrics(reg)
+    cm.last_block_age.mark()
+
+    def snap(height, connects):
+        cm.height.set(height)
+        pm.dial_attempts.add(connects, "failed")
+        return parse_exposition(reg.gather())
+
+    g = RollingGates({"max_connects_per_s": 5.0, "watch_window_s": 20.0})
+    for i in range(11):
+        g.observe("a", snap(50 + i, 20), t=1000.0 + i * 2.0)  # 10 dials/s
+    tripped = g.evaluate(now=1020.0)
+    assert [x["name"] for x in tripped] == ["churn_storm"], tripped
+
+
+# --------------------------------------------- e2e collector early abort
+
+
+class _FakeProc:
+    """Stands in for a node subprocess: alive until told otherwise."""
+
+    def __init__(self):
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig):
+        pass
+
+    def terminate(self):
+        self.returncode = 0
+
+    def kill(self):
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+def test_watch_collector_aborts_and_report_names_gate(tmp_path):
+    """The tier-1 early-abort path, node-free: frozen /metrics
+    endpoints (real PrometheusServers) trip the live liveness gate,
+    the wait loop raises WatchTripped well before its timeout, the
+    on-trip sweep lands, and cleanup's fleet report carries verdict
+    FAIL with the tripped gate named — plus metrics.last-watch.txt for
+    a node that died without a runner-initiated kill."""
+    from tendermint_tpu.e2e.manifest import Manifest
+    from tendermint_tpu.e2e.runner import E2ENode, Runner, WatchTripped
+
+    m = Manifest.parse('chain_id = "watch-unit"\n[node.validator01]\n[node.validator02]\n')
+    runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
+    servers = []
+    try:
+        for nm in m.nodes:
+            reg = Registry()
+            cm = ConsensusMetrics(reg)
+            cm.height.set(7)
+            cm.last_block_age.mark(time.time() - 300)  # head 5 min stale
+            srv = PrometheusServer(reg, "127.0.0.1:0")
+            srv.start()
+            servers.append(srv)
+            node = E2ENode(nm, str(tmp_path / "net" / nm.name), 0, 0, 0,
+                           prom_port=srv.port)
+            os.makedirs(node.home, exist_ok=True)
+            node.proc = _FakeProc()
+            runner.nodes.append(node)
+
+        runner.start_watch(interval=0.1,
+                           gates={"stall_after_s": 0.5, "watch_window_s": 5.0})
+        t0 = time.monotonic()
+        with pytest.raises(WatchTripped) as ei:
+            runner.wait_for_height(1000, timeout=60.0)
+        assert time.monotonic() - t0 < 30.0, "abort was not early"
+        assert ei.value.gate == "liveness_stall"
+        assert runner.watch_tripped["gate"] == "liveness_stall"
+        # one node dies before cleanup: its collector-cached scrape
+        # must be persisted (the kill wasn't runner-initiated)
+        runner.nodes[1].proc.returncode = -9
+    finally:
+        runner.cleanup()
+        for s in servers:
+            s.stop()
+
+    report = runner.last_report
+    assert report is not None and report["verdict"] == "fail"
+    assert report["live_abort"]["gate"] == "liveness_stall"
+    gate = next(g for g in report["gates"] if g["name"] == "liveness_stall")
+    assert not gate["ok"] and "live watch abort" in gate["detail"]
+    # the trip-time sweep captured the fleet's state at the moment
+    on_trip = [
+        n for n in runner.nodes
+        if os.path.exists(os.path.join(n.home, "metrics.on-trip.txt"))
+    ]
+    assert on_trip, "no on-trip artifact sweep"
+    assert os.path.exists(
+        os.path.join(runner.nodes[1].home, "metrics.last-watch.txt")
+    ), "dead node's last collector scrape was not persisted"
+
+
+def test_watch_hold_suppresses_trips(tmp_path):
+    """hold_watch() (run_perturbations) keeps an INTENTIONAL stall from
+    tripping; resume_watch() resets the windows so recovery is judged
+    fresh."""
+    from tendermint_tpu.e2e.manifest import Manifest
+    from tendermint_tpu.e2e.runner import E2ENode, Runner
+
+    m = Manifest.parse('chain_id = "watch-hold"\n[node.validator01]\n')
+    runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    cm.height.set(7)
+    cm.last_block_age.mark(time.time() - 300)
+    srv = PrometheusServer(reg, "127.0.0.1:0")
+    srv.start()
+    try:
+        node = E2ENode(m.nodes[0], str(tmp_path / "net" / m.nodes[0].name),
+                       0, 0, 0, prom_port=srv.port)
+        os.makedirs(node.home, exist_ok=True)
+        node.proc = _FakeProc()
+        runner.nodes.append(node)
+        runner.start_watch(interval=0.1,
+                           gates={"stall_after_s": 0.3, "watch_window_s": 5.0})
+        runner.hold_watch()
+        time.sleep(1.2)
+        assert runner.watch_tripped is None, "held watch still tripped"
+        runner.check_watch()  # no raise
+        # resume with a now-healthy head: windows restart, no trip
+        cm.last_block_age.mark()
+        cm.height.set(8)
+        runner.resume_watch()
+        time.sleep(0.3)
+        assert runner.watch_tripped is None
+    finally:
+        runner.cleanup()
+        srv.stop()
+
+
+# ------------------------------------------------- propagation stamping
+
+
+def test_consensus_codec_stamps_and_recovers_origin():
+    from tendermint_tpu.consensus.messages import (
+        HasVoteMessage,
+        ProposalMessage,
+        VoteMessage,
+    )
+    from tendermint_tpu.consensus.reactor import (
+        decode_consensus_msg,
+        encode_consensus_msg,
+    )
+    from tendermint_tpu.types.proposal import Proposal
+    from tendermint_tpu.types.vote import PREVOTE, Vote
+
+    vote = Vote(type=PREVOTE, height=3, round=0, validator_address=b"\x01" * 20,
+                validator_index=1, signature=b"\x02" * 64)
+    before = time.time_ns()
+    rt = decode_consensus_msg(encode_consensus_msg(VoteMessage(vote)))
+    after = time.time_ns()
+    assert before <= rt.origin_ns <= after, "vote frame not stamped at encode"
+    rt2 = decode_consensus_msg(encode_consensus_msg(ProposalMessage(Proposal(height=3))))
+    assert before <= rt2.origin_ns
+    # control-plane frames stay unstamped (byte-identical to reference)
+    hv = decode_consensus_msg(encode_consensus_msg(HasVoteMessage(3, 0, PREVOTE, 1)))
+    assert not hasattr(hv, "origin_ns")
+
+
+def test_reactor_observes_propagation_into_histogram():
+    from types import SimpleNamespace
+
+    from tendermint_tpu.consensus.messages import VoteMessage
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    cs = SimpleNamespace(metrics=cm, rs=SimpleNamespace(height=1, round=0, step=0,
+                                                        last_commit=None))
+    r = ConsensusReactor.__new__(ConsensusReactor)  # no channel wiring needed
+    r.cs = cs
+    now = time.time_ns()
+    r._observe_propagation(SimpleNamespace(origin_ns=now - 5_000_000), "vote")
+    r._observe_propagation(SimpleNamespace(origin_ns=0), "vote")          # unstamped: skip
+    r._observe_propagation(SimpleNamespace(origin_ns=now - int(120e9)), "vote")  # skew: skip
+    r._observe_propagation(SimpleNamespace(origin_ns=now + int(0.5e9)), "vote")  # clamp to 0
+    h = cm.msg_propagation
+    assert h.totals() == [({"type": "vote"}, pytest.approx(0.005, abs=0.05), 2.0)]
+
+
+# ------------------------------------------------- p2p redial-storm fix
+
+
+def test_peermanager_storm_backoff_escalates_past_persistent_cap():
+    from tendermint_tpu.p2p.peermanager import (
+        PeerAddressInfo,
+        PeerInfo,
+        PeerManager,
+        PeerManagerOptions,
+    )
+    from tendermint_tpu.p2p.transport import Endpoint
+
+    nid = "aa" * 20
+    pm = PeerManager("bb" * 20, PeerManagerOptions(
+        persistent_peers=[nid],
+        max_retry_time_persistent=5.0,
+        max_retry_time=30.0,
+        retry_time_jitter=0.0,
+        storm_backoff_after=4,
+    ))
+    ep = Endpoint(protocol="mconn", host="127.0.0.1", port=1, node_id=nid)
+    pm.add(ep)
+    info = pm.store.get(nid)
+    ai = info.address_info[str(ep)]
+
+    def delay_at(failures):
+        ai.dial_failures = failures
+        ai.last_dial_failure = 1000.0
+        return pm._retry_at(info, ai) - 1000.0
+
+    assert delay_at(4) == pytest.approx(2.0)     # classic backoff, under cap
+    # pre-fix, every delay past failure 6 pinned at the 5s persistent
+    # cap forever — the redial storm; escalation doubles the cap past
+    # the threshold instead
+    assert delay_at(6) == pytest.approx(8.0)     # cap escalated to 5*2**2=20
+    assert delay_at(8) == pytest.approx(30.0)    # classic 32 vs escalated cap 30
+    assert delay_at(20) == pytest.approx(30.0)   # never past max_retry_time
+    # one success resets the whole escalation
+    pm._dialing.add(nid)
+    pm.dialed(ep)
+    assert ai.dial_failures == 0
+
+
+def test_peermanager_bounds_concurrent_dials_and_counts_attempts():
+    from tendermint_tpu.p2p.peermanager import PeerManager, PeerManagerOptions
+    from tendermint_tpu.p2p.transport import Endpoint
+
+    reg = Registry()
+    metrics = P2PMetrics(reg)
+    pm = PeerManager("ff" * 20, PeerManagerOptions(max_dial_concurrency=2),
+                     metrics=metrics)
+    eps = []
+    for i in range(5):
+        nid = f"{i:02x}" * 20
+        ep = Endpoint(protocol="mconn", host="127.0.0.1", port=1000 + i, node_id=nid)
+        pm.add(ep)
+        eps.append(ep)
+    got = [pm.try_dial_next(), pm.try_dial_next()]
+    assert all(e is not None for e in got)
+    assert pm.try_dial_next() is None, "third concurrent dial not bounded"
+    # an outcome frees the slot and is counted by result
+    pm.dial_failed(got[0])
+    assert pm.try_dial_next() is not None
+    pm.dialed(got[1])
+    exp = parse_exposition(reg.gather())
+    assert exp.value("tendermint_p2p_dial_attempts_total", result="failed") == 1
+    assert exp.value("tendermint_p2p_dial_attempts_total", result="ok") == 1
+
+
+# --------------------------------------------------------- CLI + imports
+
+
+def test_tmlens_watch_cli_rundir_trips(tmp_path):
+    run = tmp_path / "net"
+    nd = run / "validator01"
+    nd.mkdir(parents=True)
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    fr = FlightRecorder([reg], str(nd / "timeseries.jsonl"), interval=1.0)
+    # recent timestamps: the watch also judges SILENCE (now - t_end),
+    # so the stream must end near the probe's wall clock
+    base = time.time() - 80.0
+    for i in range(40):
+        if i < 5:
+            cm.height.set(i + 1)
+        _tick(fr, base + i * 2.0)
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "tmlens.py"),
+         "watch", str(run), "--once", "--gates", '{"stall_after_s": 20.0}'],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    # same gate name as the post-mortem timeline gate — the two
+    # surfaces must not contradict each other on identical evidence
+    assert "rate_stall" in r.stdout
+    # healthy thresholds: rc 0
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "tmlens.py"),
+         "watch", str(run), "--once", "--gates", '{"stall_after_s": 1000.0}'],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "gates: ok" in r2.stdout
+    # a probe that can observe NOTHING must not report healthy
+    empty = tmp_path / "empty-run"
+    empty.mkdir()
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "tmlens.py"),
+         "watch", str(empty), "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r3.returncode == 2, r3.stdout + r3.stderr
+    assert "UNOBSERVABLE" in r3.stdout
+    # a stream that was HEALTHY but stopped growing (SIGKILL'd fleet):
+    # the silence itself must trip, even with zero stalled tail
+    dead = tmp_path / "dead-run" / "validator01"
+    dead.mkdir(parents=True)
+    reg2 = Registry()
+    cm2 = ConsensusMetrics(reg2)
+    fr2 = FlightRecorder([reg2], str(dead / "timeseries.jsonl"), interval=1.0)
+    base2 = time.time() - 300.0
+    for i in range(30):
+        cm2.height.set(i + 1)  # committing right up to the end
+        _tick(fr2, base2 + i * 2.0)
+    r4 = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "tmlens.py"),
+         "watch", str(tmp_path / "dead-run"), "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r4.returncode == 1, r4.stdout + r4.stderr
+    assert "rate_stall" in r4.stdout and "silent" in r4.stdout
+
+
+def test_flight_and_series_import_isolation():
+    """Two-way guard for the NEW modules, same discipline as
+    test_lens_never_touches_node_hot_path: the node-side recorder
+    (metrics/flight.py) must not import lens, and lens.series must not
+    drag in jax/ops."""
+    code = (
+        "import sys\n"
+        "import tendermint_tpu.metrics.flight, tendermint_tpu.e2e.runner\n"
+        "assert 'tendermint_tpu.lens' not in sys.modules, 'lens on the node path'\n"
+        "import tendermint_tpu.lens.series\n"
+        "assert not any(m == 'jax' or m.startswith('jax.') for m in sys.modules), 'series pulled jax'\n"
+        "assert 'tendermint_tpu.ops' not in sys.modules, 'series pulled the ops plane'\n"
+        "print('CLEAN')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=_ROOT, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0 and "CLEAN" in r.stdout, r.stdout + r.stderr
