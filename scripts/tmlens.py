@@ -10,6 +10,21 @@ Usage:
       <run-dir>/fleet_trace.json.
       Exit code: 0 = verdict pass, 1 = verdict fail, 2 = usage/IO.
 
+  python scripts/tmlens.py critical-path <run-dir>
+      tmpath: per-height critical-path attribution from the journey
+      spans in each node's trace.json (docs/observability.md#tmpath).
+      Prints, per node and height, the block interval decomposed into
+      proposer / gossip / verify / quorum / apply seconds plus the
+      dominant stage, then the fleet digest. Exit code: 0 = no stage
+      over budget, 1 = some height parked more than --budget seconds
+      on a single stage (the journey_stall condition), 2 = usage / no
+      node left journey spans.
+      --height H     only print this height's rows (verdict still
+                     judges every height)
+      --budget S     per-stage stall budget (default: the journey_stall
+                     gate's 60s)
+      --json         print the {node: critical_path} JSON instead
+
   python scripts/tmlens.py watch <run-dir>
   python scripts/tmlens.py watch --addrs host:port,host:port
       Live terminal view with the SAME rolling gates the e2e collector
@@ -219,17 +234,118 @@ def _watch(args) -> int:
         time.sleep(interval)
 
 
+def _critical_path(args) -> int:
+    from tendermint_tpu.lens.analyze import discover_nodes
+    from tendermint_tpu.lens.gates import DEFAULT_GATES
+    from tendermint_tpu.lens.journey import (
+        STAGES,
+        critical_path,
+        fleet_critical_path,
+        journey_stall_offenders,
+    )
+    from tendermint_tpu.lens.traces import load_trace_events
+
+    run_dir = None
+    budget = DEFAULT_GATES["journey_stall_budget_s"]
+    only_height = None
+    as_json = False
+    i = 0
+    try:
+        while i < len(args):
+            a = args[i]
+            if a == "--budget":
+                budget = float(args[i + 1])
+                i += 2
+            elif a == "--height":
+                only_height = int(args[i + 1])
+                i += 2
+            elif a == "--json":
+                as_json = True
+                i += 1
+            elif a.startswith("-"):
+                print(f"unknown critical-path flag {a!r}", file=sys.stderr)
+                return 2
+            elif run_dir is None:
+                run_dir = a
+                i += 1
+            else:
+                print(f"unexpected argument {a!r}", file=sys.stderr)
+                return 2
+    except (IndexError, ValueError) as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    if run_dir is None or not os.path.isdir(run_dir):
+        print(f"not a run directory: {run_dir!r}", file=sys.stderr)
+        return 2
+
+    paths: list[tuple[str, dict]] = []
+    for name, d in discover_nodes(run_dir):
+        tpath = os.path.join(d, "trace.json")
+        if not os.path.exists(tpath):
+            continue
+        try:
+            cp = critical_path(load_trace_events(tpath))
+        except (ValueError, OSError) as e:
+            print(f"  {name}: unreadable trace ({e})", file=sys.stderr)
+            continue
+        if cp["heights"]:
+            paths.append((name, cp))
+    if not paths:
+        print("no node left journey spans (run nodes with TM_TPU_TRACE=1)",
+              file=sys.stderr)
+        return 2
+
+    if as_json:
+        print(json.dumps({name: cp for name, cp in paths}, indent=1))
+    # ONE copy of the trip condition, shared with the journey_stall
+    # gate (lens/journey.py) — CLI rc and gate verdict cannot drift
+    offenders = journey_stall_offenders(paths, budget)
+    for name, cp in paths:
+        if not as_json:
+            print(f"{name}: {len(cp['heights'])} heights")
+            print(f"  {'h':>5} {'round':>5} {'interval':>9} "
+                  + " ".join(f"{s:>9}" for s in STAGES)
+                  + f" {'dominant':>9}")
+        for h, e in sorted(cp["heights"].items()):
+            if only_height is not None and int(h) != only_height:
+                continue
+            if not as_json:
+                marks = "".join(
+                    f" [{m}]" for m in e.get("missing", []))
+                print(f"  {h:>5} {e['round']:>5} {e['interval_s']:>9.3f} "
+                      + " ".join(f"{e['stages'][s]:>9.3f}" for s in STAGES)
+                      + f" {e['dominant']:>9}{marks}")
+        t = cp.get("totals") or {}
+        if not as_json and t.get("stage_fractions"):
+            print("  fractions: "
+                  + " ".join(f"{k}={v}" for k, v in t["stage_fractions"].items()))
+    if not as_json:
+        fleet = fleet_critical_path(paths)
+        w = fleet.get("worst") or {}
+        print(f"fleet: dominant {fleet.get('dominant_stage')}, worst "
+              f"{w.get('stage')} {w.get('seconds')}s @ h{w.get('height')} "
+              f"on {w.get('node')}")
+    if offenders:
+        print(f"JOURNEY STALL (> {budget}s on one stage): {offenders}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0 if argv else 2
+    if argv[0] == "critical-path":
+        return _critical_path(argv[1:])
     if argv[0] == "watch":
         try:
             return _watch(argv[1:])
         except KeyboardInterrupt:
             return 0
     if argv[0] != "analyze":
-        print(f"unknown command {argv[0]!r} (try: analyze <run-dir> | watch ...)",
+        print(f"unknown command {argv[0]!r} "
+              "(try: analyze <run-dir> | critical-path <run-dir> | watch ...)",
               file=sys.stderr)
         return 2
     args = argv[1:]

@@ -41,6 +41,11 @@ class ProposalMessage:
     # consensus_msg_propagation_seconds histogram (shared-clock
     # testnets; docs/observability.md#flight).
     origin_ns: int = 0
+    # the stamping node's p2p id ("" = unstamped) — the originator half
+    # of the deterministic tmpath journey key (trace.journey_key) that
+    # binds this frame's send/receive spans across node processes
+    # (docs/observability.md#tmpath).
+    origin_node: str = ""
 
 
 @dataclass
@@ -56,12 +61,14 @@ class BlockPartMessage:
     round: int
     part: Part
     origin_ns: int = 0  # see ProposalMessage.origin_ns
+    origin_node: str = ""  # see ProposalMessage.origin_node
 
 
 @dataclass
 class VoteMessage:
     vote: Vote
     origin_ns: int = 0  # see ProposalMessage.origin_ns
+    origin_node: str = ""  # see ProposalMessage.origin_node
 
 
 @dataclass

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import trace as _trace
 from ..p2p.types import (
     CHANNEL_CONSENSUS_DATA,
     CHANNEL_CONSENSUS_STATE,
@@ -73,7 +74,39 @@ def _ba_from_proto(p: pb.BitArrayProto | None) -> BitArray | None:
     return BitArray.from_bytes(bits, raw[: (bits + 7) // 8])
 
 
-def encode_consensus_msg(msg) -> bytes:
+def _msg_height_round(msg) -> tuple[int, int]:
+    """(height, round) of a data-plane message — the journey-key
+    coordinates shared by the frame's sender and receiver."""
+    if isinstance(msg, ProposalMessage):
+        return msg.proposal.height, msg.proposal.round
+    if isinstance(msg, VoteMessage):
+        return msg.vote.height, msg.vote.round
+    return msg.height, msg.round  # BlockPartMessage
+
+
+def _journey_send(msg, kind: str, origin_node: str, metrics) -> None:
+    """Per-peer send instrumentation of a stamped data-plane frame: the
+    journey_frames counter and (tracing on) a journey.send instant
+    whose deterministic key the RECEIVER re-derives from the frame's
+    origin_node — one send/recv pair per hop, no clock alignment.
+    UNSTAMPED frames (bare codec, no node identity wired) emit
+    nothing, mirroring the receive side: counting them would break the
+    sent/received symmetry, and an anonymous '@-' send key would
+    collide across nodes and draw false cross-node arrows in the
+    merged trace."""
+    if not origin_node:
+        return
+    if metrics is not None:
+        metrics.journey_frames.add(1, kind, "sent")
+    if _trace.enabled():
+        h, r = _msg_height_round(msg)
+        _trace.instant(
+            "journey.send", "journey", height=h, type=kind,
+            journey=_trace.journey_key(h, r, kind, origin_node),
+        )
+
+
+def encode_consensus_msg(msg, origin_node: str = "", metrics=None) -> bytes:
     """ref: internal/consensus/msgs.go MsgToProto.
 
     Data-plane frames (proposal / block part / vote) additionally carry
@@ -81,7 +114,11 @@ def encode_consensus_msg(msg) -> bytes:
     field-1000 extension): the encoder runs once per peer send, so the
     stamp is the FRAME's origin time, and the receive side's
     now - origin is pure network propagation — what splits a slow step
-    into network vs compute on shared-clock testnets."""
+    into network vs compute on shared-clock testnets. `origin_node`
+    (when the node wires its p2p id in via
+    consensus_channel_descriptors) rides field 1001 so the receiver can
+    re-derive the same tmpath journey key; empty values are omitted, so
+    unstamped frames stay byte-identical to the reference schema."""
     if isinstance(msg, NewRoundStepMessage):
         wrapped = pb.ConsensusMessage(new_round_step=pb.CsNewRoundStep(
             height=msg.height, round=msg.round, step=msg.step,
@@ -94,7 +131,8 @@ def encode_consensus_msg(msg) -> bytes:
             block_parts=_ba_to_proto(msg.block_parts), is_commit=msg.is_commit))
     elif isinstance(msg, ProposalMessage):
         wrapped = pb.ConsensusMessage(proposal=pb.CsProposal(proposal=msg.proposal.to_proto()),
-                                      origin_ns=time.time_ns())
+                                      origin_ns=time.time_ns(), origin_node=origin_node)
+        _journey_send(msg, "proposal", origin_node, metrics)
     elif isinstance(msg, ProposalPOLMessage):
         wrapped = pb.ConsensusMessage(proposal_pol=pb.CsProposalPOL(
             height=msg.height, proposal_pol_round=msg.proposal_pol_round,
@@ -102,10 +140,12 @@ def encode_consensus_msg(msg) -> bytes:
     elif isinstance(msg, BlockPartMessage):
         wrapped = pb.ConsensusMessage(block_part=pb.CsBlockPart(
             height=msg.height, round=msg.round, part=msg.part.to_proto()),
-            origin_ns=time.time_ns())
+            origin_ns=time.time_ns(), origin_node=origin_node)
+        _journey_send(msg, "block_part", origin_node, metrics)
     elif isinstance(msg, VoteMessage):
         wrapped = pb.ConsensusMessage(vote=pb.CsVote(vote=msg.vote.to_proto()),
-                                      origin_ns=time.time_ns())
+                                      origin_ns=time.time_ns(), origin_node=origin_node)
+        _journey_send(msg, "vote", origin_node, metrics)
     elif isinstance(msg, HasVoteMessage):
         wrapped = pb.ConsensusMessage(has_vote=pb.CsHasVote(
             height=msg.height, round=msg.round, type=msg.type, index=msg.index))
@@ -136,7 +176,8 @@ def decode_consensus_msg(data: bytes):
             _ba_from_proto(p.block_parts), bool(p.is_commit))
     if w.proposal is not None:
         return ProposalMessage(Proposal.from_proto(w.proposal.proposal),
-                               origin_ns=w.origin_ns or 0)
+                               origin_ns=w.origin_ns or 0,
+                               origin_node=w.origin_node or "")
     if w.proposal_pol is not None:
         p = w.proposal_pol
         return ProposalPOLMessage(p.height or 0, p.proposal_pol_round or 0,
@@ -144,9 +185,11 @@ def decode_consensus_msg(data: bytes):
     if w.block_part is not None:
         p = w.block_part
         return BlockPartMessage(p.height or 0, p.round or 0, Part.from_proto(p.part),
-                                origin_ns=w.origin_ns or 0)
+                                origin_ns=w.origin_ns or 0,
+                                origin_node=w.origin_node or "")
     if w.vote is not None:
-        return VoteMessage(Vote.from_proto(w.vote.vote), origin_ns=w.origin_ns or 0)
+        return VoteMessage(Vote.from_proto(w.vote.vote), origin_ns=w.origin_ns or 0,
+                           origin_node=w.origin_node or "")
     if w.has_vote is not None:
         p = w.has_vote
         return HasVoteMessage(p.height or 0, p.round or 0, p.type or 0, p.index or 0)
@@ -161,14 +204,19 @@ def decode_consensus_msg(data: bytes):
     raise ValueError("empty consensus message")
 
 
-def consensus_channel_descriptors() -> list[ChannelDescriptor]:
-    """ref: reactor.go:36-71 (GetChannelDescriptors)."""
+def consensus_channel_descriptors(origin_node: str = "", metrics=None) -> list[ChannelDescriptor]:
+    """ref: reactor.go:36-71 (GetChannelDescriptors). `origin_node` (the
+    node's p2p id) and `metrics` (its ConsensusMetrics) thread into the
+    per-send encoder so data-plane frames carry the tmpath journey
+    origin; the defaults leave frames unstamped (byte-identical to the
+    reference schema) for tests and tooling that build bare codecs."""
+    encode = lambda m: encode_consensus_msg(m, origin_node, metrics)
     mk = lambda cid, name, prio: ChannelDescriptor(
         id=cid,
         name=name,
         priority=prio,
         send_queue_capacity=64,
-        encode=encode_consensus_msg,
+        encode=encode,
         decode=decode_consensus_msg,
     )
     return [
@@ -404,14 +452,26 @@ class ConsensusReactor:
         (consensus_msg_propagation_seconds{type}). Unstamped frames
         (origin_ns 0: legacy peer, WAL replay) and stamps outside the
         skew window are skipped; a small negative dt (same-host clock
-        step) clamps to 0."""
+        step) clamps to 0. An origin_node stamp additionally yields a
+        journey.recv instant whose key matches the sender's
+        journey.send — the receive half of the tmpath hop flow."""
         metrics = getattr(self.cs, "metrics", None)
         origin = getattr(msg, "origin_ns", 0)
-        if metrics is None or not origin:
+        origin_node = getattr(msg, "origin_node", "")
+        if metrics is not None and origin:
+            dt = (time.time_ns() - origin) / 1e9
+            if -1.0 <= dt <= self.PROPAGATION_MAX_S:
+                metrics.msg_propagation.observe(max(0.0, dt), type_label)
+        if not origin_node:
             return
-        dt = (time.time_ns() - origin) / 1e9
-        if -1.0 <= dt <= self.PROPAGATION_MAX_S:
-            metrics.msg_propagation.observe(max(0.0, dt), type_label)
+        if metrics is not None:
+            metrics.journey_frames.add(1, type_label, "received")
+        if _trace.enabled():
+            h, r = _msg_height_round(msg)
+            _trace.instant(
+                "journey.recv", "journey", height=h, type=type_label,
+                journey=_trace.journey_key(h, r, type_label, origin_node),
+            )
 
     # ---------------------------------------------------------- gossip data
 

@@ -137,6 +137,11 @@ class ConsensusState:
         self.rs = RoundState()
         self.state = State()  # set by update_to_state
         self.replay_mode = False
+        # our p2p node id (set by node.py after construction) — the
+        # originator half of tmpath journey keys for events this node
+        # creates (proposal build); "" keeps keys deterministic-but-
+        # anonymous in harnesses that never wire an identity
+        self.node_id = ""
 
         self._queue: queue.Queue = queue.Queue(maxsize=1000)
         self._internal_queue: queue.Queue = queue.Queue(maxsize=1000)
@@ -149,6 +154,11 @@ class ConsensusState:
         # Cleared at every height transition (update_to_state).
         self._quorum_clock: dict[tuple, float] = {}
         self._quorum_done: set[tuple] = set()
+        # tmpath journey anchors, trace-clock µs (cleared per height):
+        # first-vote times for retrospective journey.quorum spans and
+        # first-block-part times for journey.block_assembled
+        self._quorum_trace_us: dict[tuple, float] = {}
+        self._part_trace_us: dict[int, float] = {}
 
         self.update_to_state(state)
         # Boot-time reconstruction is best-effort: a statesync-restored
@@ -330,7 +340,8 @@ class ConsensusState:
         added = False
         try:
             if isinstance(msg, ProposalMessage):
-                self._set_proposal(msg.proposal, self.now())
+                self._set_proposal(msg.proposal, self.now(),
+                                   origin=getattr(msg, "origin_node", ""))
             elif isinstance(msg, BlockPartMessage):
                 added = self._add_proposal_block_part(msg)
             elif isinstance(msg, VoteMessage):
@@ -437,6 +448,8 @@ class ConsensusState:
         rs.triggered_timeout_precommit = False
         self._quorum_clock.clear()
         self._quorum_done.clear()
+        self._quorum_trace_us.clear()
+        self._part_trace_us.clear()
         self.state = state
         if self.metrics is not None:
             self.metrics.validators.set(state.validators.size())
@@ -627,10 +640,30 @@ class ConsensusState:
         if rs.valid_block is not None:
             block, block_parts = rs.valid_block, rs.valid_block_parts
         else:
+            # journey.proposal_build: the proposer-compute leg of the
+            # block journey — everything between deciding to propose
+            # and having a gossip-ready part set (mempool reap, ABCI
+            # PrepareProposal, merkle roots, part split). Emitted
+            # retrospectively so a refused build (no last-commit
+            # majority yet) leaves NO anchor — a phantom span here
+            # would fabricate proposer attribution for a height this
+            # node never proposed.
+            # unconditional clock read (once per proposed height, not
+            # hot): a live-enable between here and the emit below must
+            # not pair a zero start with a real end
+            t_build = _trace.now_us()
             block = self._create_proposal_block(height)
             if block is None:
                 return
             block_parts = PartSet.from_data(block.to_proto().encode(), BLOCK_PART_SIZE_BYTES)
+            if _trace.enabled():
+                _trace.complete(
+                    "journey.proposal_build", "journey",
+                    t_build, _trace.now_us() - t_build,
+                    height=height, round=round_, parts=block_parts.total(),
+                    journey=_trace.journey_key(height, round_, "block", self.node_id),
+                )
+            self._journey_mark("proposal_build")
 
         self.wal.flush_and_sync()
         prop_block_id = BlockID(hash=block.hash(), part_set_header=block_parts.header)
@@ -846,8 +879,13 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or rs.step != STEP_COMMIT:
             return
+        # journey key origin "": all nodes share one commit key per
+        # (height, round), so the merged fleet trace binds every node's
+        # finalize span into one cross-node journey flow
         with _trace.span("consensus.finalize_commit", "consensus",
-                         height=height, round=rs.commit_round):
+                         height=height, round=rs.commit_round,
+                         journey=_trace.journey_key(height, rs.commit_round,
+                                                    "commit", "")):
             self._do_finalize_commit(height)
 
     def _do_finalize_commit(self, height: int) -> None:
@@ -934,8 +972,16 @@ class ConsensusState:
 
     # -------------------------------------------------------------- msgs
 
-    def _set_proposal(self, proposal: Proposal, recv_time: Time) -> None:
-        """ref: defaultSetProposal (state.go:2138)."""
+    def _journey_mark(self, stage: str) -> None:
+        """Count one tmpath journey span emission
+        (consensus_journey_spans_total{stage})."""
+        if self.metrics is not None:
+            self.metrics.journey_spans.add(1, stage)
+
+    def _set_proposal(self, proposal: Proposal, recv_time: Time, origin: str = "") -> None:
+        """ref: defaultSetProposal (state.go:2138). `origin` is the
+        delivering frame's origin_node stamp ("" for our own proposal
+        from the internal queue / WAL replay)."""
         rs = self.rs
         if rs.proposal is not None or proposal is None:
             return
@@ -955,6 +1001,20 @@ class ConsensusState:
         rs.proposal_receive_time = recv_time
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+        if not self.replay_mode:
+            if _trace.enabled():
+                # journey.proposal: the moment this node ACCEPTED the
+                # height's proposal — end of the proposer leg of the
+                # block journey from this node's point of view
+                _trace.instant(
+                    "journey.proposal", "journey",
+                    height=proposal.height, round=proposal.round,
+                    journey=_trace.journey_key(
+                        proposal.height, proposal.round, "proposal",
+                        origin or self.node_id,
+                    ),
+                )
+            self._journey_mark("proposal")
 
     def _add_proposal_block_part(self, msg: BlockPartMessage) -> bool:
         """ref: addProposalBlockPart (state.go:2183)."""
@@ -971,6 +1031,11 @@ class ConsensusState:
             if self.metrics is not None:
                 self.metrics.duplicate_block_part.add(1)
             return False
+        if _trace.enabled() and not self.replay_mode:
+            # first accepted part of this height starts the gossip/
+            # reassembly leg; journey.block_assembled is emitted
+            # retrospectively over [first part, set complete]
+            self._part_trace_us.setdefault(msg.height, _trace.now_us())
         # PEER-INPUT failures below are ValueErrors (logged + dropped):
         # parts and their contents are proposer-controlled bytes, and
         # the reference RETURNS errors for both (state.go:2220-2233) —
@@ -986,6 +1051,22 @@ class ConsensusState:
                 rs.proposal_block = Block.from_proto(pb.Block.decode(data))
             except Exception as e:
                 raise ValueError(f"malformed proposal block encoding: {e!r}") from e
+            if not self.replay_mode:
+                if _trace.enabled():
+                    t0 = self._part_trace_us.pop(msg.height, None)
+                    now = _trace.now_us()
+                    _trace.complete(
+                        "journey.block_assembled", "journey",
+                        now if t0 is None else t0,
+                        0.0 if t0 is None else now - t0,
+                        height=msg.height, round=msg.round,
+                        parts=rs.proposal_block_parts.total(),
+                        journey=_trace.journey_key(
+                            msg.height, msg.round, "block",
+                            getattr(msg, "origin_node", "") or self.node_id,
+                        ),
+                    )
+                self._journey_mark("block_assembled")
         return added
 
     def _handle_complete_proposal(self, height: int) -> None:
@@ -1087,12 +1168,14 @@ class ConsensusState:
             if self.metrics is not None:
                 self.metrics.duplicate_vote.add(1)
             return False
-        if self.metrics is not None and not self.replay_mode:
-            # start the quorum-assembly clock on the FIRST vote of this
+        if not self.replay_mode:
+            # start the quorum-assembly clocks on the FIRST vote of this
             # (height, round, type) — our own votes flow through here too
-            self._quorum_clock.setdefault(
-                (vote.height, vote.round, vote.type), _pytime.monotonic()
-            )
+            qkey = (vote.height, vote.round, vote.type)
+            if self.metrics is not None:
+                self._quorum_clock.setdefault(qkey, _pytime.monotonic())
+            if _trace.enabled():
+                self._quorum_trace_us.setdefault(qkey, _trace.now_us())
         self.broadcast(HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index))
 
         if vote.type == PREVOTE:
@@ -1144,19 +1227,30 @@ class ConsensusState:
     def _mark_quorum(self, vote: Vote) -> None:
         """First 2/3 majority for (height, round, type): observe the
         assembly time since that slot's first vote
-        (consensus_quorum_assembly_seconds{type}) exactly once."""
-        if self.metrics is None or self.replay_mode:
+        (consensus_quorum_assembly_seconds{type}) exactly once, and
+        emit the retrospective journey.quorum span — the quorum-wait
+        leg of the tmpath block journey."""
+        if self.replay_mode:
             return
         key = (vote.height, vote.round, vote.type)
         if key in self._quorum_done:
             return
         self._quorum_done.add(key)
-        t0 = self._quorum_clock.get(key)
-        if t0 is not None:
-            self.metrics.quorum_assembly.observe(
-                _pytime.monotonic() - t0,
-                "prevote" if vote.type == PREVOTE else "precommit",
+        label = "prevote" if vote.type == PREVOTE else "precommit"
+        if self.metrics is not None:
+            t0 = self._quorum_clock.get(key)
+            if t0 is not None:
+                self.metrics.quorum_assembly.observe(
+                    _pytime.monotonic() - t0, label
+                )
+        t0_us = self._quorum_trace_us.pop(key, None)
+        if t0_us is not None and _trace.enabled():
+            _trace.complete(
+                "journey.quorum", "journey", t0_us, _trace.now_us() - t0_us,
+                height=vote.height, round=vote.round, type=label,
+                journey=_trace.journey_key(vote.height, vote.round, label, ""),
             )
+        self._journey_mark("quorum")
 
     # -------------------------------------------------------------- votes
 

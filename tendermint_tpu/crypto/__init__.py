@@ -63,6 +63,13 @@ class BatchVerifier(ABC):
     """Accumulate (pubkey, msg, sig) triples, then verify all at once
     (ref: crypto/crypto.go:69-80)."""
 
+    # optional tmpath journey tag (trace.journey_key string): callers
+    # that verify on behalf of a specific chain event (commit verify at
+    # a height) set it so the engine's coalesced dispatch/collect spans
+    # stay attributable per height even across coalesced launches
+    # (docs/observability.md#tmpath)
+    journey: str | None = None
+
     @abstractmethod
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         """Queue a verification job. Raises on malformed inputs."""

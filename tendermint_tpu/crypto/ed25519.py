@@ -257,7 +257,8 @@ class Ed25519BatchVerifier(BatchVerifier):
 
         if _engine.engine_enabled():
             return _engine.verify_async_via_engine(
-                KEY_TYPE, self._pks, self._msgs, self._sigs
+                KEY_TYPE, self._pks, self._msgs, self._sigs,
+                journey=self.journey,
             )
         # direct dispatch: the cutovers below still deserve the one-shot
         # launch-latency calibration (no-op after the first call)

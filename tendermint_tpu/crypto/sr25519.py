@@ -382,6 +382,7 @@ class Sr25519BatchVerifier(BatchVerifier):
                 [j[0] for j in self._jobs],
                 [j[1] for j in self._jobs],
                 [j[2] for j in self._jobs],
+                journey=self.journey,
             )
         # direct dispatch: the cutovers below still deserve the one-shot
         # launch-latency calibration (no-op after the first call)

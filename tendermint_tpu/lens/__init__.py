@@ -11,9 +11,13 @@ every run so a failed gate arrives with evidence, not just a red X.
     prom.py      Prometheus exposition parser + histogram snapshots
                  (quantiles via metrics.bucket_quantile)
     traces.py    per-node Chrome-trace load, block-commit clock
-                 alignment, merged Perfetto fleet timeline
+                 alignment, merged Perfetto fleet timeline with
+                 cross-node journey flow arrows
     series.py    flight-recorder timeseries.jsonl parsing, windowed
                  rates/change-points, live RollingGates (watch plane)
+    journey.py   tmpath per-height critical-path decomposition
+                 (proposer/gossip/verify/quorum/apply) from journey
+                 spans
     analyze.py   per-node + fleet summaries over a run directory
     gates.py     declarative health gates -> pass/fail verdict
     profiler.py  TM_TPU_PROF=1 collapsed-stack sampling profiler
@@ -39,6 +43,12 @@ from .analyze import (  # noqa: F401
     write_merged_trace,
 )
 from .gates import DEFAULT_GATES, evaluate  # noqa: F401
+from .journey import (  # noqa: F401
+    STAGES,
+    critical_path,
+    fleet_critical_path,
+    height_anchors,
+)
 from .profiler import (  # noqa: F401
     SamplingProfiler,
     maybe_start_profiler,
@@ -56,4 +66,9 @@ from .series import (  # noqa: F401
     summarize_timeseries,
     window_rate,
 )
-from .traces import align_offsets, commit_anchors, merge_traces  # noqa: F401
+from .traces import (  # noqa: F401
+    align_offsets,
+    commit_anchors,
+    journey_flow_events,
+    merge_traces,
+)

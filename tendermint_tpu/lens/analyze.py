@@ -191,13 +191,29 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
     if os.path.exists(tpath):
         summary["artifacts"].append("trace.json")
         try:
+            from .journey import critical_path
             from .traces import commit_anchors
 
-            anchors = commit_anchors(load_trace_events(tpath))
+            events = load_trace_events(tpath)
+            anchors = commit_anchors(events)
             summary["trace"] = {
                 "commit_anchors": len(anchors),
                 "anchor_heights": [min(anchors), max(anchors)] if anchors else [],
             }
+            # tmpath: per-height critical-path decomposition from this
+            # node's journey spans (docs/observability.md#tmpath). The
+            # PR-9 propagation histogram rides along so the gossip
+            # stage can be read against measured per-frame latency.
+            cp = critical_path(events)
+            if cp["heights"]:
+                prop = summary.get("msg_propagation")
+                if prop:
+                    cp["propagation"] = {
+                        "p50_s": prop.get("p50_s"),
+                        "p99_s": prop.get("p99_s"),
+                        "frames": prop.get("count"),
+                    }
+                summary["critical_path"] = cp
         except (ValueError, KeyError, TypeError) as e:
             summary["trace"] = {"error": f"{type(e).__name__}: {e}"}
     return summary
@@ -257,6 +273,13 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
     fleet["step_p99_s"] = _round(merged.quantile(0.99)) if merged else None
     fleet["step_p50_s"] = _round(merged.quantile(0.5)) if merged else None
 
+    # tmpath fleet digest: where the time went, fleet-wide
+    from .journey import fleet_critical_path
+
+    fleet["critical_path"] = fleet_critical_path(
+        [(s["name"], s.get("critical_path")) for s in summaries]
+    )
+
     report = {"run_dir": os.path.abspath(run_dir), "nodes": summaries, "fleet": fleet}
     report["gates"], report["verdict"] = evaluate(report, gates)
     return report
@@ -300,6 +323,18 @@ def render_summary(report: dict) -> str:
         f"{f['min_height']}..{f['max_height']} (spread {f['height_spread']}), "
         f"step p99 {f['step_p99_s']}s, worst head age {f['worst_last_block_age_s']}s"
     )
+    fcp = f.get("critical_path") or {}
+    if fcp.get("nodes"):
+        w = fcp.get("worst") or {}
+        lines.append(
+            f"  critical path ({fcp['nodes']} nodes, "
+            f"{fcp.get('heights_covered')} heights): "
+            + " ".join(f"{k}={v}" for k, v in
+                       (fcp.get("stage_fractions") or {}).items())
+            + f", dominant {fcp.get('dominant_stage')}, worst "
+            f"{w.get('stage')} {w.get('seconds')}s @ h{w.get('height')} "
+            f"on {w.get('node')}"
+        )
     for s in report["nodes"]:
         sd = s.get("step_duration") or {}
         bi = s.get("block_interval") or {}
@@ -322,6 +357,18 @@ def render_summary(report: dict) -> str:
                 f"    timeline: {tl['records']} records / {tl['span_s']}s, "
                 f"height {h.get('rate_per_s')}/s (tail stall {h.get('stalled_tail_s')}s), "
                 f"peak churn {ch.get('peak_connects_per_s')}/s"
+            )
+        cp = (s.get("critical_path") or {}).get("totals")
+        if cp and cp.get("heights"):
+            fr = cp.get("stage_fractions") or {}
+            w = cp.get("worst") or {}
+            lines.append(
+                "    critical path: "
+                + " ".join(f"{k}={fr.get(k)}" for k in
+                           ("proposer", "gossip", "verify", "quorum", "apply"))
+                + f" over {cp['heights']} heights, dominant "
+                f"{cp.get('dominant_stage')}, worst {w.get('stage')} "
+                f"{w.get('seconds')}s @ h{w.get('height')}"
             )
         if s.get("missing_series"):
             lines.append(f"    missing series: {', '.join(s['missing_series'])}")

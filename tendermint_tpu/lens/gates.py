@@ -30,9 +30,17 @@ test assertions):
                      `max_connects_per_s` over any 30s window — the
                      redial-storm signature, as a rate instead of a
                      post-hoc total
+  journey_stall      a committed height's tmpath critical path
+                     (lens/journey.py, from journey spans in a node's
+                     trace.json) attributes more than
+                     `journey_stall_budget_s` to a SINGLE stage — the
+                     failure arrives naming the stage (proposer /
+                     gossip / verify / quorum / apply), the node, and
+                     the height, not just a slow p99
 
 rate_stall / churn_storm pass vacuously when no node left a
-timeseries.jsonl (flight recorder off): absence of the artifact is not
+timeseries.jsonl (flight recorder off), and journey_stall when no node
+left journey spans (tracing off): absence of an artifact is not
 evidence of a failure.
 """
 
@@ -64,6 +72,11 @@ DEFAULT_GATES = {
     # healthy 4-node run reconnects a handful of times total; the
     # ci.toml redial storm ran hundreds of connects per node
     "max_connects_per_s": 5.0,
+    # tmpath: no single critical-path stage of any committed height may
+    # eat more than this (kill/pause perturbations on a 2-core box cost
+    # a height tens of seconds; a healthy stage is sub-second — the
+    # budget separates "slow" from "parked on one stage")
+    "journey_stall_budget_s": 60.0,
 }
 
 
@@ -177,6 +190,31 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
             f"connect+dial rate over {cfg['max_connects_per_s']}/s: {storms}"
             if storms
             else f"peak connect+dial rates within {cfg['max_connects_per_s']}/s",
+        ))
+
+    # journey_stall (tmpath critical paths; vacuous pass when no node
+    # left journey spans — tracing off / pre-tmpath run dirs)
+    paths = [(s["name"], s["critical_path"]) for s in nodes if s.get("critical_path")]
+    if not paths:
+        gates.append(_gate(
+            "journey_stall", True,
+            "no critical-path data (no journey spans in any trace)",
+        ))
+    else:
+        # the trip condition lives in lens/journey.py
+        # journey_stall_offenders — one copy shared with the
+        # critical-path CLI, so gate and CLI can't drift apart
+        from .journey import journey_stall_offenders
+
+        budget = cfg["journey_stall_budget_s"]
+        offenders = journey_stall_offenders(paths, budget)
+        gates.append(_gate(
+            "journey_stall",
+            not offenders,
+            f"stages over {budget}s (node, height, stage, s): {offenders}"
+            if offenders
+            else f"no critical-path stage over {budget}s across "
+            f"{sum(len(cp['heights']) for _n, cp in paths)} height decompositions",
         ))
 
     # missing_series

@@ -1,0 +1,321 @@
+"""tmpath — per-height critical-path attribution over journey spans.
+
+The consensus plane emits journey-keyed spans (trace.journey_key) at
+every leg of a block's life: proposal build (proposer), proposal
+accepted, block parts reassembled, vote quorum assembly, finalize/
+apply, plus per-hop gossip send/recv instants and height-tagged verify
+spans. This module folds ONE node's trace events into a per-height
+decomposition of each block interval:
+
+  proposer   window start -> proposal accepted (proposer compute +
+             commit-timeout tail + proposal propagation)
+  gossip     proposal accepted -> block parts reassembled
+  verify     measured verify-span time inside the pre-commit window
+             (split host vs engine via journey-tagged engine spans —
+             the TPU-plane share is directly visible)
+  quorum     the remaining pre-commit wait: vote propagation + 2/3
+             assembly, i.e. (precommit quorum - block assembled) minus
+             the verify compute measured above
+  apply      precommit quorum -> finalize_commit end (block save,
+             ABCI FinalizeBlock/Commit, state update)
+
+All anchors are NODE-LOCAL trace timestamps, so the decomposition
+needs no cross-node clock alignment; stages tile the window exactly
+(sum == commit-to-commit interval) up to anchor availability, which
+`missing` records honestly. The per-height dominant stage names where
+the time went; lens/gates.py's journey_stall gate fails a run whose
+critical path parks more than a budget on one stage.
+
+Stays stdlib-only and node-runtime-free like the rest of lens/.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "STAGES",
+    "height_anchors",
+    "critical_path",
+    "fleet_critical_path",
+    "journey_height",
+    "journey_stall_offenders",
+]
+
+STAGES = ("proposer", "gossip", "verify", "quorum", "apply")
+
+# verify-plane spans whose duration is attributed to the pre-commit
+# window (signature verification of the previous height's commit runs
+# during THIS height's validate/prevote path)
+_VERIFY_SPANS = ("verify.commit_dispatch", "verify.commit_collect")
+
+
+def journey_height(key) -> int | None:
+    """Height encoded in a trace.journey_key string
+    ("<height>/<round>/<kind>@<origin>"), or None."""
+    try:
+        return int(str(key).split("/", 1)[0])
+    except (ValueError, IndexError):
+        return None
+
+
+def _args(ev: dict) -> dict:
+    return ev.get("args") or {}
+
+
+def _end(ev: dict) -> float:
+    return ev["ts"] + ev.get("dur", 0)
+
+
+def height_anchors(events: list[dict]) -> dict[int, dict]:
+    """Per-height journey anchors from one node's trace events
+    (node-local µs). For heights that ran several rounds, the LAST
+    occurrence of each anchor wins — the round that actually committed.
+
+    Returns {height: {commit_start, commit_end, round, proposal,
+    assembled_end, build_s, build_end, quorum: {prevote, precommit}}}
+    with absent anchors simply missing from the dict. Verify/engine
+    spans are window-attributed separately (critical_path) because
+    their args carry the VERIFIED commit's height, not the height being
+    processed."""
+    out: dict[int, dict] = {}
+
+    def slot(h) -> dict:
+        return out.setdefault(int(h), {})
+
+    for ev in events:
+        name = ev.get("name")
+        args = _args(ev)
+        if name == "consensus.finalize_commit" and ev.get("ph") == "X":
+            h = args.get("height")
+            if h is None:
+                continue
+            s = slot(h)
+            s["commit_start"] = ev["ts"]
+            s["commit_end"] = _end(ev)
+            s["round"] = args.get("round", 0)
+        elif name == "journey.proposal":
+            h = args.get("height")
+            if h is not None:
+                slot(h)["proposal"] = ev["ts"]
+        elif name == "journey.proposal_build" and ev.get("ph") == "X":
+            h = args.get("height")
+            if h is not None:
+                s = slot(h)
+                s["build_s"] = ev.get("dur", 0) / 1e6
+                s["build_end"] = _end(ev)
+        elif name == "journey.block_assembled" and ev.get("ph") == "X":
+            h = args.get("height")
+            if h is not None:
+                slot(h)["assembled_end"] = _end(ev)
+        elif name == "journey.quorum" and ev.get("ph") == "X":
+            h = args.get("height")
+            if h is not None:
+                slot(h).setdefault("quorum", {})[args.get("type", "?")] = _end(ev)
+    return out
+
+
+def _window_spans(events: list[dict]) -> tuple[list, list]:
+    """(verify_spans, engine_spans) as (ts, end, dur_us, ...) tuples
+    for window attribution. Engine launches whose journeys tags are
+    present but name NO commit-verify work (e.g. mempool sig
+    preverify) are dropped here — they ran during some height's window
+    without being part of its verify stage."""
+    verify, engine = [], []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name")
+        if name in _VERIFY_SPANS:
+            verify.append((ev["ts"], _end(ev), ev.get("dur", 0)))
+        elif name == "engine.collect":
+            args = _args(ev)
+            js = [str(j) for j in (args.get("journeys") or [])]
+            if js and not any("/verify@" in j for j in js):
+                continue
+            engine.append((ev["ts"], _end(ev), ev.get("dur", 0),
+                           args.get("path", "")))
+    return verify, engine
+
+
+def critical_path(events: list[dict]) -> dict:
+    """One node's per-height critical-path decomposition.
+
+    Returns {"heights": {h: {...}}, "totals": {...}} — empty heights
+    when the trace carries no commit anchors (tracing off, seed node).
+    Each height entry: interval_s, stages {proposer, gossip, verify,
+    quorum, apply}, verify_engine_s / verify_host_s, proposer_build_s
+    (when this node proposed), round, dominant, missing []."""
+    anchors = height_anchors(events)
+    verify_spans, engine_spans = _window_spans(events)
+    heights = sorted(h for h, a in anchors.items()
+                     if isinstance(h, int) and "commit_end" in a)
+    per_height: dict[int, dict] = {}
+    for h in heights:
+        a = anchors[h]
+        t1 = a["commit_end"]
+        prev = anchors.get(h - 1, {})
+        if "commit_end" in prev:
+            t0 = prev["commit_end"]
+            missing = []
+        else:
+            # first anchored height: the window opens at the earliest
+            # journey anchor we have for it — honest, but flagged
+            candidates = [v for v in (a.get("proposal"), a.get("assembled_end"),
+                                      a.get("build_end"), a.get("commit_start"))
+                          if v is not None]
+            t0 = min(candidates) if candidates else a["commit_start"]
+            missing = ["prev_commit"]
+        t0 = min(t0, t1)
+
+        t_prop = a.get("proposal")
+        if t_prop is None:
+            t_prop = a.get("build_end")
+            if t_prop is None:
+                missing.append("proposal")
+                t_prop = t0
+        t_prop = min(max(t_prop, t0), t1)
+
+        t_block = a.get("assembled_end")
+        if t_block is None:
+            missing.append("assembled")
+            t_block = t_prop
+        t_block = min(max(t_block, t_prop), t1)
+
+        q = a.get("quorum") or {}
+        t_q = q.get("precommit")
+        if t_q is None:
+            t_q = a.get("commit_start")
+            missing.append("precommit_quorum")
+        if t_q is None:
+            t_q = t1
+        t_q = min(max(t_q, t_block), t1)
+
+        # verify compute measured inside the pre-commit window. Engine
+        # spans are attributed by WINDOW too (windows are disjoint, so
+        # a coalesced launch is counted once, against the height whose
+        # processing it ran under) — NOT by their journeys tag: the tag
+        # carries the VERIFIED commit's height (h-1 while processing
+        # h), and a launch coalescing several heights would otherwise
+        # be double-counted into each. The tag stays on the span for
+        # Perfetto/debugging; here it only gates out launches that
+        # carry exclusively non-consensus work (mempool preverify).
+        verify_us = sum(dur for ts, end, dur in verify_spans if t0 <= ts < t_q)
+        engine_us = {"host": 0.0, "device": 0.0}
+        for ts, end, dur, path in engine_spans:
+            if t0 <= ts < t_q:
+                engine_us["host" if path == "host" else "device"] += dur
+        window_us = t_q - t_block
+        verify_s = min(verify_us, window_us) / 1e6
+
+        stages = {
+            "proposer": (t_prop - t0) / 1e6,
+            "gossip": (t_block - t_prop) / 1e6,
+            "verify": verify_s,
+            "quorum": max(0.0, window_us / 1e6 - verify_s),
+            "apply": (t1 - t_q) / 1e6,
+        }
+        stages = {k: round(max(0.0, v), 6) for k, v in stages.items()}
+        entry = {
+            "interval_s": round((t1 - t0) / 1e6, 6),
+            "round": a.get("round", 0),
+            "stages": stages,
+            "dominant": max(STAGES, key=lambda s: stages[s]),
+            "verify_engine_s": round(
+                min(engine_us["device"], verify_us) / 1e6, 6),
+            "verify_host_s": round(
+                max(0.0, verify_us - min(engine_us["device"], verify_us)) / 1e6, 6),
+        }
+        if "build_s" in a:
+            entry["proposer_build_s"] = round(a["build_s"], 6)
+        if missing:
+            entry["missing"] = missing
+        per_height[h] = entry
+
+    totals: dict = {"heights": len(per_height)}
+    if per_height:
+        stage_sums = {s: sum(e["stages"][s] for e in per_height.values())
+                      for s in STAGES}
+        total = sum(stage_sums.values()) or 1.0
+        totals["stage_seconds"] = {s: round(v, 6) for s, v in stage_sums.items()}
+        totals["stage_fractions"] = {s: round(v / total, 4)
+                                     for s, v in stage_sums.items()}
+        dom: dict[str, int] = {}
+        for e in per_height.values():
+            dom[e["dominant"]] = dom.get(e["dominant"], 0) + 1
+        totals["dominant_counts"] = dom
+        totals["dominant_stage"] = max(dom, key=dom.get)
+        worst_h, worst_stage, worst_s = None, None, -1.0
+        for h, e in per_height.items():
+            for s in STAGES:
+                if e["stages"][s] > worst_s:
+                    worst_h, worst_stage, worst_s = h, s, e["stages"][s]
+        totals["worst"] = {"height": worst_h, "stage": worst_stage,
+                           "seconds": round(worst_s, 6)}
+        totals["proposed_heights"] = sum(
+            1 for e in per_height.values() if "proposer_build_s" in e)
+    return {"heights": per_height, "totals": totals}
+
+
+def journey_stall_offenders(
+    node_paths: list[tuple[str, dict]], budget_s: float
+) -> list[tuple[str, int, str, float]]:
+    """The journey_stall trip condition, ONE copy shared by the gate
+    (lens/gates.py) and the critical-path CLI (scripts/tmlens.py) so
+    the two surfaces can never disagree on identical evidence (the
+    series.timeline_trips pattern): every (node, height, stage,
+    seconds) whose critical path parks more than `budget_s` on a
+    single stage, sorted by node then height."""
+    offenders: list[tuple[str, int, str, float]] = []
+    for name, cp in node_paths:
+        for h, e in sorted((cp or {}).get("heights", {}).items()):
+            for stage, secs in e["stages"].items():
+                if secs > budget_s:
+                    offenders.append((name, int(h), stage, round(secs, 3)))
+    return offenders
+
+
+def fleet_critical_path(node_paths: list[tuple[str, dict]]) -> dict:
+    """Fleet digest over per-node critical paths: [(node_name, cp)] ->
+    stage means across nodes, fleet dominant counts, the single worst
+    (node, height, stage) observation, and per-height proposer-build
+    attribution (only the proposer measured the build — the fleet view
+    stitches it in for every height some node proposed)."""
+    stage_sums = dict.fromkeys(STAGES, 0.0)
+    dom: dict[str, int] = {}
+    worst = {"node": None, "height": None, "stage": None, "seconds": -1.0}
+    heights_covered: set[int] = set()
+    build_by_height: dict[int, float] = {}
+    nodes = 0
+    for name, cp in node_paths:
+        if not cp or not cp.get("heights"):
+            continue
+        nodes += 1
+        for h, e in cp["heights"].items():
+            heights_covered.add(int(h))
+            if "proposer_build_s" in e:
+                build_by_height[int(h)] = e["proposer_build_s"]
+            for s in STAGES:
+                stage_sums[s] += e["stages"][s]
+                if e["stages"][s] > worst["seconds"]:
+                    worst = {"node": name, "height": int(h), "stage": s,
+                             "seconds": e["stages"][s]}
+        t = cp.get("totals") or {}
+        for s, n in (t.get("dominant_counts") or {}).items():
+            dom[s] = dom.get(s, 0) + n
+    if not nodes:
+        return {"nodes": 0}
+    total = sum(stage_sums.values()) or 1.0
+    worst["seconds"] = round(worst["seconds"], 6)
+    return {
+        "nodes": nodes,
+        "heights_covered": len(heights_covered),
+        "height_range": [min(heights_covered), max(heights_covered)]
+        if heights_covered else [],
+        "stage_fractions": {s: round(v / total, 4) for s, v in stage_sums.items()},
+        "dominant_counts": dom,
+        "dominant_stage": max(dom, key=dom.get) if dom else None,
+        "worst": worst,
+        "proposer_builds": len(build_by_height),
+        "proposer_build_mean_s": round(
+            sum(build_by_height.values()) / len(build_by_height), 6)
+        if build_by_height else None,
+    }

@@ -29,6 +29,7 @@ __all__ = [
     "commit_anchors",
     "align_offsets",
     "merge_traces",
+    "journey_flow_events",
 ]
 
 COMMIT_SPAN = "consensus.finalize_commit"
@@ -79,6 +80,42 @@ def align_offsets(anchor_maps: list[dict[int, float]], ref: int = 0) -> list[flo
     return offsets
 
 
+def journey_flow_events(merged_events: list[dict]) -> list[dict]:
+    """Cross-node tmpath journey arrows over ALREADY-MERGED (clock-
+    aligned, pid-stamped) events. Events sharing an args.journey key
+    (trace.journey_key: deterministic per chain event, identical on
+    every node with no coordination) are one causal journey; for each
+    key observed on >= 2 pids, emit one flow start at the earliest
+    event and one flow finish at the latest — Perfetto then draws the
+    block's hop across process tracks. The journey key itself is the
+    flow id: globally deterministic by construction, it must NOT be
+    pid-namespaced the way per-node counter ids are — cross-node
+    binding is the point."""
+    groups: dict[str, list[dict]] = {}
+    for ev in merged_events:
+        if ev.get("ph") not in ("X", "i"):
+            continue
+        key = (ev.get("args") or {}).get("journey")
+        if key:
+            groups.setdefault(str(key), []).append(ev)
+    out: list[dict] = []
+    for key, evs in groups.items():
+        if len({e.get("pid") for e in evs}) < 2:
+            continue  # single-process journey: no cross-node arrow
+        first = min(evs, key=lambda e: e["ts"])
+        last = max(evs, key=lambda e: e["ts"] + e.get("dur", 0))
+        out.append({
+            "name": "journey", "cat": "tm.journey", "ph": "s", "id": key,
+            "pid": first["pid"], "tid": first["tid"], "ts": first["ts"],
+        })
+        out.append({
+            "name": "journey", "cat": "tm.journey", "ph": "f", "bp": "e",
+            "id": key, "pid": last["pid"], "tid": last["tid"],
+            "ts": last["ts"] + last.get("dur", 0),
+        })
+    return out
+
+
 def merge_traces(
     node_events: list[tuple[str, list[dict]]], ref: int = 0
 ) -> tuple[dict, list[float | None]]:
@@ -90,7 +127,8 @@ def merge_traces(
     (ph "M") keep thread names; flow events and everything else shift
     by the node's offset. Unalignable nodes contribute only a
     process_name marked unaligned, so their absence is visible in the
-    UI instead of silent."""
+    UI instead of silent. Journey-keyed events spanning several nodes
+    additionally get cross-node flow arrows (journey_flow_events)."""
     anchor_maps = [commit_anchors(evs) for _name, evs in node_events]
     offsets = align_offsets(anchor_maps, ref=ref)
     out: list[dict] = []
@@ -114,7 +152,10 @@ def merge_traces(
                 # the trace-event format binds endpoints globally by
                 # (cat, id) — unnamespaced, node A's flow 1 would bind
                 # to node B's flow 1 and Perfetto would draw false
-                # cross-node arrows.
+                # cross-node arrows. (Journey flows below are the
+                # deliberate exception: their ids are deterministic
+                # journey keys, global by design.)
                 e["id"] = f"{pid}:{e['id']}"
             out.append(e)
+    out.extend(journey_flow_events(out))
     return {"traceEvents": out, "displayTimeUnit": "ms"}, offsets

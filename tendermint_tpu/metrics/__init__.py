@@ -382,6 +382,20 @@ class ConsensusMetrics:
             buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                      0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
         )
+        # tmpath journey plane (docs/observability.md#tmpath): stamped
+        # data-plane frames by direction, and journey span emissions by
+        # stage — the counters that prove the journey plane is live on
+        # a node even when span tracing itself is off.
+        self.journey_frames = reg.counter(
+            f"{ns}_journey_frames_total",
+            "Journey-stamped consensus frames (proposal/block_part/vote) by direction",
+            labels=("type", "dir"),
+        )
+        self.journey_spans = reg.counter(
+            f"{ns}_journey_spans_total",
+            "tmpath journey span emissions by stage",
+            labels=("stage",),
+        )
         # First vote seen for (height, round, type) -> 2/3 majority
         # assembled — the quorum-formation half of a step's wall time
         # (the other half is msg_propagation + verify compute).

@@ -261,8 +261,13 @@ class Node:
 
         from ..p2p.pex import PexReactor, pex_channel_descriptor
 
+        # consensus frames carry this node's id as the tmpath journey
+        # origin (field-1001 local extension; docs/observability.md#tmpath)
+        cs_descs = consensus_channel_descriptors(
+            origin_node=self.node_id, metrics=self.consensus_metrics
+        )
         descs = (
-            consensus_channel_descriptors()
+            cs_descs
             + [mempool_channel_descriptor(), evidence_channel_descriptor(), blocksync_channel_descriptor()]
             + statesync_channel_descriptors()
         )
@@ -316,7 +321,7 @@ class Node:
             options=RouterOptions(queue_type=config.p2p.queue_type),
             metrics=self.p2p_metrics,
         )
-        cs_chs = [self.router.open_channel(d) for d in consensus_channel_descriptors()]
+        cs_chs = [self.router.open_channel(d) for d in cs_descs]
         mp_ch = self.router.open_channel(mempool_channel_descriptor())
         ev_ch = self.router.open_channel(evidence_channel_descriptor())
         bs_ch = self.router.open_channel(blocksync_channel_descriptor())
@@ -382,6 +387,9 @@ class Node:
             mempool=self.mempool,
             double_sign_check_height=config.consensus.double_sign_check_height,
         )
+        # journey keys for events this node originates (proposal build)
+        # carry its p2p id (docs/observability.md#tmpath)
+        self.consensus.node_id = self.node_id
         if not config.consensus.create_empty_blocks:
             self.mempool.enable_txs_available()
             self._txs_watcher = threading.Thread(

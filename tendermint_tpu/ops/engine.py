@@ -153,10 +153,10 @@ def _autotune_probe(dev_pinned: bool, msm_pinned: bool) -> None:
 class _Job:
     __slots__ = (
         "plane", "pks", "msgs", "sigs", "n", "event", "result", "error",
-        "flow", "t_submit",
+        "flow", "t_submit", "journey",
     )
 
-    def __init__(self, plane, pks, msgs, sigs):
+    def __init__(self, plane, pks, msgs, sigs, journey=None):
         self.plane = plane
         self.pks = pks
         self.msgs = msgs
@@ -170,6 +170,13 @@ class _Job:
         # it (0 when tracing is off — new_flow() skipped)
         self.flow = 0
         self.t_submit = 0.0
+        # tmpath journey tag (trace.journey_key string or None): rides
+        # the job through coalescing so the launch's dispatch/collect
+        # spans list which chain events (heights) it verified — the
+        # attribution lens/journey.py uses to split verify time
+        # host-vs-engine per height even when launches coalesce several
+        # heights (docs/observability.md#tmpath)
+        self.journey = journey
 
 
 class JobHandle:
@@ -317,13 +324,15 @@ class VerifyEngine:
 
     # -------------------------------------------------------------- submit
 
-    def submit(self, plane: str, pubkeys, msgs, sigs) -> JobHandle:
+    def submit(self, plane: str, pubkeys, msgs, sigs, journey=None) -> JobHandle:
         """Queue one caller's batch for the next coalesced launch.
         plane is "ed25519" or "sr25519"; returns a JobHandle whose
-        result() yields this caller's bools in input order."""
+        result() yields this caller's bools in input order. `journey`
+        optionally tags the job with a tmpath journey key so the
+        coalesced launch's spans stay height-attributable."""
         if plane not in _HOST_VERIFY:
             raise ValueError(f"unknown verification plane {plane!r}")
-        job = _Job(plane, list(pubkeys), list(msgs), list(sigs))
+        job = _Job(plane, list(pubkeys), list(msgs), list(sigs), journey=journey)
         if len(job.pks) != job.n or len(job.msgs) != job.n:
             # ragged inputs would silently truncate in the verify
             # planes' zip()s, reporting unverified tail rows as accepted
@@ -341,8 +350,10 @@ class VerifyEngine:
         job.t_submit = _time.monotonic()
         if _trace.enabled():
             job.flow = _trace.new_flow()
-            with _trace.span("engine.submit", "engine",
-                             plane=plane, rows=job.n, flow=job.flow):
+            sub_args = {"plane": plane, "rows": job.n, "flow": job.flow}
+            if journey:
+                sub_args["journey"] = journey
+            with _trace.span("engine.submit", "engine", **sub_args):
                 pass
         m = _engine_metrics()
         m.submitted_jobs.add(1, plane)
@@ -397,6 +408,9 @@ class VerifyEngine:
                 plane=group[0].plane, jobs=len(group), rows=rows,
                 flow=group[0].flow,
             )
+            journeys = sorted({j.journey for j in group if j.journey})
+            if journeys:
+                sp.annotate(journeys=journeys)
             try:
                 with sp:
                     thunk, path = self._dispatch_group(group, seq)
@@ -505,10 +519,12 @@ class VerifyEngine:
             rows = sum(j.n for j in group)
             t0 = _time.monotonic()
             try:
-                with _trace.span("engine.collect", "engine",
-                                 plane=group[0].plane, jobs=len(group),
-                                 rows=rows, path=path,
-                                 flow=group[0].flow):
+                c_args = {"plane": group[0].plane, "jobs": len(group),
+                          "rows": rows, "path": path, "flow": group[0].flow}
+                journeys = sorted({j.journey for j in group if j.journey})
+                if journeys:
+                    c_args["journeys"] = journeys
+                with _trace.span("engine.collect", "engine", **c_args):
                     bools = thunk()
                 # materialize + validate inside the guard: a None/
                 # generator/short bitmap from a buggy verify path must
@@ -592,11 +608,12 @@ def get_engine() -> VerifyEngine:
     return _ENGINE
 
 
-def verify_async_via_engine(plane: str, pubkeys, msgs, sigs):
+def verify_async_via_engine(plane: str, pubkeys, msgs, sigs, journey=None):
     """The BatchVerifier.verify_async seam, shared by both signature
     planes: submit to the engine, return a completion callable yielding
-    the (all_ok, per-signature bools) contract."""
-    handle = get_engine().submit(plane, pubkeys, msgs, sigs)
+    the (all_ok, per-signature bools) contract. `journey` tags the job
+    for tmpath height attribution (see VerifyEngine.submit)."""
+    handle = get_engine().submit(plane, pubkeys, msgs, sigs, journey=journey)
 
     def complete():
         bools = handle.result()

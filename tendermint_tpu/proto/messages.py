@@ -592,16 +592,25 @@ class ConsensusMessage(Message):
         Field(7, "message", "has_vote", msg_cls=CsHasVote),
         Field(8, "message", "vote_set_maj23", msg_cls=CsVoteSetMaj23),
         Field(9, "message", "vote_set_bits", msg_cls=CsVoteSetBits),
-        # Local extension (no reference analog): origin wall-clock in
-        # unix nanoseconds, stamped at encode time on data-plane frames
-        # (proposal / block part / vote) so the receive side can record
-        # gossip propagation latency on shared-clock testnets
-        # (consensus/reactor.py, docs/observability.md#flight). Field
-        # number far above the reference oneof (1-9); proto3 decoders
-        # that don't know it skip it, and a zero value is omitted from
-        # the wire entirely, so unstamped frames stay byte-identical to
+        # Local extensions (no reference analog), field numbers far
+        # above the reference oneof (1-9) so proto3 decoders that don't
+        # know them skip them, and zero/empty values are omitted from
+        # the wire entirely — unstamped frames stay byte-identical to
         # the reference schema.
+        #
+        # origin_ns: origin wall-clock in unix nanoseconds, stamped at
+        # encode time on data-plane frames (proposal / block part /
+        # vote) so the receive side can record gossip propagation
+        # latency on shared-clock testnets (consensus/reactor.py,
+        # docs/observability.md#flight).
         Field(1000, "fixed64", "origin_ns"),
+        # origin_node: the stamping node's p2p id — together with
+        # (height, round, msg kind) it forms the deterministic tmpath
+        # journey key (trace.journey_key) that lets the lens merge
+        # layer bind one frame's send and receive spans across node
+        # processes without clock alignment
+        # (docs/observability.md#tmpath).
+        Field(1001, "string", "origin_node"),
     ]
 
 

@@ -424,16 +424,21 @@ def build_routes(env: RPCEnvironment) -> dict:
             )
         return {"count": len(out), "threads": out}
 
-    def dump_traces(clear=False, enable=None):
+    def dump_traces(clear=False, enable=None, min_height=None, max_height=None):
         """Snapshot the process-wide span tracer (tendermint_tpu.trace)
         as Chrome-trace JSON — the timeline counterpart of
         debug_threads. `enable` flips the tracer at runtime (a node
         started without TM_TPU_TRACE can be instrumented live); `clear`
         drops the ring after the snapshot so the next dump starts
-        fresh. The snapshot is read-only and always available; the
-        mutating params require rpc.unsafe, like the other
-        state-mutating debug routes. Save the `trace` object to a file
-        and open it in Perfetto (ui.perfetto.dev) or chrome://tracing."""
+        fresh. `min_height`/`max_height` keep only height-tagged events
+        (args.height) inside the range plus thread-name metadata — a
+        journey snapshot of one block's life on a live node without
+        shipping the whole ring (events carrying no height, e.g. raw
+        engine spans, are dropped when a bound is set). The snapshot is
+        read-only and always available; the mutating params require
+        rpc.unsafe, like the other state-mutating debug routes. Save
+        the `trace` object to a file and open it in Perfetto
+        (ui.perfetto.dev) or chrome://tracing."""
         from .. import trace as _trace
 
         # same token set the repo's env gates accept for "off" — the
@@ -447,7 +452,23 @@ def build_routes(env: RPCEnvironment) -> dict:
             raise RPCError(
                 -32603, "dump_traces clear/enable require rpc.unsafe"
             )
+        lo = _as_int(min_height, "min_height")
+        hi = _as_int(max_height, "max_height")
         doc = _trace.export()
+        if lo is not None or hi is not None:
+
+            def keep(e):
+                if e.get("ph") == "M":
+                    return True  # thread names: tiny, needed to render
+                h = (e.get("args") or {}).get("height")
+                if h is None:
+                    return False
+                return (lo is None or h >= lo) and (hi is None or h <= hi)
+
+            doc = {
+                "traceEvents": [e for e in doc["traceEvents"] if keep(e)],
+                "displayTimeUnit": doc.get("displayTimeUnit", "ms"),
+            }
         if clear:
             _trace.clear()
         if enable is not None:

@@ -30,7 +30,18 @@ verify.commit_collect / verify.direct_host, blocksync.verify_commit /
 blocksync.apply, engine.submit / engine.coalesce / engine.dispatch /
 engine.host_verify / engine.collect, ops.verify_dispatch /
 ops.msm_dispatch / ops.pk_cache_fill, sharded.verify,
-mempool.admit_batch (coalesced tx admission: n/admitted/failed).
+mempool.admit_batch (coalesced tx admission: n/admitted/failed),
+journey.proposal_build / journey.proposal / journey.block_assembled /
+journey.quorum / journey.send / journey.recv (tmpath block-journey
+plane, docs/observability.md#tmpath).
+
+Journey correlation: cross-node causality cannot use new_flow() ids
+(process-private counters) or clock alignment (perf_counter epochs are
+process-private). journey_key() derives a DETERMINISTIC id from
+(height, round, msg kind, originator node id) — every node that
+touches the same chain event computes the same key with no
+coordination, so the lens merge layer (lens/traces.py) can draw
+cross-node flow arrows from the keys alone.
 """
 
 from __future__ import annotations
@@ -49,6 +60,9 @@ __all__ = [
     "instant",
     "annotate",
     "new_flow",
+    "journey_key",
+    "now_us",
+    "complete",
     "counter",
     "clear",
     "export",
@@ -91,8 +105,28 @@ def new_flow() -> int:
     return next(_FLOW_IDS)
 
 
+def journey_key(height: int, round_: int, kind: str, origin: str = "") -> str:
+    """Deterministic cross-node journey id for one chain event: every
+    node derives the same key from (height, round, kind, originator
+    node id) with no clock alignment or coordination. `origin` is the
+    node id of whichever node ORIGINATED the event (frame sender,
+    proposer); pass "" for events whose identity is already unique per
+    (height, round, kind) — e.g. quorum assembly, finalize — so all
+    nodes share one key. Spans/instants carry it as args.journey; the
+    lens merge layer groups on it to draw cross-node arrows."""
+    return f"{int(height)}/{int(round_)}/{kind}@{(origin or '-')[:16]}"
+
+
 def _now_us() -> float:
     return time.perf_counter_ns() / 1000.0
+
+
+def now_us() -> float:
+    """Current trace-clock timestamp (µs). Callers that need to emit a
+    RETROSPECTIVE span (see complete()) capture this at the event's
+    start — e.g. the first vote of a (height, round, type) — and emit
+    once the end is known."""
+    return _now_us()
 
 
 def _stack() -> list:
@@ -188,6 +222,31 @@ def instant(name: str, cat: str = "", **args) -> None:
         "ph": "i",
         "s": "t",  # thread-scoped instant
         "ts": _now_us(),
+        "tid": t.ident or 0,
+        "tname": t.name,
+    }
+    if args:
+        ev["args"] = args
+    with _LOCK:
+        _EVENTS.append(ev)
+
+
+def complete(name: str, cat: str, ts_us: float, dur_us: float, **args) -> None:
+    """One complete ("X") event with EXPLICIT timestamps — for spans
+    whose start is only recognized in hindsight (quorum assembly: the
+    first vote's arrival becomes the span start once 2/3 is reached;
+    part reassembly: the first part's arrival once the set completes).
+    `ts_us` must come from now_us() so the event shares the ring's
+    clock."""
+    if not _STATE["on"]:
+        return
+    t = threading.current_thread()
+    ev = {
+        "name": name,
+        "cat": cat or "tm",
+        "ph": "X",
+        "ts": ts_us,
+        "dur": max(0.0, dur_us),
         "tid": t.ident or 0,
         "tname": t.name,
     }

@@ -150,6 +150,11 @@ def _verify_commit_batch(
     synchronous path would); host-side failures still raise immediately."""
     proposer = vals.get_proposer()
     bv = crypto_batch.create_batch_verifier(proposer.pub_key)
+    if _trace.enabled():
+        # tmpath journey tag: rides the engine submit so the coalesced
+        # launch's dispatch/collect spans list this commit's height —
+        # the height attribution lens/journey.py splits verify time by
+        bv.journey = _trace.journey_key(commit.height, commit.round, "verify", "")
     tallied = 0
     seen_vals: dict[int, int] = {}
     batch_sig_idxs: list[int] = []

@@ -118,7 +118,7 @@ def test_e2e_perturbed_testnet(tmp_path):
     gate_names = {g["name"] for g in runner.last_report["gates"]}
     assert gate_names == {
         "liveness_stall", "p99_step_duration", "height_spread", "missing_series",
-        "rate_stall", "churn_storm",
+        "rate_stall", "churn_storm", "journey_stall",
     }
     # the kill perturbation snapshotted the victim's pre-death state
     killed = next(n for n in runner.nodes if "kill" in n.m.perturb)
@@ -144,6 +144,96 @@ def test_e2e_perturbed_testnet(tmp_path):
         assert len(parse_timeseries(ts)) >= 5, f"{node.m.name} timeline too short"
     # the per-node timelines made it into the fleet report
     assert runner.last_report["fleet"]["nodes_with_timeseries"] >= 1
+
+
+@pytest.mark.slow
+def test_e2e_ci_live_critical_path(tmp_path, monkeypatch):
+    """The tmpath acceptance run, on the kill/pause-only live manifest
+    (e2e-manifests/ci-live.toml — partition/disconnect redial storms
+    starve 2-core boxes; memory note): a live 4-node run with tracing
+    and the live watch on must produce a fleet_report.json whose
+    critical_path block decomposes every committed height on every
+    node into proposer/gossip/verify/quorum/apply summing to within
+    15% of the measured block interval, and a merged Perfetto trace
+    with at least one cross-node journey flow per committed height."""
+    manifest_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "e2e-manifests", "ci-live.toml",
+    )
+    with open(manifest_path) as f:
+        m = Manifest.parse(f.read())
+    assert all(set(n.perturb) <= {"kill", "pause"} for n in m.nodes), (
+        "ci-live.toml must stay kill/pause-only (2-core redial-storm note)"
+    )
+    monkeypatch.setenv("TM_TPU_TRACE", "1")  # runner env propagates to nodes
+    runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
+    runner.setup()
+    try:
+        runner.start(timeout=120)
+        runner.start_watch()
+        runner.wait_for_height(2, timeout=120)
+        load = threading.Thread(target=runner.inject_load, args=(8.0,), daemon=True)
+        load.start()
+        runner.run_perturbations()
+        load.join(timeout=30)
+        h = max(n.height() for n in runner.nodes)
+        runner.wait_for_height(h + 2, timeout=120)
+        runner.check_consistency()
+    finally:
+        runner.cleanup()
+    report = runner.last_report
+    assert report is not None and report["verdict"] == "pass", (
+        report and report["gates"]
+    )
+    # per-node critical paths: every committed height decomposed, the
+    # stages tiling the measured interval within the 15% tolerance
+    # (anchors judged from partial evidence are flagged, not asserted:
+    # the kill victim's first life took its ring with it)
+    from tendermint_tpu.lens.journey import STAGES
+
+    nodes_with_paths = 0
+    full_heights = 0
+    for s in report["nodes"]:
+        cp = s.get("critical_path")
+        assert cp, f"{s['name']} left no critical_path (tracing env lost?)"
+        nodes_with_paths += 1
+        anchors = s["trace"]["anchor_heights"]
+        committed = set(range(anchors[0], anchors[1] + 1))
+        assert committed <= {int(h) for h in cp["heights"]}, (
+            s["name"], anchors, sorted(cp["heights"]))
+        for h, e in cp["heights"].items():
+            total = sum(e["stages"][st] for st in STAGES)
+            # abs floor: per-stage µs rounding on a near-zero interval
+            # (WAL-replayed heights) must not read as a 15% miss
+            assert total == pytest.approx(e["interval_s"], rel=0.15, abs=1e-4), (
+                s["name"], h, e)
+            if "missing" not in e:
+                full_heights += 1
+    assert nodes_with_paths == 4 and full_heights >= 4
+    gate = next(g for g in report["gates"] if g["name"] == "journey_stall")
+    assert gate["ok"], gate
+    # fleet digest present and spanning the chain
+    fcp = report["fleet"]["critical_path"]
+    assert fcp["nodes"] == 4 and fcp["heights_covered"] >= 3
+    # the merged trace draws >= 1 cross-node journey flow per height
+    # the fleet committed while >= 2 nodes were traced
+    import json as _json
+
+    from tendermint_tpu.lens.journey import journey_height
+
+    with open(os.path.join(runner.base_dir, "fleet_trace.json")) as f:
+        doc = _json.load(f)
+    flow_heights = {
+        journey_height(e["id"])
+        for e in doc["traceEvents"]
+        if e.get("cat") == "tm.journey" and e.get("ph") == "s"
+    } - {None}
+    lo = min(int(h) for s in report["nodes"]
+             for h in (s.get("critical_path") or {}).get("heights", {}))
+    hi = max(int(h) for s in report["nodes"]
+             for h in (s.get("critical_path") or {}).get("heights", {}))
+    covered = set(range(lo + 1, hi + 1))  # h=lo may predate every trace ring
+    assert covered <= flow_heights, sorted(covered - flow_heights)
 
 
 STALL_MANIFEST = """

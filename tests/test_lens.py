@@ -254,10 +254,11 @@ def test_empty_run_dir_fails_all_unverifiable_gates(tmp_path):
     report = analyze_run(str(run))
     assert report["verdict"] == "fail"
     # rate_stall/churn_storm judge the OPTIONAL flight-recorder
-    # artifact: its absence passes vacuously (a pre-recorder run dir
-    # must not fail for lacking it), like missing_series with
+    # artifact and journey_stall the OPTIONAL journey spans: their
+    # absence passes vacuously (a pre-recorder/pre-tmpath run dir must
+    # not fail for lacking them), like missing_series with
     # require_metrics_from_all unset
-    vacuous = ("missing_series", "rate_stall", "churn_storm")
+    vacuous = ("missing_series", "rate_stall", "churn_storm", "journey_stall")
     assert all(not g["ok"] for g in report["gates"] if g["name"] not in vacuous)
     assert all(g["ok"] for g in report["gates"] if g["name"] in vacuous)
 
