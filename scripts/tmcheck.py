@@ -1,0 +1,134 @@
+"""tmcheck CLI — repo-native static analysis for the threaded
+verify/gossip planes (docs/static-analysis.md).
+
+Usage:
+  python scripts/tmcheck.py
+      Run every rule over tendermint_tpu/, apply inline suppressions
+      (`# tmcheck: ok[rule] <reason>`) and the .tmcheck.toml baseline,
+      and print the remaining findings.
+      Exit code: 0 = clean, 1 = findings, 2 = usage/IO error.
+
+  python scripts/tmcheck.py --check
+      Tier-1 gate (metricsgen --check analog): ALSO fails on stale
+      baseline entries — a suppression whose finding no longer exists
+      must be deleted, or it will mask the next regression there.
+
+  python scripts/tmcheck.py --write-baseline
+      Regenerate .tmcheck.toml grandfathering every current finding.
+
+  --rules r1,r2     run a subset (lock-blocking, cache-stale,
+                    metric-raise, metric-drift, import-isolation,
+                    trace-pairing, unused-import)
+  --root DIR        analyze a different tree (fixture tests)
+  --json            machine-readable findings on stdout
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tendermint_tpu.check import RULES, run_checks  # noqa: E402
+from tendermint_tpu.check.baseline import (  # noqa: E402
+    BASELINE_NAME,
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+
+def main(argv) -> int:
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    root = _ROOT
+    rules = None
+    as_json = False
+    mode = "report"
+    i = 0
+    try:
+        while i < len(argv):
+            a = argv[i]
+            if a == "--root":
+                root = argv[i + 1]
+                i += 2
+            elif a == "--rules":
+                rules = [r.strip() for r in argv[i + 1].split(",") if r.strip()]
+                i += 2
+            elif a == "--json":
+                as_json = True
+                i += 1
+            elif a == "--check":
+                mode = "check"
+                i += 1
+            elif a == "--write-baseline":
+                mode = "write"
+                i += 1
+            else:
+                print(f"unknown argument {a!r} (see --help)", file=sys.stderr)
+                return 2
+    except IndexError:
+        print("missing value for flag (see --help)", file=sys.stderr)
+        return 2
+    if not os.path.isdir(os.path.join(root, "tendermint_tpu")):
+        print(f"not a repo root: {root!r}", file=sys.stderr)
+        return 2
+    if rules:
+        unknown = set(rules) - set(RULES)
+        if unknown:
+            print(f"unknown rules: {sorted(unknown)} (have: {', '.join(RULES)})",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        active, inline = run_checks(root, rules=rules)
+    except ValueError as e:
+        print(f"analysis failed: {e}", file=sys.stderr)
+        return 2
+
+    if mode == "write":
+        path = write_baseline(root, active)
+        print(f"wrote {path} ({len(active)} suppressions; "
+              f"{len(inline)} more are inline-suppressed in source)")
+        return 0
+
+    baseline = load_baseline(root)
+    new, stale = diff_baseline(active, baseline)
+
+    if as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "baselined": len(active) - len(new),
+            "inline_suppressed": len(inline),
+            "stale_baseline": [list(e) for e in stale],
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if active and len(new) < len(active):
+            print(f"({len(active) - len(new)} finding(s) absorbed by {BASELINE_NAME})")
+        if inline:
+            print(f"({len(inline)} finding(s) inline-suppressed in source)")
+        if stale and mode == "check":
+            for rule, path, snippet in stale:
+                print(f"STALE baseline entry [{rule}] {path}: {snippet!r} — "
+                      "the finding is gone; delete the suppression")
+    if new:
+        print(f"tmcheck: {len(new)} unsuppressed finding(s)",
+              file=sys.stderr)
+        return 1
+    if mode == "check" and stale:
+        print(f"tmcheck: {len(stale)} stale baseline entr(ies) — run "
+              "--write-baseline or delete them", file=sys.stderr)
+        return 1
+    counted = f"{len(active)} baselined, {len(inline)} inline-suppressed"
+    print(f"tmcheck clean ({counted})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

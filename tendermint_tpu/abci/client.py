@@ -88,6 +88,7 @@ class LocalClient(Client):
         stride = self.CHECK_TX_BATCH_STRIDE
         for lo in range(0, len(reqs), stride):
             with self._mu:
+                # tmcheck: ok[lock-blocking] the mutex IS the ABCI serial-execution contract; CHECK_TX_BATCH_STRIDE bounds the hold
                 out.extend(self._app.check_tx(r) for r in reqs[lo : lo + stride])
         return out
 

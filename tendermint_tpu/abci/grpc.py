@@ -92,6 +92,7 @@ class _AppHandler(grpc.GenericRpcHandler if grpc else object):
             else:
                 with self._mtx:
                     if method == "commit":
+                        # tmcheck: ok[lock-blocking] _mtx exists to serialize app calls (ABCI single-threaded contract)
                         res = self._app.commit()
                     else:
                         res = getattr(self._app, method)(dc)

@@ -205,6 +205,7 @@ class SocketServer:
                 if ctreq is not None:
                     try:
                         with self._app_mtx:
+                            # tmcheck: ok[lock-blocking] _app_mtx exists to serialize app calls (ABCI single-threaded contract)
                             res = self.app.check_tx(ctreq)
                         body = apb.encode_check_tx_response(res)
                     except Exception as e:  # noqa: BLE001
@@ -268,6 +269,7 @@ class SocketServer:
                 return apb.response_to_pb("flush", None)
             with self._app_mtx:
                 if method == "commit":
+                    # tmcheck: ok[lock-blocking] _app_mtx exists to serialize app calls (ABCI single-threaded contract)
                     res = self.app.commit()
                 else:
                     res = getattr(self.app, method)(dc)

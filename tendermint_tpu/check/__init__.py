@@ -1,0 +1,171 @@
+"""tmcheck — repo-native static analysis for the threaded verify/gossip
+planes (docs/static-analysis.md).
+
+The Go reference leans on `go vet` and `go test -race`; this port is
+pure Python with ~70 lock construction sites, engine worker threads,
+per-peer gossip broadcasters and daemon recorders, and the recurring
+bug classes of PRs 5-10 were all caught by hand in review: blocking
+calls made while a mempool/engine lock was held, memoized structural
+hashes served stale after a field mutation, metric writes that could
+raise on a hot path, observability modules quietly growing an import
+edge onto jax or the node runtime, and trace spans created but never
+entered. This package turns those review checklists into an AST pass
+with repo-specific rules:
+
+  lock-blocking     blocking operations (ABCI client calls, socket
+                    recv/sendall, time.sleep, JobHandle.result,
+                    subprocess, zero-arg .join) lexically inside a
+                    `with <known-lock>` region — the PR-6 bug class
+  cache-stale       a class memoizing a structural hash must route
+                    every mutation of the fields that hash reads
+                    through its invalidator (or guard the memo read,
+                    or clear via __setattr__) — the PR-5 bug class
+  metric-raise      metric write methods in metrics/__init__.py that
+                    mutate shared state must carry @_never_raise
+  metric-drift      metric attribute writes anywhere in the tree must
+                    resolve to attributes declared by a metricsgen
+                    group class (an undeclared attribute raises
+                    AttributeError on the hot path BEFORE the
+                    never-raise write wrapper can swallow anything),
+                    and every *Metrics group must be registered in
+                    scripts/metricsgen.py GROUPS (an unregistered
+                    group silently escapes the docs/metrics.md gate)
+  import-isolation  lens/, metrics/flight.py and check/ itself must
+                    not import jax or the node runtime (previously
+                    enforced only by subprocess tests)
+  trace-pairing     every trace.span() result must be entered (a span
+                    created but never used as a context manager
+                    records nothing, silently)
+  unused-import     module-level imports never referenced (skipped in
+                    __init__.py re-export surfaces)
+
+Findings carry file:line + rule id + the stripped source line, and are
+suppressed either inline (`# tmcheck: ok[rule-id] <reason>` on the
+finding's line or the line above) or through the checked-in baseline
+`.tmcheck.toml` (scripts/tmcheck.py --write-baseline), gated
+metricsgen-style: new findings AND stale baseline entries both fail
+`--check` in tier-1.
+
+The runtime half lives in .lockcheck: TM_TPU_LOCKCHECK=1 wraps
+threading.Lock/RLock to build a per-process lock-order graph
+(order-inversion cycles, sleep-under-lock, over-budget holds) streamed
+to <home>/lockcheck.jsonl and folded into fleet_report.json by lens.
+
+Import discipline: this package is itself in the import-isolation set —
+stdlib only, so the analysis runs on bare CI boxes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "discover_files",
+    "run_checks",
+    "split_suppressed",
+]
+
+RULES = (
+    "lock-blocking",
+    "cache-stale",
+    "metric-raise",
+    "metric-drift",
+    "import-isolation",
+    "trace-pairing",
+    "unused-import",
+)
+
+# Directories under the repo root that the pass walks. Tests and
+# scripts are deliberately out of scope: fixtures MUST contain
+# known-bad snippets, and scripts are one-shot CLIs without the
+# threading planes these rules police.
+SCAN_DIRS = ("tendermint_tpu",)
+
+SUPPRESS_TOKEN = "tmcheck: ok"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule hit. `snippet` is the stripped source line — the
+    baseline matches on (rule, path, snippet) rather than line numbers
+    so unrelated edits above a suppressed site don't churn the file."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    snippet: str = ""
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def discover_files(root: str) -> list[str]:
+    """Repo-relative paths of every .py file in the scanned dirs."""
+    out = []
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
+    return sorted(out)
+
+
+def _inline_suppressed(finding: Finding, lines: list[str]) -> bool:
+    """`# tmcheck: ok[rule]` (or bare `# tmcheck: ok`) on the finding's
+    line or the line above suppresses it in place — the mechanism for
+    intentional sites, with the reason in the comment."""
+    for ln in (finding.line, finding.line - 1):
+        if 1 <= ln <= len(lines):
+            text = lines[ln - 1]
+            if SUPPRESS_TOKEN not in text:
+                continue
+            tail = text.split(SUPPRESS_TOKEN, 1)[1]
+            if tail.startswith("["):
+                tagged = tail[1:].split("]", 1)[0]
+                if finding.rule in {t.strip() for t in tagged.split(",")}:
+                    return True
+            else:
+                return True  # untagged: suppresses every rule on the line
+    return False
+
+
+def split_suppressed(
+    findings: list[Finding], sources: dict[str, list[str]]
+) -> tuple[list[Finding], list[Finding]]:
+    """(active, inline_suppressed) given per-path source lines."""
+    active, suppressed = [], []
+    for f in findings:
+        if _inline_suppressed(f, sources.get(f.path, [])):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def run_checks(
+    root: str, rules=None, paths: list[str] | None = None
+) -> tuple[list[Finding], list[Finding]]:
+    """Run the AST pass over the tree at `root`.
+
+    Returns (active, inline_suppressed) findings, both sorted by
+    (path, line). `rules` restricts to a subset of RULES; `paths`
+    restricts to specific repo-relative files (fixture tests)."""
+    from . import rules as R
+
+    selected = tuple(rules) if rules else RULES
+    unknown = set(selected) - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rules: {sorted(unknown)}")
+    files = paths if paths is not None else discover_files(root)
+    findings, sources = R.analyze(root, files, selected)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return split_suppressed(findings, sources)

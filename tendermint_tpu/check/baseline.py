@@ -1,0 +1,90 @@
+"""Suppression baseline for tmcheck (docs/static-analysis.md#baseline).
+
+`.tmcheck.toml` at the repo root grandfathers known findings the same
+way docs/metrics.md pins the metric registry: `scripts/tmcheck.py
+--write-baseline` regenerates it from the current tree, and `--check`
+(tier-1) fails BOTH ways — a new finding not in the baseline (a fresh
+bug) and a baseline entry with no matching finding (stale suppression
+rot: the code was fixed but the grandfather clause lingers, ready to
+mask the next regression at the same site).
+
+Entries match on (rule, path, stripped-source-line) instead of line
+numbers, so edits elsewhere in a file don't churn the baseline. The
+intended steady state is an EMPTY baseline: intentional sites carry
+inline `# tmcheck: ok[rule] <reason>` comments next to the code they
+justify, and the baseline only absorbs transitional bulk.
+
+Written by hand rather than through a TOML library (tomli is
+read-only, and the format here is a flat array of tables); parsed with
+the same tolerant reader config/e2e use (utils.compat.require_tomllib).
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import Finding
+
+__all__ = ["BASELINE_NAME", "load_baseline", "write_baseline", "diff_baseline"]
+
+BASELINE_NAME = ".tmcheck.toml"
+
+
+def _toml_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def load_baseline(root: str) -> list[tuple[str, str, str]]:
+    """[(rule, path, snippet)] from .tmcheck.toml; [] when absent."""
+    path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(path):
+        return []
+    from ..utils.compat import require_tomllib
+
+    with open(path, "rb") as f:
+        doc = require_tomllib().load(f)
+    out = []
+    for entry in doc.get("suppress", []):
+        out.append((
+            str(entry.get("rule", "")),
+            str(entry.get("path", "")),
+            str(entry.get("snippet", "")),
+        ))
+    return out
+
+
+def write_baseline(root: str, findings: list[Finding]) -> str:
+    """Write .tmcheck.toml grandfathering `findings`; returns the path."""
+    path = os.path.join(root, BASELINE_NAME)
+    lines = [
+        "# tmcheck suppression baseline — regenerate with",
+        "#   python scripts/tmcheck.py --write-baseline",
+        "# Gated by --check in tier-1: new findings AND stale entries both fail.",
+        "# Prefer inline `# tmcheck: ok[rule] <reason>` comments for",
+        "# intentional sites; keep this file as close to empty as possible.",
+        "",
+    ]
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        lines.append("[[suppress]]")
+        lines.append(f'rule = "{_toml_escape(f.rule)}"')
+        lines.append(f'path = "{_toml_escape(f.path)}"')
+        lines.append(f'snippet = "{_toml_escape(f.snippet)}"')
+        lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+def diff_baseline(
+    findings: list[Finding], baseline: list[tuple[str, str, str]]
+) -> tuple[list[Finding], list[tuple[str, str, str]]]:
+    """(new_findings, stale_entries).
+
+    A baseline entry absorbs any number of findings with the same
+    (rule, path, snippet) — a suppressed pattern duplicated on two
+    lines of one file is the same grandfathered decision."""
+    allowed = set(baseline)
+    new = [f for f in findings if f.key() not in allowed]
+    seen = {f.key() for f in findings}
+    stale = [e for e in baseline if e not in seen]
+    return new, stale

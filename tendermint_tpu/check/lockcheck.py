@@ -1,0 +1,487 @@
+"""tmcheck runtime lock sanitizer (docs/static-analysis.md#lockcheck).
+
+`go test -race` has no Python analog, but the hazards this repo cares
+about are narrower than general data races: lock-ORDER inversions
+between the ~70 locks on the consensus/gossip/engine planes (the
+deadlocks a 2-core CI box turns into 90s timeouts), locks held across
+blocking calls, and locks held far longer than their critical section
+was designed for. All three are observable from the lock operations
+alone, so TM_TPU_LOCKCHECK=1 wraps `threading.Lock`/`threading.RLock`
+construction with a bookkeeping shim:
+
+  - every wrapped lock is identified by its CONSTRUCTION SITE
+    (file:line) — all instances born at one site share a graph node,
+    so an order inversion between two *instances* of the same pair of
+    sites is still a cycle
+  - on each acquire, an edge held-site -> acquired-site is added to a
+    process-wide order graph; a new edge that closes a cycle emits a
+    `lock_order_cycle` event with the path (a potential deadlock, even
+    if this run interleaved safely)
+  - on each release, the hold duration is checked against
+    TM_TPU_LOCKCHECK_BUDGET_MS (default 250); over-budget holds emit
+    `hold_budget` events
+  - `time.sleep` is wrapped: sleeping while holding any wrapped lock
+    emits `blocking_under_lock` (the runtime half of the static
+    lock-blocking rule — it sees through indirection the AST can't)
+
+Events stream to <home>/lockcheck.jsonl (one JSON object per line,
+flushed per event, same crash-survival contract as the flight
+recorder); an atexit summary records graph size, op counts, and an
+estimated sanitizer overhead (ops x calibrated per-op cost) that the
+e2e acceptance budget (<=1% of wall-clock) is judged against.
+`tendermint_tpu.lens` folds the artifact into fleet_report.json and
+the `lock_order_cycle` gate fails the run on any cycle.
+
+Hot-path discipline: the common acquire (no other lock held, or an
+edge already recorded) touches only thread-local state and a lock-free
+read of the edge map — the global mutex is taken exactly once per NEW
+(held, acquired) site pair and per emitted event. Per-thread op counts
+are aggregated at finalize.
+
+Disabled (the default) nothing is constructed: `maybe_install` reads
+one env var and returns None — threading and time are untouched.
+
+Condition objects need no wrapping: `threading.Condition()` builds its
+lock via the (patched) `threading.RLock`, and a Condition over a
+wrapped lock drives it through `_release_save`/`_acquire_restore`/
+`_is_owned`, which the RLock shim implements with full bookkeeping —
+so a `cond.wait()` correctly shows the lock as released while waiting.
+
+Known limitations (documented, not bugs): graph nodes are construction
+SITES, so two locks born on one source line alias to one node; a plain
+Lock acquired in one thread and released in another (cross-thread
+handoff — nothing in-tree does this) leaves a stale held-stack entry
+in the acquiring thread until that thread exits.
+
+Stdlib only; the module imports nothing from the node runtime.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time as _time
+import weakref
+
+__all__ = [
+    "LockCheck",
+    "enabled_in_env",
+    "maybe_install",
+    "ARTIFACT_NAME",
+]
+
+ARTIFACT_NAME = "lockcheck.jsonl"
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_SLEEP = _time.sleep
+_EMPTY: frozenset = frozenset()
+
+
+def enabled_in_env(env=None) -> bool:
+    v = (env if env is not None else os.environ).get("TM_TPU_LOCKCHECK", "")
+    return v.strip().lower() in ("1", "on", "true", "yes")
+
+
+def _budget_s(env=None) -> float:
+    raw = (env if env is not None else os.environ).get(
+        "TM_TPU_LOCKCHECK_BUDGET_MS", "250"
+    )
+    try:
+        ms = float(raw)
+        if ms <= 0:
+            raise ValueError(raw)
+    except ValueError:
+        ms = 250.0  # forgiving like TM_TPU_TRACE_BUF: a bad knob must not stop boot
+    return ms / 1000.0
+
+
+class _ThreadState:
+    """Per-thread held-lock stack + op counter (summed at finalize)."""
+
+    __slots__ = ("stack", "acquires")
+
+    def __init__(self):
+        self.stack: list = []  # (site, t_acquired)
+        self.acquires = 0
+
+
+class _Anchor:
+    """Weakref-able sentinel whose only reference lives in a thread's
+    local dict — its collection marks the thread's death. (Keying
+    retirement on `threading.current_thread()` is WRONG: the first
+    sanitized acquire of a new thread happens inside _bootstrap_inner's
+    `self._started.set()`, BEFORE the thread registers in _active, so
+    current_thread() returns a throwaway _DummyThread whose collection
+    would retire the state mid-run.)"""
+
+    __slots__ = ("__weakref__",)
+
+
+class LockCheck:
+    """The sanitizer state: order graph, event stream, patch lifecycle.
+
+    One instance per process (maybe_install); tests build private
+    instances against temp paths and uninstall in finally."""
+
+    def __init__(self, out_path: str, budget_s: float = 0.25):
+        self.out_path = out_path
+        self.budget_s = budget_s
+        self._file = None
+        # REAL locks guard sanitizer internals — it must not observe itself
+        self._mu = _REAL_LOCK()        # order graph + thread registry
+        self._emit_mu = _REAL_LOCK()   # event file
+        self._local = threading.local()
+        self._threads: list[_ThreadState] = []
+        self._dead_acquires = 0  # folded counts of retired threads
+        # site -> frozenset of successor sites. Mutation REPLACES the
+        # frozenset under _mu, so the lock-free fast-path read always
+        # sees a consistent (possibly slightly stale) set — staleness
+        # only costs a redundant slow-path entry, which re-checks.
+        self._edges: dict[str, frozenset] = {}
+        self._edge_count = 0
+        self._cycles_reported: set[tuple] = set()
+        self._sites: set[str] = set()
+        self.counts = {
+            "cycles": 0, "hold_budget": 0, "blocking_under_lock": 0,
+        }
+        self._installed = False
+
+    # ------------------------------------------------------------- events
+
+    def _emit(self, kind: str, **fields) -> None:
+        rec = {"t": round(_time.time(), 3), "kind": kind, **fields}
+        with self._emit_mu:
+            try:
+                if self._file is None:
+                    self._file = open(self.out_path, "a", encoding="utf-8")
+                self._file.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                self._file.flush()
+            except OSError:
+                pass  # sanitizer must never fail the node
+
+    # ------------------------------------------------------------- graph
+
+    def _state(self) -> _ThreadState:
+        st = getattr(self._local, "st", None)
+        if st is None:
+            st = self._local.st = _ThreadState()
+            with self._mu:
+                self._threads.append(st)
+            # retire the registry entry when the thread dies — a soak
+            # run churning per-peer threads must not grow _threads
+            # without bound (the count folds into _dead_acquires so
+            # total_acquires stays exact)
+            anchor = self._local.anchor = _Anchor()
+            weakref.finalize(anchor, self._retire, st)
+        return st
+
+    def _retire(self, st: _ThreadState) -> None:
+        with self._mu:
+            self._dead_acquires += st.acquires
+            try:
+                self._threads.remove(st)
+            except ValueError:
+                pass
+
+    def _on_acquired(self, site: str) -> None:
+        st = self._state()
+        st.acquires += 1
+        stack = st.stack
+        if stack:
+            edges = self._edges
+            for held, _t in stack:
+                if held != site and site not in edges.get(held, _EMPTY):
+                    self._record_edge(held, site)
+        stack.append((site, _time.monotonic()))
+
+    def _record_edge(self, held: str, site: str) -> None:
+        with self._mu:
+            succ = self._edges.get(held, _EMPTY)
+            if site in succ:
+                return  # raced: another thread recorded it
+            self._sites.update((held, site))
+            self._edges[held] = succ | {site}
+            self._edge_count += 1
+            path = self._find_path(site, held)
+            if path is None:
+                return
+            key = tuple(sorted((held, site)))
+            if key in self._cycles_reported:
+                return
+            self._cycles_reported.add(key)
+            self.counts["cycles"] += 1
+            # the new edge held->site closes the existing site->…->held
+            # path: render the full ring starting and ending at `held`
+            cycle = [held] + path
+        self._emit(
+            "lock_order_cycle", edge=[held, site], cycle=cycle,
+            thread=threading.current_thread().name,
+        )
+
+    def _on_released(self, site: str) -> None:
+        stack = self._state().stack
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == site:
+                _s, t0 = stack.pop(i)
+                held_for = _time.monotonic() - t0
+                if held_for > self.budget_s:
+                    with self._mu:
+                        self.counts["hold_budget"] += 1
+                    self._emit(
+                        "hold_budget", site=site,
+                        held_s=round(held_for, 4), budget_s=self.budget_s,
+                        thread=threading.current_thread().name,
+                    )
+                return
+
+    def _find_path(self, frm: str, to: str) -> list | None:
+        """DFS: existing path frm -> to (so the new edge to -> frm
+        closes a cycle). Called with self._mu held."""
+        seen = {frm}
+        stack = [(frm, [frm])]
+        while stack:
+            node, path = stack.pop()
+            if node == to:
+                return path
+            for nxt in self._edges.get(node, _EMPTY):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _sleep_hook(self, seconds) -> None:
+        stack = self._state().stack
+        if stack:
+            with self._mu:
+                self.counts["blocking_under_lock"] += 1
+            self._emit(
+                "blocking_under_lock",
+                call=f"time.sleep({seconds})",
+                held=[s for s, _t in stack],
+                thread=threading.current_thread().name,
+            )
+        _REAL_SLEEP(seconds)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _caller_site(self) -> str:
+        """file:line of the lock CONSTRUCTION (two frames up: caller ->
+        factory -> here), repo-relative when possible."""
+        f = sys._getframe(2)
+        fn = f.f_code.co_filename
+        idx = fn.rfind(os.sep + "tendermint_tpu" + os.sep)
+        fn = fn[idx + 1:] if idx >= 0 else os.path.basename(fn)
+        return f"{fn.replace(os.sep, '/')}:{f.f_lineno}"
+
+    def install(self) -> None:
+        """Patch threading.Lock/RLock and time.sleep. Idempotent."""
+        if self._installed:
+            return
+        self._installed = True
+        check = self
+
+        def Lock():  # noqa: N802 - stands in for threading.Lock
+            return _SanLock(_REAL_LOCK(), check, check._caller_site())
+
+        def RLock():  # noqa: N802
+            return _SanRLock(_REAL_RLOCK(), check, check._caller_site())
+
+        threading.Lock = Lock
+        threading.RLock = RLock
+        _time.sleep = self._sleep_hook
+        atexit.register(self.finalize)
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        _time.sleep = _REAL_SLEEP
+        atexit.unregister(self.finalize)
+
+    def total_acquires(self) -> int:
+        with self._mu:
+            return self._dead_acquires + sum(st.acquires for st in self._threads)
+
+    def finalize(self) -> None:
+        """Write the summary record (atexit; also callable from tests —
+        idempotent, so an explicit call plus the atexit hook writes ONE
+        summary). Overhead estimate: ops x a per-op cost calibrated NOW
+        against the real lock, so the number reflects this machine."""
+        with self._mu:
+            if getattr(self, "_finalized", False):
+                return
+            self._finalized = True
+        per_op = self._calibrate()
+        acquires = self.total_acquires()
+        with self._mu:
+            counts = dict(self.counts)
+            sites, edges = len(self._sites), self._edge_count
+        self._emit(
+            "summary",
+            sites=sites, edges=edges, acquires=acquires,
+            overhead_s_est=round(acquires * 2 * per_op, 6),
+            budget_s=self.budget_s,
+            **counts,
+        )
+        with self._emit_mu:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    def _calibrate(self, n: int = 2000, rounds: int = 3) -> float:
+        """Sanitizer cost per acquire/release pair beyond a real lock.
+        Best-of-rounds: on a loaded box a single timing round absorbs
+        scheduler noise and OVERSTATES the tax — the minimum is the
+        closest observable to the true per-op cost."""
+        raw = _REAL_LOCK()
+        san = _SanLock(_REAL_LOCK(), self, "calibrate:0")
+        st = self._state()
+        before = st.acquires
+        base = cost = None
+        for _ in range(rounds):
+            t0 = _time.perf_counter()
+            for _ in range(n):
+                raw.acquire(); raw.release()
+            base = min(b for b in (base, _time.perf_counter() - t0) if b is not None)
+            t0 = _time.perf_counter()
+            for _ in range(n):
+                san.acquire(); san.release()
+            cost = min(c for c in (cost, _time.perf_counter() - t0) if c is not None)
+        st.acquires = before  # calibration ops are not workload ops
+        return max(0.0, (cost - base) / n)
+
+
+class _SanLock:
+    """threading.Lock shim: identical surface, order/hold bookkeeping."""
+
+    __slots__ = ("_inner", "_check", "_site")
+
+    def __init__(self, inner, check: LockCheck, site: str):
+        self._inner = inner
+        self._check = check
+        self._site = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._check._on_acquired(self._site)
+        return ok
+
+    def release(self):
+        self._check._on_released(self._site)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # stdlib registers this with os.register_at_fork (e.g.
+        # concurrent.futures.thread) — the shim must expose it
+        self._inner._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<tmcheck-lock {self._site} {self._inner!r}>"
+
+
+class _SanRLock:
+    """threading.RLock shim. Implements the private Condition protocol
+    (_release_save/_acquire_restore/_is_owned) with bookkeeping so a
+    Condition bound to this lock shows it released during wait()."""
+
+    __slots__ = ("_inner", "_check", "_site", "_depth")
+
+    def __init__(self, inner, check: LockCheck, site: str):
+        self._inner = inner
+        self._check = check
+        self._site = site
+        self._depth = 0  # mutated only by the owning thread
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._depth += 1
+            if self._depth == 1:
+                self._check._on_acquired(self._site)
+        return ok
+
+    def release(self):
+        if not self._inner._is_owned():
+            # unowned release: let the inner lock raise its canonical
+            # RuntimeError with the bookkeeping untouched
+            self._inner.release()
+            return
+        # bookkeep BEFORE the inner release: after it, a contending
+        # thread may acquire and mutate _depth concurrently — the
+        # owner-only invariant on _depth holds exactly while the inner
+        # lock is still held
+        self._depth -= 1
+        if self._depth == 0:
+            self._check._on_released(self._site)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition protocol (CPython threading.Condition duck-types these)
+    def _release_save(self):
+        depth = self._depth
+        self._depth = 0
+        self._check._on_released(self._site)
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        self._inner._acquire_restore(inner_state)
+        self._depth = depth
+        self._check._on_acquired(self._site)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+        self._depth = 0  # the forked child owns nothing
+
+    def __repr__(self):
+        return f"<tmcheck-rlock {self._site} {self._inner!r}>"
+
+
+_ACTIVE: LockCheck | None = None
+
+
+def maybe_install(home: str | None = None, env=None) -> LockCheck | None:
+    """Install the process-wide sanitizer when TM_TPU_LOCKCHECK is set.
+    Disabled path: one env read, nothing constructed, None returned.
+    The artifact lands at <home>/lockcheck.jsonl (cwd without a home)."""
+    if not enabled_in_env(env):
+        return None
+    global _ACTIVE
+    if _ACTIVE is not None:
+        return _ACTIVE
+    _ACTIVE = LockCheck(
+        os.path.join(home or ".", ARTIFACT_NAME), budget_s=_budget_s(env)
+    )
+    _ACTIVE.install()
+    return _ACTIVE

@@ -1,0 +1,1025 @@
+"""The tmcheck AST rules (docs/static-analysis.md#rules).
+
+Every rule here is the mechanized form of a review checklist that has
+already caught (or missed) a real bug in this repo's history — the
+detection sets are deliberately curated against THIS codebase's idioms
+(locks are `self._x = threading.Lock()` attributes or module globals
+used via `with`; memoized hashes are `_hash`/`_*cache` attributes
+served by `hash()`/`bytes()`; metrics flow through metricsgen group
+classes) rather than trying to be a general linter. Precision over
+recall: a rule that cries wolf gets suppressed into noise, and the
+suppression baseline is supposed to stay near-empty.
+
+Stdlib only (ast, os) — the pass runs on bare CI boxes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding
+
+# ----------------------------------------------------------- shared helpers
+
+
+def _chain(node) -> str | None:
+    """Dotted name for Name/Attribute chains ("threading.Lock"), else
+    None (calls/subscripts in the chain break it)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node) -> str | None:
+    """"x" for `self.x`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _is_lock_ctor(node) -> bool:
+    """`threading.Lock()` / `RLock()` / `Condition(...)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    c = _chain(node.func)
+    return c in (
+        "threading.Lock", "threading.RLock", "threading.Condition",
+        "Lock", "RLock", "Condition",
+    )
+
+
+def _snippet(lines: list[str], lineno: int) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+class _Module:
+    def __init__(self, path: str, tree: ast.Module, lines: list[str]):
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule, self.path, line, message, _snippet(self.lines, line))
+
+
+# ------------------------------------------------------------ lock-blocking
+
+# Method names that block on I/O or another thread when called on the
+# hot path. Curated: `.send`/`.wait`/`.get` are omitted (too many
+# benign in-repo meanings: channel send, Condition.wait — which
+# RELEASES its lock — dict.get); `.join` is only flagged zero-positional
+# (thread join; `sep.join(parts)` always passes the iterable).
+_BLOCKING_METHODS = {
+    "recv", "recv_into", "recvfrom", "sendall", "sendto", "connect",
+    "accept", "makefile", "result", "urlopen",
+}
+# ABCI round-trip methods — flagged when called on an app/client-ish
+# receiver (the PR-6 class: one CheckTx under the mempool lock stalls
+# every reap/admission for the round trip).
+_ABCI_METHODS = {
+    "check_tx", "check_tx_batch", "finalize_block", "prepare_proposal",
+    "process_proposal", "extend_vote", "verify_vote_extension",
+    "init_chain", "offer_snapshot", "load_snapshot_chunk",
+    "apply_snapshot_chunk", "list_snapshots", "commit", "info", "query",
+    "echo",
+}
+_APPISH = ("app", "client", "abci", "proxy")
+_SLEEPS = {"time.sleep", "sleep"}
+_SUBPROCESS = ("subprocess.", "os.system", "os.popen")
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    """Why this call blocks, or None."""
+    func = call.func
+    c = _chain(func)
+    if c is not None:
+        if c in _SLEEPS:
+            return "time.sleep"
+        if c.startswith(_SUBPROCESS) or c in ("Popen", "check_output", "check_call"):
+            return "subprocess"
+    if isinstance(func, ast.Attribute):
+        m = func.attr
+        if m in _BLOCKING_METHODS:
+            return f"blocking .{m}()"
+        if m == "join" and not call.args:
+            recv = _chain(func.value) or ""
+            if not recv.startswith("os.path") and not isinstance(
+                func.value, ast.Constant
+            ):
+                return "thread .join()"
+        if m == "wait" and "proc" in (_chain(func.value) or "").lower():
+            return "process .wait()"
+        if m in _ABCI_METHODS:
+            recv = (_chain(func.value) or "").lower()
+            if any(tag in recv for tag in _APPISH):
+                return f"ABCI client .{m}()"
+        if "check_tx" in m:
+            return f"ABCI round trip via .{m}()"
+    return None
+
+
+class _LockBlockingRule:
+    """Blocking operations lexically inside `with <known-lock>` regions."""
+
+    def __init__(self, mod: _Module, out: list[Finding]):
+        self.mod = mod
+        self.out = out
+        self.module_locks: set[str] = set()
+        self.class_locks: dict[str, set[str]] = {}
+
+    def run(self) -> None:
+        # pass 1: collect lock construction sites
+        for node in self.mod.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+        for cls in ast.walk(self.mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            attrs: set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a:
+                            attrs.add(a)
+            self.class_locks[cls.name] = attrs
+        # pass 2: scan every function against the lock set in scope
+        self._scan_body(self.mod.tree.body, set())
+
+    def _scan_body(self, body, class_attrs: set[str]) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._scan_body(node.body, self.class_locks.get(node.name, set()))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_stmts(node.body, class_attrs, held=0)
+            # module-level with-blocks are vanishingly rare; skip
+
+    def _is_lock_item(self, expr, class_attrs: set[str]) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.module_locks
+        a = _self_attr(expr)
+        if a is not None:
+            return a in class_attrs
+        # `with x.lock_batch():` — a method handing out its lock
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+            return expr.func.attr in ("lock_batch",)
+        return False
+
+    def _scan_stmts(self, stmts, class_attrs: set[str], held: int) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                # nested defs run later, outside this lock region
+                if isinstance(stmt, ast.ClassDef):
+                    self._scan_body([stmt], class_attrs)
+                else:
+                    self._scan_stmts(stmt.body, class_attrs, held=0)
+                continue
+            if isinstance(stmt, ast.With):
+                locks = sum(
+                    1 for item in stmt.items
+                    if self._is_lock_item(item.context_expr, class_attrs)
+                )
+                if held:  # the with-expressions evaluate under the outer lock
+                    for item in stmt.items:
+                        self._check_expr(item.context_expr)
+                self._scan_stmts(stmt.body, class_attrs, held + locks)
+                continue
+            # compound statements: recurse with the same depth
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    if field == "handlers":
+                        for h in sub:
+                            self._scan_stmts(h.body, class_attrs, held)
+                    else:
+                        self._scan_stmts(sub, class_attrs, held)
+            if held and not isinstance(stmt, (ast.With,)):
+                # expressions directly on this statement (test/iter/value)
+                for field in ("value", "test", "iter", "targets", "target"):
+                    sub = getattr(stmt, field, None)
+                    if sub is None:
+                        continue
+                    for s in sub if isinstance(sub, list) else [sub]:
+                        self._check_expr(s)
+
+    def _check_expr(self, expr) -> None:
+        # manual walk so Lambda subtrees are PRUNED (a `continue` under
+        # ast.walk would still descend into the deferred body)
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue  # deferred execution: not run under this lock
+            if isinstance(node, ast.Call):
+                reason = _blocking_reason(node)
+                if reason:
+                    self.out.append(self.mod.finding(
+                        "lock-blocking", node,
+                        f"{reason} while holding a lock — the PR-6 bug class "
+                        "(release the lock around the blocking phase, or "
+                        "suppress with the reason if the hold is the point)",
+                    ))
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# -------------------------------------------------------------- cache-stale
+
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "sort", "reverse", "add", "discard", "popitem", "setdefault",
+}
+
+
+def _memo_attr_of(method: ast.FunctionDef) -> str | None:
+    """The memo attribute a hash/bytes method serves: a `self._x`
+    that is both read and written in the body, with a hash/cache-ish
+    name."""
+    reads, writes = set(), set()
+    for node in ast.walk(method):
+        a = _self_attr(node)
+        if a is None or not a.startswith("_"):
+            continue
+        if isinstance(node.ctx, ast.Store):
+            writes.add(a)
+        elif isinstance(node.ctx, ast.Load):
+            reads.add(a)
+    for a in sorted(reads & writes):
+        if "hash" in a or "cache" in a:
+            return a
+    return None
+
+
+class _CacheStaleRule:
+    """Mutations of fields backing a memoized hash must reach the
+    invalidator (or the class must guard the memo read / clear it in
+    __setattr__)."""
+
+    def __init__(self, mod: _Module, out: list[Finding]):
+        self.mod = mod
+        self.out = out
+
+    def run(self) -> None:
+        for cls in ast.walk(self.mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                self._check_class(cls)
+
+    def _methods(self, cls: ast.ClassDef) -> dict[str, ast.FunctionDef]:
+        out = {}
+        for node in cls.body:
+            if isinstance(node, ast.FunctionDef):
+                args = node.args.posonlyargs + node.args.args
+                if args and args[0].arg == "self":
+                    out[node.name] = node
+        return out
+
+    def _check_class(self, cls: ast.ClassDef) -> None:
+        methods = self._methods(cls)
+        for name in ("hash", "bytes"):
+            m = methods.get(name)
+            if m is None:
+                continue
+            memo = _memo_attr_of(m)
+            if memo is None:
+                continue
+            self._check_memo(cls, methods, m, memo)
+
+    def _is_guarded(self, serve: ast.FunctionDef, memo: str) -> bool:
+        """The serve method re-checks inputs before serving the memo:
+        some branch condition references BOTH the memo (or an alias
+        assigned from it) and another self field."""
+        aliases = {memo}
+        for node in ast.walk(serve):
+            if isinstance(node, ast.Assign) and _self_attr(node.value) == memo:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+        for node in ast.walk(serve):
+            test = getattr(node, "test", None)
+            if test is None:
+                continue
+            has_memo = any(
+                (isinstance(n, ast.Name) and n.id in aliases)
+                or _self_attr(n) in aliases
+                for n in ast.walk(test)
+            )
+            has_field = any(
+                (a := _self_attr(n)) is not None and a != memo and not a.startswith("_")
+                for n in ast.walk(test)
+            )
+            if has_memo and has_field:
+                return True
+        return False
+
+    def _auto_setattr(self, methods, memo: str) -> bool:
+        sa = methods.get("__setattr__")
+        if sa is None:
+            return False
+        for node in ast.walk(sa):
+            if isinstance(node, ast.Constant) and node.value == memo:
+                return True
+            if _self_attr(node) == memo and isinstance(node.ctx, ast.Store):
+                return True
+        return False
+
+    def _invalidates(self, method: ast.FunctionDef, memo: str) -> bool:
+        """Assigns `self.<memo> = None` somewhere in the body."""
+        for node in ast.walk(method):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and node.value.value is None
+                and any(_self_attr(t) == memo for t in node.targets)
+            ):
+                return True
+        return False
+
+    def _monitored_fields(self, serve: ast.FunctionDef, memo: str) -> set[str]:
+        out = set()
+        for node in ast.walk(serve):
+            a = _self_attr(node)
+            if (
+                a is not None
+                and a != memo
+                and not a.startswith("_")
+                and isinstance(node.ctx, ast.Load)
+            ):
+                out.add(a)
+        return out
+
+    def _mutations(self, method: ast.FunctionDef, fields: set[str]):
+        """Nodes in `method` that mutate a monitored field."""
+        hits = []
+        loop_vars: set[str] = set()
+        for node in ast.walk(method):
+            if isinstance(node, (ast.For,)):
+                it = node.iter
+                # `for v in self.F:` and `for v in list(self.F):`
+                if isinstance(it, ast.Call) and it.args:
+                    it = it.args[0]
+                if _self_attr(it) in fields and isinstance(node.target, ast.Name):
+                    loop_vars.add(node.target.id)
+        for node in ast.walk(method):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if _self_attr(t) in fields:
+                        hits.append((node, f"assigns self.{_self_attr(t)}"))
+                    elif (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in loop_vars
+                    ):
+                        hits.append((node, f"mutates elements of a hashed field via {t.value.id}.{t.attr}"))
+                    elif isinstance(t, ast.Subscript) and _self_attr(t.value) in fields:
+                        hits.append((node, f"writes into self.{_self_attr(t.value)}"))
+            elif isinstance(node, ast.AugAssign):
+                t = node.target
+                if _self_attr(t) in fields:
+                    hits.append((node, f"augments self.{_self_attr(t)}"))
+                elif (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in loop_vars
+                ):
+                    hits.append((node, f"mutates elements via {t.value.id}.{t.attr}"))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and _self_attr(node.func.value) in fields
+            ):
+                hits.append((node, f"calls self.{_self_attr(node.func.value)}.{node.func.attr}()"))
+        return hits
+
+    def _mutable_fields(self, cls: ast.ClassDef, fields: set[str]) -> set[str]:
+        """Monitored fields whose declaration is a mutable container
+        (list/dict/set annotation, or field(default_factory=...))."""
+        out = set()
+        for node in cls.body:
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                name = node.target.id
+                if name not in fields:
+                    continue
+                ann = ast.unparse(node.annotation).lower()
+                if any(t in ann for t in ("list", "dict", "set", "bytearray")):
+                    out.add(name)
+                elif (
+                    isinstance(node.value, ast.Call)
+                    and _chain(node.value.func) == "field"
+                    and any(k.arg == "default_factory" for k in node.value.keywords)
+                ):
+                    out.add(name)
+        return out
+
+    def _check_memo(self, cls, methods, serve, memo: str) -> None:
+        if self._is_guarded(serve, memo):
+            return  # Validator.bytes style: every read re-checks inputs
+        auto = self._auto_setattr(methods, memo)
+        fields = self._monitored_fields(serve, memo)
+        if not fields:
+            return
+        # the invalidator: any method that assigns memo = None (beyond
+        # the serve method itself)
+        invalidators = {
+            n for n, m in methods.items()
+            if n != serve.name and self._invalidates(m, memo)
+        }
+        # intra-class call graph for private-helper coverage
+        calls: dict[str, set[str]] = {}
+        for n, m in methods.items():
+            calls[n] = set()
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call):
+                    a = _self_attr(node.func)
+                    if a in methods:
+                        calls[n].add(a)
+        callers: dict[str, set[str]] = {n: set() for n in methods}
+        for n, callees in calls.items():
+            for c in callees:
+                callers[c].add(n)
+
+        skip = {serve.name, "__init__", "__post_init__", "__setattr__"} | invalidators
+        mutating = {}
+        for n, m in methods.items():
+            if n in skip:
+                continue
+            if auto:
+                # __setattr__ catches plain assignment; only in-place
+                # container mutation bypasses it
+                hits = [
+                    (node, why) for node, why in self._mutations(m, fields)
+                    if "calls self." in why or "elements" in why or "writes into" in why
+                ]
+            else:
+                hits = self._mutations(m, fields)
+            if hits:
+                mutating[n] = hits
+
+        # coverage fixpoint: covered = directly invalidating methods;
+        # a private method is covered when every intra-class caller is
+        covered = {
+            n for n, m in methods.items()
+            if n in invalidators
+            or self._invalidates(m, memo)
+            or any(c in invalidators for c in calls[n])
+        }
+        changed = True
+        while changed:
+            changed = False
+            for n in methods:
+                if n in covered or not n.startswith("_"):
+                    continue
+                cs = callers[n]
+                if cs and cs <= covered:
+                    covered.add(n)
+                    changed = True
+
+        if not invalidators and not auto and not mutating:
+            # No in-class mutator, but the hash covers an externally
+            # mutable public field (a list/dict/set dataclass field):
+            # any caller can resize it and the memo serves stale — the
+            # class needs an invalidator, a guarded read, or a clearing
+            # __setattr__ (the pre-fix Commit._hash shape).
+            mutable = self._mutable_fields(cls, fields)
+            if mutable:
+                self.out.append(self.mod.finding(
+                    "cache-stale", serve,
+                    f"{cls.name}.{serve.name}() memoizes over externally "
+                    f"mutable field(s) {sorted(mutable)} with no "
+                    "invalidator, guard, or clearing __setattr__ — "
+                    "external mutation serves a stale hash (the PR-5 "
+                    "bug class)",
+                ))
+            return
+
+        for n, hits in mutating.items():
+            if n in covered:
+                continue
+            node, why = hits[0]
+            if not invalidators and not auto:
+                msg = (
+                    f"{cls.name}.{n} {why}, but {cls.name} memoizes "
+                    f"{serve.name}() in self.{memo} with NO invalidator — "
+                    "stale hash served after mutation (the PR-5 bug class)"
+                )
+            else:
+                msg = (
+                    f"{cls.name}.{n} {why} without reaching the "
+                    f"self.{memo} invalidator — stale {serve.name}() "
+                    "after this mutation (the PR-5 bug class)"
+                )
+            self.out.append(self.mod.finding("cache-stale", node, msg))
+
+
+# ------------------------------------------------------------- metric-raise
+
+_METRICS_MODULE = "tendermint_tpu/metrics/__init__.py"
+_METRIC_WRITE_STATE = ("_children", "_hist")
+
+
+class _MetricRaiseRule:
+    """In the metrics module, every method of a _Metric subclass that
+    mutates shared metric state must be wrapped @_never_raise — hot
+    paths call these from engine workers whose death hangs callers."""
+
+    def __init__(self, mod: _Module, out: list[Finding]):
+        self.mod = mod
+        self.out = out
+
+    def run(self) -> None:
+        if self.mod.path != _METRICS_MODULE:
+            return
+        # lexical subclass closure from _Metric
+        classes = {
+            n.name: n for n in self.mod.tree.body if isinstance(n, ast.ClassDef)
+        }
+        metric_classes = {"_Metric"}
+        changed = True
+        while changed:
+            changed = False
+            for name, cls in classes.items():
+                if name in metric_classes:
+                    continue
+                if any(
+                    isinstance(b, ast.Name) and b.id in metric_classes
+                    for b in cls.bases
+                ):
+                    metric_classes.add(name)
+                    changed = True
+        for name in metric_classes:
+            cls = classes.get(name)
+            if cls is None:
+                continue
+            for node in cls.body:
+                if not isinstance(node, ast.FunctionDef) or node.name == "__init__":
+                    continue
+                if not self._mutates_state(node):
+                    continue
+                decos = {
+                    d.id for d in node.decorator_list if isinstance(d, ast.Name)
+                }
+                if "_never_raise" not in decos:
+                    self.out.append(self.mod.finding(
+                        "metric-raise", node,
+                        f"{name}.{node.name} mutates metric state without "
+                        "@_never_raise — an exception here kills the hot "
+                        "path that was only trying to record telemetry",
+                    ))
+
+    def _mutates_state(self, method: ast.FunctionDef) -> bool:
+        for node in ast.walk(method):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and _self_attr(t.value) in _METRIC_WRITE_STATE
+                    ):
+                        return True
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("pop", "clear", "update", "setdefault")
+                and _self_attr(node.func.value) in _METRIC_WRITE_STATE
+            ):
+                return True
+        return False
+
+
+# ------------------------------------------------------------- metric-drift
+
+_METRIC_WRITES = {"add", "set", "observe", "observe_many", "mark", "remove"}
+_METRIC_FACTORIES = {"engine_metrics", "hash_metrics"}
+
+
+def _label_count(call) -> int | None:
+    """Declared label count of a reg.counter/gauge/histogram(...) or
+    register(AgeGauge(...)) assignment value; None when undecidable."""
+    if not isinstance(call, ast.Call) or not isinstance(call.func, ast.Attribute):
+        return None
+    factory = call.func.attr
+    if factory == "register":
+        # reg.register(SomeMetric(name, help_)) — label-less in-tree
+        inner = call.args[0] if call.args else None
+        if isinstance(inner, ast.Call) and len(inner.args) <= 2 and not any(
+            k.arg == "labels" for k in inner.keywords
+        ):
+            return 0
+        return None
+    if factory not in ("counter", "gauge", "histogram"):
+        return None
+    labels = None
+    for k in call.keywords:
+        if k.arg == "labels":
+            labels = k.value
+    if labels is None and len(call.args) >= 3:
+        labels = call.args[2]
+    if labels is None:
+        return 0
+    if isinstance(labels, (ast.Tuple, ast.List)):
+        return len(labels.elts)
+    return None
+
+
+def _collect_metric_decls(root: str):
+    """(attrs, methods, groups, group_lines) declared by the metricsgen
+    group classes in metrics/__init__.py, plus the GROUPS tuple from
+    scripts/metricsgen.py. `attrs` maps attribute name -> set of
+    declared label counts (None = undecidable, arity unchecked).
+    Returns None when either file is absent (fixture trees)."""
+    mpath = os.path.join(root, _METRICS_MODULE)
+    gpath = os.path.join(root, "scripts", "metricsgen.py")
+    if not os.path.exists(mpath) or not os.path.exists(gpath):
+        return None
+    with open(mpath) as f:
+        mtree = ast.parse(f.read())
+    attrs: dict[str, set] = {}
+    methods: set[str] = set()
+    group_lines: dict[str, int] = {}
+    for cls in mtree.body:
+        if not isinstance(cls, ast.ClassDef) or not cls.name.endswith("Metrics"):
+            continue
+        group_lines[cls.name] = cls.lineno
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a and not a.startswith("_"):
+                        attrs.setdefault(a, set()).add(_label_count(node.value))
+            if isinstance(node, ast.FunctionDef) and not node.name.startswith("__"):
+                methods.add(node.name)
+    with open(gpath) as f:
+        gtree = ast.parse(f.read())
+    groups: set[str] = set()
+    for node in gtree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "GROUPS" for t in node.targets
+        ):
+            for elt in getattr(node.value, "elts", []):
+                if isinstance(elt, ast.Constant):
+                    groups.add(elt.value)
+    return attrs, methods, groups, group_lines
+
+
+class _MetricDriftRule:
+    """Metric attribute writes must resolve to declared group attrs;
+    every group class must be registered with metricsgen."""
+
+    def __init__(self, mod: _Module, out: list[Finding], decls):
+        self.mod = mod
+        self.out = out
+        self.decls = decls
+
+    def run(self) -> None:
+        if self.decls is None:
+            return
+        attrs, methods, groups, group_lines = self.decls
+        if self.mod.path == _METRICS_MODULE:
+            # registration drift: a group class metricsgen doesn't walk
+            # never reaches docs/metrics.md, so --check can't see it
+            for name, line in group_lines.items():
+                if name not in groups:
+                    self.out.append(Finding(
+                        "metric-drift", self.mod.path, line,
+                        f"{name} is not listed in scripts/metricsgen.py "
+                        "GROUPS — its series escape the docs/metrics.md "
+                        "drift gate entirely",
+                        _snippet(self.mod.lines, line),
+                    ))
+            return
+        for fn in ast.walk(self.mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(fn, attrs, methods)
+
+    def _check_arity(self, node, write: str, attr: str, counts: set) -> None:
+        """A write whose positional arity can't match any declared
+        label layout is silently DROPPED by @_never_raise (ValueError
+        inside the wrapper) — telemetry loss with no traceback."""
+        if None in counts or node.keywords or any(
+            isinstance(a, ast.Starred) for a in node.args
+        ):
+            return  # undecidable declaration / kwargs / splat: skip
+        got = len(node.args)
+        ok = set()
+        for n in counts:
+            if write in ("add", "set", "observe", "observe_many"):
+                ok.add(1 + n)
+                if n == 0 and write == "add":
+                    ok.add(0)  # Counter.add() default delta
+            elif write == "remove":
+                ok.add(n)
+            elif write == "mark":
+                ok.update((0, 1))
+        if ok and got not in ok:
+            self.out.append(self.mod.finding(
+                "metric-drift", node,
+                f".{attr}.{write}() called with {got} positional arg(s) "
+                f"but the declaration expects {sorted(ok)} — the "
+                "never-raise wrapper silently drops this write",
+            ))
+
+    def _metricsish(self, expr, aliases: set[str]) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in aliases or "metric" in expr.id.lower()
+        if isinstance(expr, ast.Attribute):
+            return "metric" in expr.attr.lower()
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            return expr.func.id in _METRIC_FACTORIES
+        return False
+
+    def _check_function(self, fn, attrs: set[str], methods: set[str]) -> None:
+        # simple local aliasing: m = self._metrics / em = engine_metrics()
+        aliases: set[str] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and self._metricsish(node.value, aliases)
+            ):
+                aliases.add(node.targets[0].id)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            w = node.func.attr
+            recv = node.func.value
+            if w in _METRIC_WRITES and isinstance(recv, ast.Attribute):
+                # <metrics>.<attr>.<write>(...)
+                if not self._metricsish(recv.value, aliases):
+                    continue
+                if recv.attr not in attrs:
+                    self.out.append(self.mod.finding(
+                        "metric-drift", node,
+                        f"metric attribute .{recv.attr} is not declared by "
+                        "any metricsgen group class — this write raises "
+                        "AttributeError on the hot path",
+                    ))
+                    continue
+                self._check_arity(node, w, recv.attr, attrs[recv.attr])
+            elif w not in _METRIC_WRITES and self._metricsish(recv, aliases):
+                # <metrics>.<method>(...) — group helper methods
+                if (
+                    w not in methods
+                    and w not in attrs
+                    and not w.startswith("_")
+                    and w not in ("get",)
+                ):
+                    self.out.append(self.mod.finding(
+                        "metric-drift", node,
+                        f"metrics method .{w}() is not defined by any "
+                        "metricsgen group class",
+                    ))
+
+
+# --------------------------------------------------------- import-isolation
+
+# Modules that must stay importable (and import-light) on bare CI
+# boxes — the artifact-reading / analysis plane.
+_ISOLATED_PREFIXES = ("tendermint_tpu/lens/", "tendermint_tpu/check/")
+_ISOLATED_FILES = ("tendermint_tpu/metrics/flight.py",)
+# Absolute top-level packages the isolated set must never touch.
+_FORBIDDEN_TOP = {"jax", "jaxlib"}
+# tendermint_tpu subpackages the isolated set MAY import; everything
+# else under tendermint_tpu is node runtime.
+_ALLOWED_SUBPACKAGES = {"lens", "check", "metrics", "trace", "utils"}
+
+
+def _isolated(path: str) -> bool:
+    return path.startswith(_ISOLATED_PREFIXES) or path in _ISOLATED_FILES
+
+
+class _ImportIsolationRule:
+    def __init__(self, mod: _Module, out: list[Finding]):
+        self.mod = mod
+        self.out = out
+
+    def run(self) -> None:
+        if not _isolated(self.mod.path):
+            return
+        pkg_parts = self.mod.path.rsplit("/", 1)[0].split("/")
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self._check(node, alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    target = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    target = node.module or ""
+                self._check(node, target)
+
+    def _check(self, node, target: str) -> None:
+        parts = target.split(".")
+        if parts[0] in _FORBIDDEN_TOP:
+            self.out.append(self.mod.finding(
+                "import-isolation", node,
+                f"imports {target!r}: the analysis plane must run on "
+                "boxes without jax (docs/static-analysis.md#isolation)",
+            ))
+        elif parts[0] == "tendermint_tpu" and len(parts) > 1:
+            if parts[1] not in _ALLOWED_SUBPACKAGES:
+                self.out.append(self.mod.finding(
+                    "import-isolation", node,
+                    f"imports {target!r}: node-runtime package "
+                    f"'{parts[1]}' is off-limits to the isolated "
+                    "lens/flight/check plane",
+                ))
+
+
+# ------------------------------------------------------------ trace-pairing
+
+
+class _TracePairingRule:
+    """Every trace.span() must be entered: as a with-item directly, or
+    assigned to a name that is later a with-item (or escapes)."""
+
+    def __init__(self, mod: _Module, out: list[Finding]):
+        self.mod = mod
+        self.out = out
+        self.aliases = self._trace_aliases()
+
+    def _trace_aliases(self) -> set[str]:
+        names = set()
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "trace":
+                        names.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.endswith(".trace"):
+                        names.add(alias.asname or alias.name.split(".")[0])
+        return names
+
+    def _is_span_call(self, node) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "span"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self.aliases
+        )
+
+    def run(self) -> None:
+        if not self.aliases:
+            return
+        for fn in ast.walk(self.mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(fn)
+
+    def _check_function(self, fn) -> None:
+        with_items: set[int] = set()  # ids of expressions used as with-items
+        with_names: set[str] = set()
+        # name -> EVERY span call bound to it (sequential reuse of one
+        # variable is a legitimate pattern; tracking only the last call
+        # would report the earlier ones as discarded)
+        assigned: dict[str, list] = {}
+        escapes: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    with_items.add(id(item.context_expr))
+                    if isinstance(item.context_expr, ast.Name):
+                        with_names.add(item.context_expr.id)
+            elif isinstance(node, ast.Assign) and self._is_span_call(node.value):
+                if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                    assigned.setdefault(node.targets[0].id, []).append(node.value)
+            elif isinstance(node, (ast.Return, ast.Yield)) and isinstance(
+                getattr(node, "value", None), ast.Name
+            ):
+                escapes.add(node.value.id)
+            elif isinstance(node, ast.Call):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        escapes.add(arg.id)
+        for node in ast.walk(fn):
+            if not self._is_span_call(node):
+                continue
+            if id(node) in with_items:
+                continue
+            bound = [
+                n for n, calls in assigned.items()
+                if any(call is node for call in calls)
+            ]
+            if bound:
+                name = bound[0]
+                if name in with_names or name in escapes:
+                    continue
+                self.out.append(self.mod.finding(
+                    "trace-pairing", node,
+                    f"span assigned to {name!r} but never entered — the "
+                    "span records nothing (enter it with `with`)",
+                ))
+            else:
+                # bare expression / nested in another call without escape
+                self.out.append(self.mod.finding(
+                    "trace-pairing", node,
+                    "span() result discarded without entering it — "
+                    "no event is ever recorded",
+                ))
+
+
+# ------------------------------------------------------------ unused-import
+
+
+class _UnusedImportRule:
+    def __init__(self, mod: _Module, out: list[Finding]):
+        self.mod = mod
+        self.out = out
+
+    def run(self) -> None:
+        if self.mod.path.endswith("__init__.py"):
+            return  # re-export surfaces
+        imports: list[tuple[str, ast.stmt]] = []
+        import_nodes = set()
+        for node in self.mod.tree.body:
+            if isinstance(node, ast.Import):
+                import_nodes.add(id(node))
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    imports.append((name, node))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                import_nodes.add(id(node))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    imports.append((alias.asname or alias.name, node))
+        if not imports:
+            return
+        used: set[str] = set()
+        for node in ast.walk(self.mod.tree):
+            if id(node) in import_nodes:
+                continue
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        for elt in ast.walk(node.value):
+                            if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, str
+                            ):
+                                used.add(elt.value)
+        for name, node in imports:
+            if name in used:
+                continue
+            line = _snippet(self.mod.lines, node.lineno)
+            if "noqa" in line:
+                continue
+            self.out.append(self.mod.finding(
+                "unused-import", node,
+                f"{name!r} imported but never used in this module",
+            ))
+
+
+# ------------------------------------------------------------------- driver
+
+
+def analyze(root: str, files: list[str], selected) -> tuple[list[Finding], dict]:
+    """Run the selected rules over `files` (repo-relative under
+    `root`). Returns (findings, {path: source lines})."""
+    findings: list[Finding] = []
+    sources: dict[str, list[str]] = {}
+    decls = _collect_metric_decls(root) if "metric-drift" in selected else None
+    for path in files:
+        full = os.path.join(root, path)
+        try:
+            with open(full, encoding="utf-8") as f:
+                text = f.read()
+            tree = ast.parse(text, filename=path)
+        except (OSError, SyntaxError) as e:
+            raise ValueError(f"tmcheck cannot parse {path}: {e}") from e
+        mod = _Module(path, tree, text.splitlines())
+        sources[path] = mod.lines
+        if "lock-blocking" in selected:
+            _LockBlockingRule(mod, findings).run()
+        if "cache-stale" in selected:
+            _CacheStaleRule(mod, findings).run()
+        if "metric-raise" in selected:
+            _MetricRaiseRule(mod, findings).run()
+        if "metric-drift" in selected:
+            _MetricDriftRule(mod, findings, decls).run()
+        if "import-isolation" in selected:
+            _ImportIsolationRule(mod, findings).run()
+        if "trace-pairing" in selected:
+            _TracePairingRule(mod, findings).run()
+        if "unused-import" in selected:
+            _UnusedImportRule(mod, findings).run()
+    return findings, sources

@@ -89,6 +89,21 @@ def cmd_init(args) -> int:
 def cmd_start(args) -> int:
     """ref: commands/run_node.go:97 NewRunNodeCmd (seed mode dispatches
     to the pex-only seed node, node/seed.go)."""
+    # TM_TPU_LOCKCHECK=1 (e2e env passthrough, like TM_TPU_PROF): wrap
+    # lock construction BEFORE the node-runtime imports below — they
+    # build module-global locks at import time (trace ring, engine
+    # metrics singletons), and installing after them would leave
+    # exactly those hot-path locks out of the order graph. lockcheck
+    # itself is stdlib-only, so importing it first costs nothing.
+    # Events stream to <home>/lockcheck.jsonl where the artifact sweep
+    # finds them (docs/static-analysis.md#lockcheck). Disabled:
+    # constructs nothing.
+    from .check.lockcheck import maybe_install as maybe_install_lockcheck
+
+    lockcheck = maybe_install_lockcheck(args.home)
+    if lockcheck is not None:
+        print(f"lockcheck sanitizer on -> {lockcheck.out_path}")
+
     from .config import load_config
     from .lens.profiler import maybe_start_profiler
     from .node import Node

@@ -13,7 +13,7 @@ import threading
 from ..types.vote import PRECOMMIT, PREVOTE
 from ..utils.bits import BitArray
 from ..utils.tmtime import Time
-from .round_state import STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PROPOSE
+from .round_state import STEP_NEW_HEIGHT
 
 
 class PeerRoundState:

@@ -48,7 +48,7 @@ from .messages import (
     VoteSetMaj23Message,
 )
 from .peer_state import PeerState
-from .round_state import STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PROPOSE
+from .round_state import STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PROPOSE
 
 # ------------------------------------------------------------------ codecs
 #

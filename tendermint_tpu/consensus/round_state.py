@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..types.block import Block, BlockID, Commit
+from ..types.block import Block, BlockID
 from ..types.part_set import PartSet
 from ..types.proposal import Proposal
 from ..types.validator_set import ValidatorSet

@@ -13,7 +13,6 @@ import threading
 
 from ..types.evidence import (
     DuplicateVoteEvidence,
-    LightClientAttackEvidence,
     evidence_from_proto,
     evidence_to_proto,
 )

@@ -8,7 +8,6 @@ inbound evidence is added to the pool, invalid senders are reported.
 from __future__ import annotations
 
 import threading
-import time
 
 from ..p2p.types import (
     CHANNEL_EVIDENCE,

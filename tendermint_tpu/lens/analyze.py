@@ -31,6 +31,7 @@ __all__ = [
     "discover_nodes",
     "analyze_node",
     "analyze_run",
+    "summarize_lockcheck",
     "write_merged_trace",
     "render_summary",
     "REPORT_NAME",
@@ -62,9 +63,72 @@ def discover_nodes(run_dir: str) -> list[tuple[str, str]]:
         if any(
             os.path.exists(os.path.join(d, f))
             for f in ("metrics.txt", "trace.json", "profile.collapsed",
-                      "timeseries.jsonl")
+                      "timeseries.jsonl", "lockcheck.jsonl")
         ):
             out.append((entry, d))
+    return out
+
+
+def summarize_lockcheck(path: str) -> dict:
+    """Digest of a node's lockcheck.jsonl (check/lockcheck.py): event
+    counts, the cycles themselves (each one names the lock sites in
+    order — the evidence the gate detail carries), worst hold, and the
+    final summary record's graph stats + overhead estimate. Tolerates
+    a truncated tail line, like every other streamed artifact."""
+    cycles: list = []
+    worst_hold = None
+    counts = {"hold_budget": 0, "blocking_under_lock": 0}
+    summaries: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail (SIGKILL mid-append)
+            if not isinstance(rec, dict):
+                continue  # valid JSON, wrong shape: skip, don't abort
+            kind = rec.get("kind")
+            if kind == "lock_order_cycle":
+                cycles.append({
+                    "cycle": rec.get("cycle"), "thread": rec.get("thread"),
+                })
+            elif kind == "hold_budget":
+                counts["hold_budget"] += 1
+                h = rec.get("held_s")
+                if isinstance(h, (int, float)) and (
+                    worst_hold is None or h > worst_hold
+                ):
+                    worst_hold = h
+            elif kind == "blocking_under_lock":
+                counts["blocking_under_lock"] += 1
+            elif kind == "summary":
+                summaries.append(rec)
+    out = {
+        "cycles": cycles,
+        "hold_budget_events": counts["hold_budget"],
+        "blocking_under_lock_events": counts["blocking_under_lock"],
+        "worst_hold_s": worst_hold,
+    }
+    if summaries:
+        # a restarted node appends a NEW process segment to the same
+        # file, each with its own summary: additive quantities
+        # (acquires, overhead) SUM across segments, graph sizes take
+        # the largest segment (per-process graphs are independent —
+        # summing would double-count shared sites)
+        def _num(rec, key):
+            v = rec.get(key)
+            return v if isinstance(v, (int, float)) else 0
+
+        out["segments"] = len(summaries)
+        out["sites"] = max(_num(s, "sites") for s in summaries)
+        out["edges"] = max(_num(s, "edges") for s in summaries)
+        out["acquires"] = sum(_num(s, "acquires") for s in summaries)
+        out["overhead_s_est"] = round(
+            sum(_num(s, "overhead_s_est") for s in summaries), 6
+        )
     return out
 
 
@@ -188,6 +252,19 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
             summary["timeline"] = None
             summary["timeline_error"] = f"{type(e).__name__}: {e}"
 
+    # lockcheck sanitizer stream (TM_TPU_LOCKCHECK=1 nodes,
+    # check/lockcheck.py): the lock_order_cycle gate reads this
+    lpath = os.path.join(node_dir, "lockcheck.jsonl")
+    if os.path.exists(lpath):
+        summary["artifacts"].append("lockcheck.jsonl")
+        try:
+            summary["lockcheck"] = summarize_lockcheck(lpath)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # one corrupt artifact must not abort the whole fleet
+            # report (same breadth as the timeline path above)
+            summary["lockcheck"] = None
+            summary["lockcheck_error"] = f"{type(e).__name__}: {e}"
+
     if os.path.exists(tpath):
         summary["artifacts"].append("trace.json")
         try:
@@ -273,6 +350,33 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
     fleet["step_p99_s"] = _round(merged.quantile(0.99)) if merged else None
     fleet["step_p50_s"] = _round(merged.quantile(0.5)) if merged else None
 
+    # lockcheck fleet digest (the lock_order_cycle gate reads per-node
+    # blocks; this is the at-a-glance roll-up, overhead included so the
+    # <=1% acceptance budget is a report field, not a hand calculation)
+    lchecks = [s["lockcheck"] for s in summaries if s.get("lockcheck")]
+    fleet["nodes_with_lockcheck"] = len(lchecks)
+    if lchecks:
+        fleet["lockcheck"] = {
+            "cycles": sum(len(lc["cycles"]) for lc in lchecks),
+            "hold_budget_events": sum(lc["hold_budget_events"] for lc in lchecks),
+            "blocking_under_lock_events": sum(
+                lc["blocking_under_lock_events"] for lc in lchecks
+            ),
+            "worst_hold_s": max(
+                (lc["worst_hold_s"] for lc in lchecks
+                 if lc.get("worst_hold_s") is not None),
+                default=None,
+            ),
+            "overhead_s_est": (
+                round(sum(ests), 6)
+                if (ests := [
+                    lc["overhead_s_est"] for lc in lchecks
+                    if lc.get("overhead_s_est") is not None
+                ])
+                else None  # None = no summary record, NOT zero overhead
+            ),
+        }
+
     # tmpath fleet digest: where the time went, fleet-wide
     from .journey import fleet_critical_path
 
@@ -357,6 +461,15 @@ def render_summary(report: dict) -> str:
                 f"    timeline: {tl['records']} records / {tl['span_s']}s, "
                 f"height {h.get('rate_per_s')}/s (tail stall {h.get('stalled_tail_s')}s), "
                 f"peak churn {ch.get('peak_connects_per_s')}/s"
+            )
+        lc = s.get("lockcheck")
+        if lc:
+            lines.append(
+                f"    lockcheck: {len(lc['cycles'])} cycles, "
+                f"{lc['hold_budget_events']} over-budget holds "
+                f"(worst {lc.get('worst_hold_s')}s), "
+                f"{lc['blocking_under_lock_events']} sleeps-under-lock, "
+                f"overhead est {lc.get('overhead_s_est')}s"
             )
         cp = (s.get("critical_path") or {}).get("totals")
         if cp and cp.get("heights"):

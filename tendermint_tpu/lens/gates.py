@@ -37,11 +37,19 @@ test assertions):
                      failure arrives naming the stage (proposer /
                      gossip / verify / quorum / apply), the node, and
                      the height, not just a slow p99
+  lock_order_cycle   a TM_TPU_LOCKCHECK=1 node's lockcheck.jsonl
+                     (check/lockcheck.py) recorded more than
+                     `max_lock_order_cycles` (default 0) lock-order
+                     inversion cycles — a potential deadlock is a
+                     verdict failure even when this run's interleaving
+                     happened to survive it; the detail names the lock
+                     construction sites in cycle order
 
 rate_stall / churn_storm pass vacuously when no node left a
-timeseries.jsonl (flight recorder off), and journey_stall when no node
-left journey spans (tracing off): absence of an artifact is not
-evidence of a failure.
+timeseries.jsonl (flight recorder off), journey_stall when no node
+left journey spans (tracing off), and lock_order_cycle when no node
+ran the sanitizer: absence of an artifact is not evidence of a
+failure.
 """
 
 from __future__ import annotations
@@ -77,6 +85,11 @@ DEFAULT_GATES = {
     # a height tens of seconds; a healthy stage is sub-second — the
     # budget separates "slow" from "parked on one stage")
     "journey_stall_budget_s": 60.0,
+    # lockcheck: order-inversion cycles tolerated before the verdict
+    # fails. Zero — a potential deadlock on the consensus planes is
+    # never "some" acceptable; raise only for a run that deliberately
+    # exercises a known-cyclic legacy path
+    "max_lock_order_cycles": 0,
 }
 
 
@@ -215,6 +228,57 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
             if offenders
             else f"no critical-path stage over {budget}s across "
             f"{sum(len(cp['heights']) for _n, cp in paths)} height decompositions",
+        ))
+
+    # lock_order_cycle (lockcheck sanitizer streams; vacuous pass when
+    # no node ran TM_TPU_LOCKCHECK=1)
+    lchecks = [(s["name"], s["lockcheck"]) for s in nodes if s.get("lockcheck")]
+    lcheck_errors = [
+        (s["name"], s["lockcheck_error"]) for s in nodes if s.get("lockcheck_error")
+    ]
+    if not lchecks:
+        gates.append(_gate(
+            "lock_order_cycle", True,
+            # evidence LOSS must not masquerade as sanitizer-disabled:
+            # still a vacuous pass (matching the timeline_error
+            # precedent), but the detail names the unreadable artifacts
+            f"lockcheck artifacts present but unreadable: {lcheck_errors}"
+            if lcheck_errors
+            else "no lockcheck.jsonl artifacts (TM_TPU_LOCKCHECK off)",
+        ))
+    else:
+        offenders = [
+            (name, lc["cycles"]) for name, lc in lchecks if lc["cycles"]
+        ]
+        total = sum(len(c) for _n, c in offenders)
+        edges = sum(lc.get("edges") or 0 for _n, lc in lchecks)
+        if total > cfg["max_lock_order_cycles"]:
+            detail = (
+                f"lock-order inversion cycles (max {cfg['max_lock_order_cycles']}): "
+                + "; ".join(
+                    f"{name}: {[c['cycle'] for c in cycles]}"
+                    for name, cycles in offenders
+                )
+            )
+        elif total:
+            # within a raised allowance: the evidence still has to be
+            # visible, or the operator who set the override never sees
+            # which sites cycled (and never learns when they stop)
+            detail = (
+                f"{total} cycle(s) within the max_lock_order_cycles="
+                f"{cfg['max_lock_order_cycles']} allowance: "
+                + "; ".join(
+                    f"{name}: {[c['cycle'] for c in cycles]}"
+                    for name, cycles in offenders
+                )
+            )
+        else:
+            detail = (
+                f"no lock-order cycles across {len(lchecks)} sanitized "
+                f"node(s) ({edges} graph edges)"
+            )
+        gates.append(_gate(
+            "lock_order_cycle", total <= cfg["max_lock_order_cycles"], detail,
         ))
 
     # missing_series

@@ -41,7 +41,6 @@ import os
 import threading
 import time as _time
 
-import numpy as np
 
 from .. import trace as _trace
 from ..metrics import engine_metrics as _engine_metrics

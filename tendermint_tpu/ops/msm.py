@@ -51,7 +51,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import curve as C
-from . import field as F
 from .. import trace as _trace
 from ..metrics import engine_metrics as _engine_metrics
 from .verify import L, pad_pow2_rows, prepare_batch

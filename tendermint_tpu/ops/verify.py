@@ -175,6 +175,23 @@ class PubkeyCache:
         self._build = build_fn or build_pk_tables  # sr25519 plugs in its decoder
         self._lock = threading.Lock()  # reactors verify concurrently
         self._lru: "collections.OrderedDict[bytes, int]" = collections.OrderedDict()
+        # Two-phase fill bookkeeping. The table build is a device
+        # kernel launch — held across the lock it serialized every
+        # concurrent verifier behind one miss fill (tmcheck hold_budget
+        # found it at 1.5s under CPU emulation), so fills reserve under
+        # the lock, build unlocked, and publish under the lock.
+        #   _pending: keys whose table is RESERVED but not yet
+        #   published (key -> Event set at publish) — other batches
+        #   touching them must wait, so no caller ever reads an
+        #   unpublished slot.
+        #   _pinned: eviction pin-COUNTS for every key an in-flight
+        #   fill batch depends on, hits included — their slots must
+        #   survive until the filler's publish-time snapshot, but their
+        #   published tables stay freely readable by concurrent
+        #   batches (a hot validator key shared with a fill must not
+        #   re-serialize hit-only verifiers behind the build).
+        self._pending: "dict[bytes, threading.Event]" = {}
+        self._pinned: "dict[bytes, int]" = {}
         self.tables = jnp.zeros((capacity,) + tuple(entry_shape), jnp.int16)
         self.oks = jnp.zeros((capacity,), bool)
 
@@ -183,47 +200,111 @@ class PubkeyCache:
         device call. Returns (B,) int32 slots, or None when the batch
         has more distinct keys than the cache holds (caller falls back
         to the uncached kernel)."""
-        with self._lock:
-            return self._ensure_locked(pubkeys)
+        slots, _tables, _oks = self.ensure_snapshot(pubkeys)
+        return slots
 
     def ensure_snapshot(self, pubkeys):
-        """(slots, tables, oks) as ONE consistent view: without the
-        lock, a concurrent insert could rebind self.tables between the
-        slot computation and the array read, losing the write the slots
-        depend on (functional .at[].set updates are lock-free to USE
-        but not to publish)."""
-        with self._lock:
-            slots = self._ensure_locked(pubkeys)
-            return slots, self.tables, self.oks
+        """(slots, tables, oks) as ONE consistent view: the returned
+        arrays are the ones the slot computation published against
+        (functional .at[].set updates are lock-free to USE but not to
+        publish). Miss fills build their tables with the lock RELEASED
+        — concurrent batches over cached keys proceed immediately, and
+        disjoint miss batches fill in parallel."""
+        import threading
 
-    def _ensure_locked(self, pubkeys):
-        distinct = list(dict.fromkeys(pubkeys))
-        if len(distinct) > self.capacity:
-            return None
-        # Refresh present keys FIRST so eviction below can never pop a
-        # key this very batch is about to use.
-        for pk in distinct:
-            if pk in self._lru:
-                self._lru.move_to_end(pk)
-        missing = [pk for pk in distinct if pk not in self._lru]
-        if missing:
-            free = self.capacity - len(self._lru)
-            for _ in range(max(0, len(missing) - free)):
-                self._lru.popitem(last=False)  # evict least-recent
-            used = set(self._lru.values())
-            free_slots = iter(i for i in range(self.capacity) if i not in used)
-            idx = np.fromiter((next(free_slots) for _ in missing), np.int32)
-            enc = np.frombuffer(b"".join(missing), np.uint8).reshape(-1, 32)
-            (enc_p,) = pad_pow2_rows([enc], len(missing))
-            with _trace.span("ops.pk_cache_fill", "ops", misses=len(missing)):
-                new_tables, new_oks = self._build(jnp.asarray(enc_p))
-            _engine_metrics().kernel_launches.add(1, "pk_table_build")
+        while True:
+            with self._lock:
+                distinct = list(dict.fromkeys(pubkeys))
+                if len(distinct) > self.capacity:
+                    return None, self.tables, self.oks
+                waits = {
+                    self._pending[pk] for pk in distinct if pk in self._pending
+                }
+                if waits:
+                    pass  # another thread is filling keys we need
+                else:
+                    # Refresh present keys FIRST so eviction below can
+                    # never pop a key this very batch is about to use.
+                    for pk in distinct:
+                        if pk in self._lru:
+                            self._lru.move_to_end(pk)
+                    missing = [pk for pk in distinct if pk not in self._lru]
+                    if not missing:
+                        slots = np.fromiter(
+                            (self._lru[pk] for pk in pubkeys), np.int32
+                        )
+                        return slots, self.tables, self.oks
+                    free = self.capacity - len(self._lru)
+                    evictable = [
+                        pk for pk in self._lru
+                        if pk not in self._pending and pk not in self._pinned
+                    ]  # OrderedDict order = least-recent first
+                    need = max(0, len(missing) - free)
+                    if need > len(evictable):
+                        # every eviction candidate is mid-fill by other
+                        # threads: fall back to the uncached kernel
+                        # instead of waiting on unrelated fills
+                        return None, self.tables, self.oks
+                    for pk in evictable[:need]:
+                        del self._lru[pk]
+                    used = set(self._lru.values())
+                    free_slots = iter(
+                        i for i in range(self.capacity) if i not in used
+                    )
+                    idx = np.fromiter(
+                        (next(free_slots) for _ in missing), np.int32
+                    )
+                    # Reserve: missing keys become pending (waiters
+                    # park until publish); EVERY key of the batch —
+                    # hits included — takes an eviction pin so its
+                    # slot survives until our publish-time snapshot.
+                    event = threading.Event()
+                    for pk, slot in zip(missing, idx):
+                        self._lru[pk] = int(slot)
+                        self._pending[pk] = event
+                    for pk in distinct:
+                        self._pinned[pk] = self._pinned.get(pk, 0) + 1
+            if waits:
+                for ev in waits:
+                    ev.wait()
+                continue  # retry: the fills we waited on moved the LRU
+            # ---- build OUTSIDE the lock (the expensive device call)
+            try:
+                enc = np.frombuffer(b"".join(missing), np.uint8).reshape(-1, 32)
+                (enc_p,) = pad_pow2_rows([enc], len(missing))
+                with _trace.span("ops.pk_cache_fill", "ops", misses=len(missing)):
+                    new_tables, new_oks = self._build(jnp.asarray(enc_p))
+                _engine_metrics().kernel_launches.add(1, "pk_table_build")
+            except BaseException:
+                with self._lock:
+                    for pk in missing:
+                        self._lru.pop(pk, None)
+                        if self._pending.get(pk) is event:
+                            del self._pending[pk]
+                    self._unpin(distinct)
+                event.set()  # waiters retry against the rolled-back state
+                raise
             m = len(missing)
-            self.tables = self.tables.at[idx].set(new_tables[:m])
-            self.oks = self.oks.at[idx].set(new_oks[:m])
-            for pk, slot in zip(missing, idx):
-                self._lru[pk] = int(slot)
-        return np.fromiter((self._lru[pk] for pk in pubkeys), np.int32)
+            with self._lock:
+                self.tables = self.tables.at[idx].set(new_tables[:m])
+                self.oks = self.oks.at[idx].set(new_oks[:m])
+                for pk in missing:
+                    if self._pending.get(pk) is event:
+                        del self._pending[pk]
+                self._unpin(distinct)
+                slots = np.fromiter((self._lru[pk] for pk in pubkeys), np.int32)
+                tables, oks = self.tables, self.oks
+            event.set()
+            return slots, tables, oks
+
+    def _unpin(self, keys) -> None:
+        """Drop one eviction pin per key (lock held by caller)."""
+        for pk in keys:
+            n = self._pinned.get(pk, 0) - 1
+            if n > 0:
+                self._pinned[pk] = n
+            else:
+                self._pinned.pop(pk, None)
 
 
 _PK_CACHE: PubkeyCache | None = None

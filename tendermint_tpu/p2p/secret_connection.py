@@ -40,7 +40,7 @@ except ImportError:  # no `cryptography` wheel: pure-Python primitives
 
 from ..crypto.ed25519 import Ed25519PubKey
 from ..proto import messages as pb
-from ..proto.wire import decode_varint, encode_varint
+from ..proto.wire import encode_varint
 
 DATA_LEN_SIZE = 4
 DATA_MAX_SIZE = 1024

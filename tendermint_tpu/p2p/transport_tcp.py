@@ -82,17 +82,23 @@ class _TokenBucket:
         one-second capacity temporarily raise the cap (tokens go negative
         never — the burst just takes n/rate seconds to accumulate), so a
         frame bigger than a tiny configured rate still eventually sends
-        instead of spinning forever."""
+        instead of spinning forever.
+
+        The throttle wait happens with the lock RELEASED (tmcheck
+        lock-blocking: sleeping under the lock would park every other
+        consumer of this bucket for the whole refill wait instead of
+        letting them take the tokens that ARE available)."""
         cap = max(self.rate, float(n))
-        with self._lock:
-            while True:
+        while True:
+            with self._lock:
                 now = time.monotonic()
                 self._tokens = min(cap, self._tokens + (now - self._last) * self.rate)
                 self._last = now
                 if self._tokens >= n:
                     self._tokens -= n
                     return
-                time.sleep(min(0.1, (n - self._tokens) / self.rate))
+                wait = (n - self._tokens) / self.rate
+            time.sleep(min(0.1, wait))
 
 
 class _ChannelSendState:

@@ -18,7 +18,6 @@ from dataclasses import dataclass, field
 from ..crypto import PrivKey, PubKey
 from ..crypto.ed25519 import Ed25519PrivKey
 from ..proto import messages as pb
-from ..types.canonical import vote_sign_bytes
 from ..types.proposal import Proposal
 from ..types.vote import PRECOMMIT, PREVOTE, Vote
 from ..utils.tmtime import Time

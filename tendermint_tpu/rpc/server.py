@@ -108,6 +108,7 @@ class _WebSocketConnection:
                 header += struct.pack(">Q", n)
             with self._send_lock:
                 try:
+                    # tmcheck: ok[lock-blocking] _send_lock exists to serialize writers on one websocket
                     self.sock.sendall(bytes(header) + payload)
                 except OSError:
                     self.closed.set()
@@ -146,6 +147,7 @@ class _WebSocketConnection:
             if opcode == 0x9:  # ping → pong
                 with self._send_lock:
                     try:
+                        # tmcheck: ok[lock-blocking] _send_lock exists to serialize writers on one websocket
                         self.sock.sendall(bytes([0x8A, len(payload)]) + payload)
                     except OSError:
                         self.closed.set()

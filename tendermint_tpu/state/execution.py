@@ -20,7 +20,7 @@ from ..types.validator_set import Validator
 from ..types.vote import Vote
 from .state import State
 from .store import StateStore
-from .validation import InvalidBlockError, validate_block
+from .validation import validate_block
 
 
 def tx_results_hash(tx_results: list[abci.ExecTxResult]) -> bytes:

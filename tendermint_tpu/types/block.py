@@ -340,9 +340,19 @@ class Commit:
     round: int = 0
     block_id: BlockID = field(default_factory=BlockID)
     signatures: list[CommitSig] = field(default_factory=list)
-    _hash: bytes | None = field(default=None, compare=False, repr=False)
-    # (chain_id, make_commit, make_nil) sign-bytes template cache —
-    # everything but the timestamp is commit-invariant
+    # Guarded memo of hash(): (signatures list identity, length, root).
+    # Unlike ValidatorSet (invalidator contract) and Header (__setattr__
+    # clears), Commit's fields are mutated only by EXTERNAL code — so
+    # the memo re-checks its inputs on every read (the Validator.bytes
+    # discipline): replacing or resizing `signatures` can never serve a
+    # stale root. In-place mutation of an individual CommitSig still
+    # bypasses the guard (nothing in-tree does that; pinned by
+    # test_hash_cache).
+    _hash: tuple | None = field(default=None, compare=False, repr=False)
+    # ((chain_id, height, round, block_id), make_commit, make_nil)
+    # sign-bytes template cache — everything but the timestamp is
+    # commit-invariant, and the guard re-checks every baked-in input so
+    # a mutated commit re-templates instead of signing for stale fields
     _sb_tmpl: tuple | None = field(default=None, compare=False, repr=False)
 
     def size(self) -> int:
@@ -370,9 +380,13 @@ class Commit:
         per-commit template (only the timestamp varies per validator) —
         the host-side hot path of batched commit verification."""
         cs = self.signatures[val_idx]
-        if self._sb_tmpl is None or self._sb_tmpl[0] != chain_id:
+        # block_id compares by VALUE here, and BlockID is frozen — the
+        # only way it changes is wholesale replacement, which the
+        # tuple inequality below catches
+        tmpl_key = (chain_id, self.height, self.round, self.block_id)
+        if self._sb_tmpl is None or self._sb_tmpl[0] != tmpl_key:
             self._sb_tmpl = (
-                chain_id,
+                tmpl_key,
                 vote_sign_bytes_template(
                     chain_id, pb.SIGNED_MSG_TYPE_PRECOMMIT,
                     self.height, self.round, self.block_id.to_proto(),
@@ -393,15 +407,19 @@ class Commit:
         return make(cs.timestamp.seconds, cs.timestamp.nanos)
 
     def hash(self) -> bytes:
-        """Merkle root of CommitSig encodings (ref: types/block.go:900)."""
-        if self._hash is None:
-            self._hash = hash_from_byte_slices(
-                [cs.to_proto().encode() for cs in self.signatures], site="commit"
-            )
-            hash_metrics().cache_events.add(1, "commit", "miss")
-        else:
+        """Merkle root of CommitSig encodings (ref: types/block.go:900).
+        Guarded memo: served only while `signatures` is the same list
+        at the same length (see _hash above)."""
+        c = self._hash
+        if c is not None and c[0] is self.signatures and c[1] == len(self.signatures):
             hash_metrics().cache_events.add(1, "commit", "hit")
-        return self._hash
+            return c[2]
+        root = hash_from_byte_slices(
+            [cs.to_proto().encode() for cs in self.signatures], site="commit"
+        )
+        self._hash = (self.signatures, len(self.signatures), root)
+        hash_metrics().cache_events.add(1, "commit", "miss")
+        return root
 
     def validate_basic(self) -> None:
         """ref: Commit.ValidateBasic (types/block.go:874)."""

@@ -6,6 +6,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 
 import pytest
 
@@ -166,8 +167,14 @@ def test_e2e_ci_live_critical_path(tmp_path, monkeypatch):
         "ci-live.toml must stay kill/pause-only (2-core redial-storm note)"
     )
     monkeypatch.setenv("TM_TPU_TRACE", "1")  # runner env propagates to nodes
+    # lockcheck acceptance rides the same run (docs/static-analysis.md
+    # #lockcheck): every node boots with the lock sanitizer on, the
+    # verdict must stay pass with zero order-inversion cycles, and the
+    # estimated sanitizer overhead must stay within 1% of wall-clock
+    monkeypatch.setenv("TM_TPU_LOCKCHECK", "1")
     runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
     runner.setup()
+    t_run0 = time.monotonic()
     try:
         runner.start(timeout=120)
         runner.start_watch()
@@ -180,11 +187,29 @@ def test_e2e_ci_live_critical_path(tmp_path, monkeypatch):
         runner.wait_for_height(h + 2, timeout=120)
         runner.check_consistency()
     finally:
+        wall_s = time.monotonic() - t_run0
         runner.cleanup()
     report = runner.last_report
     assert report is not None and report["verdict"] == "pass", (
         report and report["gates"]
     )
+    # lockcheck: artifacts from every node, gate judged on real
+    # evidence (not the vacuous pass), no cycles, overhead <= 1%
+    lock_gate = next(g for g in report["gates"] if g["name"] == "lock_order_cycle")
+    assert lock_gate["ok"] and "TM_TPU_LOCKCHECK off" not in lock_gate["detail"], lock_gate
+    lc_fleet = report["fleet"]["lockcheck"]
+    assert report["fleet"]["nodes_with_lockcheck"] >= 4
+    assert lc_fleet["cycles"] == 0, lc_fleet
+    # overhead budget is PER PROCESS (each node pays its own sanitizer
+    # tax against its own lifetime; the fleet sum divided by one
+    # wall-clock would scale with node count, not cost)
+    per_node = [
+        (s["name"], s["lockcheck"]["overhead_s_est"])
+        for s in report["nodes"] if s.get("lockcheck")
+    ]
+    assert per_node and all(o is not None for _n, o in per_node), per_node
+    worst = max(per_node, key=lambda p: p[1])
+    assert worst[1] <= 0.01 * wall_s, (worst, wall_s, per_node)
     # per-node critical paths: every committed height decomposed, the
     # stages tiling the measured interval within the 15% tolerance
     # (anchors judged from partial evidence are flagged, not asserted:

@@ -207,3 +207,58 @@ def test_hash_metrics_cache_events_flow():
     assert count("miss") == miss0 + 1
     assert count("hit") == hit0 + 1
     assert count("invalidate") == inv0 + 1
+
+
+# ------------------------------------------------------------- Commit
+
+
+def _commit(n_sigs: int = 2, height: int = 6, round_: int = 0) -> Commit:
+    return Commit(
+        height=height, round=round_, block_id=BlockID(hash=b"\x21" * 32),
+        signatures=[
+            CommitSig.new_commit(bytes([40 + i]) * 20, Time(1, i), bytes([50 + i]) * 64)
+            for i in range(n_sigs)
+        ],
+    )
+
+
+def test_commit_hash_guard_rechecks_signatures():
+    """tmcheck cache-stale regression: Commit._hash used to memoize with
+    NO invalidation path — resizing or replacing `signatures` after the
+    first hash() served the stale root. The guarded memo re-checks list
+    identity + length on every read."""
+    c = _commit(2)
+    h1 = c.hash()
+    assert c.hash() == h1  # hit path
+    # external append (commit assembly) must recompute
+    c.signatures.append(CommitSig.new_commit(b"\x60" * 20, Time(2, 0), b"\x61" * 64))
+    h2 = c.hash()
+    assert h2 != h1
+    fresh = Commit(height=c.height, round=c.round, block_id=c.block_id,
+                   signatures=list(c.signatures))
+    assert h2 == fresh.hash()
+    # replacing the list entirely must also recompute
+    c.signatures = list(c.signatures[:2])
+    assert c.hash() == _commit(2).hash()
+
+
+def test_commit_sign_bytes_template_rechecks_fields():
+    """The sign-bytes template used to key only on chain_id while
+    baking in height/round/block_id — a mutated commit signed for its
+    OLD fields. The guard now re-checks every baked-in input."""
+    c = _commit(1, height=6, round_=0)
+    sb1 = c.vote_sign_bytes("chain-a", 0)
+    # same inputs: template reused, byte-identical
+    assert c.vote_sign_bytes("chain-a", 0) == sb1
+    # chain change re-templates (pre-existing behavior)
+    assert c.vote_sign_bytes("chain-b", 0) != sb1
+    # round mutation must re-template instead of serving round-0 bytes
+    c.round = 3
+    sb3 = c.vote_sign_bytes("chain-a", 0)
+    assert sb3 != sb1
+    assert sb3 == _commit(1, height=6, round_=3).vote_sign_bytes("chain-a", 0)
+    # height mutation likewise
+    c.height = 7
+    assert c.vote_sign_bytes("chain-a", 0) == _commit(
+        1, height=7, round_=3
+    ).vote_sign_bytes("chain-a", 0)

@@ -258,7 +258,8 @@ def test_empty_run_dir_fails_all_unverifiable_gates(tmp_path):
     # absence passes vacuously (a pre-recorder/pre-tmpath run dir must
     # not fail for lacking them), like missing_series with
     # require_metrics_from_all unset
-    vacuous = ("missing_series", "rate_stall", "churn_storm", "journey_stall")
+    vacuous = ("missing_series", "rate_stall", "churn_storm", "journey_stall",
+               "lock_order_cycle")
     assert all(not g["ok"] for g in report["gates"] if g["name"] not in vacuous)
     assert all(g["ok"] for g in report["gates"] if g["name"] in vacuous)
 

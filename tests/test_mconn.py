@@ -132,3 +132,33 @@ def test_flow_control_bounds_send_rate():
         assert got == payload
         # bucket starts with a 200 KB burst; remaining 100 KB needs >= 0.5s
         assert dt >= 0.4, f"300 KB at 200 KB/s arrived in {dt:.2f}s — no throttling"
+
+
+def test_token_bucket_releases_lock_during_throttle():
+    """tmcheck lock-blocking regression: _TokenBucket.consume used to
+    hold the bucket lock across its refill sleep, parking every other
+    consumer for the full wait. A small consume must now complete while
+    a large one is mid-throttle, and the lock must be acquirable."""
+    from tendermint_tpu.p2p.transport_tcp import _TokenBucket
+
+    bucket = _TokenBucket(rate=100)  # 100 tokens/s, 100-token burst
+    bucket.consume(100)  # drain the initial burst
+    done = threading.Event()
+
+    def big():
+        bucket.consume(95)  # ~1s of refill
+        done.set()
+
+    t = threading.Thread(target=big, daemon=True)
+    t.start()
+    time.sleep(0.15)  # the big consumer is now inside its throttle wait
+    assert not done.is_set()
+    # the lock is free while the big consumer waits (pre-fix: held)
+    assert bucket._lock.acquire(timeout=0.2), "bucket lock held across the throttle sleep"
+    bucket._lock.release()
+    # a small consumer takes available tokens instead of queueing behind
+    t0 = time.monotonic()
+    bucket.consume(1)
+    assert time.monotonic() - t0 < 0.5, "small consume starved behind a throttled one"
+    assert done.wait(timeout=5), "big consume never completed"
+    t.join(timeout=5)

@@ -1,0 +1,803 @@
+"""tmcheck static-analysis + lockcheck sanitizer tests
+(docs/static-analysis.md).
+
+Every rule gets a known-bad fixture that MUST fire and a known-good
+twin that MUST NOT; the baseline drift gate fails both directions; the
+lockcheck sanitizer detects a deliberate two-lock inversion; and the
+tier-1 canary asserts the REAL tree carries zero unsuppressed
+findings — the same condition `scripts/tmcheck.py --check` enforces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tendermint_tpu.check import RULES, run_checks  # noqa: E402
+from tendermint_tpu.check.baseline import (  # noqa: E402
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tendermint_tpu.check.lockcheck import LockCheck, maybe_install  # noqa: E402
+
+
+def _fixture_tree(tmp_path, files: dict) -> str:
+    """Materialize {repo-relative path: source} under tmp_path."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def _findings(tmp_path, files, rules):
+    root = _fixture_tree(tmp_path, files)
+    active, suppressed = run_checks(root, rules=rules, paths=sorted(files))
+    return active, suppressed
+
+
+# ------------------------------------------------------------ lock-blocking
+
+
+BAD_LOCK = '''
+import threading
+import time
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self, sock, app_client):
+        with self._lock:
+            time.sleep(0.1)
+            sock.sendall(b"x")
+            app_client.check_tx(b"t")
+'''
+
+GOOD_LOCK = '''
+import threading
+import time
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self, sock, app_client):
+        with self._lock:
+            n = 1  # short critical section
+        time.sleep(0.1)
+        sock.sendall(b"x")
+        app_client.check_tx(b"t")
+
+    def deferred(self):
+        with self._lock:
+            # nested defs run later, outside this region
+            def cb():
+                time.sleep(1)
+            return cb
+
+    def deferred_lambda(self):
+        with self._lock:
+            self.cb = lambda: time.sleep(1)  # deferred: pruned subtree
+'''
+
+
+def test_lock_blocking_fires_on_bad(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": BAD_LOCK}, ["lock-blocking"]
+    )
+    msgs = [f.message for f in active]
+    assert len(active) == 3, msgs
+    assert any("time.sleep" in m for m in msgs)
+    assert any(".sendall" in m for m in msgs)
+    assert any("check_tx" in m for m in msgs)
+
+
+def test_lock_blocking_quiet_on_good(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": GOOD_LOCK}, ["lock-blocking"]
+    )
+    assert active == []
+
+
+def test_lock_blocking_inline_suppression(tmp_path):
+    src = BAD_LOCK.replace(
+        "            time.sleep(0.1)",
+        "            # tmcheck: ok[lock-blocking] fixture says so\n"
+        "            time.sleep(0.1)",
+    )
+    active, suppressed = _findings(
+        tmp_path, {"tendermint_tpu/x.py": src}, ["lock-blocking"]
+    )
+    assert len(active) == 2  # sendall + check_tx still fire
+    assert len(suppressed) == 1
+
+
+# -------------------------------------------------------------- cache-stale
+
+
+BAD_CACHE = '''
+class Roster:
+    def __init__(self):
+        self.members = []
+        self._hash_cache = None
+
+    def hash(self):
+        h = self._hash_cache
+        if h is not None:
+            return h
+        h = b"".join(self.members)
+        self._hash_cache = h
+        return h
+
+    def add(self, m):
+        self.members.append(m)   # never invalidates: stale hash served
+'''
+
+GOOD_CACHE = BAD_CACHE.replace(
+    "self.members.append(m)   # never invalidates: stale hash served",
+    "self.members.append(m)\n        self._invalidate()",
+) + '''
+    def _invalidate(self):
+        self._hash_cache = None
+'''
+
+# the pre-fix Commit shape: no in-class mutator, but the memo covers an
+# externally mutable dataclass list with no invalidation story
+BAD_CACHE_EXTERNAL = '''
+from dataclasses import dataclass, field
+
+@dataclass
+class Sigs:
+    entries: list = field(default_factory=list)
+    _hash: bytes | None = field(default=None, repr=False)
+
+    def hash(self):
+        if self._hash is None:
+            self._hash = b"".join(self.entries)
+        return self._hash
+'''
+
+# guarded-memo style (Validator.bytes / post-fix Commit.hash): the
+# serve branch re-checks its inputs, so no invalidator is needed
+GOOD_CACHE_GUARDED = '''
+from dataclasses import dataclass, field
+
+@dataclass
+class Sigs:
+    entries: list = field(default_factory=list)
+    _hash: tuple | None = field(default=None, repr=False)
+
+    def hash(self):
+        c = self._hash
+        if c is not None and c[0] is self.entries and c[1] == len(self.entries):
+            return c[2]
+        root = b"".join(self.entries)
+        self._hash = (self.entries, len(self.entries), root)
+        return root
+'''
+
+# private helper covered through an invalidating public caller
+GOOD_CACHE_PRIVATE = '''
+class Roster:
+    def __init__(self):
+        self.members = []
+        self._hash_cache = None
+
+    def hash(self):
+        if self._hash_cache is None:
+            self._hash_cache = b"".join(self.members)
+        return self._hash_cache
+
+    def update(self, ms):
+        self._hash_cache = None
+        self._apply(ms)
+
+    def _apply(self, ms):
+        self.members.extend(ms)
+'''
+
+
+def test_cache_stale_fires_on_missing_invalidation(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": BAD_CACHE}, ["cache-stale"]
+    )
+    assert len(active) == 1
+    assert "Roster.add" in active[0].message
+
+
+def test_cache_stale_quiet_on_invalidating_and_private_covered(tmp_path):
+    for src in (GOOD_CACHE, GOOD_CACHE_PRIVATE, GOOD_CACHE_GUARDED):
+        active, _ = _findings(
+            tmp_path, {"tendermint_tpu/x.py": src}, ["cache-stale"]
+        )
+        assert active == [], (src, [f.message for f in active])
+
+
+def test_cache_stale_fires_on_externally_mutable_memo(tmp_path):
+    """The pre-fix Commit._hash shape: a memoized hash over a public
+    list field with no invalidator/guard/__setattr__."""
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": BAD_CACHE_EXTERNAL}, ["cache-stale"]
+    )
+    assert len(active) == 1
+    assert "externally mutable" in active[0].message
+
+
+# ------------------------------------------------- metric-raise / drift
+
+
+BAD_METRIC_MODULE = '''
+def _never_raise(fn):
+    return fn
+
+class _Metric:
+    pass
+
+class Counter(_Metric):
+    @_never_raise
+    def add(self, d):
+        self._children[()] = d
+
+    def set_raw(self, v):      # mutates without the wrapper
+        self._children[()] = v
+'''
+
+
+def test_metric_raise_requires_wrapper(tmp_path):
+    active, _ = _findings(
+        tmp_path,
+        {"tendermint_tpu/metrics/__init__.py": BAD_METRIC_MODULE},
+        ["metric-raise"],
+    )
+    assert len(active) == 1
+    assert "set_raw" in active[0].message
+
+
+FIXTURE_METRICS = '''
+class FooMetrics:
+    def __init__(self, reg):
+        self.height = reg.gauge("h", "help")
+        self.steps = reg.counter("s", "help", labels=("step",))
+
+class OrphanMetrics:
+    def __init__(self, reg):
+        self.lost = reg.counter("l", "help")
+'''
+
+FIXTURE_METRICSGEN = 'GROUPS = (\n    "FooMetrics",\n)\n'
+
+BAD_METRIC_USE = '''
+class Thing:
+    def __init__(self, metrics):
+        self._metrics = metrics
+
+    def work(self):
+        m = self._metrics
+        m.height.set(3)            # ok: declared, arity 1+0
+        m.heigth.set(3)            # typo: undeclared attribute
+        m.steps.add(1)             # arity: labeled counter needs the label
+        m.steps.add(1, "propose")  # ok
+'''
+
+
+def test_metric_drift_catches_undeclared_attr_arity_and_group(tmp_path):
+    files = {
+        "tendermint_tpu/metrics/__init__.py": FIXTURE_METRICS,
+        "scripts/metricsgen.py": FIXTURE_METRICSGEN,
+        "tendermint_tpu/x.py": BAD_METRIC_USE,
+    }
+    root = _fixture_tree(tmp_path, files)
+    active, _ = run_checks(
+        root, rules=["metric-drift"],
+        paths=["tendermint_tpu/metrics/__init__.py", "tendermint_tpu/x.py"],
+    )
+    msgs = sorted(f.message for f in active)
+    assert len(active) == 3, msgs
+    assert any("heigth" in m for m in msgs)          # undeclared attr
+    assert any("1 positional" in m for m in msgs)    # arity drop
+    assert any("OrphanMetrics" in m for m in msgs)   # unregistered group
+
+
+# --------------------------------------------------------- import-isolation
+
+
+def test_import_isolation_rules(tmp_path):
+    files = {
+        "tendermint_tpu/lens/bad.py": "import jax\nfrom ..consensus import state\n",
+        "tendermint_tpu/lens/good.py": "import json\nfrom ..metrics import Registry\n",
+        "tendermint_tpu/node/fine.py": "import jax\n",  # not an isolated module
+    }
+    root = _fixture_tree(tmp_path, files)
+    active, _ = run_checks(root, rules=["import-isolation"], paths=sorted(files))
+    assert len(active) == 2
+    assert all(f.path == "tendermint_tpu/lens/bad.py" for f in active)
+
+
+def test_isolated_plane_is_importable_without_jax():
+    """check/ joins lens/flight in the bare-box import set: importing
+    the analyzer or sanitizer must not pull jax or the node runtime."""
+    code = (
+        "import sys\n"
+        "import tendermint_tpu.check, tendermint_tpu.check.rules\n"
+        "import tendermint_tpu.check.lockcheck, tendermint_tpu.check.baseline\n"
+        "assert not any(m == 'jax' or m.startswith('jax.') for m in sys.modules)\n"
+        "assert 'tendermint_tpu.ops' not in sys.modules\n"
+        "assert 'tendermint_tpu.node' not in sys.modules\n"
+        "print('CLEAN')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=_ROOT, timeout=120, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0 and "CLEAN" in r.stdout, r.stdout + r.stderr
+
+
+# ------------------------------------------------------------ trace-pairing
+
+
+BAD_TRACE = '''
+from .. import trace as _trace
+
+def work():
+    _trace.span("a", "cat")          # discarded: records nothing
+
+def work2():
+    sp = _trace.span("b", "cat")
+    sp.annotate(x=1)                  # annotated but never entered
+'''
+
+GOOD_TRACE = '''
+from .. import trace as _trace
+
+def work():
+    with _trace.span("a", "cat"):
+        pass
+
+def work2():
+    sp = _trace.span("b", "cat")
+    with sp:
+        sp.annotate(x=1)
+
+def work3(runner):
+    sp = _trace.span("c", "cat")
+    return runner(sp)                 # escapes: the callee enters it
+
+def work4():
+    sp = _trace.span("d", "cat")      # sequential reuse of one name:
+    with sp:                          # EVERY bound call is entered
+        pass
+    sp = _trace.span("e", "cat")
+    with sp:
+        pass
+'''
+
+
+def test_trace_pairing(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/sub/x.py": BAD_TRACE}, ["trace-pairing"]
+    )
+    assert len(active) == 2
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/sub/y.py": GOOD_TRACE}, ["trace-pairing"]
+    )
+    assert active == []
+
+
+# ------------------------------------------------------------ unused-import
+
+
+def test_unused_import(tmp_path):
+    files = {
+        "tendermint_tpu/x.py": (
+            "import os\nimport sys\nimport json  # noqa: F401\n"
+            "from collections import deque, OrderedDict\n"
+            "__all__ = ['OrderedDict']\n"
+            "print(os.sep)\n"
+        ),
+        # __init__.py re-export surfaces are exempt
+        "tendermint_tpu/pkg/__init__.py": "import os\n",
+    }
+    active, _ = _findings(tmp_path, files, ["unused-import"])
+    names = sorted(f.message.split("'")[1] for f in active)
+    assert names == ["deque", "sys"]  # json has noqa; OrderedDict in __all__
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_absorbs_and_detects_drift(tmp_path):
+    root = _fixture_tree(tmp_path, {"tendermint_tpu/x.py": BAD_CACHE})
+    active, _ = run_checks(root, rules=["cache-stale"], paths=["tendermint_tpu/x.py"])
+    assert len(active) == 1
+    write_baseline(root, active)
+    baseline = load_baseline(root)
+    new, stale = diff_baseline(active, baseline)
+    assert new == [] and stale == []
+    # the finding moves lines but keeps its source text: still absorbed
+    (tmp_path / "tendermint_tpu/x.py").write_text("# a comment\n" + BAD_CACHE)
+    active2, _ = run_checks(root, rules=["cache-stale"], paths=["tendermint_tpu/x.py"])
+    new, stale = diff_baseline(active2, baseline)
+    assert new == [] and stale == []
+    # fixing the code strands the baseline entry: stale drift
+    (tmp_path / "tendermint_tpu/x.py").write_text(GOOD_CACHE)
+    active3, _ = run_checks(root, rules=["cache-stale"], paths=["tendermint_tpu/x.py"])
+    new, stale = diff_baseline(active3, baseline)
+    assert new == [] and len(stale) == 1
+
+
+def test_cli_contract_rc0_rc1_rc2(tmp_path):
+    """scripts/tmcheck.py exit codes: 0 clean / 1 findings / 2 usage —
+    the tmlens CLI contract."""
+    script = os.path.join(_ROOT, "scripts", "tmcheck.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, script, *args],
+            capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT,
+        )
+
+    r = run("--no-such-flag")
+    assert r.returncode == 2, r.stderr
+    r = run("--root", str(tmp_path / "nope"))
+    assert r.returncode == 2, r.stderr
+
+    root = _fixture_tree(tmp_path, {
+        "tendermint_tpu/x.py": BAD_CACHE,
+        "tendermint_tpu/metrics/__init__.py": FIXTURE_METRICS,
+        "scripts/metricsgen.py": FIXTURE_METRICSGEN,
+    })
+    r = run("--root", root)
+    assert r.returncode == 1 and "cache-stale" in r.stdout, r.stdout + r.stderr
+    r = run("--root", root, "--write-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = run("--root", root, "--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # fix the code -> the grandfathered entry goes stale -> --check fails,
+    # plain report mode still passes (stale rot only gates --check)
+    (tmp_path / "tendermint_tpu/x.py").write_text(GOOD_CACHE)
+    r = run("--root", root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = run("--root", root, "--check")
+    assert r.returncode == 1 and "STALE" in r.stdout, r.stdout + r.stderr
+
+
+# ------------------------------------------------------------ tier-1 canary
+
+
+def test_tree_has_zero_unsuppressed_findings():
+    """The gate the CLI's --check enforces, in-process: every rule over
+    the real tree, minus inline suppressions and the checked-in
+    baseline, must be silent — and the baseline must carry no stale
+    entries. A new finding fails HERE, in tier-1, naming itself."""
+    active, _suppressed = run_checks(_ROOT)
+    baseline = load_baseline(_ROOT)
+    new, stale = diff_baseline(active, baseline)
+    assert not new, "unsuppressed tmcheck findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, f"stale .tmcheck.toml entries: {stale}"
+
+
+def test_rule_names_are_stable():
+    assert RULES == (
+        "lock-blocking", "cache-stale", "metric-raise", "metric-drift",
+        "import-isolation", "trace-pairing", "unused-import",
+    )
+
+
+# ------------------------------------------------------------- lockcheck
+
+
+def test_lockcheck_disabled_constructs_nothing():
+    before_lock, before_rlock, before_sleep = (
+        threading.Lock, threading.RLock, time.sleep,
+    )
+    assert maybe_install(env={}) is None
+    assert maybe_install(env={"TM_TPU_LOCKCHECK": "0"}) is None
+    assert threading.Lock is before_lock
+    assert threading.RLock is before_rlock
+    assert time.sleep is before_sleep
+
+
+def test_lockcheck_detects_two_lock_inversion(tmp_path):
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=10.0)
+    lc.install()
+    try:
+        # NOTE: distinct lines — the graph nodes are construction
+        # sites, so two locks born on one line alias to one node
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        lc.finalize()
+    finally:
+        lc.uninstall()
+    events = [json.loads(l) for l in open(out)]
+    cycles = [e for e in events if e["kind"] == "lock_order_cycle"]
+    assert len(cycles) == 1, events
+    # the cycle names both construction sites, ring-closed
+    assert len(cycles[0]["cycle"]) >= 2
+    summary = [e for e in events if e["kind"] == "summary"]
+    assert summary and summary[-1]["cycles"] == 1
+    assert summary[-1]["overhead_s_est"] >= 0.0
+
+
+def test_lockcheck_hold_budget_and_sleep_under_lock(tmp_path):
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=0.05)
+    lc.install()
+    try:
+        lk = threading.Lock()
+        with lk:
+            time.sleep(0.08)  # both events: sleep under lock + over budget
+        lc.finalize()
+    finally:
+        lc.uninstall()
+    kinds = [json.loads(l)["kind"] for l in open(out)]
+    assert "blocking_under_lock" in kinds
+    assert "hold_budget" in kinds
+
+
+def test_lockcheck_condition_wait_releases_bookkeeping(tmp_path):
+    """cond.wait() must show the lock as RELEASED: no hold_budget event
+    even though the waiter parks far beyond the budget, and no false
+    blocking_under_lock from the notifier's sleep."""
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=0.1)
+    lc.install()
+    try:
+        cv = threading.Condition()  # over a wrapped RLock
+        woke = []
+
+        def waiter():
+            with cv:
+                woke.append(cv.wait(timeout=2.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.4)  # well past the hold budget, no lock held
+        with cv:
+            cv.notify()
+        t.join()
+        lc.finalize()
+    finally:
+        lc.uninstall()
+    events = [json.loads(l) for l in open(out)]
+    assert woke == [True]
+    assert not [e for e in events if e["kind"] == "hold_budget"], events
+    assert not [e for e in events if e["kind"] == "blocking_under_lock"], events
+
+
+def test_lockcheck_queue_and_fork_surfaces_survive_patching(tmp_path):
+    """The shim must be a drop-in for stdlib consumers: bounded Queue
+    (Condition protocol over a wrapped Lock) and the _at_fork_reinit
+    registration concurrent.futures performs at import."""
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=10.0)
+    lc.install()
+    try:
+        import queue
+
+        q = queue.Queue(maxsize=2)
+        q.put(1)
+        q.put(2)
+        assert q.get() == 1 and q.get() == 2
+        lk = threading.Lock()
+        lk._at_fork_reinit()
+        rl = threading.RLock()
+        rl._at_fork_reinit()
+    finally:
+        lc.uninstall()
+
+
+def test_lockcheck_rlock_contention_keeps_depth_consistent(tmp_path):
+    """Release-side bookkeeping must happen while the inner RLock is
+    still owned: post-release `_depth` writes race a contending
+    thread's acquire and permanently skew the held-stack (phantom
+    order-graph edges). Hammer one RLock from two threads and assert
+    every thread's held stack drained and the unowned-release error
+    surface is intact."""
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=10.0)
+    lc.install()
+    try:
+        rl = threading.RLock()
+
+        def hammer():
+            for _ in range(4000):
+                with rl:
+                    with rl:  # reentrant path too
+                        pass
+
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rl._depth == 0
+        with lc._mu:
+            stacks = [st.stack for st in lc._threads]
+        assert all(s == [] for s in stacks), stacks
+        with pytest.raises(RuntimeError):
+            rl.release()  # unowned release still raises, state untouched
+        assert rl._depth == 0
+    finally:
+        lc.uninstall()
+
+
+# ------------------------------------------------------- lens integration
+
+
+def _lockcheck_node(tmp_path, name: str, records: list) -> None:
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / "lockcheck.jsonl", "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_lens_lock_order_cycle_gate(tmp_path):
+    from tendermint_tpu.lens import analyze_run
+
+    cyc = {
+        "t": 1.0, "kind": "lock_order_cycle",
+        "edge": ["a.py:1", "b.py:2"], "cycle": ["a.py:1", "b.py:2", "a.py:1"],
+        "thread": "T",
+    }
+    summary = {
+        "t": 2.0, "kind": "summary", "sites": 4, "edges": 3, "acquires": 10,
+        "overhead_s_est": 0.001, "cycles": 1, "hold_budget": 0,
+        "blocking_under_lock": 0, "budget_s": 0.25,
+    }
+    _lockcheck_node(tmp_path, "node0", [cyc, summary])
+    report = analyze_run(str(tmp_path))
+    gate = next(g for g in report["gates"] if g["name"] == "lock_order_cycle")
+    assert gate["ok"] is False
+    assert "a.py:1" in gate["detail"]
+    assert report["verdict"] == "fail"
+    assert report["fleet"]["lockcheck"]["cycles"] == 1
+
+    # a raised allowance passes but the detail still SHOWS the cycle
+    # evidence (an override must not read as "no cycles")
+    report = analyze_run(str(tmp_path), gates={"max_lock_order_cycles": 1})
+    gate = next(g for g in report["gates"] if g["name"] == "lock_order_cycle")
+    assert gate["ok"] is True
+    assert "within the max_lock_order_cycles=1 allowance" in gate["detail"]
+    assert "a.py:1" in gate["detail"]
+
+    # clean sanitized node: gate passes and names the graph size
+    _lockcheck_node(tmp_path, "node0", [dict(summary, cycles=0)])
+    report = analyze_run(str(tmp_path))
+    gate = next(g for g in report["gates"] if g["name"] == "lock_order_cycle")
+    assert gate["ok"] is True and "3 graph edges" in gate["detail"]
+
+    # torn tail line (SIGKILL mid-append), valid-JSON-but-wrong-shape
+    # lines, and wrong-typed fields are all tolerated — one corrupt
+    # artifact must never abort the fleet report
+    with open(tmp_path / "node0" / "lockcheck.jsonl", "a") as f:
+        f.write("null\n5\n")
+        f.write('{"t": 2.5, "kind": "hold_budget", "held_s": "oops"}\n')
+        f.write('{"t": 3.0, "kind": "lock_or')
+    report = analyze_run(str(tmp_path))
+    assert next(
+        g for g in report["gates"] if g["name"] == "lock_order_cycle"
+    )["ok"] is True
+
+
+def test_lens_lockcheck_multi_segment_aggregation(tmp_path):
+    """A node restarted into the same home appends a second process
+    segment: additive quantities sum across segment summaries, graph
+    sizes take the largest segment."""
+    from tendermint_tpu.lens.analyze import summarize_lockcheck
+
+    d = tmp_path / "node0"
+    d.mkdir()
+    seg = {"kind": "summary", "t": 1.0, "sites": 10, "edges": 12,
+           "acquires": 100, "overhead_s_est": 0.5, "cycles": 0,
+           "hold_budget": 0, "blocking_under_lock": 0, "budget_s": 0.25}
+    with open(d / "lockcheck.jsonl", "w") as f:
+        f.write(json.dumps(seg) + "\n")
+        f.write(json.dumps(dict(seg, t=2.0, sites=8, edges=20,
+                                acquires=40, overhead_s_est=0.25)) + "\n")
+    lc = summarize_lockcheck(str(d / "lockcheck.jsonl"))
+    assert lc["segments"] == 2
+    assert lc["acquires"] == 140 and lc["overhead_s_est"] == 0.75
+    assert lc["sites"] == 10 and lc["edges"] == 20
+
+
+def test_lens_lock_gate_names_unreadable_artifacts(tmp_path):
+    """Evidence loss must not masquerade as sanitizer-disabled: an
+    artifact that exists but cannot be summarized keeps the vacuous
+    pass (timeline_error precedent) with a detail naming the error."""
+    from tendermint_tpu.lens import analyze_run
+
+    d = tmp_path / "node0"
+    d.mkdir()
+    (d / "lockcheck.jsonl").mkdir()  # opening a directory -> OSError
+    report = analyze_run(str(tmp_path))
+    node = report["nodes"][0]
+    assert node.get("lockcheck") is None and node.get("lockcheck_error")
+    gate = next(g for g in report["gates"] if g["name"] == "lock_order_cycle")
+    assert gate["ok"] is True
+    assert "unreadable" in gate["detail"] and "TM_TPU_LOCKCHECK off" not in gate["detail"]
+
+
+def test_lockcheck_retires_dead_thread_state(tmp_path):
+    """Thread churn must not grow the registry without bound; retired
+    threads fold their counts so total_acquires stays exact."""
+    import gc
+
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=10.0)
+    lc.install()
+    try:
+        lk = threading.Lock()
+
+        def worker():
+            for _ in range(10):
+                with lk:
+                    pass
+
+        for _ in range(5):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            del t
+        gc.collect()
+        with lc._mu:
+            live = len(lc._threads)
+            dead = lc._dead_acquires
+        # each worker's 10 acquires fold on its death (plus bootstrap
+        # acquires — Thread._started.set() goes through a sanitized
+        # condition lock — so >= not ==)
+        assert dead >= 50, (live, dead)
+        assert live <= 1, f"{live} retained thread states after churn"
+        assert lc.total_acquires() >= dead
+    finally:
+        lc.uninstall()
+
+
+def test_lens_lock_gate_vacuous_without_artifacts(tmp_path):
+    from tendermint_tpu.lens import analyze_run
+
+    d = tmp_path / "node0"
+    d.mkdir()
+    (d / "metrics.txt").write_text("tendermint_consensus_height 3\n")
+    report = analyze_run(str(tmp_path))
+    gate = next(g for g in report["gates"] if g["name"] == "lock_order_cycle")
+    assert gate["ok"] is True and "TM_TPU_LOCKCHECK off" in gate["detail"]
+
+
+def test_unknown_gate_key_still_fails_loudly(tmp_path):
+    from tendermint_tpu.lens import analyze_run
+
+    (tmp_path / "node0").mkdir()
+    (tmp_path / "node0" / "metrics.txt").write_text("tendermint_consensus_height 3\n")
+    with pytest.raises(ValueError):
+        analyze_run(str(tmp_path), gates={"max_lock_cyclez": 1})
