@@ -66,6 +66,10 @@ PIPELINE_ITERS = int(os.environ.get("BENCH_ITERS", "8"))
 # published rates exclude the tracer's hot-path overhead and stay
 # comparable across rounds.
 TRACE_DIR = os.environ.get("BENCH_TRACE_DIR", os.path.join(_ROOT, ".bench_traces"))
+# Repetition count for the shared tmperf harness (perf/harness.py):
+# every stage measures repeats independent timed blocks and reports
+# median ± MAD instead of a one-shot rate.
+BENCH_REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 _T0 = time.monotonic()
 
 
@@ -75,6 +79,49 @@ def _remaining():
 
 def _log(msg):
     print(f"# [{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+# tmperf perf ledger (tendermint_tpu/perf/, docs/observability.md#tmperf):
+# every stage appends a canonical record — stage, metric, per-repetition
+# samples, median + MAD, harness shape, environment fingerprint — to
+# .bench_runs/ledger.jsonl (appended ACROSS runs: it is the trajectory
+# `scripts/tmperf.py trend/compare/gate` reads, and the evidence the
+# perf_regression gate holds PRs against). BENCH_PERF=off disables;
+# failures never sink the banked numbers.
+_PERF_RUN = f"bench-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+_DEVICE = "cpu"  # rewritten after the device claim (platform:device_kind)
+
+
+def _perf_record(stage, metric, unit, samples, params=None, device=None, note=None):
+    if os.environ.get("BENCH_PERF", "on") == "off":
+        return
+    try:
+        from tendermint_tpu.perf import append_records, fingerprint, make_record
+
+        out_dir = os.environ.get("BENCH_REPORT_DIR", os.path.join(_ROOT, ".bench_runs"))
+        rec = make_record(
+            stage, metric, unit, samples,
+            run_id=_PERF_RUN, t=time.time(), params=params,
+            provenance="bench", fingerprint=fingerprint(device=device or _DEVICE),
+            note=note,
+        )
+        append_records(os.path.join(out_dir, "ledger.jsonl"), [rec])
+    except Exception as e:  # noqa: BLE001 - telemetry must not sink the run
+        _log(f"perf record failed ({stage}/{metric}): {type(e).__name__}: {e}")
+
+
+def _measure(fn, min_time=0.25, repeats=None):
+    """Median ± MAD rate of fn through the shared tmperf harness:
+    warmed, `repeats` independent repetitions of at-least-
+    min_time/repeats inner loops (perf/harness.py rate_samples).
+    Returns a Samples — .median for ratios, .format() for logs with
+    the noise bound attached."""
+    from tendermint_tpu.perf import rate_samples
+
+    repeats = repeats or BENCH_REPEATS
+    return rate_samples(
+        fn, repeats=repeats, warmup=1, min_time=max(min_time / repeats, 0.03)
+    )
 
 
 # Flight recorder over the whole bench run (metrics/flight.py): the
@@ -154,10 +201,30 @@ def _write_bench_report() -> None:
                 }
         report = {
             "kind": "bench",
+            "run": _PERF_RUN,
             "elapsed_s": round(time.monotonic() - _T0, 1),
             "series": len(exp.names()),
             "histograms": hists,
         }
+        # tmperf: environment fingerprint (slow box vs slow build —
+        # the BENCH_r02/r03 device-kind question as a report field)
+        # plus the ledger digest + baseline comparisons for this dir
+        try:
+            from tendermint_tpu.perf import compare_run, fingerprint, summarize_for_report
+
+            report["fingerprint"] = fingerprint(device=_DEVICE)
+            lpath = os.path.join(out_dir, "ledger.jsonl")
+            if os.path.exists(lpath):
+                perf = summarize_for_report(lpath)
+                perf["comparisons"] = compare_run(perf["records"], perf["baselines"])
+                regs = [c for c in perf["comparisons"] if c["status"] == "regression"]
+                perf["perf_regression"] = {
+                    "ok": not regs,
+                    "regressions": [c["reason"] for c in regs],
+                }
+                report["perf"] = perf
+        except Exception as e:  # noqa: BLE001 - reporting must not sink the run
+            report["perf_error"] = f"{type(e).__name__}: {e}"
         global _FLIGHT
         if _FLIGHT is not None:
             _FLIGHT.stop()
@@ -237,8 +304,9 @@ def bench_cpu(jobs):
     return n / dt
 
 
-def bench_device(jobs, batch, cached: bool = False):
+def bench_device(jobs, batch, cached: bool = False, repeats: int | None = None):
     from tendermint_tpu.ops import verify as V
+    from tendermint_tpu.perf import Samples
 
     dispatch = V.verify_batch_cached_async if cached else V.verify_batch_async
     pks, msgs, sigs = jobs
@@ -246,32 +314,37 @@ def bench_device(jobs, batch, cached: bool = False):
     # Warm-up launch compiles the program (cached across runs); measure
     # steady-state pipelined throughput: every iteration pays full host
     # prep + uint8 H2D + kernel, iterations dispatched async so
-    # transfers overlap compute. Sync once at end. The cached variant
+    # transfers overlap compute. Sync once at end of each repetition
+    # (one repetition = one PIPELINE_ITERS block → one rate sample;
+    # the pipelining inside a block is the thing being measured, so
+    # per-iteration timing would destroy it). The cached variant
     # routes through the HBM pubkey cache (hits after warm-up) — fair
     # vs the CPU baseline, which also pre-expands its keys outside the
     # timed loop (see bench_cpu).
     bitmap = V.collect(dispatch(pks, msgs, sigs))
     assert bool(bitmap.all()), "device rejected valid signatures (warm-up)"
-    t0 = time.perf_counter()
-    inflight = [dispatch(pks, msgs, sigs) for _ in range(PIPELINE_ITERS)]
-    bitmaps = [V.collect(d) for d in inflight]
-    dt = (time.perf_counter() - t0) / PIPELINE_ITERS
-    assert all(bool(b.all()) for b in bitmaps), "device rejected valid signatures"
-    return batch / dt
+    rates = []
+    for _ in range(repeats or BENCH_REPEATS):
+        t0 = time.perf_counter()
+        inflight = [dispatch(pks, msgs, sigs) for _ in range(PIPELINE_ITERS)]
+        bitmaps = [V.collect(d) for d in inflight]
+        dt = (time.perf_counter() - t0) / PIPELINE_ITERS
+        assert all(bool(b.all()) for b in bitmaps), "device rejected valid signatures"
+        rates.append(batch / dt)
+    return Samples(rates, warmup=1)
 
 
-def emit(rate, cpu_rate):
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_batch_verify_throughput",
-                "value": round(rate, 1),
-                "unit": "sigs/sec/chip",
-                "vs_baseline": round(rate / cpu_rate, 3),
-            }
-        ),
-        flush=True,
-    )
+def emit(rate, cpu_rate, mad=None, n=None):
+    doc = {
+        "metric": "ed25519_batch_verify_throughput",
+        "value": round(rate, 1),
+        "unit": "sigs/sec/chip",
+        "vs_baseline": round(rate / cpu_rate, 3),
+    }
+    if mad is not None:
+        doc["mad"] = round(mad, 1)
+        doc["n_samples"] = n
+    print(json.dumps(doc), flush=True)
 
 
 def make_fastsync_chain(n_vals: int = 1000, n_blocks: int = 2):
@@ -344,16 +417,11 @@ def bench_coalesced(jobs, n_callers=4, per_call=256, iters=4):
 
 
 def _rate(fn, min_time=0.25, min_iters=3):
-    """Calls/sec of fn, warmed, at least min_iters and min_time."""
-    fn()
-    iters = 0
-    t0 = time.perf_counter()
-    while True:
-        fn()
-        iters += 1
-        dt = time.perf_counter() - t0
-        if dt >= min_time and iters >= min_iters:
-            return iters / dt
+    """Median calls/sec of fn — back-compat shim over the shared
+    harness (`min_iters` is subsumed: every repetition loops until its
+    time floor, so fast fns get plenty of iterations)."""
+    del min_iters
+    return _measure(fn, min_time=min_time).median
 
 
 def bench_hash():
@@ -388,17 +456,24 @@ def bench_hash():
     rng = random.Random(1234)
     lib = N.load_prep()
     native_ok = lib is not None and hasattr(lib, "tm_merkle_root")
+    backend_name = "native" if native_ok else "python"
     merkle_rates = {}
     for n in (64, 1024, 16384):
         items = [rng.randbytes(40) for _ in range(n)]
-        r_seed = _rate(lambda: seed_recursive_root(items))
-        r_py = _rate(lambda: MK._hash_from_byte_slices_py(items))
-        r_nat = _rate(lambda: N.merkle_root(items)) if native_ok else 0.0
-        merkle_rates[n] = (r_nat, r_py, r_seed)
+        s_seed = _measure(lambda: seed_recursive_root(items))
+        s_py = _measure(lambda: MK._hash_from_byte_slices_py(items))
+        s_nat = _measure(lambda: N.merkle_root(items)) if native_ok else None
+        r_nat = s_nat.median if s_nat else 0.0
+        merkle_rates[n] = (r_nat, s_py.median, s_seed.median)
+        _perf_record(
+            "hash", "merkle_root_per_sec", "roots/s",
+            s_nat if native_ok else s_py,
+            params={"leaves": n, "backend": backend_name},
+        )
         _log(
-            f"merkle root n={n}: native {r_nat:,.0f}/s, python-iter "
-            f"{r_py:,.0f}/s, seed-recursive {r_seed:,.0f}/s"
-            + (f" (native {r_nat / r_seed:.1f}x seed)" if native_ok else "")
+            f"merkle root n={n}: native {s_nat.format() if s_nat else 'n/a'}, "
+            f"python-iter {s_py.format()}, seed-recursive {s_seed.format()}"
+            + (f" (native {r_nat / s_seed.median:.1f}x seed)" if native_ok else "")
         )
 
     from tendermint_tpu.crypto import encoding as _enc
@@ -429,11 +504,16 @@ def bench_hash():
     # never touches merkle, so both are backend-independent; the COLD
     # rate (1000-leaf rebuild) is backend-dependent and is re-measured
     # inside the backend loop below
-    r_vs_seed = _rate(valset_seed)
-    r_vs_cached = _rate(vs.hash, min_iters=10000)
+    s_vs_seed = _measure(valset_seed)
+    s_vs_cached = _measure(vs.hash)
+    r_vs_seed, r_vs_cached = s_vs_seed.median, s_vs_cached.median
+    _perf_record(
+        "hash", "valset_hash_per_sec", "hashes/s", s_vs_cached,
+        params={"validators": 1000, "workload": "cached"},
+    )
     _log(
-        f"ValidatorSet.hash @1000: seed-recompute {r_vs_seed:,.0f}/s, "
-        f"cached {r_vs_cached:,.0f}/s "
+        f"ValidatorSet.hash @1000: seed-recompute {s_vs_seed.format()}, "
+        f"cached {s_vs_cached.format()} "
         f"(cached {r_vs_cached / r_vs_seed:,.0f}x seed)"
     )
 
@@ -467,7 +547,7 @@ def bench_hash():
             cdc_encode(hd.evidence_hash), cdc_encode(hd.proposer_address),
         ])
 
-    r_hd_seed = _rate(header_seed)
+    r_hd_seed = _measure(header_seed).median
     backends = ["native", "python"] if native_ok else ["python"]
     # NOTE on labels: `backend` is the PLANE CONFIG the iteration ran
     # under (native enabled vs TM_TPU_NATIVE=0). The 14-leaf header
@@ -481,19 +561,29 @@ def bench_hash():
         try:
             if backend == "python":
                 os.environ["TM_TPU_NATIVE"] = "0"
-            r_hd_cold = _rate(header_cold)
-            r_hd_cached = _rate(hd.hash, min_iters=10000)
-            r_vs_cold = _rate(valset_cold)
+            s_hd_cold = _measure(header_cold)
+            s_hd_cached = _measure(hd.hash)
+            s_vs_cold = _measure(valset_cold)
         finally:
             if prior is not None:
                 os.environ["TM_TPU_NATIVE"] = prior
             else:
                 os.environ.pop("TM_TPU_NATIVE", None)
+        r_hd_cold, r_hd_cached = s_hd_cold.median, s_hd_cached.median
+        r_vs_cold = s_vs_cold.median
+        _perf_record(
+            "hash", "header_hash_per_sec", "headers/s", s_hd_cold,
+            params={"workload": "cold", "backend": backend},
+        )
+        _perf_record(
+            "hash", "valset_hash_per_sec", "hashes/s", s_vs_cold,
+            params={"validators": 1000, "workload": "cold", "backend": backend},
+        )
         _log(
-            f"Header.hash [{backend}]: cold {r_hd_cold:,.0f}/s (14 leaves "
+            f"Header.hash [{backend}]: cold {s_hd_cold.format()} (14 leaves "
             f"< native cutover: same code path both backends), cached "
-            f"{r_hd_cached:,.0f}/s, seed {r_hd_seed:,.0f}/s; "
-            f"ValidatorSet cold [{backend}]: {r_vs_cold:,.0f}/s"
+            f"{s_hd_cached.format()}, seed {r_hd_seed:,.0f}/s; "
+            f"ValidatorSet cold [{backend}]: {s_vs_cold.format()}"
         )
         r_nat, r_py, r_seed = merkle_rates[1024]
         print(
@@ -503,6 +593,8 @@ def bench_hash():
                     "value": round(r_hd_cold, 1),
                     "unit": "headers/sec (cold recompute; 14-leaf tree is below the native cutover, so backend-independent)",
                     "vs_baseline": round(r_hd_cold / r_hd_seed, 3),
+                    "mad": round(s_hd_cold.mad, 1),
+                    "n_samples": len(s_hd_cold),
                     "backend": backend,
                     "cached_per_sec": round(r_hd_cached, 1),
                     "valset1000_seed_per_sec": round(r_vs_seed, 1),
@@ -607,16 +699,31 @@ def bench_mempool(floods=(1000, 10000, 50000)):
                     pool.check_tx(tx)
                 per_tx_rate = len(per_tx_sample) / (time.perf_counter() - t0)
 
-                pool = mk_pool(mk_client(), flood)
-                t0 = time.perf_counter()
-                out = pool.check_tx_batch(txs)
-                batched_rate = flood / (time.perf_counter() - t0)
-                ok = sum(1 for o in out if not isinstance(o, Exception) and o.is_ok)
-                assert ok == flood, f"flood admitted {ok}/{flood}"
+                # batched admission through the shared harness: one
+                # repetition = one whole flood into a FRESH pool, so
+                # the median carries run-to-run noise, not intra-batch
+                # variance (timer-hygiene: no more one-shot rates)
+                from tendermint_tpu.perf import Samples
+
+                reps = []
+                for _ in range(BENCH_REPEATS):
+                    pool = mk_pool(mk_client(), flood)
+                    t0 = time.perf_counter()
+                    out = pool.check_tx_batch(txs)
+                    dt = time.perf_counter() - t0
+                    ok = sum(1 for o in out if not isinstance(o, Exception) and o.is_ok)
+                    assert ok == flood, f"flood admitted {ok}/{flood}"
+                    reps.append(flood / dt)
+                s_batched = Samples(reps)
+                batched_rate = s_batched.median
                 ratio = batched_rate / per_tx_rate
                 _log(
                     f"mempool flood {flood} [{tname}]: per-tx {per_tx_rate:,.0f} tx/s, "
-                    f"batched {batched_rate:,.0f} tx/s ({ratio:.1f}x)"
+                    f"batched {s_batched.format(0)} tx/s ({ratio:.1f}x)"
+                )
+                _perf_record(
+                    "mempool", "admitted_tx_per_sec", "tx/s", s_batched,
+                    params={"flood": flood, "transport": tname, "mode": "batched"},
                 )
                 last[tname] = (flood, batched_rate, ratio)
                 print(
@@ -626,6 +733,8 @@ def bench_mempool(floods=(1000, 10000, 50000)):
                             "value": round(batched_rate, 1),
                             "unit": f"tx/sec admitted ({tname} transport, {flood}-tx flood)",
                             "vs_baseline": round(ratio, 3),
+                            "mad": round(s_batched.mad, 1),
+                            "n_samples": len(s_batched),
                             "flood": flood,
                             "mode": f"batched_{tname}",
                             "per_tx_baseline": round(per_tx_rate, 1),
@@ -647,19 +756,28 @@ def bench_mempool(floods=(1000, 10000, 50000)):
     # warm the engine outside the timed region (first submit pays the
     # one-shot accelerator probe's jax import + worker thread startup)
     EngineTxPreVerifier()([signed[0]])
+    from tendermint_tpu.perf import Samples
+
     rates = {}
+    s_signed = None
     for mode, env_val in (("engine_on", "auto"), ("engine_off", "off")):
         prior = os.environ.get("TM_TPU_ENGINE")
         os.environ["TM_TPU_ENGINE"] = env_val
         try:
-            pool = mk_pool(
-                LocalClient(KVStoreApplication()), n_signed,
-                pre_verify=EngineTxPreVerifier(),
-            )
-            t0 = time.perf_counter()
-            out = pool.check_tx_batch(signed)
-            rates[f"batched_{mode}"] = n_signed / (time.perf_counter() - t0)
-            assert all(not isinstance(o, Exception) and o.is_ok for o in out)
+            reps = []
+            for _ in range(BENCH_REPEATS):
+                pool = mk_pool(
+                    LocalClient(KVStoreApplication()), n_signed,
+                    pre_verify=EngineTxPreVerifier(),
+                )
+                t0 = time.perf_counter()
+                out = pool.check_tx_batch(signed)
+                reps.append(n_signed / (time.perf_counter() - t0))
+                assert all(not isinstance(o, Exception) and o.is_ok for o in out)
+            s = Samples(reps)
+            if mode == "engine_on":
+                s_signed = s
+            rates[f"batched_{mode}"] = s.median
             pool = mk_pool(
                 LocalClient(KVStoreApplication()), n_signed,
                 pre_verify=EngineTxPreVerifier(),
@@ -678,6 +796,10 @@ def bench_mempool(floods=(1000, 10000, 50000)):
         "mempool signed flood (1024 sig-txs): "
         + ", ".join(f"{k} {v:,.0f} tx/s" for k, v in sorted(rates.items()))
     )
+    _perf_record(
+        "mempool", "admitted_tx_per_sec", "tx/s", s_signed,
+        params={"flood": n_signed, "mode": "engine_on", "signed": True},
+    )
     print(
         json.dumps(
             {
@@ -687,6 +809,8 @@ def bench_mempool(floods=(1000, 10000, 50000)):
                 "vs_baseline": round(
                     rates["batched_engine_on"] / rates["per_tx_engine_off"], 3
                 ),
+                "mad": round(s_signed.mad, 1),
+                "n_samples": len(s_signed),
                 "flood": n_signed,
                 "mode": "batched_engine_on",
                 "per_tx_baseline": round(rates["per_tx_engine_off"], 1),
@@ -740,27 +864,29 @@ def bench_mempool(floods=(1000, 10000, 50000)):
     return last
 
 
-def bench_fastsync(chain):
+def bench_fastsync(chain, repeats: int | None = None):
     """Sequential verify_commit_light over the prebuilt chain — the
     per-block work of blocksync replay (reactor.go:582) on the device
-    batch plane. Returns blocks/sec. The ~667-sig batches pad to the
-    same 1024-row program shapes the sigs/s stages already compiled."""
+    batch plane. Returns blocks/sec Samples (one full-chain pass per
+    repetition). The ~667-sig batches pad to the same 1024-row program
+    shapes the sigs/s stages already compiled."""
     from bench_baseline import CHAIN as BCHAIN
+    from tendermint_tpu.perf import Samples
     from tendermint_tpu.types.validation import verify_commit_light
 
     vals0, c0 = chain[0]
     verify_commit_light(BCHAIN, vals0, c0.block_id, c0.height, c0)  # warm-up
-    iters = 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
+    rates = []
+    for _ in range(repeats or BENCH_REPEATS):
+        t0 = time.perf_counter()
         for vals, commit in chain:
             verify_commit_light(BCHAIN, vals, commit.block_id, commit.height, commit)
-    dt = time.perf_counter() - t0
-    return (iters * len(chain)) / dt
+        rates.append(len(chain) / (time.perf_counter() - t0))
+    return Samples(rates, warmup=1)
 
 
 def main():
-    global BATCHES, PIPELINE_ITERS
+    global BATCHES, PIPELINE_ITERS, _DEVICE
     if len(sys.argv) > 1 and sys.argv[1] == "mempool":
         # targeted device-free run: `python bench.py mempool`
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -768,6 +894,21 @@ def main():
         _flight_mark("mempool")
         bench_mempool()
         _write_bench_report()
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "smoke":
+        # CI-budget device-free perf smoke: micro hash + mempool
+        # stages through the tmperf harness into the perf ledger
+        # (scripts/perf_smoke.py; `scripts/tmperf.py gate` judges it)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from perf_smoke import run_smoke
+
+        run_id, records = run_smoke(log=_log)
+        _write_bench_report()
+        print(json.dumps({
+            "metric": "perf_smoke_records",
+            "value": len(records),
+            "unit": f"ledger records (run {run_id})",
+        }), flush=True)
         sys.exit(0)
     from tendermint_tpu import trace as _tmtrace
 
@@ -909,7 +1050,8 @@ def main():
             pass
     _log("claiming device (jax.devices())...")
     dev = jax.devices()[0]
-    _log(f"claimed: {dev.platform}:{dev.device_kind}")
+    _DEVICE = f"{dev.platform}:{dev.device_kind}"
+    _log(f"claimed: {_DEVICE}")
 
     # Stage 3: bank batches smallest-first; each success re-emits the
     # best rate so far. A stage timeout or error stops escalation but
@@ -924,19 +1066,23 @@ def main():
         try:
             _flight_mark(f"device_b{batch}")
             with stage_deadline(rem - 15 if best else rem):
-                rate = bench_device(jobs, batch)
+                s = bench_device(jobs, batch)
         except StageTimeout:
             _log(f"batch {batch} hit stage deadline; stopping escalation")
             break
         except Exception as e:  # noqa: BLE001 - bank what we have
             _log(f"batch {batch} failed: {type(e).__name__}: {e}")
             break
-        _log(f"batch {batch}: {rate:,.0f} sigs/s pipelined")
+        _log(f"batch {batch}: {s.format(0)} sigs/s pipelined")
+        _perf_record(
+            "engine", "ed25519_batch_verify_throughput", "sigs/sec/chip", s,
+            params={"batch": batch, "cached": False},
+        )
         _save_stage_trace(f"device_b{batch}")
         best_batch = batch
-        if rate > best:
-            best = rate
-            emit(best, cpu_rate)
+        if s.median > best:
+            best = s.median
+            emit(best, cpu_rate, mad=s.mad, n=len(s))
 
     # Stage 4: the HBM-pubkey-cache path at the largest banked batch —
     # production steady state (validator sets repeat every height).
@@ -945,12 +1091,16 @@ def main():
         try:
             _flight_mark("cached")
             with stage_deadline(min(_remaining() - 15, 240)):
-                rate = bench_device(jobs, best_batch, cached=True)
-            _log(f"batch {best_batch} cached: {rate:,.0f} sigs/s pipelined")
+                s = bench_device(jobs, best_batch, cached=True)
+            _log(f"batch {best_batch} cached: {s.format(0)} sigs/s pipelined")
+            _perf_record(
+                "engine", "ed25519_batch_verify_throughput", "sigs/sec/chip", s,
+                params={"batch": best_batch, "cached": True},
+            )
             _save_stage_trace("cached")
-            if rate > best:
-                best = rate
-                emit(best, cpu_rate)
+            if s.median > best:
+                best = s.median
+                emit(best, cpu_rate, mad=s.mad, n=len(s))
         except StageTimeout:
             _log("cached stage hit deadline; keeping uncached result")
         except Exception as e:  # noqa: BLE001
@@ -974,23 +1124,35 @@ def main():
         else:
             dispatch_msm = M.verify_batch_rlc_async
         try:
+            from tendermint_tpu.perf import Samples
+
             _flight_mark("msm")
+            msm_rates = []
             with stage_deadline(min(_remaining() - 15, 300)):
                 h = dispatch_msm(pks, msgs, sigs)
                 assert M.collect_rlc(h), "MSM rejected valid batch (warm-up)"
-                t0 = time.perf_counter()
-                inflight = [
-                    dispatch_msm(pks, msgs, sigs) for _ in range(PIPELINE_ITERS)
-                ]
-                oks = [M.collect_rlc(x) for x in inflight]
-                dt = (time.perf_counter() - t0) / PIPELINE_ITERS
-            assert all(oks), "MSM rejected valid batch"
-            rate = best_batch / dt
-            _log(f"batch {best_batch} msm: {rate:,.0f} sigs/s pipelined")
+                for _ in range(BENCH_REPEATS):
+                    t0 = time.perf_counter()
+                    inflight = [
+                        dispatch_msm(pks, msgs, sigs) for _ in range(PIPELINE_ITERS)
+                    ]
+                    oks = [M.collect_rlc(x) for x in inflight]
+                    dt = (time.perf_counter() - t0) / PIPELINE_ITERS
+                    assert all(oks), "MSM rejected valid batch"
+                    msm_rates.append(best_batch / dt)
+            s = Samples(msm_rates, warmup=1)
+            _log(f"batch {best_batch} msm: {s.format(0)} sigs/s pipelined")
+            _perf_record(
+                "msm", "ed25519_msm_throughput", "sigs/sec/chip", s,
+                params={
+                    "batch": best_batch,
+                    "cached": dispatch_msm is M.verify_batch_rlc_cached_async,
+                },
+            )
             _save_stage_trace("msm")
-            if rate > best:
-                best = rate
-                emit(best, cpu_rate)
+            if s.median > best:
+                best = s.median
+                emit(best, cpu_rate, mad=s.mad, n=len(s))
         except StageTimeout:
             _log("msm stage hit deadline; keeping prior result")
         except Exception as e:  # noqa: BLE001
@@ -1005,17 +1167,24 @@ def main():
         try:
             _flight_mark("fastsync")
             with stage_deadline(min(_remaining() - 15, 240)):
-                blocks_rate = bench_fastsync(fastsync_chain)
+                s = bench_fastsync(fastsync_chain)
             cpu_blocks = cpu_rate / 667.0
-            _log(f"fast-sync: {blocks_rate:,.1f} blocks/s @1000 vals")
+            _log(f"fast-sync: {s.format()} blocks/s @1000 vals")
+            _perf_record(
+                "fastsync", "fast_sync_blocks_per_sec",
+                "blocks/sec/chip @1000 validators", s,
+                params={"validators": 1000},
+            )
             _save_stage_trace("fastsync")
             print(
                 json.dumps(
                     {
                         "metric": "fast_sync_blocks_per_sec",
-                        "value": round(blocks_rate, 2),
+                        "value": round(s.median, 2),
                         "unit": "blocks/sec/chip @1000 validators",
-                        "vs_baseline": round(blocks_rate / cpu_blocks, 3),
+                        "vs_baseline": round(s.median / cpu_blocks, 3),
+                        "mad": round(s.mad, 2),
+                        "n_samples": len(s),
                     }
                 ),
                 flush=True,
@@ -1034,18 +1203,31 @@ def main():
 
     if _engine.engine_enabled() and _remaining() > 45:
         try:
+            from tendermint_tpu.perf import Samples
+
             _flight_mark("coalesced")
             with stage_deadline(min(_remaining() - 15, 240)):
-                rate = bench_coalesced(jobs)
-            _log(f"coalesced 4-caller engine throughput: {rate:,.0f} sigs/s")
+                # each bench_coalesced call warms its own shape
+                # bracket, so one call = one clean repetition
+                s = Samples(
+                    [bench_coalesced(jobs) for _ in range(BENCH_REPEATS)],
+                    warmup=0,
+                )
+            _log(f"coalesced 4-caller engine throughput: {s.format(0)} sigs/s")
+            _perf_record(
+                "coalesced", "coalesced_verify_throughput", "sigs/sec", s,
+                params={"callers": 4, "per_call": 256},
+            )
             _save_stage_trace("coalesced")
             print(
                 json.dumps(
                     {
                         "metric": "coalesced_verify_throughput",
-                        "value": round(rate, 1),
+                        "value": round(s.median, 1),
                         "unit": "sigs/sec (4 concurrent callers x 256)",
-                        "vs_baseline": round(rate / cpu_rate, 3),
+                        "vs_baseline": round(s.median / cpu_rate, 3),
+                        "mad": round(s.mad, 1),
+                        "n_samples": len(s),
                     }
                 ),
                 flush=True,

@@ -1,0 +1,193 @@
+"""Device-free perf smoke stages — the CI-budget tmperf path.
+
+The full bench (bench.py) needs a device claim and most of a
+15-minute budget; CI needs a perf signal it can afford every run.
+These stages time the HOST planes (structural hash, mempool
+admission) with micro workloads and small repeat counts through the
+shared tmperf harness, appending canonical records to the perf
+ledger. Two back-to-back runs of unchanged code must compare clean;
+a real hot-path regression (the memoization breaking, the batched
+admission path degrading to per-tx) lands far outside the noise
+threshold even at this scale.
+
+Noise honesty: within-run MAD cannot see whole-run CPU contention on
+a shared CI box (a neighbor can slow an ENTIRE run's reps together),
+so smoke gating on busy boxes should use a generous relative floor
+(`tmperf gate --min-rel-delta 0.35`) — the compare defaults suit
+quiet boxes and the device bench. docs/observability.md#tmperf.
+
+Used by `scripts/tmperf.py record` and `python bench.py smoke`;
+tier-1 tests drive it with tiny repeats (tests/test_perf.py).
+
+Workload sizes are deliberately pinned in each record's `params`:
+a 2k-tx smoke flood and bench.py's 50k flood are different workloads
+and never gate against each other (perf/record.py record_key).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# the host planes under test never need a device; keep jax (if any
+# stage pulls it in transitively) off the flaky tunnel
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tendermint_tpu.perf import (  # noqa: E402
+    Samples,
+    append_records,
+    fingerprint,
+    make_record,
+    rate_samples,
+)
+
+SMOKE_STAGES = ("hash", "mempool")
+
+
+def default_ledger() -> str:
+    """BENCH_REPORT_DIR-aware (read at call time, like bench.py's
+    report paths): a redirected bench run's smoke records must land in
+    the same dir its report reads the ledger from."""
+    out_dir = os.environ.get("BENCH_REPORT_DIR", os.path.join(_ROOT, ".bench_runs"))
+    return os.path.join(out_dir, "ledger.jsonl")
+
+
+def _measure_hash(repeats: int, min_time: float) -> list[tuple]:
+    """(metric, unit, params, Samples) rows for the structural-hash
+    plane: cold Header.hash (memo invalidated per call) and the
+    1024-leaf merkle root on whichever backend is active."""
+    import random
+
+    from tendermint_tpu import native as N
+    from tendermint_tpu.crypto import merkle as MK
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.utils.tmtime import Time
+
+    hd = Header(
+        chain_id="perf-smoke", height=12345, time=Time(1700000000, 42),
+        last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
+        validators_hash=b"\x03" * 32, next_validators_hash=b"\x04" * 32,
+        consensus_hash=b"\x05" * 32, app_hash=b"\x06" * 32,
+        last_results_hash=b"\x07" * 32, evidence_hash=b"\x08" * 32,
+        proposer_address=b"\x09" * 20,
+    )
+
+    def header_cold():
+        hd.height = 12345  # any field write invalidates the memo
+        hd.hash()
+
+    lib = N.load_prep()
+    backend = "native" if lib is not None and hasattr(lib, "tm_merkle_root") else "python"
+    rng = random.Random(1234)
+    items = [rng.randbytes(40) for _ in range(1024)]
+    root = (lambda: N.merkle_root(items)) if backend == "native" else (
+        lambda: MK._hash_from_byte_slices_py(items)
+    )
+    # warmup=2: the first measured call after import still pays
+    # allocator/cache warmth — visible as a 20%-low first rep on a
+    # busy CI box
+    return [
+        (
+            "header_hash_per_sec", "headers/s", {"workload": "cold"},
+            rate_samples(header_cold, repeats=repeats, warmup=2, min_time=min_time),
+        ),
+        (
+            "merkle_root_per_sec", "roots/s",
+            {"leaves": 1024, "backend": backend},
+            rate_samples(root, repeats=repeats, warmup=2, min_time=min_time),
+        ),
+    ]
+
+
+def _measure_mempool(repeats: int, min_time: float, flood: int) -> list[tuple]:
+    """Batched admission (check_tx_batch: native batch hashing + one
+    pipelined ABCI round + single-lock settle) of a `flood`-tx flood
+    into a fresh pool per repetition — the PR-6 write path's smoke
+    signal."""
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.mempool.mempool import TxMempool
+
+    txs = [b"smoke-%d=%d" % (i, i) for i in range(flood)]
+
+    def admit():
+        pool = TxMempool(
+            LocalClient(KVStoreApplication()),
+            size=flood + flood // 4, cache_size=2 * flood + 1000,
+        )
+        out = pool.check_tx_batch(txs)
+        ok = sum(1 for o in out if not isinstance(o, Exception) and o.is_ok)
+        assert ok == flood, f"smoke flood admitted {ok}/{flood}"
+        return flood  # units of work this call performed
+
+    return [
+        (
+            "admitted_tx_per_sec", "tx/s",
+            {"flood": flood, "transport": "local", "mode": "batched"},
+            # min_time=0: each repetition is exactly one flood —
+            # repeats carry the noise model, not inner-loop padding
+            rate_samples(admit, repeats=repeats, warmup=1, min_time=0.0),
+        ),
+    ]
+
+
+def run_smoke(
+    stages=None,
+    repeats: int = 5,
+    min_time: float = 0.1,
+    ledger_path: str | None = None,
+    inject: dict | None = None,
+    note: str | None = None,
+    run_id: str | None = None,
+    flood: int = 2000,
+    log=None,
+) -> tuple[str, list[dict]]:
+    """Run the device-free smoke stages, append canonical records to
+    the ledger, return (run_id, records).
+
+    `inject` maps stage -> fractional slowdown (0.3 = 30% slower) and
+    scales the measured samples down before recording — the
+    documented hook tests and the acceptance demo use to prove the
+    gate trips on a real delta without de-optimizing the code."""
+    stages = list(stages) if stages else list(SMOKE_STAGES)
+    unknown = set(stages) - set(SMOKE_STAGES)
+    if unknown:
+        raise ValueError(f"unknown smoke stages: {sorted(unknown)} (have {SMOKE_STAGES})")
+    # ns suffix: two record calls in the same second (tests, scripted
+    # demos) must be two runs, not one merged run group
+    run_id = run_id or (
+        f"smoke-{time.strftime('%Y%m%d-%H%M%S')}-{time.time_ns() % 1_000_000_000}"
+    )
+    ledger_path = ledger_path or default_ledger()
+    fp = fingerprint(device="cpu")
+    records = []
+    for stage in stages:
+        rows = (
+            _measure_hash(repeats, min_time)
+            if stage == "hash"
+            else _measure_mempool(repeats, min_time, flood)
+        )
+        slow_frac = float((inject or {}).get(stage, 0.0))
+        for metric, unit, params, samples in rows:
+            if slow_frac:
+                samples = Samples(
+                    [v * (1.0 - slow_frac) for v in samples.values],
+                    warmup=samples.warmup,
+                )
+            rec = make_record(
+                stage, metric, unit, samples,
+                run_id=run_id, t=time.time(), params=params,
+                provenance="smoke", fingerprint=fp,
+                note=note or (f"injected {slow_frac:.0%} slowdown" if slow_frac else None),
+            )
+            records.append(rec)
+            if log is not None:
+                log(f"{stage}/{metric} {params}: {samples.format()}"
+                    + (f"  [injected -{slow_frac:.0%}]" if slow_frac else ""))
+    append_records(ledger_path, records)
+    return run_id, records

@@ -1,0 +1,514 @@
+"""tmperf CLI — the performance-regression observatory
+(docs/observability.md#tmperf, tendermint_tpu/perf/).
+
+Answers "did PR N make stage X faster, and is the claim bigger than
+box noise?" in one command. Exit codes follow the tmlens contract:
+0 = pass/ok, 1 = a gate/regression tripped, 2 = usage or no data.
+
+Usage:
+  python scripts/tmperf.py record [--stages hash,mempool] [--repeats N]
+      [--min-time S] [--flood N] [--ledger PATH] [--note S]
+      [--inject stage:frac[,stage:frac]] [--json]
+      Run the device-free smoke stages (scripts/perf_smoke.py) through
+      the shared warmup/repeat/median harness and append canonical
+      records to the perf ledger. --inject scales a stage's measured
+      samples down by the given fraction (0.3 = 30% slower) — the
+      documented hook for proving the gate trips without
+      de-optimizing code.
+
+  python scripts/tmperf.py compare [--ledger PATH] [--baselines PATH]
+      [--run RUN] [--min-samples N] [--noise-mads X] [--min-rel-delta X]
+      [--json]
+      Compare a run's records (default: the latest non-backfill run)
+      against the blessed baseline floors, one row per key with the
+      noise-aware verdict: ok / regression / improved / refused
+      (small sample) / informational (cross- or unknown fingerprint)
+      / no_baseline. rc 1 iff any row is a regression.
+
+  python scripts/tmperf.py gate [--check] [compare flags]
+      The perf_regression verdict (same comparison math as the lens
+      gate — perf/compare.py, one copy). --check additionally fails
+      when a blessed stage emitted NO record in the latest run: a
+      stage that silently stops measuring must fail loudly, not pass
+      vacuously. rc 0 pass, 1 regression/drift, 2 no data.
+
+  python scripts/tmperf.py trend [--ledger PATH] [--stage S]
+      [--metric M] [--json]
+      Per-(stage, metric) history over the whole ledger — backfilled
+      BENCH_r01–r05 rounds included — as a table + sparkline.
+
+  python scripts/tmperf.py backfill [--bench-dir DIR] [--ledger PATH]
+      Parse the salvageable rate lines out of the committed
+      BENCH_r*.json stdout captures into ledger records tagged
+      provenance=backfill (fingerprint unknown => informational-only,
+      never gated). Idempotent: rounds already in the ledger are
+      skipped.
+
+  python scripts/tmperf.py bless [--ledger PATH] [--baselines PATH]
+      [--stages s1,s2] [--note S]
+      Write the latest run's records into the baselines file as the
+      new blessed floors. Run after an INTENTIONAL perf change and
+      commit the diff (docs/observability.md#tmperf).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+# scripts/ itself, so `from perf_smoke import ...` resolves both under
+# __main__ and when tests import this module via importlib
+sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+
+from tendermint_tpu.perf import (  # noqa: E402
+    COMPARE_DEFAULTS,
+    append_records,
+    bless,
+    compare_run,
+    coverage_gaps,
+    default_baselines_path,
+    latest_run,
+    load_baselines,
+    make_record,
+    read_ledger,
+    render_trend,
+    run_groups,
+    save_baselines,
+)
+
+
+def _default_ledger() -> str:
+    # BENCH_REPORT_DIR-aware, read per call — same resolution as
+    # bench.py's report paths and perf_smoke.default_ledger()
+    out_dir = os.environ.get("BENCH_REPORT_DIR", os.path.join(_ROOT, ".bench_runs"))
+    return os.path.join(out_dir, "ledger.jsonl")
+
+# salvageable stderr lines in the BENCH_r* tails (bench.py _log format)
+_RE_BATCH = re.compile(
+    r"batch (?P<batch>\d+)(?P<cached> cached| msm)?: (?P<rate>[\d,]+(?:\.\d+)?) sigs/s"
+)
+_RE_FASTSYNC = re.compile(r"fast-sync: (?P<rate>[\d,]+(?:\.\d+)?) blocks/s")
+
+# metric name -> stage for the banked JSON lines
+_METRIC_STAGE = {
+    "ed25519_batch_verify_throughput": "engine",
+    "fast_sync_blocks_per_sec": "fastsync",
+    "header_hash_per_sec": "hash",
+    "admitted_tx_per_sec": "mempool",
+    "coalesced_verify_throughput": "coalesced",
+}
+
+
+def _parse_flags(args, flags: dict, positional: int = 0):
+    """Shared hand-rolled flag loop (the tmlens style): `flags` maps
+    '--name' -> ('key', converter|None for boolean). Returns (opts,
+    positionals) or raises ValueError."""
+    opts: dict = {}
+    pos: list[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a in flags:
+            key, conv = flags[a]
+            if conv is None:
+                opts[key] = True
+                i += 1
+            else:
+                if i + 1 >= len(args):
+                    raise ValueError(f"{a} needs a value")
+                opts[key] = conv(args[i + 1])
+                i += 2
+        elif a.startswith("-"):
+            raise ValueError(f"unknown flag {a!r}")
+        elif len(pos) < positional:
+            pos.append(a)
+            i += 1
+        else:
+            raise ValueError(f"unexpected argument {a!r}")
+    return opts, pos
+
+
+def _parse_inject(spec: str) -> dict:
+    out = {}
+    for part in spec.split(","):
+        stage, _, frac = part.partition(":")
+        if not stage or not frac:
+            raise ValueError(f"--inject wants stage:frac, got {part!r}")
+        out[stage.strip()] = float(frac)
+    return out
+
+
+def cmd_record(args) -> int:
+    try:
+        opts, _ = _parse_flags(args, {
+            "--stages": ("stages", lambda s: [x.strip() for x in s.split(",") if x.strip()]),
+            "--repeats": ("repeats", int),
+            "--min-time": ("min_time", float),
+            "--flood": ("flood", int),
+            "--ledger": ("ledger", str),
+            "--note": ("note", str),
+            "--inject": ("inject", _parse_inject),
+            "--json": ("json", None),
+        })
+    except ValueError as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    from perf_smoke import run_smoke
+
+    try:
+        run_id, records = run_smoke(
+            stages=opts.get("stages"),
+            repeats=opts.get("repeats", 5),
+            min_time=opts.get("min_time", 0.1),
+            ledger_path=opts.get("ledger") or _default_ledger(),
+            inject=opts.get("inject"),
+            note=opts.get("note"),
+            flood=opts.get("flood", 2000),
+            log=None if opts.get("json") else (lambda m: print(f"  {m}")),
+        )
+    except (ValueError, AssertionError) as e:
+        print(f"record failed: {e}", file=sys.stderr)
+        return 2
+    if opts.get("json"):
+        print(json.dumps({"run": run_id, "records": records}, indent=1))
+    else:
+        ledger = opts.get("ledger") or _default_ledger()
+        print(f"recorded run {run_id}: {len(records)} records -> {ledger}")
+    return 0
+
+
+def _compare_opts(args, extra: dict | None = None):
+    flags = {
+        "--ledger": ("ledger", str),
+        "--baselines": ("baselines", str),
+        "--run": ("run", str),
+        "--min-samples": ("perf_min_samples", int),
+        "--noise-mads": ("perf_noise_mads", float),
+        "--min-rel-delta": ("perf_min_rel_delta", float),
+        "--json": ("json", None),
+    }
+    flags.update(extra or {})
+    return _parse_flags(args, flags)
+
+
+def _resolve_baselines_path(opts, ledger: str) -> str:
+    """ONE baseline-path resolution for compare/gate/bless: explicit
+    --baselines, else a baselines.json sibling of the ledger when one
+    exists (a run dir pins its own floors — ledger.py), else the
+    committed package file. bless WRITES through the same resolution,
+    so a blessed floor is always the floor the next gate reads."""
+    if opts.get("baselines"):
+        return opts["baselines"]
+    sibling = os.path.join(os.path.dirname(os.path.abspath(ledger)), "baselines.json")
+    return sibling if os.path.exists(sibling) else default_baselines_path()
+
+
+def _load_run(opts) -> tuple[str | None, list, dict, str, str]:
+    """(run_id, records, baselines, baselines_path, error)."""
+    ledger = opts.get("ledger") or _default_ledger()
+    bpath = _resolve_baselines_path(opts, ledger)
+    if not os.path.exists(ledger):
+        return None, [], {}, bpath, f"no ledger at {ledger} (run `tmperf record` first)"
+    records = read_ledger(ledger)
+    if opts.get("run"):
+        runs = run_groups(records)
+        if opts["run"] not in runs:
+            return None, [], {}, bpath, f"run {opts['run']!r} not in ledger ({len(runs)} runs)"
+        run_id, latest = opts["run"], runs[opts["run"]]
+    else:
+        run_id, latest = latest_run(records)
+    if not latest:
+        return None, [], {}, bpath, "ledger holds no gateable (non-backfill) run"
+    try:
+        baselines = load_baselines(bpath)
+    except (OSError, ValueError) as e:
+        return None, [], {}, bpath, f"bad baselines file: {e}"
+    return run_id, latest, baselines, bpath, ""
+
+
+def cmd_compare(args, gate_mode: bool = False) -> int:
+    try:
+        opts, _ = _compare_opts(args, {"--check": ("check", None)} if gate_mode else None)
+    except ValueError as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    run_id, records, baselines, _bpath, err = _load_run(opts)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+    thresholds = {
+        "min_samples": opts.get("perf_min_samples", COMPARE_DEFAULTS["perf_min_samples"]),
+        "noise_mads": opts.get("perf_noise_mads", COMPARE_DEFAULTS["perf_noise_mads"]),
+        "min_rel_delta": opts.get("perf_min_rel_delta", COMPARE_DEFAULTS["perf_min_rel_delta"]),
+    }
+    comps = compare_run(records, baselines, **thresholds)
+    regs = [c for c in comps if c["status"] == "regression"]
+    gaps = coverage_gaps(records, baselines) if gate_mode and opts.get("check") else []
+    if opts.get("json"):
+        print(json.dumps({
+            "run": run_id, "comparisons": comps,
+            "regressions": len(regs), "coverage_gaps": gaps,
+        }, indent=1))
+    else:
+        print(f"run {run_id} vs {len(baselines)} blessed floors:")
+        for c in comps:
+            mark = {"regression": "FAIL", "improved": "FAST"}.get(c["status"], "  ok")
+            if c["status"] in ("refused", "informational", "no_baseline"):
+                mark = "  --"
+            print(f"  [{mark}] {c['key']}: {c['status']} — {c.get('reason')}")
+        for key in gaps:
+            print(f"  [FAIL] {key}: blessed but the run emitted NO record "
+                  "(stage went silent — re-measure or un-bless)")
+    if regs:
+        if not opts.get("json"):
+            print(f"PERF REGRESSION: {len(regs)} stage(s) slower than blessed "
+                  "floors beyond noise", file=sys.stderr)
+        return 1
+    if gaps:
+        if not opts.get("json"):
+            print(f"PERF COVERAGE DRIFT: {len(gaps)} blessed key(s) unmeasured",
+                  file=sys.stderr)
+        return 1
+    if not opts.get("json") and gate_mode:
+        print("perf_regression: PASS")
+    return 0
+
+
+def cmd_trend(args) -> int:
+    try:
+        opts, _ = _parse_flags(args, {
+            "--ledger": ("ledger", str),
+            "--stage": ("stage", str),
+            "--metric": ("metric", str),
+            "--json": ("json", None),
+        })
+    except ValueError as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    ledger = opts.get("ledger") or _default_ledger()
+    if not os.path.exists(ledger):
+        print(f"no ledger at {ledger}", file=sys.stderr)
+        return 2
+    records = read_ledger(ledger)
+    if opts.get("json"):
+        from tendermint_tpu.perf import trend_series
+
+        print(json.dumps(
+            trend_series(records, stage=opts.get("stage"), metric=opts.get("metric")),
+            indent=1,
+        ))
+    else:
+        print(render_trend(records, stage=opts.get("stage"), metric=opts.get("metric")))
+    return 0
+
+
+def _backfill_round(obj: dict, run_id: str, t: float) -> list[dict]:
+    """Canonical records salvaged from one BENCH_r* round capture:
+    the banked JSON lines (incl. any inside the tail) plus the
+    stderr-log rate lines the JSON never carried (msm, cached)."""
+    # key -> (stage, metric, unit, params, value); later lines win.
+    # Params are mapped to the SAME shapes bench.py's live
+    # _perf_record calls emit, so `tmperf trend` connects the
+    # backfilled history to new runs instead of rendering disjoint
+    # series (record_key includes params). The banked engine headline
+    # carries no batch size, so it stays its own best-banked series.
+    found: dict[tuple, tuple] = {}
+
+    def note_metric(line_obj: dict) -> None:
+        stage = _METRIC_STAGE.get(line_obj.get("metric"))
+        if stage is None or not isinstance(line_obj.get("value"), (int, float)):
+            return
+        params: dict = {}
+        if stage == "fastsync":
+            params = {"validators": 1000}
+        elif stage == "coalesced":
+            params = {"callers": 4, "per_call": 256}
+        elif stage == "hash":
+            params = {"workload": "cold"}  # the JSON line IS the cold rate
+            if "backend" in line_obj:
+                params["backend"] = line_obj["backend"]
+        elif stage == "mempool":
+            if "flood" in line_obj:
+                params["flood"] = line_obj["flood"]
+            mode = line_obj.get("mode") or ""
+            if mode in ("batched_local", "batched_socket"):
+                params["transport"] = mode.split("_", 1)[1]
+                params["mode"] = "batched"
+            elif mode == "batched_engine_on":
+                params["mode"] = "engine_on"
+                params["signed"] = True
+            elif mode:
+                params["mode"] = mode
+        found[(stage, line_obj["metric"], tuple(sorted(params.items())))] = (
+            stage, line_obj["metric"], line_obj.get("unit", ""), params,
+            float(line_obj["value"]),
+        )
+
+    if isinstance(obj.get("parsed"), dict):
+        note_metric(obj["parsed"])
+    for line in (obj.get("tail") or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                note_metric(json.loads(line))
+            except ValueError:
+                pass
+            continue
+        m = _RE_BATCH.search(line)
+        if m:
+            kind = (m.group("cached") or "").strip()
+            stage = "msm" if kind == "msm" else "engine"
+            metric = (
+                "ed25519_msm_throughput" if stage == "msm"
+                else "ed25519_batch_verify_throughput"
+            )
+            # `cached` matches the live records: engine lines say it
+            # explicitly; the r04/r05 msm rounds ran the production-
+            # default cache gates (pk + msm caches on), which is what
+            # bench.py's live msm record reports as cached=True
+            params = {
+                "batch": int(m.group("batch")),
+                "cached": kind in ("cached", "msm"),
+            }
+            rate = float(m.group("rate").replace(",", ""))
+            found[(stage, metric, tuple(sorted(params.items())))] = (
+                stage, metric, "sigs/sec/chip", params, rate,
+            )
+            continue
+        m = _RE_FASTSYNC.search(line)
+        if m:
+            rate = float(m.group("rate").replace(",", ""))
+            params = {"validators": 1000}
+            found[("fastsync", "fast_sync_blocks_per_sec",
+                   tuple(sorted(params.items())))] = (
+                "fastsync", "fast_sync_blocks_per_sec",
+                "blocks/sec/chip @1000 validators", params, rate,
+            )
+    return [
+        make_record(
+            stage, metric, unit, [value],
+            run_id=run_id, t=t, params=params,
+            provenance="backfill", fingerprint=None,
+            note="backfilled from raw stdout capture; single sample, "
+                 "fingerprint unknown — informational only",
+        )
+        for stage, metric, unit, params, value in found.values()
+    ]
+
+
+def cmd_backfill(args) -> int:
+    try:
+        opts, _ = _parse_flags(args, {
+            "--bench-dir": ("bench_dir", str),
+            "--ledger": ("ledger", str),
+        })
+    except ValueError as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    bench_dir = opts.get("bench_dir", _ROOT)
+    ledger = opts.get("ledger") or _default_ledger()
+    files = sorted(
+        f for f in os.listdir(bench_dir)
+        if re.fullmatch(r"BENCH_r\d+\.json", f)
+    )
+    if not files:
+        print(f"no BENCH_r*.json captures in {bench_dir}", file=sys.stderr)
+        return 2
+    existing = set()
+    if os.path.exists(ledger):
+        existing = set(run_groups(read_ledger(ledger)))
+    total = 0
+    decoder = json.JSONDecoder()
+    for fname in files:
+        run_id = fname.rsplit(".", 1)[0]
+        if run_id in existing:
+            print(f"  {run_id}: already in ledger, skipped")
+            continue
+        path = os.path.join(bench_dir, fname)
+        with open(path) as f:
+            text = f.read()
+        # the captures are CONCATENATED json objects (no separators):
+        # raw_decode in a loop, skipping garbage between objects
+        objs, idx = [], 0
+        while idx < len(text):
+            while idx < len(text) and text[idx] not in "{[":
+                idx += 1
+            if idx >= len(text):
+                break
+            try:
+                obj, end = decoder.raw_decode(text, idx)
+            except ValueError:
+                idx += 1
+                continue
+            idx = end
+            if isinstance(obj, dict):
+                objs.append(obj)
+        recs = []
+        for obj in objs:
+            recs.extend(_backfill_round(obj, run_id, os.path.getmtime(path)))
+        if recs:
+            append_records(ledger, recs)
+            total += len(recs)
+            print(f"  {run_id}: {len(recs)} records "
+                  f"({', '.join(sorted({r['stage'] for r in recs}))})")
+        else:
+            print(f"  {run_id}: nothing salvageable (rc={objs[0].get('rc') if objs else '?'})")
+    print(f"backfilled {total} records -> {ledger}")
+    return 0
+
+
+def cmd_bless(args) -> int:
+    try:
+        opts, _ = _parse_flags(args, {
+            "--ledger": ("ledger", str),
+            "--baselines": ("baselines", str),
+            "--stages": ("stages", lambda s: [x.strip() for x in s.split(",") if x.strip()]),
+            "--note": ("note", str),
+            "--run": ("run", str),
+        })
+    except ValueError as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    run_id, records, baselines, bpath, err = _load_run(opts)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+    updated = bless(records, baselines, stages=opts.get("stages"), note=opts.get("note"))
+    new = {k for k in updated if k not in baselines or updated[k] != baselines[k]}
+    save_baselines(bpath, updated)
+    print(f"blessed run {run_id}: {len(new)} floor(s) updated -> {bpath}")
+    for k in sorted(new):
+        e = updated[k]
+        print(f"  {k}: median {e['median']:,} ±{e['mad']:,} (n={e['n']}, fp {e['fp']})")
+    return 0
+
+
+def main(argv) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "record":
+        return cmd_record(rest)
+    if cmd == "compare":
+        return cmd_compare(rest)
+    if cmd == "gate":
+        return cmd_compare(rest, gate_mode=True)
+    if cmd == "trend":
+        return cmd_trend(rest)
+    if cmd == "backfill":
+        return cmd_backfill(rest)
+    if cmd == "bless":
+        return cmd_bless(rest)
+    print(f"unknown command {cmd!r} "
+          "(try: record | compare | gate | trend | backfill | bless)",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

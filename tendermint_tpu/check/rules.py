@@ -789,13 +789,15 @@ class _MetricDriftRule:
 
 # Modules that must stay importable (and import-light) on bare CI
 # boxes — the artifact-reading / analysis plane.
-_ISOLATED_PREFIXES = ("tendermint_tpu/lens/", "tendermint_tpu/check/")
+_ISOLATED_PREFIXES = (
+    "tendermint_tpu/lens/", "tendermint_tpu/check/", "tendermint_tpu/perf/",
+)
 _ISOLATED_FILES = ("tendermint_tpu/metrics/flight.py",)
 # Absolute top-level packages the isolated set must never touch.
 _FORBIDDEN_TOP = {"jax", "jaxlib"}
 # tendermint_tpu subpackages the isolated set MAY import; everything
 # else under tendermint_tpu is node runtime.
-_ALLOWED_SUBPACKAGES = {"lens", "check", "metrics", "trace", "utils"}
+_ALLOWED_SUBPACKAGES = {"lens", "check", "metrics", "perf", "trace", "utils"}
 
 
 def _isolated(path: str) -> bool:

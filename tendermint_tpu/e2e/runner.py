@@ -133,11 +133,19 @@ class Runner:
         if os.path.isdir(self.base_dir):
             entries = os.listdir(self.base_dir)
             # a previous testnet is recognized by its layout (every
-            # entry is a node home with config/), independent of THIS
-            # manifest's node names — refuse anything else (protects
-            # against pointing the runner at an unrelated directory)
+            # entry is a node home with config/, or a run artifact the
+            # runner/analyzer itself writes into the base dir),
+            # independent of THIS manifest's node names — refuse
+            # anything else (protects against pointing the runner at
+            # an unrelated directory)
+            run_artifacts = {
+                "fleet_report.json", "fleet_trace.json", "env_fingerprint.json",
+            }
             looks_like_testnet = all(
-                os.path.isdir(os.path.join(self.base_dir, e, "config")) for e in entries
+                e in run_artifacts
+                if os.path.isfile(os.path.join(self.base_dir, e))
+                else os.path.isdir(os.path.join(self.base_dir, e, "config"))
+                for e in entries
             )
             if entries and not looks_like_testnet:
                 raise ValueError(
@@ -273,6 +281,19 @@ class Runner:
                     f"builtin:kvstore:snapshot={self.manifest.snapshot_interval}"
                 )
             cfg.save()
+
+        # tmperf environment fingerprint, persisted AT RUN TIME: the
+        # fleet report's post-mortem reader (possibly on another box)
+        # must be able to tell a slow box from a slow build — the
+        # BENCH_r02/r03 CPU-emulation fallback would have been one
+        # device-kind line here, not an XLA error-tail excavation.
+        try:
+            from ..perf.record import fingerprint
+
+            with open(os.path.join(self.base_dir, "env_fingerprint.json"), "w") as f:
+                json.dump(fingerprint(), f, indent=1)
+        except Exception as e:  # noqa: BLE001 - telemetry must not sink setup
+            self.log(f"env fingerprint failed: {type(e).__name__}: {e}")
 
     def _peer_addr(self, dialer: E2ENode, target: E2ENode) -> str:
         """target's address as `dialer` should dial it: direct, or via a

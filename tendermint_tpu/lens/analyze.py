@@ -385,6 +385,42 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
     )
 
     report = {"run_dir": os.path.abspath(run_dir), "nodes": summaries, "fleet": fleet}
+
+    # environment fingerprint (tmperf): a post-mortem must be able to
+    # tell a slow box from a slow build. Prefer the artifact the
+    # runner persisted AT RUN TIME (analysis may happen on another
+    # box); else fingerprint the analyzing host and say so.
+    fp_path = os.path.join(run_dir, "env_fingerprint.json")
+    try:
+        if os.path.exists(fp_path):
+            with open(fp_path) as f:
+                report["fingerprint"] = json.load(f)
+        else:
+            from ..perf.record import fingerprint
+
+            report["fingerprint"] = dict(fingerprint(), source="analyzer")
+    except (OSError, ValueError) as e:
+        report["fingerprint"] = None
+        report["fingerprint_error"] = f"{type(e).__name__}: {e}"
+
+    # tmperf ledger in the run dir (bench report dirs carry one) →
+    # report["perf"] block the perf_regression gate judges; the
+    # default-threshold comparisons ride along for the report reader
+    lpath = os.path.join(run_dir, "ledger.jsonl")
+    if os.path.exists(lpath):
+        try:
+            from ..perf.compare import compare_run
+            from ..perf.ledger import summarize_for_report
+
+            perf = summarize_for_report(lpath)
+            perf["comparisons"] = compare_run(perf["records"], perf["baselines"])
+            report["perf"] = perf
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # a corrupt ledger must not abort the fleet report; the
+            # gate's vacuous pass names the unreadable artifact
+            report["perf"] = None
+            report["perf_error"] = f"{type(e).__name__}: {e}"
+
     report["gates"], report["verdict"] = evaluate(report, gates)
     return report
 
@@ -421,6 +457,22 @@ def render_summary(report: dict) -> str:
     """Human-readable digest of a report (the CLI's stdout; also logged
     by the e2e runner)."""
     lines = [f"tmlens: {report['run_dir']}"]
+    fp = report.get("fingerprint")
+    if fp:
+        lines.append(
+            f"  env: {fp.get('device') or 'host'} cores={fp.get('cores')} "
+            f"py{fp.get('python')} jax={fp.get('jax')} rev={fp.get('git_rev')} "
+            f"fp={fp.get('fp')}"
+            + (" (analyzer host, not run host)" if fp.get("source") == "analyzer" else "")
+        )
+    perf = report.get("perf")
+    if perf:
+        lines.append(
+            f"  perf: latest run {perf.get('latest_run')} "
+            f"({len(perf.get('records') or [])} records; ledger holds "
+            f"{perf.get('total_records')} over {perf.get('runs')} runs, "
+            f"{perf.get('backfill_records')} backfilled)"
+        )
     f = report["fleet"]
     lines.append(
         f"  fleet: {f['nodes']} nodes, heights "

@@ -44,12 +44,20 @@ test assertions):
                      verdict failure even when this run's interleaving
                      happened to survive it; the detail names the lock
                      construction sites in cycle order
+  perf_regression    the run dir's perf ledger (ledger.jsonl,
+                     tendermint_tpu/perf/) shows the latest run's
+                     median for some stage below its blessed baseline
+                     floor by more than the MAD-scaled noise threshold
+                     (compare.py) — the failure names the stage and
+                     the measured delta. Cross-fingerprint and
+                     small-sample comparisons never gate; they are
+                     reported as informational/refused.
 
 rate_stall / churn_storm pass vacuously when no node left a
 timeseries.jsonl (flight recorder off), journey_stall when no node
-left journey spans (tracing off), and lock_order_cycle when no node
-ran the sanitizer: absence of an artifact is not evidence of a
-failure.
+left journey spans (tracing off), lock_order_cycle when no node ran
+the sanitizer, and perf_regression when the run dir carries no perf
+ledger: absence of an artifact is not evidence of a failure.
 """
 
 from __future__ import annotations
@@ -90,6 +98,15 @@ DEFAULT_GATES = {
     # never "some" acceptable; raise only for a run that deliberately
     # exercises a known-cyclic legacy path
     "max_lock_order_cycles": 0,
+    # tmperf compare thresholds (perf/compare.py COMPARE_DEFAULTS —
+    # the values here are the verdict plane's own defaults and may be
+    # overridden per run like any gate): fewer samples than
+    # perf_min_samples refuses to gate; a regression must exceed
+    # max(perf_min_rel_delta, perf_noise_mads standard errors of the
+    # median — MAD-sigma scaled by 1/sqrt(repetitions))
+    "perf_min_samples": 3,
+    "perf_noise_mads": 5.0,
+    "perf_min_rel_delta": 0.10,
 }
 
 
@@ -280,6 +297,47 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
         gates.append(_gate(
             "lock_order_cycle", total <= cfg["max_lock_order_cycles"], detail,
         ))
+
+    # perf_regression (tmperf ledger in the run dir; vacuous pass when
+    # absent — e2e dirs usually carry none, bench report dirs do)
+    perf = report.get("perf")
+    if not perf or not perf.get("records"):
+        gates.append(_gate(
+            "perf_regression", True,
+            # evidence LOSS must not masquerade as tmperf-disabled
+            # (the lockcheck precedent): vacuous pass, named artifact
+            f"perf ledger present but unreadable: {report.get('perf_error')}"
+            if report.get("perf_error")
+            else "no perf ledger in run dir (tmperf off)",
+        ))
+    else:
+        # the comparison math lives in perf/compare.py — ONE copy
+        # shared with the tmperf CLI and the bench report, so gate and
+        # CLI can't drift apart on identical evidence
+        from ..perf.compare import compare_run
+
+        comps = compare_run(
+            perf["records"], perf.get("baselines") or {},
+            min_samples=cfg["perf_min_samples"],
+            noise_mads=cfg["perf_noise_mads"],
+            min_rel_delta=cfg["perf_min_rel_delta"],
+        )
+        regs = [c for c in comps if c["status"] == "regression"]
+        if regs:
+            detail = f"run {perf.get('latest_run')}: " + "; ".join(
+                f"{c['stage']}/{c['metric']}: {c['reason']}" for c in regs
+            )
+        else:
+            by_status: dict[str, int] = {}
+            for c in comps:
+                by_status[c["status"]] = by_status.get(c["status"], 0) + 1
+            detail = (
+                f"run {perf.get('latest_run')}: no regression vs "
+                f"{len(perf.get('baselines') or {})} blessed floors ("
+                + ", ".join(f"{k}={v}" for k, v in sorted(by_status.items()))
+                + ")"
+            )
+        gates.append(_gate("perf_regression", not regs, detail))
 
     # missing_series
     problems = []

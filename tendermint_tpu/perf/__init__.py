@@ -1,0 +1,53 @@
+"""tmperf — the performance-regression observatory.
+
+Every ROADMAP perf item promises a measurable gain on a bench stage,
+but until this plane existed nothing *held* a perf result across PRs:
+bench.py printed one-shot rates and the BENCH_r* files are raw stdout
+captures. tmperf is the tracking half — the instrument subsequent perf
+PRs are accepted against:
+
+    record.py    canonical per-stage result record (stage, metric,
+                 unit, samples, median + MAD, warmup/repeat counts,
+                 environment fingerprint) + the fingerprint itself
+    harness.py   the shared warmup/repeat/median measurement harness
+                 every bench stage times through (no more one-shot
+                 rates)
+    ledger.py    the on-disk perf ledger (.bench_runs/ledger.jsonl,
+                 flight-recorder crash contract: append + flush per
+                 line, torn tails tolerated on read) and the committed
+                 blessed baselines (perf/baselines.json)
+    compare.py   noise-aware comparison: median-of-k vs baseline with
+                 MAD-scaled thresholds, minimum-sample refusal, and
+                 same-fingerprint gating (cross-fingerprint deltas are
+                 informational, never verdicts)
+    trend.py     per-(stage, metric) history rendering over the ledger
+                 (backfilled BENCH_r* rounds included)
+
+The `perf_regression` gate (lens/gates.py) folds the comparison into
+the fleet verdict plane alongside the PR 8–11 gates; `scripts/
+tmperf.py` (record / compare / trend / gate, tmlens rc contract
+0/1/2) is the CLI. Docs: docs/observability.md#tmperf.
+
+This package is part of the import-isolated analysis plane (with
+lens/, check/, metrics/flight.py): stdlib-only, never imports jax or
+the node runtime, enforced by the tmcheck import-isolation rule and
+pinned by tests/test_perf.py.
+"""
+
+from .compare import COMPARE_DEFAULTS, compare_run, compare_to_baseline, coverage_gaps  # noqa: F401
+from .harness import Samples, median_mad, rate_samples  # noqa: F401
+from .ledger import (  # noqa: F401
+    BASELINES_NAME,
+    LEDGER_NAME,
+    append_records,
+    bless,
+    default_baselines_path,
+    latest_run,
+    load_baselines,
+    read_ledger,
+    run_groups,
+    save_baselines,
+    summarize_for_report,
+)
+from .record import fingerprint, fp_id, make_record, record_key, validate_record  # noqa: F401
+from .trend import render_trend, trend_series  # noqa: F401

@@ -1,0 +1,177 @@
+"""Noise-aware comparison: is the claim bigger than box noise?
+
+The gate condition, per (stage, metric, workload) key:
+
+    drop      = (baseline.median - candidate.median) / baseline.median
+                (sign flipped for lower_better metrics)
+    noise_rel = 1.4826 * max(candidate.mad, baseline.mad) / baseline.median
+    threshold = max(min_rel_delta,
+                    noise_mads * noise_rel / sqrt(min(n_cand, n_base)))
+    regression  iff  drop > threshold
+
+1.4826 * MAD estimates one standard deviation of the PER-REPETITION
+noise; the gate compares MEDIANS of k repetitions, whose sampling
+error shrinks like sigma/sqrt(k) — without that scaling, one noisy
+measurement (an 11% MAD) inflates a 5-sigma threshold to 80%+ and a
+halved throughput sails through. So `noise_mads` reads as "how many
+standard errors of the median a real regression must clear". The
+floor `min_rel_delta` covers what repetition count cannot shrink:
+whole-run systematic drift (CPU contention on a shared box) and an
+eerily quiet box (MAD ~ 0) flagging sub-percent jitter.
+
+A comparison can refuse to gate — and a refusal is never a verdict:
+
+    no_baseline     nothing blessed for this key
+    refused         either side has fewer than `min_samples` reps
+                    (median-of-2 has no noise model)
+    informational   fingerprints differ or are unknown (different
+                    box/runtime/device — e.g. the BENCH_r02/r03 CPU-
+                    emulation fallback — or backfilled history). The
+                    delta is still reported; it just cannot gate.
+
+One copy of this math serves the lens `perf_regression` gate, the
+bench report, and every `scripts/tmperf.py` subcommand — the
+timeline_trips precedent: surfaces may differ in thresholds, never in
+the condition.
+"""
+
+from __future__ import annotations
+
+from .record import record_key
+
+__all__ = [
+    "COMPARE_DEFAULTS",
+    "MAD_SIGMA",
+    "compare_to_baseline",
+    "compare_run",
+    "coverage_gaps",
+]
+
+# consistency-constant: sigma ~= 1.4826 * MAD under normal noise
+MAD_SIGMA = 1.4826
+
+COMPARE_DEFAULTS = {
+    # median-of-k below this k has no usable noise model: refuse
+    "perf_min_samples": 3,
+    # how many MAD-sigmas of box noise a regression must clear
+    "perf_noise_mads": 5.0,
+    # relative-drop floor, so a near-zero-MAD box doesn't gate jitter
+    "perf_min_rel_delta": 0.10,
+}
+
+
+def compare_to_baseline(
+    rec: dict,
+    base: dict,
+    *,
+    min_samples: int = COMPARE_DEFAULTS["perf_min_samples"],
+    noise_mads: float = COMPARE_DEFAULTS["perf_noise_mads"],
+    min_rel_delta: float = COMPARE_DEFAULTS["perf_min_rel_delta"],
+) -> dict:
+    """One comparison row. `status` is one of ok / regression /
+    improved / refused / informational; only `regression` ever fails
+    a gate."""
+    base_med = base.get("median") or 0.0
+    cand_med = rec["median"]
+    out = {
+        "key": record_key(rec),
+        "stage": rec["stage"],
+        "metric": rec["metric"],
+        "unit": rec.get("unit"),
+        "run": rec.get("run"),
+        "baseline_run": base.get("run"),
+        "baseline_median": base_med,
+        "candidate_median": cand_med,
+        "delta_frac": round((cand_med - base_med) / base_med, 4) if base_med else None,
+    }
+    if not base_med:
+        out["status"] = "informational"
+        out["reason"] = "baseline median is zero/absent"
+        return out
+    if not rec.get("fp") or not base.get("fp"):
+        out["status"] = "informational"
+        out["reason"] = (
+            f"unknown fingerprint (provenance={rec.get('provenance', '?')}) — "
+            "cannot tell a slow box from a slow build"
+        )
+        return out
+    if rec["fp"] != base["fp"]:
+        out["status"] = "informational"
+        out["reason"] = (
+            f"cross-fingerprint ({rec['fp']} vs baseline {base['fp']}: "
+            "different box/runtime/device) — delta reported, never gated"
+        )
+        return out
+    n_c, n_b = rec.get("n", 0), base.get("n", 0)
+    if n_c < min_samples or n_b < min_samples:
+        out["status"] = "refused"
+        out["reason"] = (
+            f"insufficient samples (candidate n={n_c}, baseline n={n_b}, "
+            f"min {min_samples}) — median-of-few has no noise model"
+        )
+        return out
+    noise_rel = (
+        MAD_SIGMA
+        * max(float(rec.get("mad") or 0.0), float(base.get("mad") or 0.0))
+        / base_med
+    )
+    # medians of k reps: sampling error shrinks ~ sigma/sqrt(k)
+    threshold = max(
+        float(min_rel_delta),
+        float(noise_mads) * noise_rel / (min(n_c, n_b) ** 0.5),
+    )
+    drop = (base_med - cand_med) / base_med
+    if rec.get("direction", base.get("direction", "higher_better")) == "lower_better":
+        drop = -drop
+    out["drop_frac"] = round(drop, 4)
+    out["threshold_frac"] = round(threshold, 4)
+    out["noise_rel"] = round(noise_rel, 4)
+    if drop > threshold:
+        out["status"] = "regression"
+        out["reason"] = (
+            f"median {cand_med:g} vs blessed {base_med:g}: "
+            f"{100 * drop:.1f}% slower, over the "
+            f"{100 * threshold:.1f}% noise threshold "
+            f"({noise_mads} MAD-sigmas)"
+        )
+    elif -drop > threshold:
+        out["status"] = "improved"
+        out["reason"] = (
+            f"median {cand_med:g} vs blessed {base_med:g}: "
+            f"{100 * -drop:.1f}% faster, beyond noise — "
+            "bless it (tmperf bless) to hold the gain"
+        )
+    else:
+        out["status"] = "ok"
+        out["reason"] = (
+            f"delta {100 * -drop:+.1f}% within the "
+            f"{100 * threshold:.1f}% noise threshold"
+        )
+    return out
+
+
+def compare_run(records, baselines: dict[str, dict], **thresholds) -> list[dict]:
+    """Compare every record of one run against the blessed baselines.
+    Records with no blessed key report status `no_baseline`."""
+    out = []
+    for rec in records:
+        key = record_key(rec)
+        base = baselines.get(key)
+        if base is None:
+            out.append({
+                "key": key, "stage": rec["stage"], "metric": rec["metric"],
+                "run": rec.get("run"), "candidate_median": rec["median"],
+                "status": "no_baseline",
+                "reason": "nothing blessed for this key (tmperf bless)",
+            })
+            continue
+        out.append(compare_to_baseline(rec, base, **thresholds))
+    return out
+
+
+def coverage_gaps(records, baselines: dict[str, dict]) -> list[str]:
+    """Blessed keys the run emitted NO record for — the drift the
+    `tmperf gate --check` mode fails loudly on: a stage that silently
+    stops emitting records must not pass vacuously forever."""
+    seen = {record_key(r) for r in records}
+    return sorted(k for k in baselines if k not in seen)

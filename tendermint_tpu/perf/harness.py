@@ -1,0 +1,82 @@
+"""The shared warmup/repeat/median measurement harness.
+
+Every bench stage used to time one shot and print a point estimate; a
+reader (human or gate) had no way to tell a 10% win from box noise.
+The harness makes the noise visible: warm up, measure k independent
+repetitions, report median ± MAD. The median is robust to the one rep
+that caught a GC pause or a cron tick; the MAD is the noise scale the
+compare gate turns into a threshold (compare.py).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+__all__ = ["Samples", "median_mad", "rate_samples"]
+
+
+def median_mad(values) -> tuple[float, float]:
+    """(median, median-absolute-deviation). MAD rather than stddev:
+    one outlier repetition must not inflate the noise estimate it is
+    an outlier *against*."""
+    vals = [float(v) for v in values]
+    if not vals:
+        raise ValueError("median_mad of no samples")
+    med = statistics.median(vals)
+    mad = statistics.median(abs(v - med) for v in vals)
+    return med, mad
+
+
+class Samples:
+    """Per-repetition rates from one measurement: the raw values plus
+    median/MAD accessors and a human format with the noise bound."""
+
+    def __init__(self, values, warmup: int = 0, unit: str = ""):
+        self.values = [float(v) for v in values]
+        if not self.values:
+            raise ValueError("Samples needs at least one value")
+        self.warmup = int(warmup)
+        self.unit = unit
+        self.median, self.mad = median_mad(self.values)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def format(self, nd: int | None = None) -> str:
+        """"123.4 ±2.1/s (n=5)" — median ± MAD, so every bench log
+        line carries its own noise bound."""
+        if nd is None:
+            nd = 1 if self.median < 1000 else 0
+        unit = self.unit or "/s"
+        return f"{self.median:,.{nd}f} ±{self.mad:,.{nd}f}{unit} (n={len(self.values)})"
+
+    def __repr__(self) -> str:
+        return f"Samples({self.format()})"
+
+
+def rate_samples(fn, repeats: int = 5, warmup: int = 1, min_time: float = 0.1) -> Samples:
+    """Calls/sec of `fn`, measured as `repeats` independent
+    repetitions of at-least-`min_time` inner loops (each repetition
+    yields one rate sample). `fn` may return a number — the units of
+    work that call performed (defaults to 1 call = 1 unit), so a
+    batch-shaped fn can report units/s instead of calls/s. Warmup
+    calls run first and are excluded."""
+    for _ in range(max(0, int(warmup))):
+        fn()
+    rates = []
+    for _ in range(max(1, int(repeats))):
+        units = 0.0
+        t0 = time.perf_counter()
+        while True:
+            r = fn()
+            units += (
+                float(r)
+                if isinstance(r, (int, float)) and not isinstance(r, bool)
+                else 1.0
+            )
+            dt = time.perf_counter() - t0
+            if dt >= min_time:
+                break
+        rates.append(units / dt)
+    return Samples(rates, warmup=warmup)

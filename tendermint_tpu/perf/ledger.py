@@ -1,0 +1,184 @@
+"""The on-disk perf ledger and the blessed baselines.
+
+Ledger (`.bench_runs/ledger.jsonl`): one canonical record per line,
+appended + flushed as each stage finishes — the flight-recorder crash
+contract. A bench run killed mid-stage leaves a well-formed prefix
+plus at most one truncated tail line, which `read_ledger` skips. The
+ledger is APPENDED across runs (unlike timeseries.jsonl, which is one
+run's timeline): it *is* the trajectory `tmperf trend` renders.
+
+Baselines (`tendermint_tpu/perf/baselines.json`, committed): the
+blessed per-stage floors the `perf_regression` gate compares against.
+Blessing is deliberate (`tmperf bless` after an intentional perf
+change, reviewed like any other diff) — a baseline that silently
+tracked the latest run would gate nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .record import record_key, validate_record
+
+__all__ = [
+    "LEDGER_NAME",
+    "BASELINES_NAME",
+    "append_records",
+    "read_ledger",
+    "run_groups",
+    "latest_run",
+    "default_baselines_path",
+    "load_baselines",
+    "save_baselines",
+    "bless",
+    "summarize_for_report",
+]
+
+LEDGER_NAME = "ledger.jsonl"
+BASELINES_NAME = "baselines.json"
+
+
+def append_records(path: str, records) -> int:
+    """Append + flush each validated record as one JSON line. Returns
+    the number written. Writers validate; readers tolerate."""
+    records = list(records)
+    for rec in records:
+        validate_record(rec)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            f.flush()
+    return len(records)
+
+
+def read_ledger(path: str) -> list[dict]:
+    """Every well-formed record, in file order. Torn tail lines
+    (SIGKILL mid-append) and foreign lines are skipped, not fatal —
+    the prefix is the evidence."""
+    out: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail
+            if not isinstance(rec, dict):
+                continue
+            try:
+                validate_record(rec)
+            except ValueError:
+                continue  # wrong shape: skip, don't abort
+            out.append(rec)
+    return out
+
+
+def run_groups(records) -> dict[str, list[dict]]:
+    """run_id -> records, in order of first appearance."""
+    runs: dict[str, list[dict]] = {}
+    for rec in records:
+        runs.setdefault(rec["run"], []).append(rec)
+    return runs
+
+
+def latest_run(records, gateable_only: bool = True) -> tuple[str | None, list[dict]]:
+    """(run_id, records) of the last run in the ledger. With
+    `gateable_only` (the default), backfilled history is skipped: a
+    backfill import must never become the "latest run" a gate judges."""
+    runs = run_groups(records)
+    for run_id in reversed(list(runs)):
+        if gateable_only and all(r.get("provenance") == "backfill" for r in runs[run_id]):
+            continue
+        return run_id, runs[run_id]
+    return None, []
+
+
+def default_baselines_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), BASELINES_NAME)
+
+
+def load_baselines(path: str | None = None) -> dict[str, dict]:
+    """key -> blessed entry. A missing or empty file is an empty dict
+    (nothing blessed yet — the gate passes with nothing to hold)."""
+    path = path or default_baselines_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc.get("entries", {})
+    if not isinstance(entries, dict):
+        raise ValueError(f"{path}: baselines 'entries' must be an object")
+    return entries
+
+
+def save_baselines(path: str, entries: dict[str, dict]) -> None:
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def bless(records, baselines: dict[str, dict], stages=None, note: str | None = None) -> dict[str, dict]:
+    """Fold a run's records into the baselines as the new blessed
+    floors (docs/observability.md#tmperf: run this after an
+    INTENTIONAL perf change, and commit the diff). Backfilled and
+    fingerprint-less records are refused — a floor nobody can gate
+    against is not a floor. Returns the updated dict."""
+    out = dict(baselines)
+    for rec in records:
+        if stages is not None and rec["stage"] not in stages:
+            continue
+        if rec.get("provenance") == "backfill" or not rec.get("fp"):
+            continue
+        entry = {
+            "stage": rec["stage"],
+            "metric": rec["metric"],
+            "unit": rec["unit"],
+            "direction": rec.get("direction", "higher_better"),
+            "params": rec.get("params") or {},
+            "median": rec["median"],
+            "mad": rec.get("mad", 0.0),
+            "n": rec["n"],
+            "fp": rec["fp"],
+            "fingerprint": rec.get("fingerprint"),
+            "run": rec["run"],
+            "blessed_t": rec["t"],
+        }
+        if note:
+            entry["note"] = note
+        out[record_key(rec)] = entry
+    return out
+
+
+def summarize_for_report(ledger_path: str, baselines_path: str | None = None) -> dict:
+    """The `report["perf"]` block lens/analyze.py attaches when a run
+    dir carries a ledger: the latest gateable run's records plus the
+    blessed baselines, ready for the perf_regression gate (gates.py
+    passes its thresholds into compare.compare_run — the data and the
+    judgment stay separate, like timeline_trips). Baselines resolve
+    to a `baselines.json` SIBLING of the ledger when one exists (a
+    run dir may pin its own floors), else the committed package
+    defaults."""
+    if baselines_path is None:
+        sibling = os.path.join(
+            os.path.dirname(os.path.abspath(ledger_path)), BASELINES_NAME
+        )
+        if os.path.exists(sibling):
+            baselines_path = sibling
+    records = read_ledger(ledger_path)
+    runs = run_groups(records)
+    run_id, latest = latest_run(records)
+    return {
+        "ledger": os.path.abspath(ledger_path),
+        "total_records": len(records),
+        "runs": len(runs),
+        "backfill_records": sum(
+            1 for r in records if r.get("provenance") == "backfill"
+        ),
+        "latest_run": run_id,
+        "records": latest,
+        "baselines": load_baselines(baselines_path),
+    }

@@ -1,0 +1,185 @@
+"""Canonical perf record + environment fingerprint.
+
+One record = one measured (stage, metric) under one workload in one
+bench/smoke run: the raw per-repetition samples, their median + MAD
+(the noise model compare.py gates with), the harness shape
+(warmup/repeats), and the environment fingerprint.
+
+The fingerprint answers "is this the same box and runtime?" — the
+BENCH_r02/r03 postmortem took reading XLA error tails to discover the
+runs had silently fallen back to CPU emulation; a `device` field
+mismatch flags that in one line. The *comparability id* (`fp_id`)
+hashes only the box-relevant fields: `git_rev` rides along for
+post-mortems ("slow box or slow build?") but is excluded from the id,
+because the entire point of the ledger is comparing PR N against
+PR N-1 on the same box.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+from .harness import Samples, median_mad
+
+__all__ = [
+    "RECORD_VERSION",
+    "fingerprint",
+    "fp_id",
+    "make_record",
+    "record_key",
+    "validate_record",
+]
+
+RECORD_VERSION = 1
+
+# fingerprint fields that define comparability (fp_id hashes exactly
+# these, in this order); everything else in the dict is context
+_FP_ID_FIELDS = ("os", "machine", "python", "cores", "jax", "device")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _git_rev(root: str | None = None) -> str | None:
+    """Current short commit hash, read straight from .git (no
+    subprocess — this plane runs on artifact-reading CI boxes where
+    spawning git per record is both slow and unnecessary)."""
+    root = root or _REPO_ROOT
+    git = os.path.join(root, ".git")
+    try:
+        with open(os.path.join(git, "HEAD")) as f:
+            head = f.read().strip()
+        if not head.startswith("ref:"):
+            return head[:12] or None
+        ref = head.split(None, 1)[1]
+        ref_path = os.path.join(git, *ref.split("/"))
+        if os.path.exists(ref_path):
+            with open(ref_path) as f:
+                return f.read().strip()[:12] or None
+        packed = os.path.join(git, "packed-refs")
+        if os.path.exists(packed):
+            with open(packed) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 2 and parts[1] == ref:
+                        return parts[0][:12]
+    except OSError:
+        pass
+    return None
+
+
+def fingerprint(device: str | None = None, root: str | None = None) -> dict:
+    """Environment fingerprint: cores, platform, python, the JAX
+    version *if the process already imported it* (this module never
+    imports jax itself — sys.modules is a read, not an import), the
+    device the measurement ran on ("cpu", "tpu:TPU v4", ...), and the
+    git rev. `fp` is the comparability id (git_rev excluded — see the
+    module docstring)."""
+    import platform as _platform
+
+    jax_mod = sys.modules.get("jax")
+    fp = {
+        "os": sys.platform,
+        "machine": _platform.machine(),
+        "python": "%d.%d" % sys.version_info[:2],
+        "cores": os.cpu_count(),
+        "jax": getattr(jax_mod, "__version__", None),
+        "device": device,
+        "git_rev": _git_rev(root),
+    }
+    fp["fp"] = fp_id(fp)
+    return fp
+
+
+def fp_id(fp: dict) -> str:
+    """12-hex comparability id over the box-relevant fingerprint
+    fields (git_rev deliberately excluded)."""
+    canon = json.dumps([fp.get(k) for k in _FP_ID_FIELDS])
+    return hashlib.sha256(canon.encode()).hexdigest()[:12]
+
+
+def _canon_params(params: dict | None) -> str:
+    if not params:
+        return ""
+    return ",".join(f"{k}={params[k]}" for k in sorted(params))
+
+
+def record_key(rec: dict) -> str:
+    """Baseline-matching key: stage/metric plus the canonicalized
+    workload params — a 50k-tx flood and a 2k-tx smoke flood are
+    different workloads and must never gate against each other."""
+    key = f"{rec['stage']}/{rec['metric']}"
+    params = _canon_params(rec.get("params"))
+    return f"{key}?{params}" if params else key
+
+
+def make_record(
+    stage: str,
+    metric: str,
+    unit: str,
+    samples,
+    *,
+    run_id: str,
+    t: float,
+    warmup: int = 0,
+    params: dict | None = None,
+    provenance: str = "bench",
+    fingerprint: dict | None = None,
+    direction: str = "higher_better",
+    note: str | None = None,
+) -> dict:
+    """Build one canonical ledger record. `samples` is a
+    harness.Samples or a plain list of per-repetition rates."""
+    if isinstance(samples, Samples):
+        warmup = samples.warmup
+        values = list(samples.values)
+    else:
+        values = [float(v) for v in samples]
+    if not values:
+        raise ValueError(f"{stage}/{metric}: a record needs at least one sample")
+    med, mad = median_mad(values)
+    rec = {
+        "v": RECORD_VERSION,
+        "t": round(float(t), 3),
+        "run": run_id,
+        "provenance": provenance,
+        "stage": stage,
+        "metric": metric,
+        "unit": unit,
+        "direction": direction,
+        "samples": [round(v, 4) for v in values],
+        "n": len(values),
+        "warmup": int(warmup),
+        "repeats": len(values),
+        "median": round(med, 4),
+        "mad": round(mad, 4),
+        "params": dict(params) if params else {},
+        "fingerprint": fingerprint,
+        "fp": fingerprint.get("fp") if fingerprint else None,
+    }
+    if note:
+        rec["note"] = note
+    validate_record(rec)
+    return rec
+
+
+def validate_record(rec: dict) -> None:
+    """Raise ValueError when a record is not ledger-shaped. The ledger
+    reader *skips* bad lines (crash contract); this is for writers,
+    which must never append one."""
+    if not isinstance(rec, dict):
+        raise ValueError("record must be a dict")
+    for field, typ in (
+        ("run", str), ("stage", str), ("metric", str), ("unit", str),
+        ("provenance", str), ("t", (int, float)), ("median", (int, float)),
+        ("n", int), ("samples", list),
+    ):
+        v = rec.get(field)
+        if not isinstance(v, typ) or (isinstance(v, bool)):
+            raise ValueError(f"record field {field!r} missing or mis-typed: {v!r}")
+    if rec["n"] != len(rec["samples"]) or rec["n"] < 1:
+        raise ValueError("record sample count mismatch")
+    if rec.get("direction") not in ("higher_better", "lower_better"):
+        raise ValueError(f"bad direction {rec.get('direction')!r}")

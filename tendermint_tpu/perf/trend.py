@@ -1,0 +1,64 @@
+"""Trend rendering: the bench trajectory, per (stage, metric).
+
+`tmperf trend` answers "what has this stage done across rounds?" from
+the ledger alone — backfilled BENCH_r01–r05 history included, so the
+plot starts with the repo's past instead of an empty axis. Output is
+a table (run, median ± MAD, n, device, provenance) plus a unicode
+sparkline of medians; informational history (unknown fingerprint) is
+marked so nobody reads a CPU-emulation round as a regression.
+"""
+
+from __future__ import annotations
+
+from .record import record_key
+
+__all__ = ["trend_series", "render_trend", "sparkline"]
+
+_SPARKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values) -> str:
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARKS[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        _SPARKS[min(len(_SPARKS) - 1, int((v - lo) / span * len(_SPARKS)))]
+        for v in vals
+    )
+
+
+def trend_series(records, stage: str | None = None, metric: str | None = None) -> dict[str, list[dict]]:
+    """key -> records in ledger (= time) order, optionally filtered."""
+    series: dict[str, list[dict]] = {}
+    for rec in records:
+        if stage is not None and rec["stage"] != stage:
+            continue
+        if metric is not None and rec["metric"] != metric:
+            continue
+        series.setdefault(record_key(rec), []).append(rec)
+    return series
+
+
+def render_trend(records, stage: str | None = None, metric: str | None = None) -> str:
+    """Human trend digest over the ledger (the CLI's stdout)."""
+    series = trend_series(records, stage=stage, metric=metric)
+    if not series:
+        return "no matching records in the ledger"
+    lines = []
+    for key in sorted(series):
+        recs = series[key]
+        unit = recs[-1].get("unit", "")
+        lines.append(f"{key}  [{unit}]")
+        lines.append(f"  trend: {sparkline([r['median'] for r in recs])}")
+        for r in recs:
+            dev = (r.get("fingerprint") or {}).get("device") or "?"
+            info = "" if r.get("fp") else "  (informational: unknown fingerprint)"
+            lines.append(
+                f"  {r['run']:>18}  {r['median']:>12,.1f} ±{r.get('mad', 0):,.1f}"
+                f"  n={r['n']:<2} dev={dev:<12} {r.get('provenance', '?')}{info}"
+            )
+    return "\n".join(lines)

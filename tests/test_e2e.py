@@ -119,8 +119,18 @@ def test_e2e_perturbed_testnet(tmp_path):
     gate_names = {g["name"] for g in runner.last_report["gates"]}
     assert gate_names == {
         "liveness_stall", "p99_step_duration", "height_spread", "missing_series",
-        "rate_stall", "churn_storm", "journey_stall",
+        "rate_stall", "churn_storm", "journey_stall", "lock_order_cycle",
+        "perf_regression",
     }
+    # tmperf fingerprint surfacing: the runner persisted the run-time
+    # environment fingerprint and the report carries it (slow box vs
+    # slow build is a report field, not an XLA-error-tail excavation)
+    assert os.path.exists(os.path.join(runner.base_dir, "env_fingerprint.json"))
+    assert runner.last_report["fingerprint"]["cores"] == os.cpu_count()
+    assert "source" not in runner.last_report["fingerprint"], (
+        "the report must carry the RUN-time fingerprint artifact, "
+        "not an analyzer-host fallback"
+    )
     # the kill perturbation snapshotted the victim's pre-death state
     killed = next(n for n in runner.nodes if "kill" in n.m.perturb)
     assert os.path.exists(os.path.join(killed.home, "metrics.pre-kill.txt")), (
