@@ -1,0 +1,106 @@
+"""preflight — the one device-free gate chain CI and builders run
+before a PR (docs/static-analysis.md#preflight).
+
+Chains, in order:
+
+  1. tmcheck --check       static analysis + baseline drift (both ways)
+  2. metricsgen --check    docs/metrics.md byte-drift gate
+  3. bench.py smoke        device-free perf smoke (~seconds) — records
+                           a fresh run into .bench_runs/ledger.jsonl
+  4. tmperf gate --check   noise-aware regression gate over the run
+                           smoke just recorded, plus blessed-key
+                           coverage drift
+
+Exit code is the tmlens rc contract: 0 = every stage passed, 1 = at
+least one gate tripped (every remaining stage still runs, so one
+preflight shows ALL failures), 2 = usage error or a stage that could
+not run at all. Stages run as subprocesses with JAX_PLATFORMS=cpu —
+the whole chain is device-free by construction.
+
+  python scripts/preflight.py             # run the chain
+  python scripts/preflight.py --skip smoke --skip perf-gate
+  python scripts/preflight.py --list      # show the stages and exit
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STAGES = (
+    # (name, argv relative to repo root)
+    ("tmcheck", [sys.executable, "scripts/tmcheck.py", "--check"]),
+    ("metricsgen", [sys.executable, "scripts/metricsgen.py", "--check"]),
+    ("smoke", [sys.executable, "bench.py", "smoke"]),
+    ("perf-gate", [sys.executable, "scripts/tmperf.py", "gate", "--check"]),
+)
+
+
+def main(argv) -> int:
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    skip: set[str] = set()
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--skip":
+            if i + 1 >= len(argv):
+                print("missing value for --skip (see --help)", file=sys.stderr)
+                return 2
+            skip.add(argv[i + 1])
+            i += 2
+        elif a == "--list":
+            for name, cmd in STAGES:
+                print(f"{name}: {' '.join(cmd[1:])}")
+            return 0
+        else:
+            print(f"unknown argument {a!r} (see --help)", file=sys.stderr)
+            return 2
+    unknown = skip - {name for name, _cmd in STAGES}
+    if unknown:
+        print(f"unknown stage(s) in --skip: {sorted(unknown)} "
+              f"(have: {', '.join(n for n, _c in STAGES)})", file=sys.stderr)
+        return 2
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    results: list[tuple[str, int | None, float]] = []
+    worst = 0
+    for name, cmd in STAGES:
+        if name in skip:
+            results.append((name, None, 0.0))
+            continue
+        print(f"=== preflight: {name}: {' '.join(cmd[1:])}", flush=True)
+        t0 = time.monotonic()
+        try:
+            rc = subprocess.run(cmd, cwd=_ROOT, env=env, timeout=900).returncode
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"preflight: {name} could not run: {e}", file=sys.stderr)
+            rc = 2
+        dt = time.monotonic() - t0
+        results.append((name, rc, dt))
+        if rc not in (0, 1):
+            worst = 2  # a stage that can't run is a broken chain
+        elif rc == 1 and worst == 0:
+            worst = 1
+
+    print("\npreflight summary:")
+    for name, rc, dt in results:
+        status = (
+            "SKIP" if rc is None
+            else "PASS" if rc == 0
+            else "FAIL" if rc == 1
+            else f"ERROR (rc {rc})"
+        )
+        print(f"  {name:<12} {status:<12} {dt:6.1f}s")
+    print(f"preflight: {'clean' if worst == 0 else 'FAILED'} (rc {worst})")
+    return worst
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

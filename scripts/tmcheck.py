@@ -18,27 +18,89 @@ Usage:
 
   --rules r1,r2     run a subset (lock-blocking, cache-stale,
                     metric-raise, metric-drift, import-isolation,
-                    trace-pairing, unused-import)
+                    trace-pairing, unused-import, shared-mutation,
+                    guard-consistency, atomicity)
   --root DIR        analyze a different tree (fixture tests)
   --json            machine-readable findings on stdout
+  --diff REV        restrict findings to files changed vs the git rev
+                    (worktree diff + untracked; the pre-commit fast
+                    path — note the thread-escape rules still read the
+                    WHOLE tree for call-graph context, they just
+                    report only on the changed files). Stale-baseline
+                    checking is restricted to the same files;
+                    --write-baseline refuses --diff (a restricted scan
+                    would silently drop every suppression outside it).
+  --jobs N          parse/analyze with N worker processes (the
+                    per-file rules chunk across workers; the
+                    whole-tree race pass runs once in its own worker).
+                    rc contract unchanged.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
-from tendermint_tpu.check import RULES, run_checks  # noqa: E402
+from tendermint_tpu.check import RULES, discover_files, run_checks  # noqa: E402
 from tendermint_tpu.check.baseline import (  # noqa: E402
     BASELINE_NAME,
     diff_baseline,
     load_baseline,
     write_baseline,
 )
+from tendermint_tpu.check.race import RACE_RULES  # noqa: E402
+
+
+def _changed_files(root: str, rev: str) -> list[str]:
+    """Repo-relative .py paths changed vs `rev` (worktree diff plus
+    untracked), or raises CalledProcessError on a bad rev."""
+    diff = subprocess.run(
+        ["git", "-C", root, "diff", "--name-only", rev, "--"],
+        capture_output=True, text=True, check=True,
+    ).stdout.splitlines()
+    untracked = subprocess.run(
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+        capture_output=True, text=True, check=True,
+    ).stdout.splitlines()
+    changed = {p.strip() for p in diff + untracked if p.strip()}
+    return [p for p in discover_files(root) if p in changed]
+
+
+def _run_chunk(root, rules, paths):
+    """Worker entry for --jobs (top-level so fork/pickle resolve it)."""
+    return run_checks(root, rules=rules, paths=paths)
+
+
+def _run_parallel(root, selected, files, jobs):
+    """(active, inline) with per-file rules chunked across `jobs`
+    workers and the whole-tree race pass in one extra worker. Output
+    order matches the serial path (re-sorted at the end)."""
+    from concurrent.futures import ProcessPoolExecutor
+
+    selected = list(selected) if selected else list(RULES)
+    per_file = [r for r in selected if r not in RACE_RULES]
+    race = [r for r in selected if r in RACE_RULES]
+    chunks = [files[i::jobs] for i in range(jobs)]
+    active, inline = [], []
+    with ProcessPoolExecutor(max_workers=jobs + (1 if race else 0)) as ex:
+        futs = []
+        if per_file:
+            futs += [
+                ex.submit(_run_chunk, root, per_file, c) for c in chunks if c
+            ]
+        if race:
+            futs.append(ex.submit(_run_chunk, root, race, files))
+        for fut in futs:
+            a, i = fut.result()
+            active.extend(a)
+            inline.extend(i)
+    key = lambda f: (f.path, f.line, f.rule)  # noqa: E731
+    return sorted(active, key=key), sorted(inline, key=key)
 
 
 def main(argv) -> int:
@@ -49,6 +111,8 @@ def main(argv) -> int:
     rules = None
     as_json = False
     mode = "report"
+    diff_rev = None
+    jobs = 1
     i = 0
     try:
         while i < len(argv):
@@ -68,11 +132,22 @@ def main(argv) -> int:
             elif a == "--write-baseline":
                 mode = "write"
                 i += 1
+            elif a == "--diff":
+                diff_rev = argv[i + 1]
+                i += 2
+            elif a == "--jobs":
+                jobs = int(argv[i + 1])
+                if jobs < 1:
+                    raise ValueError(jobs)
+                i += 2
             else:
                 print(f"unknown argument {a!r} (see --help)", file=sys.stderr)
                 return 2
     except IndexError:
         print("missing value for flag (see --help)", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"bad flag value: {e} (see --help)", file=sys.stderr)
         return 2
     if not os.path.isdir(os.path.join(root, "tendermint_tpu")):
         print(f"not a repo root: {root!r}", file=sys.stderr)
@@ -84,8 +159,33 @@ def main(argv) -> int:
                   file=sys.stderr)
             return 2
 
+    if diff_rev is not None and mode == "write":
+        # a restricted scan sees none of the unscanned files' findings:
+        # regenerating the baseline from it would silently DELETE every
+        # suppression outside the diff
+        print("--write-baseline requires a full scan (drop --diff)",
+              file=sys.stderr)
+        return 2
+    files = None
+    if diff_rev is not None:
+        try:
+            files = _changed_files(root, diff_rev)
+        except (subprocess.CalledProcessError, OSError) as e:
+            err = getattr(e, "stderr", "") or str(e)
+            print(f"--diff failed: {err.strip()}", file=sys.stderr)
+            return 2
+        if not files:
+            print(f"tmcheck clean (no analyzable files changed vs {diff_rev})")
+            return 0
+
     try:
-        active, inline = run_checks(root, rules=rules)
+        if jobs > 1:
+            active, inline = _run_parallel(
+                root, rules, files if files is not None else discover_files(root),
+                jobs,
+            )
+        else:
+            active, inline = run_checks(root, rules=rules, paths=files)
     except ValueError as e:
         print(f"analysis failed: {e}", file=sys.stderr)
         return 2
@@ -98,6 +198,11 @@ def main(argv) -> int:
 
     baseline = load_baseline(root)
     new, stale = diff_baseline(active, baseline)
+    if diff_rev is not None:
+        # a restricted scan can only vouch for the files it scanned:
+        # baseline entries elsewhere are not "stale", they are unseen
+        scanned = set(files)
+        stale = [e for e in stale if e[1] in scanned]
 
     if as_json:
         print(json.dumps({

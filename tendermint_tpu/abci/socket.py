@@ -354,8 +354,12 @@ class SocketClient(Client):
             self._fail_all(e)
 
     def _fail_all(self, err: Exception) -> None:
-        self._err = err
         with self._pending_lock:
+            # under the pending lock so the error slot and the queue
+            # drain publish together: a submitter that got past the
+            # fast-path _err check either lands in `pending` here and
+            # is failed below, or sees _err set
+            self._err = err
             pending, self._pending = list(self._pending), deque()
         for _method, slot in pending:
             slot["error"] = err

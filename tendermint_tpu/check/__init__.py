@@ -38,6 +38,16 @@ with repo-specific rules:
                     records nothing, silently)
   unused-import     module-level imports never referenced (skipped in
                     __init__.py re-export surfaces)
+  shared-mutation   an attribute written from >=2 thread roots with an
+                    empty guarding-lockset intersection (thread-escape
+                    lockset analysis, .race — queues/Events/
+                    single-assignment flags allowlisted)
+  guard-consistency a field guarded by lock A in one method and lock B
+                    in another (empty intersection of nonempty
+                    locksets)
+  atomicity         compound read-modify-write (self.n += 1, dict
+                    check-then-act) on a shared field outside any lock
+                    region
 
 Findings carry file:line + rule id + the stripped source line, and are
 suppressed either inline (`# tmcheck: ok[rule-id] <reason>` on the
@@ -47,9 +57,13 @@ metricsgen-style: new findings AND stale baseline entries both fail
 `--check` in tier-1.
 
 The runtime half lives in .lockcheck: TM_TPU_LOCKCHECK=1 wraps
-threading.Lock/RLock to build a per-process lock-order graph
-(order-inversion cycles, sleep-under-lock, over-budget holds) streamed
-to <home>/lockcheck.jsonl and folded into fleet_report.json by lens.
+threading.Lock/RLock/Condition/Semaphore to build a per-process
+lock-order graph (order-inversion cycles, sleep-under-lock,
+over-budget holds) streamed to <home>/lockcheck.jsonl and folded into
+fleet_report.json by lens. The race-detection runtime lives in
+.racecheck: TM_TPU_RACECHECK=1 installs an Eraser-style lockset
+sanitizer on declared hot classes, streaming shared_state_race events
+to <home>/racecheck.jsonl (the shared_state_race gate).
 
 Import discipline: this package is itself in the import-isolation set —
 stdlib only, so the analysis runs on bare CI boxes.
@@ -76,6 +90,9 @@ RULES = (
     "import-isolation",
     "trace-pairing",
     "unused-import",
+    "shared-mutation",
+    "guard-consistency",
+    "atomicity",
 )
 
 # Directories under the repo root that the pass walks. Tests and

@@ -41,11 +41,21 @@ are aggregated at finalize.
 Disabled (the default) nothing is constructed: `maybe_install` reads
 one env var and returns None — threading and time are untouched.
 
-Condition objects need no wrapping: `threading.Condition()` builds its
-lock via the (patched) `threading.RLock`, and a Condition over a
-wrapped lock drives it through `_release_save`/`_acquire_restore`/
-`_is_owned`, which the RLock shim implements with full bookkeeping —
-so a `cond.wait()` correctly shows the lock as released while waiting.
+Condition construction is wrapped so a BARE `threading.Condition()`
+gets a shimmed RLock carrying the CALLER's construction site (through
+the patched RLock alone it would alias to one threading.py frame);
+either way the Condition drives the lock through `_release_save`/
+`_acquire_restore`/`_is_owned`, which the RLock shim implements with
+full bookkeeping — so a `cond.wait()` correctly shows the lock as
+released while waiting. `threading.Semaphore`/`BoundedSemaphore`
+construction is wrapped the same way (`_SanSemaphore`); BINARY
+semaphores (initial value 1 — mutex usage) participate in the order
+graph and hold budgets, counting/zero-value semaphores are signaling
+primitives (acquire and release on different threads by design) and
+get a pass-through shim — graphing ThreadPoolExecutor's idle
+semaphore fabricated cycles through stdlib sites. A binary
+cross-thread handoff falls under the documented stale-stack-entry
+limitation below.
 
 Known limitations (documented, not bugs): graph nodes are construction
 SITES, so two locks born on one source line alias to one node; a plain
@@ -77,6 +87,9 @@ ARTIFACT_NAME = "lockcheck.jsonl"
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+_REAL_SEMAPHORE = threading.Semaphore
+_REAL_BOUNDED_SEMAPHORE = threading.BoundedSemaphore
 _REAL_SLEEP = _time.sleep
 _EMPTY: frozenset = frozenset()
 
@@ -222,6 +235,12 @@ class LockCheck:
             thread=threading.current_thread().name,
         )
 
+    def held_sites(self) -> tuple:
+        """Construction sites of every lock the CURRENT thread holds —
+        the racecheck sanitizer's lockset source (check/racecheck.py).
+        Touches only thread-local state."""
+        return tuple(s for s, _t in self._state().stack)
+
     def _on_released(self, site: str) -> None:
         stack = self._state().stack
         for i in range(len(stack) - 1, -1, -1):
@@ -278,7 +297,8 @@ class LockCheck:
         return f"{fn.replace(os.sep, '/')}:{f.f_lineno}"
 
     def install(self) -> None:
-        """Patch threading.Lock/RLock and time.sleep. Idempotent."""
+        """Patch threading.Lock/RLock/Condition/Semaphore and
+        time.sleep. Idempotent."""
         if self._installed:
             return
         self._installed = True
@@ -290,8 +310,32 @@ class LockCheck:
         def RLock():  # noqa: N802
             return _SanRLock(_REAL_RLOCK(), check, check._caller_site())
 
+        def Condition(lock=None):  # noqa: N802
+            # a bare Condition() built through the patched RLock would
+            # alias every construction to one threading.py frame; give
+            # its lock the CALLER's site so per-site Conditions get
+            # their own order-graph nodes
+            if lock is None:
+                lock = _SanRLock(_REAL_RLOCK(), check, check._caller_site())
+            return _REAL_CONDITION(lock)
+
+        def Semaphore(value=1):  # noqa: N802
+            return _SanSemaphore(
+                _make_inner_semaphore(_REAL_SEMAPHORE, value),
+                check, check._caller_site(), graphed=value == 1,
+            )
+
+        def BoundedSemaphore(value=1):  # noqa: N802
+            return _SanSemaphore(
+                _make_inner_semaphore(_REAL_BOUNDED_SEMAPHORE, value),
+                check, check._caller_site(), graphed=value == 1,
+            )
+
         threading.Lock = Lock
         threading.RLock = RLock
+        threading.Condition = Condition
+        threading.Semaphore = Semaphore
+        threading.BoundedSemaphore = BoundedSemaphore
         _time.sleep = self._sleep_hook
         atexit.register(self.finalize)
 
@@ -301,6 +345,9 @@ class LockCheck:
         self._installed = False
         threading.Lock = _REAL_LOCK
         threading.RLock = _REAL_RLOCK
+        threading.Condition = _REAL_CONDITION
+        threading.Semaphore = _REAL_SEMAPHORE
+        threading.BoundedSemaphore = _REAL_BOUNDED_SEMAPHORE
         _time.sleep = _REAL_SLEEP
         atexit.unregister(self.finalize)
 
@@ -466,6 +513,72 @@ class _SanRLock:
 
     def __repr__(self):
         return f"<tmcheck-rlock {self._site} {self._inner!r}>"
+
+
+def _make_inner_semaphore(cls, value):
+    """Build a REAL (un-sanitized) Semaphore/BoundedSemaphore without
+    running its stdlib __init__ under the patch: that init (a) resolves
+    the module globals `Semaphore`/`Condition`/`Lock`, and the patched
+    `Semaphore` global breaks `BoundedSemaphore.__init__`'s explicit
+    `Semaphore.__init__(self, ...)` chain outright, and (b) would hang
+    the semaphore's INTERNAL condition lock off a sanitized lock,
+    polluting the order graph with threading.py frames. Replicates
+    CPython 3.x Semaphore.__init__ (`_cond`, `_value`, and
+    `_initial_value` for the bounded variant) — the same
+    version-pinned-internals trade the Condition `_release_save`
+    protocol already makes."""
+    if value < 0:
+        raise ValueError("semaphore initial value must be >= 0")
+    inner = cls.__new__(cls)
+    inner._cond = _REAL_CONDITION(_REAL_LOCK())
+    inner._value = value
+    if issubclass(cls, _REAL_BOUNDED_SEMAPHORE):
+        inner._initial_value = value
+    return inner
+
+
+class _SanSemaphore:
+    """threading.Semaphore/BoundedSemaphore shim: identical surface.
+    Only BINARY semaphores (initial value 1 — mutex usage) join the
+    order graph and hold budgets: a counting/zero-value semaphore is a
+    SIGNALING primitive whose acquire and release legitimately happen
+    on different threads (ThreadPoolExecutor's idle semaphore: submit
+    acquires, workers release), and graphing those would leave stale
+    held-stack entries that fabricate cycles through stdlib sites —
+    observed live before this guard. Binary semaphores handed off
+    cross-thread still fall under the documented stale-stack-entry
+    limitation."""
+
+    __slots__ = ("_inner", "_check", "_site", "_graphed")
+
+    def __init__(self, inner, check: LockCheck, site: str,
+                 graphed: bool = True):
+        self._inner = inner
+        self._check = check
+        self._site = site
+        self._graphed = graphed
+
+    def acquire(self, blocking=True, timeout=None):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and self._graphed:
+            self._check._on_acquired(self._site)
+        return ok
+
+    def release(self, n=1):
+        if self._graphed:
+            self._check._on_released(self._site)
+        self._inner.release(n)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<tmcheck-semaphore {self._site} {self._inner!r}>"
 
 
 _ACTIVE: LockCheck | None = None

@@ -1,0 +1,900 @@
+"""tmrace — static thread-escape lockset analysis
+(docs/static-analysis.md#race-rules).
+
+tmcheck's original rules police what happens INSIDE a lock region;
+nothing answered the more common pure-Python concurrency bug: shared
+state mutated from two threads with no lock at all, or guarded by
+*different* locks in different methods. This module mechanizes the
+Eraser lockset discipline at the AST level:
+
+  1. Root an intra-package call graph at every thread entry point —
+     `threading.Thread(target=self._m)` (including the repo's two
+     indirections: loop-variable targets iterating a tuple of bound
+     methods, and spawn-helper methods whose parameter is the target),
+     executor `.submit(self._m, ...)`, and nested-def targets
+     (`_watchdog`-style closures over self).
+  2. For every class, compute per-method attribute read/write sets
+     with the *effective* lockset of each access: the locks lexically
+     held at the access plus the locks guaranteed held on every call
+     path from the root (meet-over-paths intersection at join points).
+     Cross-class edges resolve callee method names that are defined by
+     exactly ONE class in the package (name-based linking, with a
+     blocklist of generic stdlib-ish names) — how a reactor's gossip
+     thread reaches `PeerState.apply_*`.
+  3. Judge each class attribute:
+
+  shared-mutation    written from >=2 thread roots with an EMPTY
+                     intersection of guarding locksets, where at least
+                     one write is fully unguarded — the "works until
+                     the 50k flood" defect class
+  guard-consistency  every write is guarded, but by lock A in one
+                     method and lock B in another (empty intersection
+                     of nonempty locksets) — mutual exclusion that
+                     excludes nothing
+  atomicity          compound read-modify-write (`self.n += 1`,
+                     `self.x = f(self.x)`, dict/set check-then-act)
+                     on a multi-thread attribute outside any lock
+                     region — each step is GIL-atomic, the compound
+                     is not
+
+Allowlists (precision over recall, like every tmcheck rule):
+`__init__`/`__post_init__` writes never count (Eraser's init phase —
+ownership handoff to a worker thread is the dominant in-tree idiom);
+attributes initialized to synchronization/queue objects (Queue, deque,
+Event, Condition, Lock, ...) are excluded wholesale (their internals
+are thread-safe and rebinding them is not an in-tree pattern);
+single-assignment flags — attributes whose every post-init write
+assigns a bare True/False/None constant — are excluded (a constant
+store is atomic under the GIL and `self._stopped = True` from another
+thread is the repo's standard shutdown signal); `# tmcheck: ok[rule]`
+inline suppressions apply as everywhere else.
+
+Known limitations (documented, not bugs): the analysis is class-level,
+so two threads mutating DIFFERENT instances of one class alias to one
+report (the runtime half, check/racecheck.py, is per-instance);
+`Condition.wait()` windows inside a `with` region read as locked;
+attribute writes reached only through unresolvable indirection
+(callbacks stored in containers, channel handlers) fall back to the
+synthetic public-API root.
+
+Stdlib only (ast, os) — the pass runs on bare CI boxes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding
+
+RACE_RULES = ("shared-mutation", "guard-consistency", "atomicity")
+
+# Callee names never linked cross-class by name: too generic — a
+# `d.get(...)` must not resolve to whatever single in-package class
+# happens to define `get`.
+_GENERIC_NAMES = {
+    "get", "put", "set", "add", "pop", "items", "keys", "values",
+    "append", "extend", "remove", "clear", "update", "join", "start",
+    "stop", "close", "open", "read", "write", "send", "recv", "wait",
+    "notify", "notify_all", "acquire", "release", "submit", "result",
+    "encode", "decode", "copy", "run", "next", "flush", "reset",
+    "name", "size", "height", "hash", "bytes", "validate", "info",
+}
+
+# Constructor chains that mark an attribute as a synchronization /
+# thread-safe-container object (excluded from the race rules).
+_SYNC_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.local", "threading.Barrier",
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier",
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "Queue", "LifoQueue", "PriorityQueue",
+    "SimpleQueue", "collections.deque", "deque",
+}
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+# Container-mutator method names: calling one on a plain-container
+# `self.attr` is a WRITE to attr's contents (rules.py _MUTATORS plus a
+# few).
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "sort", "reverse", "add", "discard", "popitem", "setdefault",
+    "appendleft", "popleft",
+}
+
+# RHS shapes that mark an attribute as a plain container.
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "collections.defaultdict", "defaultdict",
+    "collections.OrderedDict", "OrderedDict", "collections.Counter",
+    "Counter",
+}
+
+_INIT_METHODS = {"__init__", "__post_init__", "__new__", "__del__"}
+
+PUBLIC_ROOT = "<public-api>"
+
+
+def _chain(node) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _Access:
+    """One attribute access inside a method body."""
+
+    __slots__ = ("attr", "kind", "locks", "line", "rmw")
+
+    def __init__(self, attr: str, kind: str, locks: frozenset, line: int,
+                 rmw: str | None = None):
+        self.attr = attr
+        self.kind = kind  # "read" | "write"
+        self.locks = locks  # lexical lockset (lock ids)
+        self.line = line
+        self.rmw = rmw  # non-None: compound RMW description
+
+
+class _Call:
+    __slots__ = ("name", "is_self", "locks", "args_self_methods")
+
+    def __init__(self, name: str, is_self: bool, locks: frozenset,
+                 args_self_methods: tuple):
+        self.name = name
+        self.is_self = is_self
+        self.locks = locks
+        # self._m references passed as positional args (spawn helpers)
+        self.args_self_methods = args_self_methods
+
+
+class _Method:
+    __slots__ = ("cls", "name", "accesses", "calls", "spawn_param", "line")
+
+    def __init__(self, cls: "_Class | None", name: str, line: int):
+        self.cls = cls
+        self.name = name
+        self.line = line
+        self.accesses: list[_Access] = []
+        self.calls: list[_Call] = []
+        # parameter index whose value this method passes to
+        # Thread(target=...) — the Router._spawn idiom
+        self.spawn_param: int | None = None
+
+
+class _Class:
+    __slots__ = ("module", "name", "methods", "lock_attrs", "sync_attrs",
+                 "container_attrs", "line")
+
+    def __init__(self, module: "_ModuleInfo", name: str, line: int):
+        self.module = module
+        self.name = name
+        self.line = line
+        self.methods: dict[str, _Method] = {}
+        # attr -> lock id (Condition(self._x) aliases to _x's id)
+        self.lock_attrs: dict[str, str] = {}
+        self.sync_attrs: set[str] = set()
+        # attrs known to hold PLAIN containers (dict/list/set literals
+        # or builtin ctors): a mutator call (.add/.clear/...) on these
+        # is a WRITE; on anything else it is a method of an object that
+        # owns its own discipline (self.peer_manager.add(...)) — a read
+        # plus a cross-class edge candidate
+        self.container_attrs: set[str] = set()
+
+    def lock_id(self, attr: str) -> str:
+        return self.lock_attrs.get(
+            attr, f"{self.module.path}:{self.name}.{attr}"
+        )
+
+
+class _ModuleInfo:
+    __slots__ = ("path", "classes", "functions", "module_locks", "lines")
+
+    def __init__(self, path: str, lines: list[str]):
+        self.path = path
+        self.lines = lines
+        self.classes: dict[str, _Class] = {}
+        self.functions: dict[str, _Method] = {}
+        self.module_locks: dict[str, str] = {}  # name -> lock id
+
+
+# --------------------------------------------------------------- collection
+
+
+def _is_ctor(value, names: set) -> bool:
+    return (
+        isinstance(value, ast.Call)
+        and (_chain(value.func) or "") in names
+    )
+
+
+def _is_metric_factory(value) -> bool:
+    """`self.x = reg.counter(...)` — metric objects are thread-safe by
+    construction (their write methods carry @_never_raise and mutate
+    under the GIL) and are written from every plane by design."""
+    return (
+        isinstance(value, ast.Call)
+        and isinstance(value.func, ast.Attribute)
+        and value.func.attr in ("counter", "gauge", "histogram", "register")
+    )
+
+
+class _BodyScanner:
+    """Walks one function body tracking the lexical lockset, recording
+    attribute accesses, intra/cross-class calls, and thread spawns."""
+
+    def __init__(self, cls: _Class | None, module: _ModuleInfo,
+                 method: _Method, roots_out: list):
+        self.cls = cls
+        self.module = module
+        self.method = method
+        self.roots_out = roots_out  # [(class|None, method_name)]
+
+    # -- lock identification
+
+    def _lock_for(self, expr) -> str | None:
+        """Lock id when `expr` names a known lock, else None."""
+        a = _self_attr(expr)
+        if a is not None and self.cls is not None and a in self.cls.lock_attrs:
+            return self.cls.lock_attrs[a]
+        if isinstance(expr, ast.Name) and expr.id in self.module.module_locks:
+            return self.module.module_locks[expr.id]
+        return None
+
+    # -- spawn targets
+
+    def _self_method_ref(self, node) -> str | None:
+        a = _self_attr(node)
+        if a is not None and self.cls is not None and a in self.cls.methods:
+            return a
+        return None
+
+    def _loop_target_names(self, fn_body) -> dict[str, list[str]]:
+        """Loop variable name -> self-methods appearing in the loop's
+        iterable (the reactor `for fn, ch in ((self._a, ...), ...)`
+        idiom)."""
+        out: dict[str, list[str]] = {}
+        for node in ast.walk(fn_body):
+            if not isinstance(node, ast.For):
+                continue
+            methods = []
+            for sub in ast.walk(node.iter):
+                m = self._self_method_ref(sub)
+                if m:
+                    methods.append(m)
+            if not methods:
+                continue
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).extend(methods)
+        return out
+
+    def _record_spawn(self, target, loop_targets, fn_def) -> None:
+        """Register `target` (the Thread(target=X) / submit(X) value)
+        as a thread root when resolvable."""
+        m = self._self_method_ref(target)
+        if m is not None:
+            self.roots_out.append((self.cls, m))
+            return
+        if isinstance(target, ast.Name):
+            for m in loop_targets.get(target.id, ()):
+                self.roots_out.append((self.cls, m))
+            # spawn-helper: the target is a parameter of this method
+            args = fn_def.args.posonlyargs + fn_def.args.args
+            for i, a in enumerate(args):
+                if a.arg == target.id:
+                    self.method.spawn_param = i - (
+                        1 if args and args[0].arg == "self" else 0
+                    )
+            # nested-def target (closure over self): pseudo-method
+            for node in ast.walk(fn_def):
+                if (
+                    isinstance(node, ast.FunctionDef)
+                    and node.name == target.id
+                    and self.cls is not None
+                ):
+                    pname = f"{self.method.name}.<{node.name}>"
+                    if pname not in self.cls.methods:
+                        pm = _Method(self.cls, pname, node.lineno)
+                        self.cls.methods[pname] = pm
+                        _BodyScanner(
+                            self.cls, self.module, pm, self.roots_out
+                        ).scan(node, nested_closure=True)
+                    self.roots_out.append((self.cls, pname))
+
+    # -- the walk
+
+    def scan(self, fn_def, nested_closure: bool = False) -> None:
+        self._loop_targets = self._loop_target_names(fn_def)
+        self._fn_def = fn_def
+        self._nested = nested_closure
+        self._stmts(fn_def.body, frozenset())
+
+    def _stmts(self, stmts, held: frozenset) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run later (targets handled separately)
+            if isinstance(stmt, ast.ClassDef):
+                continue
+            if isinstance(stmt, ast.With):
+                inner = held
+                for item in stmt.items:
+                    lid = self._lock_for(item.context_expr)
+                    if lid is not None:
+                        inner = inner | {lid}
+                    else:
+                        self._expr(item.context_expr, held)
+                self._stmts(stmt.body, inner)
+                continue
+            if isinstance(stmt, ast.Try):
+                # the manual-acquire idiom: `lk.acquire(); try: ...
+                # finally: lk.release()` — a finally that releases a
+                # known lock marks the try body (and handlers, which
+                # run BEFORE the finally) as held
+                released = frozenset(
+                    lid for fs in stmt.finalbody for n in ast.walk(fs)
+                    if isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "release"
+                    and (lid := self._lock_for(n.func.value)) is not None
+                )
+                inner = held | released
+                self._stmts(stmt.body, inner)
+                self._stmts(stmt.orelse, inner)
+                for h in stmt.handlers:
+                    self._stmts(h.body, inner)
+                self._stmts(stmt.finalbody, held)
+                continue
+            # expressions hanging off this statement
+            for field in ("value", "test", "iter", "msg", "exc", "cause"):
+                sub = getattr(stmt, field, None)
+                if sub is not None and isinstance(sub, ast.AST):
+                    self._expr(sub, held)
+            if isinstance(stmt, ast.Assign):
+                self._assign(stmt, held)
+            elif isinstance(stmt, ast.AugAssign):
+                self._augassign(stmt, held)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.target is not None:
+                a = _self_attr(stmt.target)
+                if a and stmt.value is not None:
+                    self._write(a, held, stmt.lineno)
+            elif isinstance(stmt, ast.If):
+                self._check_then_act(stmt, held)
+            elif isinstance(stmt, (ast.Return, ast.Expr)):
+                pass  # value handled above
+            # recurse into compound bodies at the same depth
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    self._stmts(sub, held)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._stmts(h.body, held)
+
+    # -- statement forms
+
+    def _assign(self, stmt: ast.Assign, held: frozenset) -> None:
+        reads_of: set[str] = set()
+        for n in ast.walk(stmt.value):
+            a = _self_attr(n)
+            if a is not None and isinstance(getattr(n, "ctx", None), ast.Load):
+                reads_of.add(a)
+        for t in stmt.targets:
+            a = _self_attr(t)
+            if a is not None:
+                rmw = (
+                    f"self.{a} = <expr reading self.{a}>"
+                    if a in reads_of else None
+                )
+                self._write(a, held, stmt.lineno, rmw=rmw)
+                continue
+            # self.attr[k] = v / self.a.b = v — content write to attr
+            base = t
+            while isinstance(base, (ast.Subscript, ast.Attribute)):
+                inner = base.value
+                a = _self_attr(inner)
+                if a is not None:
+                    rmw = (
+                        f"self.{a}[...] = <expr reading self.{a}>"
+                        if a in reads_of and isinstance(base, ast.Subscript)
+                        else None
+                    )
+                    self._write(a, held, stmt.lineno, rmw=rmw)
+                    break
+                base = inner
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    a = _self_attr(elt)
+                    if a is not None:
+                        self._write(a, held, stmt.lineno)
+
+    def _augassign(self, stmt: ast.AugAssign, held: frozenset) -> None:
+        t = stmt.target
+        a = _self_attr(t)
+        if a is not None:
+            self._write(a, held, stmt.lineno, rmw=f"self.{a} {_op(stmt.op)}= ...")
+            return
+        if isinstance(t, (ast.Subscript, ast.Attribute)):
+            a = _self_attr(t.value)
+            if a is not None:
+                self._write(a, held, stmt.lineno,
+                            rmw=f"self.{a}[...] {_op(stmt.op)}= ...")
+
+    def _check_then_act(self, stmt: ast.If, held: frozenset) -> None:
+        """`if k in self.d: ... self.d[k]` / `if not self.d.get(k): ...
+        self.d[k] = v` — dict/set check-then-act outside a lock."""
+        tested: set[str] = set()
+        for n in ast.walk(stmt.test):
+            if isinstance(n, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in n.ops
+            ):
+                for c in n.comparators:
+                    a = _self_attr(c)
+                    if a is not None:
+                        tested.add(a)
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "get"
+            ):
+                a = _self_attr(n.func.value)
+                if a is not None:
+                    tested.add(a)
+        if not tested:
+            return
+        for n in ast.walk(stmt):
+            written = None
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        written = _self_attr(t.value)
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _MUTATORS
+            ):
+                written = _self_attr(n.func.value)
+            if written in tested:
+                self._write(
+                    written, held, n.lineno,
+                    rmw=f"check-then-act on self.{written}",
+                )
+                return
+
+    # -- expressions
+
+    def _expr(self, expr, held: frozenset) -> None:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue  # deferred execution
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+            a = _self_attr(node)
+            if a is not None and isinstance(node.ctx, ast.Load):
+                self._read(a, held, node.lineno)
+                continue  # don't descend into the Name('self')
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _call(self, call: ast.Call, held: frozenset) -> None:
+        func = call.func
+        args_self_methods = tuple(
+            self._self_method_ref(a) or "" for a in call.args
+        )
+        # Thread(target=...) / executor.submit(self._m, ...)
+        chain = _chain(func) or ""
+        if chain.endswith("Thread") or chain in ("Thread", "threading.Thread"):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    self._record_spawn(kw.value, self._loop_targets,
+                                       self._fn_def)
+        elif isinstance(func, ast.Attribute) and func.attr == "submit":
+            if call.args:
+                self._record_spawn(call.args[0], self._loop_targets,
+                                   self._fn_def)
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                # self.m(...) — intra-class when defined here, else a
+                # unique-name candidate (inherited/mixin methods)
+                self.method.calls.append(_Call(
+                    func.attr,
+                    self.cls is not None and func.attr in self.cls.methods,
+                    held, args_self_methods))
+                return
+            a = _self_attr(recv)
+            if a is not None:
+                # self.x.m(...) — a method ON the attr object: container
+                # mutators on PLAIN containers are writes to x, anything
+                # else reads x AND is a cross-class edge candidate (the
+                # reactor->PeerState shape: self.ps.apply_...())
+                if func.attr in _MUTATORS and (
+                    self.cls is None or a in self.cls.container_attrs
+                ):
+                    self._write(a, held, call.lineno)
+                else:
+                    self._read(a, held, call.lineno)
+                    self.method.calls.append(_Call(
+                        func.attr, False, held, args_self_methods))
+            else:
+                # cross-class candidate: x.m(...)
+                self.method.calls.append(_Call(
+                    func.attr, False, held, args_self_methods))
+        elif isinstance(func, ast.Name):
+            self.method.calls.append(_Call(
+                func.id, False, held, args_self_methods))
+
+    def _write(self, attr: str, held: frozenset, line: int,
+               rmw: str | None = None) -> None:
+        if attr.startswith("__"):
+            return
+        self.method.accesses.append(_Access(attr, "write", held, line, rmw))
+
+    def _read(self, attr: str, held: frozenset, line: int) -> None:
+        if attr.startswith("__"):
+            return
+        self.method.accesses.append(_Access(attr, "read", held, line))
+
+
+def _op(op) -> str:
+    return {
+        ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.Div: "/",
+        ast.FloorDiv: "//", ast.Mod: "%", ast.BitOr: "|",
+        ast.BitAnd: "&", ast.BitXor: "^", ast.LShift: "<<",
+        ast.RShift: ">>",
+    }.get(type(op), "?")
+
+
+def _collect_module(path: str, tree: ast.Module, lines: list[str],
+                    roots: list) -> _ModuleInfo:
+    mod = _ModuleInfo(path, lines)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_ctor(node.value, _LOCK_CTORS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mod.module_locks[t.id] = f"{path}:{t.id}"
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = _Class(mod, node.name, node.lineno)
+            mod.classes[node.name] = cls
+            _collect_class(cls, node, roots)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = _Method(None, node.name, node.lineno)
+            mod.functions[node.name] = fn
+            _BodyScanner(None, mod, fn, roots).scan(node)
+    return mod
+
+
+def _collect_class(cls: _Class, node: ast.ClassDef, roots: list) -> None:
+    # pass 1: lock + sync attribute identification (Condition(self._x)
+    # aliases to _x's lock id; bare Condition() gets its own id)
+    method_defs = [
+        n for n in node.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for m in method_defs:
+        for sub in ast.walk(m):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for t in sub.targets:
+                a = _self_attr(t)
+                if a is None:
+                    continue
+                v = sub.value
+                if _is_ctor(v, _LOCK_CTORS):
+                    inner = None
+                    if (
+                        isinstance(v, ast.Call) and v.args
+                        and (_chain(v.func) or "").endswith("Condition")
+                    ):
+                        inner = _self_attr(v.args[0])
+                    if inner is not None and inner in cls.lock_attrs:
+                        cls.lock_attrs[a] = cls.lock_attrs[inner]
+                    else:
+                        cls.lock_attrs[a] = (
+                            f"{cls.module.path}:{cls.name}.{a}"
+                        )
+                    cls.sync_attrs.add(a)
+                elif _is_ctor(v, _SYNC_CTORS) or _is_metric_factory(v):
+                    cls.sync_attrs.add(a)
+                elif isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                                    ast.ListComp, ast.SetComp)) or _is_ctor(
+                                        v, _CONTAINER_CTORS):
+                    cls.container_attrs.add(a)
+    # pass 2: method bodies
+    for m in method_defs:
+        meth = _Method(cls, m.name, m.lineno)
+        cls.methods[m.name] = meth
+    for m in method_defs:
+        _BodyScanner(cls, cls.module, cls.methods[m.name], roots).scan(m)
+
+
+# ------------------------------------------------------------- propagation
+
+
+class _Graph:
+    """The package call graph + per-root entry-lockset dataflow."""
+
+    def __init__(self, modules: dict[str, _ModuleInfo]):
+        self.modules = modules
+        # unambiguous method name -> (class, method)
+        by_name: dict[str, list] = {}
+        for mod in modules.values():
+            for cls in mod.classes.values():
+                for name, meth in cls.methods.items():
+                    by_name.setdefault(name, []).append((cls, meth))
+        self.unique = {
+            n: targets[0] for n, targets in by_name.items()
+            if len(targets) == 1 and n not in _GENERIC_NAMES
+            and not n.startswith("__")
+        }
+
+    def _resolve(self, caller: _Method, call: _Call):
+        if call.is_self and caller.cls is not None:
+            return caller.cls.methods.get(call.name)
+        hit = self.unique.get(call.name)
+        if hit is not None:
+            return hit[1]
+        # module-level function in the same module
+        if caller.cls is not None:
+            return caller.cls.module.functions.get(call.name)
+        return None
+
+    def reach(self, root_method: _Method):
+        """{method: entry_lockset} reachable from root (meet-over-paths:
+        a method reached twice keeps only locks held on EVERY path)."""
+        entry: dict[_Method, frozenset] = {root_method: frozenset()}
+        work = [root_method]
+        while work:
+            m = work.pop()
+            base = entry[m]
+            for call in m.calls:
+                callee = self._resolve(m, call)
+                if callee is None:
+                    continue
+                new = base | call.locks
+                cur = entry.get(callee)
+                if cur is None:
+                    entry[callee] = new
+                    work.append(callee)
+                elif not (cur <= new):
+                    entry[callee] = cur & new
+                    work.append(callee)
+        return entry
+
+
+# --------------------------------------------------------------- judgment
+
+
+def _root_name(cls: _Class | None, mname: str) -> str:
+    if cls is None:
+        return mname
+    return f"{cls.name}.{mname}"
+
+
+def analyze_race(
+    root: str,
+    report_paths: list[str],
+    selected,
+    parsed: dict[str, tuple] | None = None,
+) -> list[Finding]:
+    """Run the thread-escape lockset analysis over the whole package at
+    `root`, reporting findings only for files in `report_paths`.
+    `parsed` maps path -> (ast tree, source lines) for files the caller
+    already parsed (rules.analyze hands its modules in)."""
+    from . import discover_files
+
+    parsed = parsed or {}
+    all_files = discover_files(root)
+    modules: dict[str, _ModuleInfo] = {}
+    spawn_roots: list = []
+    for path in all_files:
+        if path in parsed:
+            tree, lines = parsed[path]
+        else:
+            try:
+                with open(os.path.join(root, path), encoding="utf-8") as f:
+                    text = f.read()
+                tree = ast.parse(text, filename=path)
+                lines = text.splitlines()
+            except (OSError, SyntaxError):
+                continue  # rules.analyze already reports unparsable files
+        modules[path] = _collect_module(path, tree, lines, spawn_roots)
+
+    graph = _Graph(modules)
+
+    # spawn-helper indirection: a call to a method whose body threads
+    # one of its PARAMETERS (the Router._spawn idiom) roots the bound
+    # method passed at that position — found globally, because the
+    # helper is typically called from __init__/start(), which no thread
+    # root reaches
+    for mod in modules.values():
+        all_methods = [
+            m for cls in mod.classes.values() for m in cls.methods.values()
+        ] + list(mod.functions.values())
+        for meth in all_methods:
+            for call in meth.calls:
+                callee = graph._resolve(meth, call)
+                if callee is None or callee.spawn_param is None:
+                    continue
+                i = callee.spawn_param
+                if 0 <= i < len(call.args_self_methods):
+                    mname = call.args_self_methods[i]
+                    if mname and meth.cls is not None:
+                        spawn_roots.append((meth.cls, mname))
+
+    # thread roots: every spawn-resolved (class, method), deduplicated
+    roots: dict[str, _Method] = {}
+    for cls, mname in spawn_roots:
+        if cls is None:
+            continue
+        meth = cls.methods.get(mname)
+        if meth is not None:
+            roots[f"{cls.module.path}:{_root_name(cls, mname)}"] = meth
+
+    # per-root reachability with entry locksets
+    reach: dict[str, dict] = {
+        rid: graph.reach(m) for rid, m in roots.items()
+    }
+
+    # the synthetic public-API root per class: accesses in public
+    # methods NOT already attributed to a thread root still happen on
+    # SOME caller thread (RPC handlers, the consensus thread, tests)
+    thread_rooted: set = set()
+    for entry in reach.values():
+        thread_rooted.update(entry.keys())
+
+    findings: list[Finding] = []
+    report_set = set(report_paths)
+    for mod in modules.values():
+        for cls in mod.classes.values():
+            findings.extend(
+                _judge_class(cls, graph, roots, reach, thread_rooted,
+                             selected)
+            )
+    findings = [f for f in findings if f.path in report_set]
+    return findings
+
+
+def _judge_class(cls, graph, roots, reach, thread_rooted, selected):
+    findings: list[Finding] = []
+    accesses: dict[str, list] = {}
+
+    # thread-root attributed accesses
+    for rid, entry in reach.items():
+        for meth, entry_locks in entry.items():
+            if meth.cls is not cls or meth.name in _INIT_METHODS:
+                continue
+            for acc in meth.accesses:
+                accesses.setdefault(acc.attr, []).append(
+                    (rid, meth, acc, entry_locks | acc.locks)
+                )
+
+    # synthetic public-API root: public methods not reached by any
+    # thread root, plus everything they reach intra-class
+    pub_id = f"{cls.module.path}:{cls.name}.{PUBLIC_ROOT}"
+    pub_seen: set = set()
+    for name, meth in cls.methods.items():
+        if name.startswith("_") or meth in thread_rooted:
+            continue
+        for callee, entry_locks in graph.reach(meth).items():
+            if callee.cls is not cls or callee.name in _INIT_METHODS:
+                continue
+            key = (callee, entry_locks)
+            if key in pub_seen:
+                continue
+            pub_seen.add(key)
+            for acc in callee.accesses:
+                accesses.setdefault(acc.attr, []).append(
+                    (pub_id, callee, acc, entry_locks | acc.locks)
+                )
+
+    for attr, accs in sorted(accesses.items()):
+        if attr in cls.sync_attrs:
+            continue
+        writes = [a for a in accs if a[2].kind == "write"]
+        if not writes:
+            continue
+        # single-assignment flags: every write assigns a bare constant
+        if all(_is_flag_write(cls, w[1], w[2]) for w in writes):
+            continue
+        write_roots = {w[0] for w in writes}
+        all_roots = {a[0] for a in accs}
+        shared = len(all_roots) >= 2
+
+        inter = None
+        for _rid, _m, _a, locks in writes:
+            inter = locks if inter is None else (inter & locks)
+
+        if "shared-mutation" in selected and len(write_roots) >= 2:
+            if not inter and any(not w[3] for w in writes):
+                w = min(writes, key=lambda w: (len(w[3]), w[2].line))
+                findings.append(_finding(
+                    cls, "shared-mutation", w[2].line,
+                    f"{cls.name}.{attr} is written from "
+                    f"{len(write_roots)} thread roots "
+                    f"({_fmt_roots(write_roots)}) with no common "
+                    "guarding lock — unguarded shared mutation (wrap "
+                    "the writes in one lock, or suppress with the "
+                    "reason if the field is thread-confined by design)",
+                ))
+                continue
+        if "guard-consistency" in selected and len(writes) >= 2:
+            methods_w = {w[1].name for w in writes}
+            if (
+                not inter
+                and len(methods_w) >= 2
+                and all(w[3] for w in writes)
+            ):
+                locksets = sorted({
+                    "{" + ", ".join(sorted(_short_lock(l) for l in w[3])) + "}"
+                    for w in writes
+                })
+                w = writes[0]
+                findings.append(_finding(
+                    cls, "guard-consistency", w[2].line,
+                    f"{cls.name}.{attr} is guarded by DIFFERENT locks "
+                    f"in different methods ({', '.join(sorted(methods_w))}: "
+                    f"{' vs '.join(locksets)}) — mutual exclusion that "
+                    "excludes nothing",
+                ))
+                continue
+        if "atomicity" in selected and shared:
+            for _rid, meth, acc, locks in accs:
+                if acc.rmw and not locks and acc.kind == "write":
+                    findings.append(_finding(
+                        cls, "atomicity", acc.line,
+                        f"{cls.name}.{meth.name} performs a compound "
+                        f"read-modify-write ({acc.rmw}) on the shared "
+                        f"field {attr!r} outside any lock region — "
+                        "each step is GIL-atomic, the compound is not",
+                    ))
+                    break  # one report per attr
+    return findings
+
+
+def _is_flag_write(cls, meth, acc) -> bool:
+    """True when the write at acc.line assigns a bare True/False/None
+    constant (the shutdown-flag idiom — atomic under the GIL)."""
+    line = (
+        cls.module.lines[acc.line - 1]
+        if 1 <= acc.line <= len(cls.module.lines) else ""
+    )
+    tail = line.split("=", 1)[1].strip() if "=" in line else ""
+    tail = tail.split("#", 1)[0].strip()
+    return tail in ("True", "False", "None")
+
+
+def _short_lock(lock_id: str) -> str:
+    return lock_id.rsplit(":", 1)[-1]
+
+
+def _fmt_roots(rids) -> str:
+    names = sorted(r.rsplit(":", 1)[-1] for r in rids)
+    return ", ".join(names[:4]) + (", ..." if len(names) > 4 else "")
+
+
+def _finding(cls: _Class, rule: str, line: int, message: str) -> Finding:
+    lines = cls.module.lines
+    snippet = lines[line - 1].strip() if 1 <= line <= len(lines) else ""
+    return Finding(rule, cls.module.path, line, message, snippet)

@@ -1,0 +1,357 @@
+"""tmrace runtime shared-state race sanitizer
+(docs/static-analysis.md#racecheck).
+
+The static half (check/race.py) judges locksets the AST can see; this
+is the runtime complement — an Eraser-style lockset discipline on the
+hot shared classes the repo's threads actually contend on, seeing
+through every indirection the AST can't. `TM_TPU_RACECHECK=1` installs
+a `__setattr__` shim on each declared hot class (mempool pool + cache,
+blocksync pool, consensus peer state, engine, router); every attribute
+WRITE is tracked per (instance, field) through a small state machine:
+
+  EXCLUSIVE  first writer owns the field; same-thread writes are free.
+             A second thread's first write TRANSFERS ownership (the
+             dominant in-tree idiom: __init__ populates, one worker
+             thread owns thereafter — never a report) and seeds the
+             field's candidate lockset from the locks that thread held.
+  SHARED     every subsequent write intersects the candidate lockset
+             with the writer's held locks. A candidate that shrinks to
+             EMPTY while >=2 distinct threads have written in the
+             shared phase is the Eraser verdict: no single lock
+             protected every write — a `shared_state_race` event
+             streams to <home>/racecheck.jsonl (flight-recorder crash
+             contract), once per (class, field).
+
+Held locks come from lockcheck's per-thread bookkeeping
+(`LockCheck.held_sites`, check/lockcheck.py) — enabling racecheck
+force-installs the lock construction shim even when TM_TPU_LOCKCHECK
+is off, so lock identity is the construction site there and here.
+
+Opt-outs: a hot class may declare `_tmrace_ignore_ = frozenset({...})`
+naming fields that are deliberately lock-free (the runtime analog of
+the static `# tmcheck: ok` comment — same contract: the reason lives
+next to the declaration). Fields whose written value is a bool/None
+constant are skipped outright (`self._stopped = True` shutdown flags —
+atomic reference stores by design).
+
+Known limitations (documented, not bugs): container CONTENTS mutation
+(`self.d[k] = v`, `self.q.append(x)`) does not pass through
+`__setattr__` — the static half's mutator tracking covers those sites;
+lock identity is the construction site, so two locks born on one line
+alias; classes defining their own `__setattr__` are not shimmable
+(none of the declared set does — pinned by test).
+
+Disabled (the default) nothing is constructed: `maybe_install` reads
+one env var and returns None — the hot classes' method tables are
+untouched.
+
+Import discipline: stdlib-only at import time. The node-runtime hot
+classes are imported lazily INSIDE attach_declared(), which only runs
+when the sanitizer is enabled — the module itself stays in the
+import-isolated check/ plane.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time as _time
+
+from . import lockcheck as _lockcheck
+
+__all__ = [
+    "RaceCheck",
+    "HOT_CLASSES",
+    "enabled_in_env",
+    "maybe_install",
+    "ARTIFACT_NAME",
+]
+
+ARTIFACT_NAME = "racecheck.jsonl"
+
+# Declared hot classes: the shared-state planes PR 6-12 grew threads
+# around. Dotted module path : class name; resolved lazily at attach.
+HOT_CLASSES = (
+    "tendermint_tpu.mempool.mempool:TxMempool",
+    "tendermint_tpu.mempool.mempool:LRUTxCache",
+    "tendermint_tpu.blocksync.pool:BlockPool",
+    "tendermint_tpu.consensus.peer_state:PeerState",
+    "tendermint_tpu.ops.engine:VerifyEngine",
+    "tendermint_tpu.p2p.router:Router",
+)
+
+_STATE_SLOT = "_tmrace_fields_"
+IGNORE_SLOT = "_tmrace_ignore_"
+
+
+def enabled_in_env(env=None) -> bool:
+    v = (env if env is not None else os.environ).get("TM_TPU_RACECHECK", "")
+    return v.strip().lower() in ("1", "on", "true", "yes")
+
+
+class _FieldState:
+    """Per-(instance, field) Eraser state. Mutated under the owning
+    RaceCheck's real lock only on the slow path (thread transition /
+    lockset change); the fast path (same thread, same lockset) reads
+    plain attributes."""
+
+    __slots__ = ("owner", "candidate", "shared_writers", "writer_names",
+                 "reported")
+
+    def __init__(self, owner: int):
+        self.owner = owner          # thread ident of the first writer
+        self.candidate = None       # frozenset once SHARED, None while EXCLUSIVE
+        self.shared_writers: set = set()
+        # names captured at write time — a writer may be dead by the
+        # time the race is reported
+        self.writer_names: set = set()
+        self.reported = False
+
+
+class RaceCheck:
+    """The sanitizer: hot-class shims, per-field lockset state, event
+    stream. One instance per process (maybe_install); tests build
+    private instances against temp paths and uninstall in finally."""
+
+    def __init__(self, out_path: str, lockcheck: "_lockcheck.LockCheck"):
+        self.out_path = out_path
+        self.lockcheck = lockcheck
+        self._file = None
+        self._mu = _lockcheck._REAL_LOCK()       # field-state transitions
+        self._emit_mu = _lockcheck._REAL_LOCK()  # event file
+        self._patched: list = []  # (cls, original __setattr__)
+        self.counts = {"writes": 0, "races": 0}
+        self._fields_seen: set = set()  # (cls_name, field) ever tracked
+        self._finalized = False
+
+    # ------------------------------------------------------------- events
+
+    def _emit(self, kind: str, **fields) -> None:
+        rec = {"t": round(_time.time(), 3), "kind": kind, **fields}
+        with self._emit_mu:
+            try:
+                if self._file is None:
+                    self._file = open(self.out_path, "a", encoding="utf-8")
+                self._file.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                self._file.flush()
+            except OSError:
+                pass  # sanitizer must never fail the node
+
+    # -------------------------------------------------------------- shim
+
+    def watch_class(self, cls) -> None:
+        """Install the write-tracking `__setattr__` shim on `cls`.
+        Refuses classes with their own __setattr__ (the shim would
+        shadow real semantics) — none of the declared set has one."""
+        existing = cls.__dict__.get("__setattr__")
+        if existing is not None:
+            if getattr(existing, "_tmrace_shim_", False):
+                return  # already watched
+            raise TypeError(
+                f"racecheck cannot shim {cls.__name__}: it defines its "
+                "own __setattr__"
+            )
+        check = self
+        real_set = cls.__setattr__  # object.__setattr__ via the MRO
+        ignore = frozenset(getattr(cls, IGNORE_SLOT, ()))
+        cls_name = cls.__name__
+
+        def __setattr__(obj, name, value):  # noqa: N807
+            real_set(obj, name, value)
+            if name == _STATE_SLOT:
+                return
+            if name in ignore or value is None or value is True or value is False:
+                # constant/None stores are atomic reference swaps — the
+                # shutdown-flag idiom (mirrors the static rule's
+                # single-assignment-flag allowlist)
+                return
+            check._on_write(obj, cls_name, name)
+
+        __setattr__._tmrace_shim_ = True
+        cls.__setattr__ = __setattr__
+        self._patched.append((cls, real_set))
+
+    def uninstall(self) -> None:
+        for cls, real_set in self._patched:
+            # the shim sits in cls.__dict__; deleting it re-exposes the
+            # inherited object.__setattr__ (== real_set for this set)
+            try:
+                del cls.__setattr__
+            except AttributeError:
+                cls.__setattr__ = real_set
+        self._patched.clear()
+
+    # ---------------------------------------------------------- tracking
+
+    def _on_write(self, obj, cls_name: str, field: str) -> None:
+        self.counts["writes"] += 1  # benign int bump; exactness via GIL
+        states = obj.__dict__.get(_STATE_SLOT)
+        tid = threading.get_ident()
+        if states is None:
+            with self._mu:
+                states = obj.__dict__.get(_STATE_SLOT)
+                if states is None:
+                    states = {}
+                    object.__setattr__(obj, _STATE_SLOT, states)
+        st = states.get(field)
+        if st is None:
+            with self._mu:
+                st = states.get(field)
+                if st is None:
+                    states[field] = _FieldState(tid)
+                    self._fields_seen.add((cls_name, field))
+                    return
+        if st.candidate is None and tid == st.owner:
+            return  # EXCLUSIVE fast path: same-thread write
+        with self._mu:
+            held = frozenset(self.lockcheck.held_sites())
+            if st.candidate is None:
+                # ownership transfer: the second thread seeds the
+                # candidate lockset; the init-phase writer's (usually
+                # lock-free) stores never poison it
+                st.candidate = held
+                st.shared_writers = {tid}
+                st.writer_names = {threading.current_thread().name}
+                return
+            st.shared_writers.add(tid)
+            st.writer_names.add(threading.current_thread().name)
+            st.candidate &= held
+            if (
+                not st.candidate
+                and len(st.shared_writers) >= 2
+                and not st.reported
+            ):
+                st.reported = True
+                self.counts["races"] += 1
+                f = sys._getframe(2)  # _on_write -> shim -> the write
+                fn = f.f_code.co_filename
+                idx = fn.rfind(os.sep + "tendermint_tpu" + os.sep)
+                site = (
+                    f"{(fn[idx + 1:] if idx >= 0 else os.path.basename(fn)).replace(os.sep, '/')}"
+                    f":{f.f_lineno}"
+                )
+                threads = sorted(st.writer_names)
+                self._emit(
+                    "shared_state_race",
+                    cls=cls_name,
+                    field=field,
+                    threads=threads,
+                    site=site,
+                    thread=threading.current_thread().name,
+                )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def attach_declared(self) -> list:
+        """Import + shim every HOT_CLASSES entry. Returns the classes
+        patched. Import errors are tolerated per entry (a stripped-down
+        deployment without e.g. the engine must still sanitize the
+        rest)."""
+        import importlib
+
+        out = []
+        for spec in HOT_CLASSES:
+            mod_name, _, cls_name = spec.partition(":")
+            try:
+                cls = getattr(importlib.import_module(mod_name), cls_name)
+            except (ImportError, AttributeError):
+                continue
+            self.watch_class(cls)
+            out.append(cls)
+        return out
+
+    def finalize(self) -> None:
+        """Write the summary record (atexit; idempotent). Overhead
+        estimate: observed writes x a per-write shim cost calibrated
+        NOW against a plain setattr on this machine."""
+        with self._mu:
+            if self._finalized:
+                return
+            self._finalized = True
+            writes = self.counts["writes"]
+            races = self.counts["races"]
+            fields = len(self._fields_seen)
+            classes = len({c for c, _f in self._fields_seen})
+        per_op = self._calibrate()
+        self._emit(
+            "summary",
+            classes=classes,
+            fields=fields,
+            writes=writes,
+            races=races,
+            overhead_s_est=round(writes * per_op, 6),
+        )
+        with self._emit_mu:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    def _calibrate(self, n: int = 2000, rounds: int = 3) -> float:
+        """Shim cost per tracked write beyond a plain attribute store.
+        Best-of-rounds, like lockcheck's calibration: the minimum is
+        the closest observable to the true per-op cost on a loaded
+        box."""
+
+        class _Plain:
+            pass
+
+        class _Shimmed:
+            pass
+
+        self.watch_class(_Shimmed)
+        try:
+            plain, shimmed = _Plain(), _Shimmed()
+            writes_before = self.counts["writes"]
+            base = cost = None
+            for _ in range(rounds):
+                t0 = _time.perf_counter()
+                for i in range(n):
+                    plain.f = i
+                base = min(b for b in (base, _time.perf_counter() - t0)
+                           if b is not None)
+                t0 = _time.perf_counter()
+                for i in range(n):
+                    shimmed.f = i
+                cost = min(c for c in (cost, _time.perf_counter() - t0)
+                           if c is not None)
+            self.counts["writes"] = writes_before  # not workload writes
+            self._fields_seen.discard(("_Shimmed", "f"))
+        finally:
+            # unpatch just the calibration class
+            for i, (cls, real) in enumerate(self._patched):
+                if cls is _Shimmed:
+                    del cls.__setattr__
+                    del self._patched[i]
+                    break
+        return max(0.0, (cost - base) / n)
+
+
+_ACTIVE: RaceCheck | None = None
+
+
+def maybe_install(home: str | None = None, env=None) -> RaceCheck | None:
+    """Install the process-wide race sanitizer when TM_TPU_RACECHECK is
+    set. Disabled path: one env read, nothing constructed, None
+    returned. The artifact lands at <home>/racecheck.jsonl (cwd without
+    a home). Force-installs the lockcheck construction shim (held-locks
+    bookkeeping is the lockset source); lockcheck's own event stream
+    activates alongside — a racecheck-enabled node always leaves both
+    artifacts."""
+    if not enabled_in_env(env):
+        return None
+    global _ACTIVE
+    if _ACTIVE is not None:
+        return _ACTIVE
+    lock_env = dict(env if env is not None else os.environ)
+    lock_env["TM_TPU_LOCKCHECK"] = "1"  # force the shim; keep e.g. BUDGET_MS
+    lc = _lockcheck.maybe_install(home, env=lock_env)
+    _ACTIVE = RaceCheck(os.path.join(home or ".", ARTIFACT_NAME), lc)
+    _ACTIVE.attach_declared()
+    atexit.register(_ACTIVE.finalize)
+    return _ACTIVE

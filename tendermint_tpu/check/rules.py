@@ -1000,6 +1000,7 @@ def analyze(root: str, files: list[str], selected) -> tuple[list[Finding], dict]
     findings: list[Finding] = []
     sources: dict[str, list[str]] = {}
     decls = _collect_metric_decls(root) if "metric-drift" in selected else None
+    parsed: dict[str, tuple] = {}
     for path in files:
         full = os.path.join(root, path)
         try:
@@ -1010,6 +1011,7 @@ def analyze(root: str, files: list[str], selected) -> tuple[list[Finding], dict]
             raise ValueError(f"tmcheck cannot parse {path}: {e}") from e
         mod = _Module(path, tree, text.splitlines())
         sources[path] = mod.lines
+        parsed[path] = (tree, mod.lines)
         if "lock-blocking" in selected:
             _LockBlockingRule(mod, findings).run()
         if "cache-stale" in selected:
@@ -1024,4 +1026,11 @@ def analyze(root: str, files: list[str], selected) -> tuple[list[Finding], dict]
             _TracePairingRule(mod, findings).run()
         if "unused-import" in selected:
             _UnusedImportRule(mod, findings).run()
+    # the thread-escape lockset rules need the WHOLE package in view
+    # (a reactor thread reaching PeerState is a cross-module edge), so
+    # they run once over the tree and report only on `files`
+    from .race import RACE_RULES, analyze_race
+
+    if any(r in selected for r in RACE_RULES):
+        findings.extend(analyze_race(root, files, selected, parsed))
     return findings, sources

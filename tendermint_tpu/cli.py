@@ -104,6 +104,20 @@ def cmd_start(args) -> int:
     if lockcheck is not None:
         print(f"lockcheck sanitizer on -> {lockcheck.out_path}")
 
+    # TM_TPU_RACECHECK=1 (same e2e passthrough): Eraser-style lockset
+    # sanitizer on the declared hot classes (check/racecheck.py).
+    # Installed AFTER lockcheck's env check but BEFORE node-runtime
+    # imports: attach_declared imports the hot-class modules itself,
+    # and the lock shim it force-installs must be in place first so
+    # their module-global locks land in the order graph. Events stream
+    # to <home>/racecheck.jsonl (shared_state_race gate). Disabled:
+    # constructs nothing.
+    from .check.racecheck import maybe_install as maybe_install_racecheck
+
+    racecheck = maybe_install_racecheck(args.home)
+    if racecheck is not None:
+        print(f"racecheck sanitizer on -> {racecheck.out_path}")
+
     from .config import load_config
     from .lens.profiler import maybe_start_profiler
     from .node import Node

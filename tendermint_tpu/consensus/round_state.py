@@ -70,6 +70,7 @@ class HeightVoteSet:
             )
         else:
             precommits = VoteSet(self.chain_id, self.height, round_, PRECOMMIT, self.val_set)
+        # tmcheck: ok[shared-mutation] single-consumer discipline: vote sets mutate only on the consensus thread (reactor reads go through the receive queue)
         self._round_vote_sets[round_] = (prevotes, precommits)
 
     def _get(self, round_: int, vote_type: int) -> VoteSet | None:

@@ -450,6 +450,7 @@ class ConsensusState:
         self._quorum_done.clear()
         self._quorum_trace_us.clear()
         self._part_trace_us.clear()
+        # tmcheck: ok[shared-mutation] single-consumer discipline: update_to_state runs on the boot/statesync handoff BEFORE the receive routine consumes, then only on it
         self.state = state
         if self.metrics is not None:
             self.metrics.validators.set(state.validators.size())
@@ -524,6 +525,7 @@ class ConsensusState:
         (ref: newStep state.go:861)."""
         rs = self.rs
         self.wal.write(EventRoundStep(rs.height, rs.round, rs.step))
+        # tmcheck: ok[shared-mutation,atomicity] single-consumer discipline: _new_step only runs on the consensus thread (handoff callers precede it)
         self._n_steps += 1
         if _trace.enabled():
             from .round_state import STEP_NAMES

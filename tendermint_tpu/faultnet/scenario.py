@@ -99,6 +99,7 @@ class Scenario:
         return self.events[-1].at if self.events else 0.0
 
     def reset(self) -> None:
+        # tmcheck: ok[shared-mutation] sequential lifecycle: reset() runs between scenario drives, never concurrently with the driver thread
         self._applied = 0
 
     def apply_until(self, net, t: float) -> list[FaultEvent]:

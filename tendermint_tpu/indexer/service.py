@@ -19,6 +19,7 @@ class IndexerService:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
+        # tmcheck: ok[shared-mutation] handoff: start() publishes _sub before the thread exists; _run is the sole writer afterwards
         self._sub = self.event_bus.subscribe(
             self.SUBSCRIBER, parse_query(f"tm.event = '{EVENT_NEW_BLOCK}'"), buffer_size=512
         )

@@ -32,6 +32,7 @@ __all__ = [
     "analyze_node",
     "analyze_run",
     "summarize_lockcheck",
+    "summarize_racecheck",
     "write_merged_trace",
     "render_summary",
     "REPORT_NAME",
@@ -63,7 +64,8 @@ def discover_nodes(run_dir: str) -> list[tuple[str, str]]:
         if any(
             os.path.exists(os.path.join(d, f))
             for f in ("metrics.txt", "trace.json", "profile.collapsed",
-                      "timeseries.jsonl", "lockcheck.jsonl")
+                      "timeseries.jsonl", "lockcheck.jsonl",
+                      "racecheck.jsonl")
         ):
             out.append((entry, d))
     return out
@@ -126,6 +128,50 @@ def summarize_lockcheck(path: str) -> dict:
         out["sites"] = max(_num(s, "sites") for s in summaries)
         out["edges"] = max(_num(s, "edges") for s in summaries)
         out["acquires"] = sum(_num(s, "acquires") for s in summaries)
+        out["overhead_s_est"] = round(
+            sum(_num(s, "overhead_s_est") for s in summaries), 6
+        )
+    return out
+
+
+def summarize_racecheck(path: str) -> dict:
+    """Digest of a node's racecheck.jsonl (check/racecheck.py): the
+    shared_state_race events themselves (class/field/threads — the
+    evidence the gate detail carries) and the final summary record's
+    tracking stats + overhead estimate. Multi-segment (restarted-node)
+    files sum additive quantities and MAX the per-process tracking
+    sizes, like summarize_lockcheck. Tolerates a truncated tail."""
+    races: list = []
+    summaries: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail (SIGKILL mid-append)
+            if not isinstance(rec, dict):
+                continue
+            kind = rec.get("kind")
+            if kind == "shared_state_race":
+                races.append({
+                    "cls": rec.get("cls"), "field": rec.get("field"),
+                    "threads": rec.get("threads"), "site": rec.get("site"),
+                })
+            elif kind == "summary":
+                summaries.append(rec)
+    out: dict = {"races": races}
+    if summaries:
+        def _num(rec, key):
+            v = rec.get(key)
+            return v if isinstance(v, (int, float)) else 0
+
+        out["segments"] = len(summaries)
+        out["classes"] = max(_num(s, "classes") for s in summaries)
+        out["fields"] = max(_num(s, "fields") for s in summaries)
+        out["writes"] = sum(_num(s, "writes") for s in summaries)
         out["overhead_s_est"] = round(
             sum(_num(s, "overhead_s_est") for s in summaries), 6
         )
@@ -265,6 +311,17 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
             summary["lockcheck"] = None
             summary["lockcheck_error"] = f"{type(e).__name__}: {e}"
 
+    # racecheck sanitizer stream (TM_TPU_RACECHECK=1 nodes,
+    # check/racecheck.py): the shared_state_race gate reads this
+    rpath = os.path.join(node_dir, "racecheck.jsonl")
+    if os.path.exists(rpath):
+        summary["artifacts"].append("racecheck.jsonl")
+        try:
+            summary["racecheck"] = summarize_racecheck(rpath)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            summary["racecheck"] = None
+            summary["racecheck_error"] = f"{type(e).__name__}: {e}"
+
     if os.path.exists(tpath):
         summary["artifacts"].append("trace.json")
         try:
@@ -372,6 +429,25 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
                 if (ests := [
                     lc["overhead_s_est"] for lc in lchecks
                     if lc.get("overhead_s_est") is not None
+                ])
+                else None  # None = no summary record, NOT zero overhead
+            ),
+        }
+
+    # racecheck fleet digest (the shared_state_race gate reads per-node
+    # blocks; the roll-up puts the <=2% combined-sanitizer acceptance
+    # budget next to lockcheck's half)
+    rchecks = [s["racecheck"] for s in summaries if s.get("racecheck")]
+    fleet["nodes_with_racecheck"] = len(rchecks)
+    if rchecks:
+        fleet["racecheck"] = {
+            "races": sum(len(rc["races"]) for rc in rchecks),
+            "writes": sum(rc.get("writes") or 0 for rc in rchecks),
+            "overhead_s_est": (
+                round(sum(ests), 6)
+                if (ests := [
+                    rc["overhead_s_est"] for rc in rchecks
+                    if rc.get("overhead_s_est") is not None
                 ])
                 else None  # None = no summary record, NOT zero overhead
             ),
@@ -522,6 +598,13 @@ def render_summary(report: dict) -> str:
                 f"(worst {lc.get('worst_hold_s')}s), "
                 f"{lc['blocking_under_lock_events']} sleeps-under-lock, "
                 f"overhead est {lc.get('overhead_s_est')}s"
+            )
+        rc = s.get("racecheck")
+        if rc:
+            lines.append(
+                f"    racecheck: {len(rc['races'])} shared-state races, "
+                f"{rc.get('fields')} fields / {rc.get('writes')} writes "
+                f"tracked, overhead est {rc.get('overhead_s_est')}s"
             )
         cp = (s.get("critical_path") or {}).get("totals")
         if cp and cp.get("heights"):

@@ -44,6 +44,12 @@ test assertions):
                      verdict failure even when this run's interleaving
                      happened to survive it; the detail names the lock
                      construction sites in cycle order
+  shared_state_race  a TM_TPU_RACECHECK=1 node's racecheck.jsonl
+                     (check/racecheck.py) recorded more than
+                     `max_shared_state_races` (default 0) Eraser
+                     lockset violations — a hot-class field written
+                     from >=2 threads with no common lock; the detail
+                     names class, field, and the writing threads
   perf_regression    the run dir's perf ledger (ledger.jsonl,
                      tendermint_tpu/perf/) shows the latest run's
                      median for some stage below its blessed baseline
@@ -55,9 +61,10 @@ test assertions):
 
 rate_stall / churn_storm pass vacuously when no node left a
 timeseries.jsonl (flight recorder off), journey_stall when no node
-left journey spans (tracing off), lock_order_cycle when no node ran
-the sanitizer, and perf_regression when the run dir carries no perf
-ledger: absence of an artifact is not evidence of a failure.
+left journey spans (tracing off), lock_order_cycle / shared_state_race
+when no node ran the respective sanitizer, and perf_regression when
+the run dir carries no perf ledger: absence of an artifact is not
+evidence of a failure.
 """
 
 from __future__ import annotations
@@ -98,6 +105,12 @@ DEFAULT_GATES = {
     # never "some" acceptable; raise only for a run that deliberately
     # exercises a known-cyclic legacy path
     "max_lock_order_cycles": 0,
+    # racecheck: Eraser lockset violations tolerated before the verdict
+    # fails. Zero for the same reason — an unguarded shared write on a
+    # hot class is never "some" acceptable; deliberately lock-free
+    # fields belong in the class's _tmrace_ignore_ declaration, not in
+    # a raised allowance
+    "max_shared_state_races": 0,
     # tmperf compare thresholds (perf/compare.py COMPARE_DEFAULTS —
     # the values here are the verdict plane's own defaults and may be
     # overridden per run like any gate): fewer samples than
@@ -296,6 +309,60 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
             )
         gates.append(_gate(
             "lock_order_cycle", total <= cfg["max_lock_order_cycles"], detail,
+        ))
+
+    # shared_state_race (racecheck sanitizer streams; vacuous pass when
+    # no node ran TM_TPU_RACECHECK=1 — the lock_order_cycle shape)
+    rchecks = [(s["name"], s["racecheck"]) for s in nodes if s.get("racecheck")]
+    rcheck_errors = [
+        (s["name"], s["racecheck_error"]) for s in nodes if s.get("racecheck_error")
+    ]
+    if not rchecks:
+        gates.append(_gate(
+            "shared_state_race", True,
+            # evidence LOSS must not masquerade as sanitizer-disabled
+            f"racecheck artifacts present but unreadable: {rcheck_errors}"
+            if rcheck_errors
+            else "no racecheck.jsonl artifacts (TM_TPU_RACECHECK off)",
+        ))
+    else:
+        offenders = [
+            (name, rc["races"]) for name, rc in rchecks if rc["races"]
+        ]
+        total = sum(len(r) for _n, r in offenders)
+
+        def _fmt(races):
+            return [
+                f"{r.get('cls')}.{r.get('field')} by {r.get('threads')}"
+                for r in races
+            ]
+
+        if total > cfg["max_shared_state_races"]:
+            detail = (
+                f"shared-state races (max {cfg['max_shared_state_races']}): "
+                + "; ".join(
+                    f"{name}: {_fmt(races)}" for name, races in offenders
+                )
+            )
+        elif total:
+            # within a raised allowance: the evidence still has to be
+            # visible (the lock_order_cycle precedent)
+            detail = (
+                f"{total} race(s) within the max_shared_state_races="
+                f"{cfg['max_shared_state_races']} allowance: "
+                + "; ".join(
+                    f"{name}: {_fmt(races)}" for name, races in offenders
+                )
+            )
+        else:
+            writes = sum(rc.get("writes") or 0 for _n, rc in rchecks)
+            detail = (
+                f"no shared-state races across {len(rchecks)} sanitized "
+                f"node(s) ({writes} tracked writes)"
+            )
+        gates.append(_gate(
+            "shared_state_race", total <= cfg["max_shared_state_races"],
+            detail,
         ))
 
     # perf_regression (tmperf ledger in the run dir; vacuous pass when

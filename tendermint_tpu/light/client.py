@@ -342,6 +342,7 @@ class LightClient:
                     ev.byzantine_validators = ev.get_byzantine_validators(
                         common.validator_set, new_lb.signed_header
                     )
+                # tmcheck: ok[shared-mutation] last-slot publication: an atomic reference store consumers read once; last evidence wins
                 self.latest_attack_evidence = ev
                 for p in [self.primary] + self.witnesses:
                     try:

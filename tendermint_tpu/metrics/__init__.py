@@ -423,7 +423,9 @@ class ConsensusMetrics:
         now = time.monotonic()
         if self._last_step is not None:
             self.step_duration.observe(now - self._step_start, self._last_step)
+        # tmcheck: ok[shared-mutation] telemetry bookkeeping: the statesync->consensus switchover can at worst garble ONE duration sample
         self._step_start = now
+        # tmcheck: ok[shared-mutation] same one-garbled-sample trade as _step_start above
         self._last_step = step
 
     def mark_round(self) -> None:

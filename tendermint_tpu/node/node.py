@@ -517,6 +517,7 @@ class Node:
         state = hs.handshake(self.app_client)
         self._initial_state = state
         self.consensus.update_to_state(state)
+        # tmcheck: ok[shared-mutation] boot/statesync handoff: the reactor's routines are not running yet when these anchors are (re)set
         self.blocksync_reactor.state = state
         # Handshake replay may have advanced state past what the reactor
         # saw at construction (crash between blockstore and state saves);

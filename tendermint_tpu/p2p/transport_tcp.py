@@ -313,6 +313,7 @@ class TcpConnection(Connection):
             # — close, which unblocks the receive thread with an error
             bd = self._body_deadline
             if bd is not None and now > bd:
+                # tmcheck: ok[shared-mutation] deliberately lock-free error slot: the reap must fire while the send plane is wedged HOLDING the send lock; last error wins
                 self._send_error = TimeoutError("packet stalled mid-flight")
                 self.close()
                 return
@@ -395,11 +396,14 @@ class TcpConnection(Connection):
         frames; cf. SecretConnection's own resumable _raw_buf)."""
         while True:
             b = self._secret.read_exact(1)[0]
+            # tmcheck: ok[shared-mutation] one reader thread per connection owns the resumable varint state (receive_message is single-consumer by contract)
             self._varint_result |= (b & 0x7F) << self._varint_shift
             if not (b & 0x80):
                 result = self._varint_result
+                # tmcheck: ok[shared-mutation] same single-reader contract as above
                 self._varint_result, self._varint_shift = 0, 0
                 return result
+            # tmcheck: ok[shared-mutation] same single-reader contract as _varint_result above
             self._varint_shift += 7
             if self._varint_shift > 63:
                 raise ValueError("uvarint overflow")

@@ -126,6 +126,11 @@ class SignerListenerEndpoint:
         self._conn = None
         self._conn_ready = threading.Event()
         self._instance_lock = threading.Lock()  # serializes send_request
+        # guards the (_conn, _conn_ready) pair: the accept loop swaps in
+        # a fresh dial while send_request may still be failing on the
+        # old one — held only for the reference swap, never across I/O,
+        # so a wedged request cannot block new accepts
+        self._conn_lock = threading.Lock()
         self._stop = threading.Event()
         self._accept_thread: threading.Thread | None = None
         self._ping_thread: threading.Thread | None = None
@@ -203,11 +208,11 @@ class SignerListenerEndpoint:
                 except OSError:
                     pass
                 continue
-            old = self._conn
-            self._conn = conn
+            with self._conn_lock:
+                old, self._conn = self._conn, conn
+                self._conn_ready.set()
             if old is not None:
                 old.close()
-            self._conn_ready.set()
             self.logger.info("signer connected")
 
     # ------------------------------------------------------------ requests
@@ -222,7 +227,10 @@ class SignerListenerEndpoint:
         with self._instance_lock:
             if not self.wait_for_connection():
                 raise TimeoutError("no signer connected")
-            conn = self._conn
+            with self._conn_lock:
+                conn = self._conn
+            if conn is None:
+                raise TimeoutError("no signer connected")
             try:
                 _write_msg(conn, msg)
                 while True:
@@ -232,10 +240,16 @@ class SignerListenerEndpoint:
                         continue
                     return resp
             except Exception:
-                # drop the dead connection; the signer will redial
-                self._conn_ready.clear()
-                if self._conn is conn:
-                    self._conn = None
+                # drop the dead connection; the signer will redial.
+                # Clearing readiness is PAIRED with the null-out under
+                # the lock: if the accept loop already swapped in a
+                # fresh dial, that connection is live and readiness
+                # must stay set — an unconditional clear here stranded
+                # the endpoint until the signer happened to redial
+                with self._conn_lock:
+                    if self._conn is conn:
+                        self._conn = None
+                        self._conn_ready.clear()
                 conn.close()
                 raise
 

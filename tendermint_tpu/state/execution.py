@@ -202,6 +202,7 @@ class BlockExecutor:
             return
         validate_block(state, block)
         self.evpool.check_evidence(block.evidence)
+        # tmcheck: ok[shared-mutation] blocksync and consensus validate in SEQUENTIAL lifecycle phases; the memo never sees concurrent writers
         self._last_validated_hash = h
 
     # ------------------------------------------------------ application
@@ -274,6 +275,7 @@ class BlockExecutor:
                 res = self.app.commit()
             # on-chain ConsensusParams may have changed this block:
             # refresh the admission gas cap (PostCheckMaxGas analog)
+            # tmcheck: ok[shared-mutation] atomic int store; admission reading the old cap for one batch is the documented eventual-consistency trade
             self.mempool.max_gas = state.consensus_params.block.max_gas
             self.mempool.update(
                 block.header.height,

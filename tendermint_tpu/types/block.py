@@ -468,6 +468,7 @@ class Block:
     def fill_header(self) -> None:
         """Compute derived header hashes (ref: Block.fillHeader, types/block.go:99)."""
         if not self.header.last_commit_hash and self.last_commit is not None:
+            # tmcheck: ok[shared-mutation] value object: filled by its building thread before publication; blocksync/consensus touch blocks in sequential phases
             self.header.last_commit_hash = self.last_commit.hash()
         if not self.header.data_hash:
             self.header.data_hash = txs_hash(self.txs)

@@ -203,12 +203,14 @@ class ValidatorSet:
             total = _clip64(total + v.voting_power)
             if total > MAX_TOTAL_VOTING_POWER:
                 raise OverflowError(f"total voting power exceeds {MAX_TOTAL_VOTING_POWER}: {total}")
+        # tmcheck: ok[shared-mutation] idempotent lazy memo: concurrent readers store the same total; mutation happens on the consensus thread
         self._total_voting_power = total
 
     def get_proposer(self) -> Validator | None:
         if not self.validators:
             return None
         if self.proposer is None:
+            # tmcheck: ok[shared-mutation] idempotent lazy memo: priorities only move on the consensus thread, so every racing fill picks the same proposer
             self.proposer = self._find_proposer()
         return self.proposer.copy()
 
@@ -220,6 +222,7 @@ class ValidatorSet:
 
     def _invalidate_hash(self) -> None:
         if self._hash_cache is not None:
+            # tmcheck: ok[shared-mutation] idempotent lazy memo: racing fills compute identical roots; every mutation path (single consensus thread) clears here
             self._hash_cache = None
             hash_metrics().cache_events.add(1, "validator_set", "invalidate")
 
@@ -398,6 +401,7 @@ class ValidatorSet:
         if not deletes:
             return
         delete_addrs = {d.address for d in deletes}
+        # tmcheck: ok[atomicity] validator-set updates run on the consensus thread against a private copy; readers see the old or new list reference atomically
         self.validators = [v for v in self.validators if v.address not in delete_addrs]
 
     # -- serialization ----------------------------------------------------

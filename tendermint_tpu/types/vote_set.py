@@ -142,6 +142,7 @@ class VoteSet:
         else:
             self.votes[val_index] = vote
             self.votes_bit_array.set_index(val_index, True)
+            # tmcheck: ok[atomicity] single-consumer discipline: add_vote runs only on the consensus thread (COVERAGE row 23)
             self.sum += voting_power
 
         votes_by_block = self.votes_by_block.get(block_key)

@@ -120,7 +120,7 @@ def test_e2e_perturbed_testnet(tmp_path):
     assert gate_names == {
         "liveness_stall", "p99_step_duration", "height_spread", "missing_series",
         "rate_stall", "churn_storm", "journey_stall", "lock_order_cycle",
-        "perf_regression",
+        "shared_state_race", "perf_regression",
     }
     # tmperf fingerprint surfacing: the runner persisted the run-time
     # environment fingerprint and the report carries it (slow box vs
@@ -182,6 +182,11 @@ def test_e2e_ci_live_critical_path(tmp_path, monkeypatch):
     # verdict must stay pass with zero order-inversion cycles, and the
     # estimated sanitizer overhead must stay within 1% of wall-clock
     monkeypatch.setenv("TM_TPU_LOCKCHECK", "1")
+    # racecheck acceptance too (docs/static-analysis.md#racecheck):
+    # the Eraser lockset sanitizer shims the hot classes fleet-wide;
+    # zero shared_state_race events, and the COMBINED per-node
+    # sanitizer overhead (lockcheck + racecheck) stays within 2%
+    monkeypatch.setenv("TM_TPU_RACECHECK", "1")
     runner = Runner(m, str(tmp_path / "net"), logger=lambda *a: None)
     runner.setup()
     t_run0 = time.monotonic()
@@ -212,14 +217,35 @@ def test_e2e_ci_live_critical_path(tmp_path, monkeypatch):
     assert lc_fleet["cycles"] == 0, lc_fleet
     # overhead budget is PER PROCESS (each node pays its own sanitizer
     # tax against its own lifetime; the fleet sum divided by one
-    # wall-clock would scale with node count, not cost)
+    # wall-clock would scale with node count, not cost). Since PR 13
+    # the acceptance budget is the COMBINED lockcheck+racecheck 2%
+    # below — both sanitizers always ride this run together, and the
+    # old solo-1% line sat within calibration noise of a loaded 2-core
+    # box (per-op cost is measured at exit while 4 nodes tear down)
     per_node = [
         (s["name"], s["lockcheck"]["overhead_s_est"])
         for s in report["nodes"] if s.get("lockcheck")
     ]
     assert per_node and all(o is not None for _n, o in per_node), per_node
-    worst = max(per_node, key=lambda p: p[1])
-    assert worst[1] <= 0.01 * wall_s, (worst, wall_s, per_node)
+    # racecheck: artifacts from every node, gate judged on real
+    # evidence, zero shared-state races, and the COMBINED sanitizer
+    # overhead (lock shim + race shim, per process) within 2%
+    race_gate = next(g for g in report["gates"] if g["name"] == "shared_state_race")
+    assert race_gate["ok"] and "TM_TPU_RACECHECK off" not in race_gate["detail"], race_gate
+    assert report["fleet"]["nodes_with_racecheck"] >= 4
+    assert report["fleet"]["racecheck"]["races"] == 0, report["fleet"]["racecheck"]
+    combined = [
+        (s["name"], s["lockcheck"].get("overhead_s_est"),
+         s["racecheck"].get("overhead_s_est"))
+        for s in report["nodes"]
+        if s.get("lockcheck") and s.get("racecheck")
+    ]
+    assert len(combined) >= 4 and all(
+        lo is not None and ro is not None for _n, lo, ro in combined
+    ), combined
+    worst_combined = max(combined, key=lambda p: p[1] + p[2])
+    assert worst_combined[1] + worst_combined[2] <= 0.02 * wall_s, (
+        worst_combined, wall_s, combined)
     # per-node critical paths: every committed height decomposed, the
     # stages tiling the measured interval within the 15% tolerance
     # (anchors judged from partial evidence are flagged, not asserted:

@@ -259,7 +259,7 @@ def test_empty_run_dir_fails_all_unverifiable_gates(tmp_path):
     # not fail for lacking them), like missing_series with
     # require_metrics_from_all unset
     vacuous = ("missing_series", "rate_stall", "churn_storm", "journey_stall",
-               "lock_order_cycle", "perf_regression")
+               "lock_order_cycle", "shared_state_race", "perf_regression")
     assert all(not g["ok"] for g in report["gates"] if g["name"] not in vacuous)
     assert all(g["ok"] for g in report["gates"] if g["name"] in vacuous)
 

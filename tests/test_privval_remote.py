@@ -93,6 +93,76 @@ def test_remote_ping(signer_pair):
     assert client.ping()
 
 
+def test_failed_request_does_not_strand_a_fresh_redial(tmp_path):
+    """The tmrace shared-mutation fix (docs/static-analysis.md#racecheck
+    first-run findings): when send_request fails on a STALE connection
+    AFTER the accept loop already swapped in a fresh dial, the error
+    path must not clear _conn_ready — the fresh connection is live, and
+    an unconditional clear stranded every subsequent request until the
+    signer happened to redial."""
+
+    import threading as _threading
+
+    from tendermint_tpu.privval import proto as pvproto
+
+    swapped = _threading.Event()
+
+    class _DeadConn:
+        """The stale connection: fails only AFTER the accept loop has
+        already installed the fresh one — the deterministic form of
+        the race (error path runs against a replaced self._conn)."""
+
+        def write(self, data):
+            swapped.wait(2.0)
+            raise ConnectionError("stale connection")
+
+        def read_exact(self, n):
+            raise ConnectionError("stale connection")
+
+        def close(self):
+            pass
+
+    addr = f"unix://{tmp_path}/signer.sock"
+    pv = FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+    pv.save_key()
+    endpoint = SignerListenerEndpoint(addr)
+    endpoint.start()
+    server = SignerServer(endpoint.bound_addr, pv, CHAIN_ID)
+    server.start()
+    try:
+        client = SignerClient(endpoint, CHAIN_ID)
+        client.get_pub_key()  # the real connection works
+        with endpoint._conn_lock:
+            live = endpoint._conn
+            endpoint._conn = _DeadConn()
+
+        def _accept_loop_swaps_back():
+            time.sleep(0.05)
+            with endpoint._conn_lock:
+                endpoint._conn = live
+                endpoint._conn_ready.set()
+            swapped.set()
+
+        t = _threading.Thread(target=_accept_loop_swaps_back)
+        t.start()
+        with pytest.raises((ConnectionError, OSError)):
+            endpoint.send_request(
+                pvproto.PrivvalMessage(ping_request=pvproto.PingRequest())
+            )
+        t.join()
+        # the fresh connection must still be installed and READY: the
+        # pre-fix code cleared _conn_ready unconditionally here
+        assert endpoint._conn is live
+        assert endpoint._conn_ready.is_set(), (
+            "error path cleared readiness for a connection it did not own"
+        )
+        # and requests keep working without any signer redial
+        assert client.get_pub_key() is not None
+    finally:
+        server.stop()
+        endpoint.stop()
+
+
 def test_double_sign_guard_across_signer_restart(tmp_path):
     """Kill the signer, restart it on the same state file: the conflicting
     vote must still be refused (the guard lives in the signer's

@@ -494,6 +494,7 @@ def test_rule_names_are_stable():
     assert RULES == (
         "lock-blocking", "cache-stale", "metric-raise", "metric-drift",
         "import-isolation", "trace-pairing", "unused-import",
+        "shared-mutation", "guard-consistency", "atomicity",
     )
 
 

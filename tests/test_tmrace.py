@@ -1,0 +1,979 @@
+"""tmrace tests: static thread-escape lockset rules, the runtime
+shared-state race sanitizer, the lockcheck Condition/Semaphore shims,
+and the lens shared_state_race gate (docs/static-analysis.md).
+
+The acceptance contract (ISSUE 13): a seeded unguarded-shared-write
+defect is caught TWICE — a `shared-mutation` static finding AND a
+runtime `shared_state_race` event that trips the lens gate naming
+class/field/threads — while the triaged tree stays clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tendermint_tpu.check import run_checks  # noqa: E402
+from tendermint_tpu.check.lockcheck import LockCheck  # noqa: E402
+from tendermint_tpu.check.racecheck import (  # noqa: E402
+    HOT_CLASSES,
+    RaceCheck,
+    maybe_install,
+)
+
+
+def _fixture_tree(tmp_path, files: dict) -> str:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def _findings(tmp_path, files, rules):
+    root = _fixture_tree(tmp_path, files)
+    return run_checks(root, rules=rules, paths=sorted(files))
+
+
+# ---------------------------------------------------------- shared-mutation
+
+
+# The seeded defect of the acceptance criterion: a daemon loop and the
+# public API both write `pending` with no lock anywhere.
+BAD_SHARED = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self.pending = {}
+        self._count = 0
+        threading.Thread(target=self._drain_loop, daemon=True).start()
+
+    def _drain_loop(self):
+        while True:
+            self.pending = {}
+
+    def submit(self, k, v):
+        self.pending[k] = v
+'''
+
+GOOD_SHARED = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self.pending = {}
+        self._lock = threading.Lock()
+        threading.Thread(target=self._drain_loop, daemon=True).start()
+
+    def _drain_loop(self):
+        while True:
+            with self._lock:
+                self.pending = {}
+
+    def submit(self, k, v):
+        with self._lock:
+            self.pending[k] = v
+'''
+
+# handoff: __init__ writes, ONE worker owns afterwards — never a report
+GOOD_HANDOFF = '''
+import threading
+
+class Loop:
+    def __init__(self):
+        self.state = 0
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            self.state = compute()
+'''
+
+# single-assignment shutdown flags are allowlisted
+GOOD_FLAG = '''
+import threading
+
+class Loop:
+    def __init__(self):
+        self.running = True
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while self.running:
+            work()
+
+    def stop(self):
+        self.running = False
+'''
+
+# queue/Event attributes are allowlisted wholesale
+GOOD_QUEUE = '''
+import queue
+import threading
+
+class Loop:
+    def __init__(self):
+        self.q = queue.Queue()
+        self.wake = threading.Event()
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            self.q.put(1)
+            self.wake.set()
+
+    def submit(self, item):
+        self.q.put(item)
+        self.wake.set()
+'''
+
+
+def test_shared_mutation_fires_on_unguarded_two_root_write(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": BAD_SHARED}, ["shared-mutation"]
+    )
+    assert len(active) == 1, [f.message for f in active]
+    f = active[0]
+    assert "Pool.pending" in f.message
+    assert "_drain_loop" in f.message  # the finding names the roots
+
+
+def test_shared_mutation_quiet_on_locked_handoff_flag_queue(tmp_path):
+    for src in (GOOD_SHARED, GOOD_HANDOFF, GOOD_FLAG, GOOD_QUEUE):
+        active, _ = _findings(
+            tmp_path, {"tendermint_tpu/x.py": src}, ["shared-mutation"]
+        )
+        assert active == [], (src, [f.message for f in active])
+
+
+def test_shared_mutation_inline_suppression(tmp_path):
+    src = BAD_SHARED.replace(
+        "            self.pending = {}",
+        "            # tmcheck: ok[shared-mutation] fixture reason\n"
+        "            self.pending = {}",
+    )
+    active, suppressed = _findings(
+        tmp_path, {"tendermint_tpu/x.py": src}, ["shared-mutation"]
+    )
+    assert active == [] and len(suppressed) == 1
+
+
+# thread-root indirections: loop-variable targets, spawn helper,
+# executor submit, nested-def closure
+INDIRECT_ROOTS = '''
+import threading
+
+class Reactor:
+    def __init__(self, pool):
+        self.seen = {}
+        for fn, ch in ((self._recv_a, 1), (self._recv_b, 2)):
+            threading.Thread(target=fn, args=(ch,), daemon=True).start()
+        self._spawn(self._recv_c)
+        pool.submit(self._recv_d)
+        self._watch()
+
+    def _spawn(self, fn):
+        threading.Thread(target=fn, daemon=True).start()
+
+    def _watch(self):
+        def watchdog():
+            self.seen = {}
+        threading.Thread(target=watchdog, daemon=True).start()
+
+    def _recv_a(self, ch):
+        self.seen[ch] = 1
+
+    def _recv_b(self, ch):
+        self.seen[ch] = 2
+
+    def _recv_c(self):
+        self.seen[3] = 3
+
+    def _recv_d(self):
+        self.seen[4] = 4
+'''
+
+
+def test_thread_root_indirections_all_resolve(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": INDIRECT_ROOTS}, ["shared-mutation"]
+    )
+    assert len(active) == 1
+    m = active[0].message
+    # every spawn idiom produced a root: loop-tuple targets, the
+    # _spawn helper's parameter, executor submit, the nested watchdog
+    assert "Reactor.seen" in m and "5 thread roots" in m, m
+
+
+# cross-class linking: a thread in one class reaches another class's
+# method by (unambiguous) name — the reactor->PeerState shape
+CROSS_CLASS = '''
+import threading
+
+class Gossip:
+    def __init__(self, ps):
+        self.ps = ps
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.ps.apply_round_step_fixture(1)
+
+class PeerStateFixture:
+    def __init__(self):
+        self.round = 0
+
+    def apply_round_step_fixture(self, r):
+        self.round = r
+
+    def reset_fixture(self):
+        self.round = 0
+'''
+
+
+def test_cross_class_name_linking(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": CROSS_CLASS}, ["shared-mutation"]
+    )
+    assert len(active) == 1
+    assert "PeerStateFixture.round" in active[0].message
+
+
+# -------------------------------------------------------- guard-consistency
+
+
+BAD_GUARD = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self.items = {}
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock_a:
+            self.items = {}
+
+    def put(self, k, v):
+        with self._lock_b:
+            self.items[k] = v
+'''
+
+GOOD_GUARD_NESTED = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self.items = {}
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock_a:
+            self.items = {}
+
+    def put(self, k, v):
+        with self._lock_a:
+            with self._lock_b:
+                self.items[k] = v
+'''
+
+
+def test_guard_consistency_fires_on_disjoint_locks(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": BAD_GUARD},
+        ["shared-mutation", "guard-consistency"],
+    )
+    assert len(active) == 1
+    f = active[0]
+    assert f.rule == "guard-consistency"
+    assert "_lock_a" in f.message and "_lock_b" in f.message
+
+
+def test_guard_consistency_quiet_on_common_lock(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": GOOD_GUARD_NESTED},
+        ["shared-mutation", "guard-consistency"],
+    )
+    assert active == [], [f.message for f in active]
+
+
+def test_manual_acquire_release_counts_as_guarded(tmp_path):
+    """The `lk.acquire(); try: ... finally: lk.release()` idiom must
+    read as locked (transport_tcp's _write_control shape)."""
+    src = GOOD_SHARED.replace(
+        "    def submit(self, k, v):\n        with self._lock:\n"
+        "            self.pending[k] = v",
+        "    def submit(self, k, v):\n        self._lock.acquire()\n"
+        "        try:\n            self.pending[k] = v\n"
+        "        finally:\n            self._lock.release()",
+    )
+    assert "finally" in src  # the replace happened
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": src},
+        ["shared-mutation", "guard-consistency"],
+    )
+    assert active == [], [f.message for f in active]
+
+
+def test_condition_aliases_to_its_lock(tmp_path):
+    """`self._cv = threading.Condition(self._lock)` — holding the cv
+    IS holding the lock (the mempool/engine idiom)."""
+    src = GOOD_SHARED.replace(
+        "        self._lock = threading.Lock()",
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)",
+    ).replace(
+        "    def submit(self, k, v):\n        with self._lock:",
+        "    def submit(self, k, v):\n        with self._cv:",
+    )
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": src},
+        ["shared-mutation", "guard-consistency"],
+    )
+    assert active == [], [f.message for f in active]
+
+
+# ---------------------------------------------------------------- atomicity
+
+
+BAD_ATOMIC = '''
+import threading
+
+class Stats:
+    def __init__(self):
+        self.count = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.count += 1
+
+    def read(self):
+        return self.count
+'''
+
+GOOD_ATOMIC_LOCKED = BAD_ATOMIC.replace(
+    "        self.count = 0\n",
+    "        self.count = 0\n        self._lock = threading.Lock()\n",
+).replace(
+    "    def _loop(self):\n        self.count += 1",
+    "    def _loop(self):\n        with self._lock:\n            self.count += 1",
+)
+
+BAD_CHECK_THEN_ACT = '''
+import threading
+
+class Cache:
+    def __init__(self):
+        self.slots = {}
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        if "x" not in self.slots:
+            self.slots["x"] = 1
+
+    def read(self):
+        return self.slots.get("x")
+'''
+
+
+def test_atomicity_fires_on_unlocked_rmw(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": BAD_ATOMIC}, ["atomicity"]
+    )
+    assert len(active) == 1
+    assert "self.count +=" in active[0].message
+
+
+def test_atomicity_fires_on_check_then_act(tmp_path):
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/x.py": BAD_CHECK_THEN_ACT}, ["atomicity"]
+    )
+    assert len(active) == 1
+    assert "check-then-act" in active[0].message
+
+
+def test_atomicity_quiet_when_locked_or_unshared(tmp_path):
+    # locked RMW is fine; an RMW on a field no second root touches is
+    # fine too (drop the reader -> single root)
+    solo = BAD_ATOMIC.replace(
+        "    def read(self):\n        return self.count\n", ""
+    )
+    for src in (GOOD_ATOMIC_LOCKED, solo):
+        active, _ = _findings(
+            tmp_path, {"tendermint_tpu/x.py": src}, ["atomicity"]
+        )
+        assert active == [], (src, [f.message for f in active])
+
+
+# -------------------------------------------------------- tree-level canary
+
+
+def test_tree_race_rules_clean():
+    """The triaged tree carries zero unsuppressed race findings — the
+    acceptance criterion's steady state (the full-canary twin in
+    test_tmcheck.py covers every rule; this one isolates the new
+    plane so a regression names itself here first)."""
+    from tendermint_tpu.check.baseline import diff_baseline, load_baseline
+
+    active, _ = run_checks(
+        _ROOT, rules=["shared-mutation", "guard-consistency", "atomicity"]
+    )
+    new, _stale = diff_baseline(active, load_baseline(_ROOT))
+    assert not new, "unsuppressed race findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_cli_diff_refuses_write_baseline(tmp_path):
+    """--write-baseline from a --diff-restricted scan would silently
+    delete every suppression outside the diff: refused, rc 2."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "tmcheck.py"),
+         "--diff", "HEAD", "--write-baseline"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_ROOT,
+    )
+    assert r.returncode == 2 and "full scan" in r.stderr, r.stdout + r.stderr
+
+
+# ------------------------------------------------------- runtime sanitizer
+
+
+def _sanitizer(tmp_path):
+    lc = LockCheck(str(tmp_path / "lockcheck.jsonl"), budget_s=10.0)
+    lc.install()
+    rc = RaceCheck(str(tmp_path / "racecheck.jsonl"), lc)
+    return lc, rc
+
+
+def _events(tmp_path):
+    p = tmp_path / "racecheck.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(l) for l in open(p)]
+
+
+def test_racecheck_two_thread_unguarded_write_emits_event(tmp_path):
+    lc, rc = _sanitizer(tmp_path)
+    try:
+        class Hot:
+            def __init__(self):
+                self.n = 0
+
+        rc.watch_class(Hot)
+        h = Hot()
+
+        def w(v):
+            for i in range(3):
+                h.n = v + i
+
+        for name, v in (("wr-1", 10), ("wr-2", 20), ("wr-3", 30)):
+            t = threading.Thread(target=w, args=(v,), name=name)
+            t.start()
+            t.join()
+        rc.finalize()
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+    races = [e for e in _events(tmp_path) if e["kind"] == "shared_state_race"]
+    assert len(races) == 1, races
+    ev = races[0]
+    assert ev["cls"] == "Hot" and ev["field"] == "n"
+    # names >=2 writing threads and the offending write site (__init__
+    # ran on the main thread, wr-1 took the ownership transfer, so the
+    # report fires at wr-2's first write)
+    assert len(ev["threads"]) >= 2
+    assert all(t.startswith("wr-") for t in ev["threads"]), ev
+    assert "test_tmrace.py" in ev["site"]
+    summary = [e for e in _events(tmp_path) if e["kind"] == "summary"]
+    assert summary and summary[-1]["races"] == 1
+    assert summary[-1]["overhead_s_est"] >= 0.0
+
+
+def test_racecheck_consistently_locked_path_stays_silent(tmp_path):
+    lc, rc = _sanitizer(tmp_path)
+    try:
+        class Hot:
+            def __init__(self):
+                self.n = 0
+                self.lk = threading.Lock()
+
+        rc.watch_class(Hot)
+        h = Hot()
+
+        def w(v):
+            for i in range(3):
+                with h.lk:
+                    h.n = v + i
+
+        for v in (10, 20, 30):
+            t = threading.Thread(target=w, args=(v,))
+            t.start()
+            t.join()
+        rc.finalize()
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+    assert not [
+        e for e in _events(tmp_path) if e["kind"] == "shared_state_race"
+    ]
+
+
+def test_racecheck_handoff_and_flags_stay_silent(tmp_path):
+    """__init__ populates, one worker owns thereafter (ownership
+    transfer) — and True/False/None stores are never tracked."""
+    lc, rc = _sanitizer(tmp_path)
+    try:
+        class Hot:
+            def __init__(self):
+                self.state = 0      # init write by the test thread
+                self.running = True
+
+        rc.watch_class(Hot)
+        h = Hot()
+
+        def worker():
+            for i in range(5):
+                h.state = i  # sole post-init writer
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        h.running = False  # flag write from the test thread: allowlisted
+        rc.finalize()
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+    assert not [
+        e for e in _events(tmp_path) if e["kind"] == "shared_state_race"
+    ]
+
+
+def test_racecheck_ignore_declaration(tmp_path):
+    """_tmrace_ignore_ is the runtime analog of `# tmcheck: ok` — the
+    deliberately lock-free field never reports."""
+    lc, rc = _sanitizer(tmp_path)
+    try:
+        class Hot:
+            _tmrace_ignore_ = frozenset({"last_err"})
+
+            def __init__(self):
+                self.last_err = 0
+
+        rc.watch_class(Hot)
+        h = Hot()
+
+        def w(v):
+            h.last_err = v
+
+        for v in (1, 2, 3):
+            t = threading.Thread(target=w, args=(v,))
+            t.start()
+            t.join()
+        rc.finalize()
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+    assert not [
+        e for e in _events(tmp_path) if e["kind"] == "shared_state_race"
+    ]
+
+
+def test_racecheck_guard_inconsistency_is_caught_at_runtime(tmp_path):
+    """Two threads each holding a DIFFERENT lock: the candidate
+    lockset intersects to empty — the runtime sees the
+    guard-consistency defect class too."""
+    lc, rc = _sanitizer(tmp_path)
+    try:
+        class Hot:
+            def __init__(self):
+                self.n = 0
+                self.lk_a = threading.Lock()
+                self.lk_b = threading.Lock()
+
+        rc.watch_class(Hot)
+        h = Hot()
+
+        def wa():
+            with h.lk_a:
+                h.n = 1
+
+        def wb():
+            with h.lk_b:
+                h.n = 2
+
+        for fn in (wa, wb, wa):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        rc.finalize()
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+    races = [e for e in _events(tmp_path) if e["kind"] == "shared_state_race"]
+    assert len(races) == 1, races
+
+
+def test_racecheck_disabled_constructs_nothing():
+    import tendermint_tpu.check.racecheck as rcheck
+
+    before = {}
+    for spec in HOT_CLASSES:
+        mod_name, _, cls_name = spec.partition(":")
+        try:
+            import importlib
+
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            before[spec] = cls.__dict__.get("__setattr__")
+        except ImportError:
+            pass
+    assert maybe_install(env={}) is None
+    assert maybe_install(env={"TM_TPU_RACECHECK": "0"}) is None
+    assert rcheck._ACTIVE is None
+    for spec, prior in before.items():
+        mod_name, _, cls_name = spec.partition(":")
+        import importlib
+
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        assert cls.__dict__.get("__setattr__") is prior, spec
+
+
+def test_racecheck_hot_classes_are_shimmable(tmp_path):
+    """Every declared hot class must be importable, slot-free, and
+    free of a custom __setattr__ (watch_class refuses those) — and
+    uninstall must restore the original method table."""
+    lc, rc = _sanitizer(tmp_path)
+    try:
+        patched = rc.attach_declared()
+        names = {c.__name__ for c in patched}
+        assert names == {
+            "TxMempool", "LRUTxCache", "BlockPool", "PeerState",
+            "VerifyEngine", "Router",
+        }, names
+        for cls in patched:
+            assert cls.__dict__["__setattr__"]._tmrace_shim_
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+    for spec in HOT_CLASSES:
+        import importlib
+
+        mod_name, _, cls_name = spec.partition(":")
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        assert "__setattr__" not in cls.__dict__, cls
+
+
+def test_racecheck_refuses_custom_setattr(tmp_path):
+    lc, rc = _sanitizer(tmp_path)
+    try:
+        class Custom:
+            def __setattr__(self, k, v):
+                object.__setattr__(self, k, v)
+
+        with pytest.raises(TypeError):
+            rc.watch_class(Custom)
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+
+
+# -------------------------------------------- lockcheck shim satellites
+
+
+def test_lockcheck_condition_gets_caller_site(tmp_path):
+    """A bare threading.Condition() must be keyed on the CALLER's
+    construction site, not a shared threading.py frame: an inversion
+    between two bare Conditions is two distinct graph nodes."""
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=10.0)
+    lc.install()
+    try:
+        cv_a = threading.Condition()
+        cv_b = threading.Condition()
+
+        def ab():
+            with cv_a:
+                with cv_b:
+                    pass
+
+        def ba():
+            with cv_b:
+                with cv_a:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        lc.finalize()
+    finally:
+        lc.uninstall()
+    events = [json.loads(l) for l in open(out)]
+    cycles = [e for e in events if e["kind"] == "lock_order_cycle"]
+    assert len(cycles) == 1, events
+    assert all("test_tmrace.py" in site for site in cycles[0]["cycle"]), cycles
+
+
+def test_lockcheck_semaphore_participates_in_order_graph(tmp_path):
+    out = str(tmp_path / "lockcheck.jsonl")
+    lc = LockCheck(out, budget_s=10.0)
+    lc.install()
+    try:
+        sem = threading.Semaphore(1)
+        lk = threading.Lock()
+
+        def sl():
+            with sem:
+                with lk:
+                    pass
+
+        def ls():
+            with lk:
+                with sem:
+                    pass
+
+        for fn in (sl, ls):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        # BoundedSemaphore surface: release beyond initial raises
+        bsem = threading.BoundedSemaphore(1)
+        with bsem:
+            pass
+        with pytest.raises(ValueError):
+            bsem.release()
+        # SIGNALING semaphores (counting/zero-value) are pass-through:
+        # cross-thread acquire/release must leave NO held-stack state
+        # and fabricate NO edges (the ThreadPoolExecutor idle-semaphore
+        # regression from the live acceptance run)
+        sig = threading.Semaphore(0)
+
+        def producer():
+            sig.release()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        assert sig.acquire(timeout=2.0)
+        t.join()
+        with lk:
+            pass  # this thread must not appear to hold `sig` here
+        lc.finalize()
+    finally:
+        lc.uninstall()
+    events = [json.loads(l) for l in open(out)]
+    cycles = [e for e in events if e["kind"] == "lock_order_cycle"]
+    assert len(cycles) == 1, events
+    assert any("test_tmrace.py" in s for s in cycles[0]["cycle"])
+
+
+def test_lockcheck_new_shims_disabled_is_free():
+    """With the sanitizer off, Condition/Semaphore/BoundedSemaphore are
+    the untouched stdlib classes (the disabled-is-free pin for the new
+    shims, matching the Lock/RLock pin in test_tmcheck.py)."""
+    from tendermint_tpu.check.lockcheck import maybe_install as lc_install
+
+    before = (
+        threading.Condition, threading.Semaphore, threading.BoundedSemaphore,
+    )
+    assert lc_install(env={}) is None
+    assert (
+        threading.Condition, threading.Semaphore, threading.BoundedSemaphore,
+    ) == before
+
+
+def test_lockcheck_semaphore_uninstall_restores():
+    out_lc = LockCheck(os.devnull, budget_s=10.0)
+    real = (threading.Condition, threading.Semaphore,
+            threading.BoundedSemaphore)
+    out_lc.install()
+    try:
+        assert threading.Condition is not real[0]
+        assert threading.Semaphore is not real[1]
+        assert threading.BoundedSemaphore is not real[2]
+    finally:
+        out_lc.uninstall()
+    assert (threading.Condition, threading.Semaphore,
+            threading.BoundedSemaphore) == real
+
+
+# ------------------------------------------------------- lens integration
+
+
+def _racecheck_node(tmp_path, name: str, records: list) -> None:
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / "racecheck.jsonl", "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+_RACE_EVENT = {
+    "t": 1.0, "kind": "shared_state_race", "cls": "TxMempool",
+    "field": "notify", "threads": ["mempool-bcast:abc", "rpc-worker"],
+    "site": "tendermint_tpu/mempool/mempool.py:200", "thread": "rpc-worker",
+}
+_RACE_SUMMARY = {
+    "t": 2.0, "kind": "summary", "classes": 6, "fields": 40,
+    "writes": 1234, "races": 1, "overhead_s_est": 0.002,
+}
+
+
+def test_lens_shared_state_race_gate_trips_naming_evidence(tmp_path):
+    from tendermint_tpu.lens import analyze_run
+
+    _racecheck_node(tmp_path, "node0", [_RACE_EVENT, _RACE_SUMMARY])
+    report = analyze_run(str(tmp_path))
+    gate = next(g for g in report["gates"] if g["name"] == "shared_state_race")
+    assert gate["ok"] is False
+    # the detail names class, field, and threads — the rc-1 contract
+    assert "TxMempool.notify" in gate["detail"]
+    assert "mempool-bcast:abc" in gate["detail"]
+    assert report["verdict"] == "fail"
+    assert report["fleet"]["racecheck"]["races"] == 1
+    assert report["fleet"]["nodes_with_racecheck"] == 1
+
+    # a raised allowance passes but keeps the evidence visible
+    report = analyze_run(str(tmp_path), gates={"max_shared_state_races": 1})
+    gate = next(g for g in report["gates"] if g["name"] == "shared_state_race")
+    assert gate["ok"] is True
+    assert "allowance" in gate["detail"] and "TxMempool.notify" in gate["detail"]
+
+    # clean sanitized node: pass naming the tracked-write count
+    _racecheck_node(tmp_path, "node0", [dict(_RACE_SUMMARY, races=0)])
+    report = analyze_run(str(tmp_path))
+    gate = next(g for g in report["gates"] if g["name"] == "shared_state_race")
+    assert gate["ok"] is True and "1234 tracked writes" in gate["detail"]
+
+    # torn tail + wrong-shape lines tolerated
+    with open(tmp_path / "node0" / "racecheck.jsonl", "a") as f:
+        f.write("null\n7\n")
+        f.write('{"t": 3.0, "kind": "shared_state')
+    report = analyze_run(str(tmp_path))
+    assert next(
+        g for g in report["gates"] if g["name"] == "shared_state_race"
+    )["ok"] is True
+
+
+def test_lens_racecheck_multi_segment_aggregation(tmp_path):
+    from tendermint_tpu.lens.analyze import summarize_racecheck
+
+    d = tmp_path / "node0"
+    d.mkdir()
+    with open(d / "racecheck.jsonl", "w") as f:
+        f.write(json.dumps(_RACE_SUMMARY) + "\n")
+        f.write(json.dumps(dict(
+            _RACE_SUMMARY, t=3.0, fields=25, writes=100, overhead_s_est=0.001,
+        )) + "\n")
+    rc = summarize_racecheck(str(d / "racecheck.jsonl"))
+    assert rc["segments"] == 2
+    assert rc["writes"] == 1334 and rc["overhead_s_est"] == 0.003
+    assert rc["fields"] == 40  # per-process max, not sum
+
+
+def test_lens_race_gate_vacuous_and_unreadable(tmp_path):
+    from tendermint_tpu.lens import analyze_run
+
+    d = tmp_path / "node0"
+    d.mkdir()
+    (d / "metrics.txt").write_text("tendermint_consensus_height 3\n")
+    report = analyze_run(str(tmp_path))
+    gate = next(g for g in report["gates"] if g["name"] == "shared_state_race")
+    assert gate["ok"] is True and "TM_TPU_RACECHECK off" in gate["detail"]
+
+    (d / "racecheck.jsonl").mkdir()  # opening a directory -> OSError
+    report = analyze_run(str(tmp_path))
+    node = report["nodes"][0]
+    assert node.get("racecheck") is None and node.get("racecheck_error")
+    gate = next(g for g in report["gates"] if g["name"] == "shared_state_race")
+    assert gate["ok"] is True
+    assert "unreadable" in gate["detail"]
+    assert "TM_TPU_RACECHECK off" not in gate["detail"]
+
+
+# --------------------------------------------------- the acceptance demo
+
+
+def test_deliberate_race_caught_twice(tmp_path):
+    """ISSUE 13 acceptance: ONE seeded defect — an unguarded
+    shared-write field on a threaded class — is caught (a) by the
+    static shared-mutation rule over its source and (b) by a runtime
+    shared_state_race event from actually running it, which trips the
+    lens gate with rc 1 naming class/field/threads."""
+    # (a) static: the defect's source fires shared-mutation
+    active, _ = _findings(
+        tmp_path, {"tendermint_tpu/seeded.py": BAD_SHARED}, ["shared-mutation"]
+    )
+    assert len(active) == 1 and active[0].rule == "shared-mutation"
+
+    # (b) runtime: execute the same defect shape under the sanitizer
+    run_dir = tmp_path / "run"
+    node_dir = run_dir / "node0"
+    node_dir.mkdir(parents=True)
+    lc = LockCheck(str(node_dir / "lockcheck.jsonl"), budget_s=10.0)
+    lc.install()
+    rc = RaceCheck(str(node_dir / "racecheck.jsonl"), lc)
+    try:
+        class Pool:  # the BAD_SHARED shape, executed
+            def __init__(self):
+                self.pending = {}
+
+        rc.watch_class(Pool)
+        p = Pool()
+        stop = threading.Event()
+
+        def drain_loop():
+            while not stop.is_set():
+                p.pending = {}
+                time.sleep(0.001)
+
+        t = threading.Thread(target=drain_loop, name="drain", daemon=True)
+        t.start()
+        for i in range(50):
+            p.pending = {i: i}  # the public-API writer
+            time.sleep(0.001)
+        stop.set()
+        t.join(timeout=5)
+        rc.finalize()
+        lc.finalize()
+    finally:
+        rc.uninstall()
+        lc.uninstall()
+
+    races = [
+        json.loads(l) for l in open(node_dir / "racecheck.jsonl")
+        if l.strip()
+    ]
+    races = [e for e in races if e["kind"] == "shared_state_race"]
+    assert races and races[0]["cls"] == "Pool" and races[0]["field"] == "pending"
+
+    # (c) the lens gate trips on the artifact and the CLI exits 1
+    # naming the evidence
+    from tendermint_tpu.lens import analyze_run
+
+    report = analyze_run(str(run_dir))
+    gate = next(g for g in report["gates"] if g["name"] == "shared_state_race")
+    assert gate["ok"] is False and "Pool.pending" in gate["detail"]
+    assert "drain" in gate["detail"]
+    assert report["verdict"] == "fail"
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "tmlens.py"),
+         "analyze", str(run_dir)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "shared_state_race" in r.stdout and "Pool.pending" in r.stdout
