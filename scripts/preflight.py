@@ -5,9 +5,11 @@ Chains, in order:
 
   1. tmcheck --check       static analysis + baseline drift (both ways)
   2. metricsgen --check    docs/metrics.md byte-drift gate
-  3. bench.py smoke        device-free perf smoke (~seconds) — records
+  3. tmsoak --dry-run      the committed soak manifests parse, validate,
+                           and core-gate for this box (nothing launches)
+  4. bench.py smoke        device-free perf smoke (~seconds) — records
                            a fresh run into .bench_runs/ledger.jsonl
-  4. tmperf gate --check   noise-aware regression gate over the run
+  5. tmperf gate --check   noise-aware regression gate over the run
                            smoke just recorded, plus blessed-key
                            coverage drift
 
@@ -35,6 +37,8 @@ STAGES = (
     # (name, argv relative to repo root)
     ("tmcheck", [sys.executable, "scripts/tmcheck.py", "--check"]),
     ("metricsgen", [sys.executable, "scripts/metricsgen.py", "--check"]),
+    ("soak-dry", [sys.executable, "scripts/tmsoak.py", "--dry-run",
+                  "e2e-manifests/soak-small.toml", "e2e-manifests/soak-large.toml"]),
     ("smoke", [sys.executable, "bench.py", "smoke"]),
     ("perf-gate", [sys.executable, "scripts/tmperf.py", "gate", "--check"]),
 )

@@ -1,0 +1,144 @@
+"""tmsoak CLI — manifest-driven soak runs + offline timeline validation
+(docs/e2e.md#tmsoak).
+
+Usage:
+  python scripts/tmsoak.py --dry-run <manifest> [<manifest>...] [--cores N]
+      Parse + validate each manifest, core-gate it for this box (or
+      --cores), and print the RESOLVED node table and scenario
+      timeline — exactly what a live run would execute, without
+      launching anything. Exit code: 0 = every manifest valid,
+      1 = at least one invalid (the error is printed per manifest),
+      2 = usage.
+
+  python scripts/tmsoak.py run <manifest> [--duration S] [--base-dir D]
+                                [--cores N] [--gates <json-or-path>]
+      One full soak cycle (e2e/runner.py run_soak): core-gate the
+      manifest, start the testnet (statesync_join nodes deferred to
+      the timeline), drive the scenario under the live tmwatch rolling
+      gates with paced load, then converge, collect artifacts, and run
+      the tmlens verdict plane. Exit code: 0 = fleet verdict pass,
+      1 = verdict fail or the run errored/aborted (WatchTripped),
+      2 = usage.
+      --duration S   paced-load window + soak clock (default 45)
+      --base-dir D   testnet directory (default <repo>/soak-net)
+      --cores N      override the detected core count for gating
+      --gates ...    tmlens gate overrides (lens/gates.py
+                     DEFAULT_GATES), inline JSON or a file path
+
+The core gate (e2e/scenario.py) is always applied: on a <4-core box
+storm-surface perturbations (partition/disconnect/churn/...) are
+stripped and the net clamps to 4 nodes keeping the genesis quorum plus
+one statesync late joiner — the docs/e2e.md#core-gating rule. TM_TPU_*
+environment knobs (TRACE, LOCKCHECK, RACECHECK, PROF) propagate to
+every node like any e2e run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def _load_gates(arg: str) -> dict:
+    if os.path.exists(arg):
+        with open(arg) as f:
+            return json.load(f)
+    return json.loads(arg)
+
+
+def _dry_run(paths: list[str], cores: int | None) -> int:
+    from tendermint_tpu.e2e.generator import validate_generated
+    from tendermint_tpu.e2e.scenario import render_resolution, resolve_for_cores
+
+    cores = cores if cores is not None else (os.cpu_count() or 1)
+    rc = 0
+    for path in paths:
+        print(f"== {path}")
+        try:
+            with open(path) as f:
+                text = f.read()
+            manifest = validate_generated(text)  # parse + runner invariants
+            resolved, timeline, notes = resolve_for_cores(manifest, cores=cores)
+            print(render_resolution(resolved, timeline, notes, cores))
+        except (OSError, ValueError) as e:
+            print(f"INVALID: {e}")
+            rc = 1
+    return rc
+
+
+def _run(path: str, duration: float, base_dir: str, cores: int | None,
+         gates: dict | None) -> int:
+    from tendermint_tpu.e2e.runner import WatchTripped, run_soak
+
+    try:
+        runner, summary = run_soak(
+            path, base_dir, duration=duration, cores=cores, gates=gates,
+        )
+    except WatchTripped as e:
+        print(f"soak aborted by live watch: {e}", file=sys.stderr)
+        return 1
+    except (TimeoutError, RuntimeError, AssertionError, OSError, ValueError) as e:
+        print(f"soak failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    report = runner.last_report
+    if report is None:
+        print("soak finished but the tmlens analyzer produced no report",
+              file=sys.stderr)
+        return 1
+    print(f"fleet verdict: {report['verdict']}")
+    return 0 if report["verdict"] == "pass" else 1
+
+
+def main(argv: list[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    cores: int | None = None
+    duration = 45.0
+    base_dir = os.path.join(_ROOT, "soak-net")
+    gates: dict | None = None
+    mode = ""
+    paths: list[str] = []
+    i = 0
+    try:
+        while i < len(argv):
+            a = argv[i]
+            if a == "--dry-run":
+                mode = mode or "dry"
+            elif a == "run":
+                mode = mode or "run"
+            elif a == "--cores":
+                cores = int(argv[i + 1]); i += 1
+            elif a == "--duration":
+                duration = float(argv[i + 1]); i += 1
+            elif a == "--base-dir":
+                base_dir = argv[i + 1]; i += 1
+            elif a == "--gates":
+                gates = _load_gates(argv[i + 1]); i += 1
+            elif a.startswith("-"):
+                print(f"unknown flag {a!r} (see --help)", file=sys.stderr)
+                return 2
+            else:
+                paths.append(a)
+            i += 1
+    except (IndexError, ValueError, json.JSONDecodeError) as e:
+        print(f"bad arguments: {e} (see --help)", file=sys.stderr)
+        return 2
+    if not mode or not paths:
+        print("expected `run <manifest>` or `--dry-run <manifest>...` (see --help)",
+              file=sys.stderr)
+        return 2
+    if mode == "dry":
+        return _dry_run(paths, cores)
+    if len(paths) != 1:
+        print("run takes exactly one manifest (see --help)", file=sys.stderr)
+        return 2
+    return _run(paths[0], duration, base_dir, cores, gates)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,347 @@
+"""Bank example application — a STATEFUL soak workload beyond kvstore
+(ISSUE 14 / ROADMAP item 4's scale half).
+
+Where the kvstore's state is an append-only k=v bag whose app hash is
+`varint(size)`, the bank carries real, growing, verifiable state:
+
+  * accounts     `acct:<addr-hex>` -> canonical JSON {balance, nonce,
+                 pub} — created by the first credit, growing without
+                 bound under transfer load (each fresh recipient is a
+                 new account)
+  * transfers    ed25519-SIGNED txs with strict per-account nonces:
+                 replay of a committed transfer fails with BAD_NONCE
+  * app hash     RFC-6962 merkle root (crypto/merkle, the PR-5 batched
+                 hash plane) over every `acct:`/`val:` entry — any
+                 divergence in any balance on any node forks the chain
+                 immediately, instead of hiding behind a size count
+  * queries      point lookups plus ITERATED RANGE QUERIES over the
+                 account space, and a `/supply` invariant endpoint
+                 (transfers conserve total supply by construction)
+  * snapshots    the kvstore's chunked export with a 4 KiB chunk size,
+                 so a few thousand accounts already span hundreds of
+                 chunks — statesync restore, chunk retry/backoff, and
+                 pruned-provider paths finally see non-trivial state
+
+Tx wire format (self-describing, mempool-safe ASCII):
+
+    bank:{"amount":5,"from":"<pub 64 hex>","nonce":0,"op":"transfer",
+          "sig":"<128 hex>","to":"<addr 40 hex>"}
+
+`from` is the sender's full ed25519 pubkey (the account address is
+derived from it); `to` is a 20-byte account address. The signature
+covers `bank-transfer|chain_id|from|to|amount|nonce` — chain-bound, so
+a tx cannot be replayed across testnets. `val:` txs pass through to the
+kvstore's validator-update machinery unchanged (manifest
+validator_updates keep working under `app = "bank"`).
+
+The faucet is a TREASURY account whose ed25519 seed is derived
+deterministically from the chain id (init_chain credits it with the
+entire supply), so every load generator and test can sign transfers
+without key distribution: `treasury_priv(chain_id)`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey, address_hash
+from ..crypto.merkle import hash_from_byte_slices
+from . import types as abci
+from .kvstore import (
+    CODE_TYPE_BAD_NONCE,
+    CODE_TYPE_ENCODING_ERROR,
+    CODE_TYPE_UNAUTHORIZED,
+    KVStoreApplication,
+    VALIDATOR_PREFIX,
+)
+
+ACCT_PREFIX = b"acct:"
+ACCT_END = b"acct;"  # ';' = ':' + 1 — the half-open prefix range bound
+BANK_TX_PREFIX = b"bank:"
+TREASURY_SUPPLY = 1_000_000_000_000
+
+# insufficient funds — the one failure mode the kvstore's code table
+# has no word for
+CODE_TYPE_INSUFFICIENT_FUNDS = 6
+
+
+def treasury_priv(chain_id: str) -> Ed25519PrivKey:
+    """The faucet key every bank testnet shares, derived from the chain
+    id — deterministic so the e2e load generator, the soak CLI, and the
+    tests can all sign treasury transfers without key distribution."""
+    seed = hashlib.sha256(b"tmsoak-bank-treasury|" + chain_id.encode()).digest()
+    return Ed25519PrivKey.generate(seed=seed)
+
+
+def transfer_sign_bytes(chain_id: str, from_pub_hex: str, to_addr_hex: str,
+                        amount: int, nonce: int) -> bytes:
+    return f"bank-transfer|{chain_id}|{from_pub_hex}|{to_addr_hex}|{amount}|{nonce}".encode()
+
+
+def make_transfer_tx(priv: Ed25519PrivKey, to_addr: bytes, amount: int,
+                     nonce: int, chain_id: str) -> bytes:
+    """A signed transfer tx as wire bytes."""
+    pub_hex = priv.pub_key().bytes().hex()
+    to_hex = to_addr.hex()
+    sig = priv.sign(transfer_sign_bytes(chain_id, pub_hex, to_hex, amount, nonce))
+    doc = {"amount": amount, "from": pub_hex, "nonce": nonce,
+           "op": "transfer", "sig": sig.hex(), "to": to_hex}
+    return BANK_TX_PREFIX + json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _acct_key(addr: bytes) -> bytes:
+    return ACCT_PREFIX + addr.hex().encode()
+
+
+def _acct_value(balance: int, nonce: int, pub: bytes | None) -> bytes:
+    """Canonical account encoding — sorted keys, no whitespace — so the
+    merkle leaves (and therefore the app hash) are byte-deterministic
+    across nodes and across snapshot restore."""
+    doc = {"balance": balance, "nonce": nonce}
+    if pub:
+        doc["pub"] = pub.hex()
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+
+
+class BankApplication(KVStoreApplication):
+    """Accounts + signed transfers on the kvstore's ABCI chassis: the
+    pending-buffer commit discipline, crash-replay guard, chunked
+    snapshot machinery, and validator-update txs are inherited; what
+    changes is the state model, the tx format, and the app hash."""
+
+    # 4 KiB chunks (vs the kvstore's 16 KiB): a soak-sized account set
+    # crosses the 100-chunk mark at roughly half a MB of state, so the
+    # multi-chunk statesync paths are exercised by every bank restore
+    SNAPSHOT_CHUNK_SIZE = 4 * 1024
+
+    # ------------------------------------------------------------ state io
+    # chain_id is persisted in the db (written by init_chain) so a
+    # RESTARTED out-of-process app — and a statesync-RESTORED one that
+    # never saw InitChain — keeps verifying transfer signatures with
+    # the right chain binding. No extra __init__: the chassis's
+    # _load_state hook (called from __init__, rollback, and reload)
+    # re-derives it.
+
+    def _load_bank_state(self) -> None:
+        raw = self.db.get(b"bank:chain_id")
+        self.chain_id = raw.decode() if raw else ""
+
+    def _load_state(self) -> None:
+        super()._load_state()
+        self._load_bank_state()
+
+    def apply_snapshot_chunk(self, req: abci.RequestApplySnapshotChunk) -> abci.ResponseApplySnapshotChunk:
+        resp = super().apply_snapshot_chunk(req)
+        with self._mu:
+            # the final chunk replaced the whole db, including the
+            # persisted chain id — without this reload a restored node
+            # would verify transfers against chain_id "" and reject
+            # every tx its peers accept (instant app-hash fork)
+            self._load_bank_state()
+        return resp
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        resp = super().init_chain(req)
+        with self._mu:
+            self.chain_id = req.chain_id
+            self._pending[b"bank:chain_id"] = req.chain_id.encode()
+            treasury = treasury_priv(req.chain_id)
+            pub = treasury.pub_key().bytes()
+            addr = address_hash(pub)
+            if not self._db_has(_acct_key(addr)):
+                self._pending[_acct_key(addr)] = _acct_value(TREASURY_SUPPLY, 0, pub)
+                self.size += 1
+        return resp
+
+    # ------------------------------------------------------------ accounts
+
+    def _get_account(self, addr: bytes) -> dict | None:
+        raw = self._db_get(_acct_key(addr))
+        return json.loads(raw) if raw else None
+
+    def _put_account(self, addr: bytes, balance: int, nonce: int, pub: bytes | None) -> None:
+        existed = self._db_has(_acct_key(addr))
+        self._pending[_acct_key(addr)] = _acct_value(balance, nonce, pub)
+        if not existed:
+            self.size += 1  # size = number of accounts (Info data)
+
+    # ------------------------------------------------------------ tx exec
+
+    @staticmethod
+    def _parse_transfer(tx: bytes) -> dict | str:
+        """Parsed doc, or an error string."""
+        try:
+            doc = json.loads(tx[len(BANK_TX_PREFIX):])
+        except Exception:
+            return "bank tx is not valid JSON"
+        if not isinstance(doc, dict) or doc.get("op") != "transfer":
+            return f"unknown bank op {doc.get('op') if isinstance(doc, dict) else doc!r}"
+        try:
+            pub = bytes.fromhex(doc["from"])
+            to = bytes.fromhex(doc["to"])
+            amount = int(doc["amount"])
+            nonce = int(doc["nonce"])
+            sig = bytes.fromhex(doc["sig"])
+        except Exception as e:
+            return f"malformed transfer field: {e}"
+        if len(pub) != 32 or len(to) != 20 or len(sig) != 64:
+            return "bad field length (pub 32B, to 20B, sig 64B)"
+        if amount <= 0:
+            return "amount must be positive"
+        if nonce < 0:
+            return "nonce must be >= 0"
+        return {"pub": pub, "to": to, "amount": amount, "nonce": nonce, "sig": sig,
+                "from_hex": doc["from"], "to_hex": doc["to"]}
+
+    def _verify_transfer_sig(self, p: dict) -> bool:
+        msg = transfer_sign_bytes(self.chain_id, p["from_hex"], p["to_hex"],
+                                  p["amount"], p["nonce"])
+        return Ed25519PubKey(p["pub"]).verify_signature(msg, p["sig"])
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        tx = req.tx
+        if tx.startswith(VALIDATOR_PREFIX.encode()):
+            return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+        if not tx.startswith(BANK_TX_PREFIX):
+            return abci.ResponseCheckTx(
+                code=CODE_TYPE_ENCODING_ERROR, gas_wanted=1,
+                log="bank app accepts bank:/val: txs only",
+            )
+        p = self._parse_transfer(tx)
+        if isinstance(p, str):
+            return abci.ResponseCheckTx(code=CODE_TYPE_ENCODING_ERROR, gas_wanted=1, log=p)
+        if req.type != 1:  # 1 = Recheck: the sig was verified at admission
+            # and cannot have changed — re-verifying every pending tx
+            # after every block would burn ~1.5ms/tx/node of pure CPU
+            # on flood drains (seen live: a 400-tx flood starved a
+            # 1-core box into a liveness stall through rechecks alone)
+            with self._mu:
+                ok = self._verify_transfer_sig(p)
+            if not ok:
+                return abci.ResponseCheckTx(
+                    code=CODE_TYPE_UNAUTHORIZED, gas_wanted=1, log="bad transfer signature"
+                )
+        # nonce/balance are judged at FinalizeBlock against the state
+        # the tx actually executes on — CheckTx admission is signature +
+        # shape (a strict nonce check here would evict every queued
+        # same-sender tx behind the first)
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1,
+                                    sender=p["from_hex"])
+
+    def _handle_tx(self, tx: bytes) -> abci.ExecTxResult:
+        if tx.startswith(VALIDATOR_PREFIX.encode()):
+            return self._exec_validator_tx(tx)
+        if not tx.startswith(BANK_TX_PREFIX):
+            return abci.ExecTxResult(
+                code=CODE_TYPE_ENCODING_ERROR,
+                log="bank app accepts bank:/val: txs only",
+            )
+        p = self._parse_transfer(tx)
+        if isinstance(p, str):
+            return abci.ExecTxResult(code=CODE_TYPE_ENCODING_ERROR, log=p)
+        if not self._verify_transfer_sig(p):
+            return abci.ExecTxResult(code=CODE_TYPE_UNAUTHORIZED, log="bad transfer signature")
+        from_addr = address_hash(p["pub"])
+        sender = self._get_account(from_addr)
+        if sender is None:
+            return abci.ExecTxResult(
+                code=CODE_TYPE_UNAUTHORIZED, log=f"unknown sender account {from_addr.hex()}"
+            )
+        if p["nonce"] != sender["nonce"]:
+            return abci.ExecTxResult(
+                code=CODE_TYPE_BAD_NONCE,
+                log=f"bad nonce {p['nonce']} (want {sender['nonce']})",
+            )
+        if sender["balance"] < p["amount"]:
+            return abci.ExecTxResult(
+                code=CODE_TYPE_INSUFFICIENT_FUNDS,
+                log=f"balance {sender['balance']} < {p['amount']}",
+            )
+        # debit + nonce bump, credit (self-transfer must stay conserving:
+        # read the recipient AFTER the debit landed in _pending)
+        self._put_account(from_addr, sender["balance"] - p["amount"],
+                          sender["nonce"] + 1, p["pub"])
+        recipient = self._get_account(p["to"]) or {"balance": 0, "nonce": 0}
+        rec_pub = bytes.fromhex(recipient["pub"]) if recipient.get("pub") else None
+        self._put_account(p["to"], recipient["balance"] + p["amount"],
+                          recipient["nonce"], rec_pub)
+        events = [abci.Event(type="transfer", attributes=[
+            abci.EventAttribute("sender", from_addr.hex(), True),
+            abci.EventAttribute("recipient", p["to_hex"], True),
+            abci.EventAttribute("amount", str(p["amount"]), True),
+        ])]
+        return abci.ExecTxResult(code=abci.CODE_TYPE_OK, events=events)
+
+    # ------------------------------------------------------------ app hash
+
+    def _compute_app_hash(self) -> bytes:
+        """Merkle root over every account and validator entry (sorted
+        key order = deterministic leaf order). Routed through the PR-5
+        batched hash plane — the soak workload doubles as load on the
+        native merkle path."""
+        leaves = [
+            k + b"=" + v
+            for k, v in self._iter_merged(ACCT_PREFIX, ACCT_END)
+        ] + [
+            k + b"=" + v for k, v in self._iter_merged(b"val:", b"val;")
+        ]
+        return hash_from_byte_slices(leaves, site="bank")
+
+    # ------------------------------------------------------------- queries
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        with self._mu:
+            if req.path == "/account":
+                raw = self.db.get(_acct_key(req.data))
+                return abci.ResponseQuery(
+                    key=req.data, value=raw or b"", height=self._committed[0],
+                    log="exists" if raw else "does not exist",
+                )
+            if req.path == "/range":
+                return self._query_range(req)
+            if req.path == "/supply":
+                total = n = 0
+                for _k, v in self.db.iterator(ACCT_PREFIX, ACCT_END):
+                    total += json.loads(v)["balance"]
+                    n += 1
+                return abci.ResponseQuery(
+                    value=json.dumps({"supply": total, "accounts": n}).encode(),
+                    height=self._committed[0],
+                )
+            if req.path == "/val":
+                value = self.db.get(b"val:" + req.data)
+                return abci.ResponseQuery(key=req.data, value=value or b"")
+        return abci.ResponseQuery(
+            code=CODE_TYPE_ENCODING_ERROR, height=self._committed[0],
+            log=f"unknown query path {req.path!r} (bank: /account /range /supply /val)",
+        )
+
+    def _query_range(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        """Iterated range query over the COMMITTED account space:
+        data = b"<start-addr-hex>:<end-addr-hex>:<limit>" (empty start =
+        first account, empty end = past the last, limit <= 500). Returns
+        a JSON array of {addr, balance, nonce} plus the next-page start
+        address — the soak load uses this to walk the whole account set
+        in pages, hammering the db iterator as state grows."""
+        try:
+            start_hex, end_hex, limit_s = req.data.decode().split(":")
+            limit = min(int(limit_s or 100), 500)
+        except Exception:
+            return abci.ResponseQuery(
+                code=CODE_TYPE_ENCODING_ERROR, log="range data must be start:end:limit"
+            )
+        start = ACCT_PREFIX + start_hex.encode() if start_hex else ACCT_PREFIX
+        end = ACCT_PREFIX + end_hex.encode() if end_hex else ACCT_END
+        out, next_start = [], ""
+        for k, v in self.db.iterator(start, end):
+            if len(out) >= limit:
+                next_start = k[len(ACCT_PREFIX):].decode()
+                break
+            doc = json.loads(v)
+            out.append({"addr": k[len(ACCT_PREFIX):].decode(),
+                        "balance": doc["balance"], "nonce": doc["nonce"]})
+        return abci.ResponseQuery(
+            value=json.dumps({"accounts": out, "next": next_start}).encode(),
+            height=self._committed[0],
+        )

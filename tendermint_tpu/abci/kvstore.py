@@ -214,7 +214,7 @@ class KVStoreApplication(abci.Application):
                         abci.ValidatorUpdate(pub_key_type=entry[0], pub_key_bytes=entry[1], power=ev.validator.power - 1)
                     )
             tx_results = [self._handle_tx(tx) for tx in req.txs]
-            self.app_hash = _put_varint(self.size)
+            self.app_hash = self._compute_app_hash()
             self.height += 1
             return abci.ResponseFinalizeBlock(
                 tx_results=tx_results,
@@ -238,6 +238,13 @@ class KVStoreApplication(abci.Application):
             if self.retain_blocks > 0 and self.height >= self.retain_blocks:
                 resp.retain_height = self.height - self.retain_blocks + 1
             return resp
+
+    def _compute_app_hash(self) -> bytes:
+        """App hash at the end of FinalizeBlock (called under _mu).
+        Subclass hook — the kvstore's is the reference's 8-byte varint
+        of size (kvstore.go:201-203); abci/bank.py overrides with a
+        merkle root over the account set."""
+        return _put_varint(self.size)
 
     # ----------------------------------------------------------- snapshots
     # ref: test/e2e/app/snapshots.go — the e2e app's chunked state export
@@ -308,6 +315,16 @@ class KVStoreApplication(abci.Application):
                     reject_senders=[req.sender] if req.sender else [],
                 )
             doc = json.loads(data)
+            # the snapshot IS the complete state: any buffered
+            # uncommitted effects are void — a statesync node's
+            # InitChain-time writes (genesis validators, the bank's
+            # treasury) otherwise survive in _pending, overlay the
+            # restored db in every merged read, and fork the app hash
+            # at the first post-restore block (seen live: a restored
+            # joiner recomputed the treasury at full supply and halted
+            # on wrong Block.Header.AppHash)
+            self._pending.clear()
+            self.val_updates = []
             for k, v in self.db.iterator(None, None):
                 self.db.delete(k)
             for k_hex, v_hex in doc["items"]:

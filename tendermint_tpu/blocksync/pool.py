@@ -64,6 +64,22 @@ class BlockPool:
         self.settle_seconds = STATUS_SETTLE_SECONDS
         self._started_at = time.monotonic()
 
+    def reanchor(self, height: int) -> None:
+        """Move the next-height cursor after a handshake replay or a
+        statesync restore (node.py's boot/statesync handoffs). Under
+        the pool lock even though the pool thread is not running yet
+        at either call site: the anchor write then shares the same
+        discipline as every other height access — a bare attribute
+        store here is exactly the lock-free handoff write the
+        racecheck sanitizer flags (found live by the ISSUE-14 soak's
+        statesync join, the first run to drive this path under
+        TM_TPU_RACECHECK)."""
+        with self._lock:
+            self.height = height
+            self.start_height = height
+            self.last_advance = time.monotonic()
+            self.last_hundred_start = self.last_advance
+
     # ----------------------------------------------------------- lifecycle
 
     def start(self) -> None:

@@ -536,6 +536,15 @@ class ConsensusReactor:
             return True
         if prs.proposal_block_parts_header is None:
             return False
+        meta = self.block_store.load_block_meta(prs.height)
+        if meta is None or meta.block_id.part_set_header != prs.proposal_block_parts_header:
+            # the peer is assembling a DIFFERENT part set than our
+            # stored committed block (its own in-flight round proposal)
+            # — our parts can never prove into its header, and sending
+            # them just feeds the peer "invalid proof" errors
+            # (ref: reactor.go gossipDataForCatchup's
+            # PartSetHeader.Equals guard)
+            return False
         missing = BitArray(prs.proposal_block_parts_header.total).not_().sub(prs.proposal_block_parts)
         idx, ok = missing.pick_random()
         if not ok:

@@ -8,5 +8,9 @@ checks, and block-cadence benchmarking over RPC.
 
 from .manifest import Manifest, NodeManifest
 from .runner import Runner, WatchTripped
+from .scenario import SoakTimeline, resolve_for_cores
 
-__all__ = ["Manifest", "NodeManifest", "Runner", "WatchTripped"]
+__all__ = [
+    "Manifest", "NodeManifest", "Runner", "SoakTimeline", "WatchTripped",
+    "resolve_for_cores",
+]

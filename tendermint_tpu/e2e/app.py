@@ -1,8 +1,10 @@
-"""Standalone test app process for e2e testnets: the kvstore served over
-socket or gRPC ABCI (ref: test/e2e/node/main.go + test/e2e/app/;
-manifest abci_protocol in {builtin, tcp, unix, grpc}).
+"""Standalone test app process for e2e testnets: the kvstore or the
+bank app served over socket or gRPC ABCI (ref: test/e2e/node/main.go +
+test/e2e/app/; manifest abci_protocol in {builtin, tcp, unix, grpc},
+manifest `app` in {kvstore, bank}).
 
-Usage: python -m tendermint_tpu.e2e.app tcp://127.0.0.1:PORT
+Usage: python -m tendermint_tpu.e2e.app tcp://127.0.0.1:PORT \
+           [snapshot_interval] [app_name] [retain_blocks]
        python -m tendermint_tpu.e2e.app grpc://127.0.0.1:PORT
 """
 
@@ -14,6 +16,15 @@ import time
 from ..abci.kvstore import KVStoreApplication
 from ..abci.socket import SocketServer
 
+# the manifest `app` axis; node.py's builtin:<name> parser and the
+# generator draw from the same table
+APP_NAMES = ("kvstore", "bank")
+
+
+def _delay_methods(delays_ms: dict | None) -> dict:
+    """{call: seconds} for the four delayable ABCI calls."""
+    return {k: v / 1000.0 for k, v in (delays_ms or {}).items() if v > 0}
+
 
 class DelayedKVStore(KVStoreApplication):
     """kvstore with artificial per-call delays mimicking app computation
@@ -23,7 +34,7 @@ class DelayedKVStore(KVStoreApplication):
 
     def __init__(self, delays_ms: dict | None = None, **kw):
         super().__init__(**kw)
-        self._delays = {k: v / 1000.0 for k, v in (delays_ms or {}).items() if v > 0}
+        self._delays = _delay_methods(delays_ms)
 
     def _dally(self, call: str) -> None:
         d = self._delays.get(call)
@@ -47,14 +58,57 @@ class DelayedKVStore(KVStoreApplication):
         return super().finalize_block(req)
 
 
+def build_app(name: str, snapshot_interval: int = 0, retain_blocks: int = 0,
+              delays_ms: dict | None = None, db=None):
+    """Construct a builtin test app by manifest name. ONE factory shared
+    by the node's in-process path (node.py _make_app) and this external
+    app runner, so `app = "bank"` means the same thing on every
+    abci_protocol. `db` persists app state across restarts — REQUIRED
+    once retain_blocks prunes the blockstore, because a restarted
+    memory-only app (height 0) can no longer replay from a genesis
+    that is gone (the reference's persistent_kvstore shape)."""
+    if name == "kvstore":
+        cls = DelayedKVStore if delays_ms else KVStoreApplication
+    elif name == "bank":
+        from ..abci.bank import BankApplication
+
+        if delays_ms:
+            class DelayedBank(DelayedKVStore, BankApplication):
+                """MRO: the delay overrides FIRST (so a delayed call
+                dallies, then super()-dispatches into the bank's
+                handler), bank state model second; the kvstore chassis
+                is inherited exactly once."""
+
+            cls = DelayedBank
+        else:
+            cls = BankApplication
+    else:
+        raise ValueError(f"unknown app {name!r} (expected one of {APP_NAMES})")
+    kw: dict = {"snapshot_interval": snapshot_interval, "retain_blocks": retain_blocks}
+    if db is not None:
+        kw["db"] = db
+    if delays_ms:
+        kw["delays_ms"] = delays_ms
+    return cls(**kw)
+
+
 def main() -> int:
     import json
     import os
 
     addr = sys.argv[1] if len(sys.argv) > 1 else "tcp://127.0.0.1:26658"
     snapshot_interval = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    app_name = sys.argv[3] if len(sys.argv) > 3 else "kvstore"
+    retain_blocks = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    state_dir = sys.argv[5] if len(sys.argv) > 5 else ""
     delays = json.loads(os.environ.get("TM_E2E_DELAYS_MS", "{}"))
-    app = DelayedKVStore(delays_ms=delays, snapshot_interval=snapshot_interval)
+    db = None
+    if state_dir:
+        from ..store.kv import FileDB
+
+        db = FileDB(os.path.join(state_dir, "app.db"))
+    app = build_app(app_name, snapshot_interval=snapshot_interval,
+                    retain_blocks=retain_blocks, delays_ms=delays or None, db=db)
     if addr.startswith("grpc://"):
         from ..abci.grpc import GRPCServer
 
@@ -62,7 +116,7 @@ def main() -> int:
     else:
         server = SocketServer(app, addr)
     server.start()
-    print(f"e2e kvstore app listening on {addr}", flush=True)
+    print(f"e2e {app_name} app listening on {addr}", flush=True)
     try:
         while True:
             time.sleep(1)

@@ -27,8 +27,13 @@ def _weighted(r: random.Random, table: dict[str, int]) -> str:
 
 
 # ref: generate.go testnetCombinations — the Cartesian axes; the rest is
-# randomly chosen per testnet/node.
-TOPOLOGIES = ("single", "duo", "quad", "large")
+# randomly chosen per testnet/node. "soak" is the ISSUE-14 scale
+# topology: a 10-20-node net mixing validators/fulls/seeds/light
+# proxies with a bank-app scenario timeline (rolling restarts, churn,
+# a flood, a statesync late-join mid-flood); it is emitted for
+# generation/validation sweeps and core-gates down to a launchable
+# 4-node mix on small boxes (e2e/scenario.py).
+TOPOLOGIES = ("single", "duo", "quad", "large", "soak")
 ABCI_MODES = ("builtin", "outofprocess")
 
 ABCI_PROTOCOLS = {"tcp": 20, "grpc": 20, "unix": 10}  # generate.go:36-40
@@ -62,16 +67,41 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
     lines.append(f"load_tx_rate = {r.choice((5, 10, 20))}")
     lines.append(f'key_type = "{key_type}"')
 
+    n_lights = 0
     if topology == "single":
         n_validators, n_fulls, n_seeds = 1, 0, 0
     elif topology == "duo":
         n_validators, n_fulls, n_seeds = 2, 0, 0
     elif topology == "quad":
         n_validators, n_fulls, n_seeds = 4, 0, 0
-    else:  # large
+    elif topology == "large":
         n_validators = 4 + r.randrange(3)
         n_fulls = r.randrange(2)
         n_seeds = r.randrange(2)
+    else:  # soak: 10-20 nodes mixing every role (ISSUE 14)
+        n_validators = 7 + r.randrange(5)  # 7-11
+        n_fulls = 2 + r.randrange(4)  # 2-5
+        n_seeds = r.randrange(3)  # 0-2
+        n_lights = 1 + r.randrange(2)  # 1-2
+
+    # app axis: soak nets usually run the stateful bank app (accounts +
+    # signed transfers + merkle app hash, abci/bank.py) so statesync/
+    # pruning/indexer see real state; a quarter of quads do too
+    app = "kvstore"
+    if topology == "soak" and r.random() < 0.75:
+        app = "bank"
+    elif topology == "quad" and r.random() < 0.25:
+        app = "bank"
+    if app != "kvstore":
+        lines.append(f'app = "{app}"')
+    # pruning axis: the app asks the node to prune below
+    # height - retain_blocks + 1 at every commit past the window. Only
+    # emitted alongside statesync late joiners (a blocksync-only late
+    # joiner cannot start below a pruned provider's base)
+    retain_blocks = 0
+    if topology == "soak" and r.random() < 0.5:
+        retain_blocks = 10 + r.randrange(11)
+        lines.append(f"retain_blocks = {retain_blocks}")
 
     # Vote extensions activate a few heights in, half the time
     # (ref: generate.go:124-126).
@@ -91,15 +121,28 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
 
     # Late joiners: only meaningful with >= 4 validators (a BFT quorum
     # must remain at genesis). Half are statesync restores, half plain
-    # blocksync (ref: generate.go:178-186 startAt + nodeStateSyncs).
+    # blocksync (ref: generate.go:178-186 startAt + nodeStateSyncs);
+    # soak nets ALWAYS get one statesync late joiner — the mid-flood
+    # statesync_join event below targets it — and with retain_blocks
+    # set every late joiner must be a statesync one.
     late: dict[str, tuple[int, bool]] = {}
     snapshot_interval = 0
-    if n_validators >= 4 and r.random() < 0.5:
+    if topology == "soak":
+        start_at = 4 + r.randrange(4)
+        late[f"validator{n_validators:02d}"] = (start_at, True)
+        snapshot_interval = r.choice((2, 3))
+    elif n_validators >= 4 and r.random() < 0.5:
         start_at = 3 + r.randrange(3)
+        # (retain_blocks is never set on non-soak topologies, so no
+        # forced-statesync arm here; validate_generated holds the
+        # retain→statesync invariant for hand-written manifests)
         use_statesync = r.random() < 0.5
         late[f"validator{n_validators:02d}"] = (start_at, use_statesync)
         if use_statesync:
             snapshot_interval = r.choice((2, 3))
+    if app == "bank" and not snapshot_interval:
+        # the bank's chunked snapshots are the point of the app axis
+        snapshot_interval = r.choice((2, 3))
     if snapshot_interval or (r.random() < 0.25):
         lines.append(f"snapshot_interval = {snapshot_interval or r.choice((2, 3))}")
 
@@ -122,11 +165,31 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
         for name, power in sorted(upd.items()):
             lines.append(f"{name} = {power}")
 
+    # Soak scenario timeline (e2e/scenario.py): a rolling restart
+    # walking the genesis validators, a churn wave over the fulls (or
+    # validators), then a tx flood with the statesync late-join landing
+    # MID-flood. Storm kinds (churn) are stripped by the core gate on
+    # small boxes; the timeline itself always validates.
+    if topology == "soak":
+        def event(**kw) -> None:
+            lines.append("[[scenario]]")
+            for k, v in kw.items():
+                lines.append(f'{k} = "{v}"' if isinstance(v, str) else f"{k} = {v}")
+
+        event(at=6.0, kind="rolling_restart", node="validator*",
+              gap=float(1 + r.randrange(3)))
+        event(at=14.0, kind="churn", node="full*" if n_fulls else "validator*",
+              gap=1.0)
+        flood_at = 20.0
+        event(at=flood_at, kind="flood", txs=200 + 100 * r.randrange(4))
+        event(at=flood_at + 2.0, kind="statesync_join",
+              node=f"validator{n_validators:02d}")
+
     def node_lines(name: str, mode: str) -> None:
         lines.append(f"[node.{name}]")
         if mode != "validator":
             lines.append(f'mode = "{mode}"')
-        if mode != "seed":
+        if mode not in ("seed", "light"):
             if abci_mode == "outofprocess":
                 lines.append(f'abci_protocol = "{_weighted(r, ABCI_PROTOCOLS)}"')
             start = late.get(name)
@@ -158,6 +221,8 @@ def generate_manifest(r: random.Random, topology: str, abci_mode: str, index: in
         node_lines(f"validator{i:02d}", "validator")
     for i in range(1, n_fulls + 1):
         node_lines(f"full{i:02d}", "full")
+    for i in range(1, n_lights + 1):
+        node_lines(f"light{i:02d}", "light")
     return "\n".join(lines) + "\n"
 
 
@@ -177,8 +242,13 @@ def generate(seed: int, topologies=TOPOLOGIES, abci_modes=ABCI_MODES) -> list[tu
 
 def validate_generated(text: str) -> Manifest:
     """Parse + check the runner's invariants; raises on violation."""
+    from .app import APP_NAMES
+    from .scenario import SoakTimeline
+
     m = Manifest.parse(text)
     names = {n.name for n in m.nodes}
+    if m.app not in APP_NAMES:
+        raise ValueError(f"unknown app {m.app!r}")
     # Every manifest validator is in the genesis set (runner.setup), so
     # the ones whose processes start at genesis must alone exceed 2/3:
     # at most floor((n-1)/3) validators may join late.
@@ -186,12 +256,31 @@ def validate_generated(text: str) -> Manifest:
     if len(late_vals) > max(0, (len(m.validators) - 1) // 3):
         raise ValueError("too many late validators for a genesis quorum")
     for n in m.nodes:
+        if n.mode not in ("validator", "full", "seed", "light"):
+            raise ValueError(f"{n.name}: unknown mode {n.mode!r}")
         if n.state_sync and n.start_at <= 0:
             raise ValueError(f"{n.name}: state_sync without start_at")
         if n.state_sync and m.snapshot_interval <= 0:
             raise ValueError(f"{n.name}: state_sync without snapshots")
+        if m.retain_blocks > 0 and n.start_at > 0 and not n.state_sync:
+            # a blocksync-only late joiner starts below every pruned
+            # provider's blockstore base and can never catch up
+            raise ValueError(f"{n.name}: blocksync late joiner with retain_blocks set")
+        if n.mode == "light" and (n.perturb and set(n.perturb) - {"kill", "restart"}):
+            raise ValueError(f"{n.name}: light proxies support kill/restart only")
+        if n.mode == "light" and n.start_at > 0:
+            raise ValueError(
+                f"{n.name}: light proxies start after block 1, not at a height"
+            )
+    if any(n.mode == "light" for n in m.nodes) and not any(
+        n.mode in ("validator", "full") and n.start_at == 0 for n in m.nodes
+    ):
+        raise ValueError("light proxies need a genesis validator/full as primary")
     for height, upd in m.validator_updates.items():
         for name in upd:
             if name not in names:
                 raise ValueError(f"validator_update.{height} references unknown node {name}")
+    # the scenario timeline must parse AND resolve: every event's
+    # pattern matches an eligible node (SoakTimeline.resolve raises)
+    SoakTimeline.from_manifest(m).resolve(m)
     return m

@@ -29,7 +29,10 @@ class NodeManifest:
     """ref: manifest.go ManifestNode."""
 
     name: str
-    mode: str = "validator"  # validator | full | seed
+    # validator | full | seed | light — light runs the verifying RPC
+    # proxy (`tendermint_tpu light`) against a full/validator primary
+    # instead of a consensus node (docs/e2e.md roles)
+    mode: str = "validator"
     abci_protocol: str = "builtin"  # builtin | tcp | unix | grpc
     # kill|pause|restart|disconnect|partition, plus the packet-level
     # faultnet kinds blackhole|halfopen (docs/faultnet.md) — those
@@ -89,6 +92,29 @@ class Manifest:
     # the kvstore's val: txs once the chain passes that height
     # (ref: manifest.go ValidatorUpdates)
     validator_updates: dict = field(default_factory=dict)
+    # consensus.create-empty-blocks-interval for every node (seconds,
+    # 0 = eager empty blocks). Soak manifests set this: an idle chain
+    # racing 5 empty blocks/s sprints away from any paused/restarted
+    # node faster than consensus catch-up gossip can feed it (the
+    # reference's switch-to-blocksync isn't implemented), and a
+    # production chain doesn't commit empty blocks at commit-timeout
+    # cadence anyway
+    empty_blocks_interval: float = 0.0
+    # on-chain BlockParams.max_bytes override, 0 = the default 21 MB.
+    # Soak manifests cap this around one part-set part (64 KiB) so a
+    # flood drains across heights instead of jamming one multi-part
+    # proposal into a propose-timeout loop on a saturated box
+    block_max_bytes: int = 0
+    # ABCI app the testnet runs: kvstore | bank (e2e/app.py APP_NAMES).
+    # The bank app (abci/bank.py) carries real state growth — accounts,
+    # signed transfers, merkle app hash, hundreds-of-chunks snapshots —
+    # so statesync/pruning/indexer paths see non-trivial state
+    app: str = "kvstore"
+    # app ResponseCommit.retain_height window: every Commit past this
+    # many blocks asks the node to prune blocks/states below
+    # height - retain_blocks + 1 (state/execution.py). 0 = keep all
+    # (ref: e2e manifest.go RetainBlocks)
+    retain_blocks: int = 0
     # builtin kvstore app snapshot cadence, 0 = no snapshots
     # (ref: manifest.go SnapshotInterval)
     snapshot_interval: int = 0
@@ -110,6 +136,10 @@ class Manifest:
     # perturbed run needs, and the per-tick cost is sub-millisecond.
     # 0 turns it off.
     flight_interval: float = 1.0
+    # declarative soak timeline: [[scenario]] tables, each
+    # {at, kind, node?, txs?, gap?} — parsed/validated by
+    # e2e/scenario.py SoakTimeline and driven by Runner.soak()
+    scenario: list[dict] = field(default_factory=list)
 
     @classmethod
     def parse(cls, text: str) -> "Manifest":
@@ -120,6 +150,10 @@ class Manifest:
             flood_txs=int(doc.get("flood_txs", 0)),
             initial_height=int(doc.get("initial_height", 1)),
             key_type=doc.get("key_type", "ed25519"),
+            app=doc.get("app", "kvstore"),
+            empty_blocks_interval=float(doc.get("empty_blocks_interval", 0.0)),
+            block_max_bytes=int(doc.get("block_max_bytes", 0)),
+            retain_blocks=int(doc.get("retain_blocks", 0)),
             snapshot_interval=int(doc.get("snapshot_interval", 0)),
             vote_extensions_enable_height=int(doc.get("vote_extensions_enable_height", 0)),
             prepare_proposal_delay_ms=int(doc.get("prepare_proposal_delay_ms", 0)),
@@ -136,6 +170,7 @@ class Manifest:
             drop=float(fn.get("drop", 0.0)),
             bandwidth=int(fn.get("bandwidth", 0)),
         )
+        m.scenario = [dict(e) for e in (doc.get("scenario") or [])]
         for h, updates in (doc.get("validator_update") or {}).items():
             m.validator_updates[int(h)] = {k: int(v) for k, v in updates.items()}
         for name, nd in (doc.get("node") or {}).items():

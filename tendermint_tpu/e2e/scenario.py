@@ -1,0 +1,390 @@
+"""Soak scenario timelines + core-aware manifest resolution (ISSUE 14).
+
+Two concerns live here, both declarative:
+
+**Timelines.** A manifest's `[[scenario]]` tables describe WHEN things
+happen to WHICH nodes — rolling restarts walking the validator set,
+kill/pause storms, peer-churn waves, tx floods, statesync late-joins
+arriving mid-flood — layered on the same sorted-events shape as the
+faultnet scenario plane (faultnet/scenario.py):
+
+    [[scenario]]
+    at = 10.0                 # seconds after the soak clock starts
+    kind = "rolling_restart"  # walk every match, one at a time
+    node = "validator*"       # fnmatch over node names
+    gap = 2.0                 # settle seconds between victims
+
+    [[scenario]]
+    at = 30.0
+    kind = "flood"
+    txs = 500
+
+    [[scenario]]
+    at = 32.0
+    kind = "statesync_join"   # start the late joiner NOW, mid-flood
+    node = "validator04"
+
+`SoakTimeline.resolve(manifest)` expands patterns into concrete
+per-node actions without launching anything — the tier-1 tests and
+`tmsoak --dry-run` print exactly what a run would do; `Runner.soak`
+executes the same resolution.
+
+**Core gating.** The perturbation mix a box can absorb depends on its
+cores: on a <4-core box, partition/disconnect-style perturbations make
+vetoed peers redial in a tight loop of pure-Python handshakes that
+starves consensus itself (the PR-8 diagnosis, previously lore in
+memory/ROADMAP prose — this module is that rule as code; docs/e2e.md
+#core-gating). `resolve_for_cores` rewrites a manifest + timeline for
+the detected (or given) core count:
+
+  * cores >= FULL_MIX_CORES (4): full mix, node count capped at
+    2*cores (a 20-node net needs a 10-core box)
+  * cores < FULL_MIX_CORES: kill/pause/restart ONLY (storm-surface
+    perturbations stripped from node perturb lists AND timeline
+    events), net clamped to SMALL_BOX_MAX_NODES keeping genesis
+    validators first, then statesync late joiners, then fulls/seeds/
+    lights
+
+Resolution is deterministic for a given (manifest, cores) pair and
+returns human-readable notes naming everything it changed.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from dataclasses import dataclass
+from fnmatch import fnmatch
+
+from .manifest import Manifest
+
+# perturbation taxonomy for core gating: "safe" kinds have no dial-storm
+# surface (a killed/paused node's peers back off quietly); "storm" kinds
+# make live peers redial/renegotiate in a loop of pure-Python handshake
+# crypto, which on small boxes starves consensus (docs/e2e.md)
+SAFE_PERTURBS = frozenset({"kill", "pause", "restart"})
+STORM_PERTURBS = frozenset({"disconnect", "partition", "blackhole", "halfopen"})
+
+# timeline event kinds: the per-node perturbations plus the composite
+# soak moves
+COMPOSITE_KINDS = frozenset({"rolling_restart", "churn", "flood", "statesync_join"})
+SOAK_KINDS = SAFE_PERTURBS | STORM_PERTURBS | COMPOSITE_KINDS
+
+# core-gating thresholds (docs/e2e.md#core-gating)
+FULL_MIX_CORES = 4
+SMALL_BOX_MAX_NODES = 4
+
+
+def max_nodes_for(cores: int) -> int:
+    """Node budget for a box: each node is a multi-threaded Python
+    process; past ~2 per core the scheduler churn eats the consensus
+    cadence the gates judge."""
+    return max(SMALL_BOX_MAX_NODES, 2 * cores)
+
+
+@dataclass
+class SoakEvent:
+    """One timeline entry (shape mirrors faultnet.FaultEvent)."""
+
+    at: float
+    kind: str
+    node: str = "*"  # fnmatch over node names; composite kinds expand it
+    txs: int = 0  # flood burst size
+    gap: float = 1.0  # settle seconds between rolling_restart/churn victims
+
+    def __post_init__(self):
+        if self.at < 0:
+            raise ValueError(f"event at={self.at} before the soak clock start")
+        if self.kind not in SOAK_KINDS:
+            raise ValueError(
+                f"unknown scenario kind {self.kind!r} (expected one of {sorted(SOAK_KINDS)})"
+            )
+        if self.kind == "flood" and self.txs <= 0:
+            raise ValueError("flood event requires txs > 0")
+        if self.gap < 0:
+            raise ValueError(f"negative gap {self.gap}")
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "SoakEvent":
+        doc = dict(doc)
+        ev = cls(
+            at=float(doc.pop("at", 0.0)),
+            kind=str(doc.pop("kind", "")),
+            node=str(doc.pop("node", "*")),
+            txs=int(doc.pop("txs", 0)),
+            gap=float(doc.pop("gap", 1.0)),
+        )
+        if doc:
+            raise ValueError(f"unknown scenario event keys: {sorted(doc)}")
+        return ev
+
+    def matches(self, manifest: Manifest) -> list[str]:
+        """Concrete node names this event touches, honoring per-kind
+        role constraints (resolution, not launch)."""
+        if self.kind == "flood":
+            return []
+        out = []
+        for n in manifest.nodes:
+            if not fnmatch(n.name, self.node):
+                continue
+            if self.kind == "statesync_join":
+                if n.start_at > 0:
+                    out.append(n.name)
+            elif self.kind in ("disconnect", "partition", "churn"):
+                # need a live RPC + a p2p router: consensus nodes only
+                if n.mode in ("validator", "full") and n.start_at == 0:
+                    out.append(n.name)
+            elif self.kind == "rolling_restart":
+                # the walk restarts consensus processes; lights/seeds
+                # are covered by plain kill/restart events
+                if n.mode in ("validator", "full") and n.start_at == 0:
+                    out.append(n.name)
+            else:  # kill | pause | restart | blackhole | halfopen
+                if n.start_at == 0:
+                    out.append(n.name)
+        return out
+
+
+class SoakTimeline:
+    """An ordered soak timeline (the faultnet Scenario shape, over
+    node-level moves instead of link policies)."""
+
+    def __init__(self, events: list[SoakEvent], name: str = "soak"):
+        self.name = name
+        self.events = sorted(events, key=lambda e: e.at)
+
+    @classmethod
+    def from_manifest(cls, m: Manifest, name: str | None = None) -> "SoakTimeline":
+        events = [SoakEvent.from_doc(doc) for doc in m.scenario]
+        return cls(events, name=name or f"{m.chain_id}-soak")
+
+    @property
+    def duration(self) -> float:
+        return self.events[-1].at if self.events else 0.0
+
+    def resolve(self, manifest: Manifest) -> list[dict]:
+        """Expand every event against the manifest into concrete
+        actions: [{at, kind, nodes, ...}]. Raises on an event that can
+        never fire (pattern matching nothing) — a typoed node name must
+        fail the dry-run, not silently no-op the live run."""
+        out = []
+        for ev in self.events:
+            nodes = ev.matches(manifest)
+            if ev.kind == "flood":
+                out.append({"at": ev.at, "kind": "flood", "txs": ev.txs, "nodes": []})
+                continue
+            if not nodes:
+                raise ValueError(
+                    f"scenario event at={ev.at:g} kind={ev.kind} matches no "
+                    f"eligible node for pattern {ev.node!r}"
+                )
+            act = {"at": ev.at, "kind": ev.kind, "nodes": nodes}
+            if ev.kind in ("rolling_restart", "churn"):
+                act["gap"] = ev.gap
+            out.append(act)
+        return out
+
+
+def gate_overrides_for(cores: int | None = None) -> tuple[dict, dict]:
+    """(post-mortem gate overrides, live watch-gate overrides) scaled
+    to this box — the budget half of core-aware resolution.
+
+    The default stall budgets (30s live / 60s post-mortem) were sized
+    for boxes where a 4-validator net idles well under full CPU. On a
+    <FULL_MIX_CORES box the SAME net saturates the core at baseline
+    (~25%/process measured live on 1 core), so every scenario move —
+    a restart's WAL replay, a statesync restore, a flood drain — puts
+    consensus rounds into timeout-escalation territory and legitimate
+    recovery takes minutes, not seconds. Scaling the budgets 3x keeps
+    the gates as real bounds (a deadlock still fails loudly) without
+    condemning the box's floor. Big boxes get {} — the defaults stand.
+    """
+    cores = cores if cores is not None else (os.cpu_count() or 1)
+    if cores >= FULL_MIX_CORES:
+        return {}, {}
+    # p99_step_budget_s = 10.0 deliberately parks the step-p99 gates at
+    # the histogram's top finite bucket (the estimate CLAMPS there, so
+    # this budget can never fire — the gates.py docstring's warning,
+    # used on purpose): on a saturated small box >1% of steps genuinely
+    # spill past 10s during joins/floods, the instrument saturates, and
+    # liveness/rate_stall remain the binding liveness bounds.
+    # max_height_spread 20 (post-mortem only — the LIVE spread gate
+    # keeps the default 5): final heights are scraped one node at a
+    # time during a teardown that takes seconds per node on a
+    # saturated box, while the chain keeps committing — a follower
+    # trailing the sprinting validators by a few seconds of blocks
+    # read as spread 12 with every node healthy (seen live under the
+    # sanitizers). A genuinely wedged node still fails rate_stall/
+    # liveness, and a stranded one exceeds 20 immediately.
+    return (
+        {"max_last_block_age_s": 180.0, "rate_stall_tail_s": 180.0,
+         "p99_step_budget_s": 10.0, "max_height_spread": 20},
+        {"stall_after_s": 90.0, "p99_step_budget_s": 10.0},
+    )
+
+
+# --------------------------------------------------------------- core gating
+
+
+def resolve_for_cores(
+    manifest: Manifest,
+    timeline: SoakTimeline | None = None,
+    cores: int | None = None,
+) -> tuple[Manifest, SoakTimeline, list[str]]:
+    """Rewrite (manifest, timeline) for this box's core count. Returns
+    (manifest', timeline', notes); inputs are never mutated. The
+    output is deterministic for a given (manifest, cores)."""
+    cores = cores if cores is not None else (os.cpu_count() or 1)
+    m = copy.deepcopy(manifest)
+    tl = timeline if timeline is not None else SoakTimeline.from_manifest(m)
+    notes: list[str] = []
+
+    cap = max_nodes_for(cores)
+    small = cores < FULL_MIX_CORES
+
+    if small:
+        # the kill/pause-only rule (docs/e2e.md#core-gating): strip
+        # every storm-surface perturbation from the node lists...
+        for n in m.nodes:
+            dropped = [p for p in n.perturb if p in STORM_PERTURBS]
+            if dropped:
+                n.perturb = [p for p in n.perturb if p not in STORM_PERTURBS]
+                notes.append(
+                    f"{n.name}: dropped {dropped} ({cores} cores < "
+                    f"{FULL_MIX_CORES}: kill/pause/restart only)"
+                )
+        # ...and the storm-kind timeline events (churn is a disconnect
+        # wave — same dial-storm surface)
+        kept_events = []
+        for ev in tl.events:
+            if ev.kind in STORM_PERTURBS or ev.kind == "churn":
+                notes.append(
+                    f"timeline: dropped at={ev.at:g} {ev.kind} on {ev.node!r} "
+                    f"({cores} cores < {FULL_MIX_CORES})"
+                )
+            else:
+                kept_events.append(ev)
+        tl = SoakTimeline(kept_events, name=tl.name)
+
+    if len(m.nodes) > cap:
+        m = _clamp_nodes(m, cap, notes, cores)
+        # clamped-away nodes may strand timeline patterns: drop events
+        # that no longer match anything (the clamp is OUR edit — unlike
+        # a typo it must not fail the run)
+        kept_events = []
+        for ev in tl.events:
+            if ev.kind != "flood" and not ev.matches(m):
+                notes.append(
+                    f"timeline: dropped at={ev.at:g} {ev.kind} on {ev.node!r} "
+                    "(its nodes were clamped away)"
+                )
+            else:
+                kept_events.append(ev)
+        tl = SoakTimeline(kept_events, name=tl.name)
+
+    return m, tl, notes
+
+
+def _clamp_nodes(m: Manifest, cap: int, notes: list[str], cores: int) -> Manifest:
+    """Shrink the net to `cap` nodes, preserving a launchable shape:
+    genesis validators first (the quorum), then statesync late joiners
+    (the scenario the soak exists to exercise), then plain late
+    validators, fulls, seeds, lights. The genesis-quorum invariant
+    (late validators <= floor((n-1)/3)) is re-enforced after the cut."""
+    genesis_vals = [n for n in m.nodes if n.mode == "validator" and n.start_at == 0]
+    late_all = sorted(
+        (n for n in m.nodes if n.start_at > 0),
+        key=lambda n: (not n.state_sync, n.mode != "validator", n.name),
+    )
+    rest = [n for n in m.nodes if n.mode != "validator" and n.start_at == 0]
+    # A statesync late joiner rides ONE slot ABOVE the cap: it is
+    # deferred/idle for most of the run (it costs nothing until its
+    # join event fires), it is the scenario the soak harness exists to
+    # exercise, and folding it INTO the cap would shrink the genesis
+    # quorum below fault tolerance — a 3+1-deferred validator set
+    # halts outright during every rolling-restart step (2/4 < 2/3+,
+    # seen live), so the cap must hold 4 genesis validators.
+    ss_late = [n for n in late_all if n.state_sync]
+    ordered = (
+        genesis_vals[:cap]
+        + ss_late[:1]
+        + [n for n in genesis_vals if n not in genesis_vals[:cap]]
+        + [n for n in late_all if n not in ss_late[:1]]
+        + rest
+    )
+    keep = ordered[: cap + (1 if ss_late else 0)]
+
+    # quorum: with v validators kept, at most (v-1)//3 may start late
+    vals = [n for n in keep if n.mode == "validator"]
+    late_kept = [n for n in vals if n.start_at > 0]
+    while late_kept and len(late_kept) > max(0, (len(vals) - 1) // 3):
+        victim = late_kept.pop()  # least-preferred late joiner
+        keep.remove(victim)
+        extra = next((n for n in ordered if n not in keep and n.mode == "validator"
+                      and n.start_at == 0), None)
+        if extra is not None:
+            keep.append(extra)
+        vals = [n for n in keep if n.mode == "validator"]
+        late_kept = [n for n in vals if n.start_at > 0]
+
+    kept_names = {n.name for n in keep}
+    dropped = [n.name for n in m.nodes if n.name not in kept_names]
+    if not dropped:
+        # the whole net fits once the deferred-joiner allowance is
+        # counted: nothing to rewrite
+        return m
+    notes.append(
+        f"clamped {len(m.nodes)} nodes -> {len(keep)} for {cores} core(s) "
+        f"(cap {cap}); dropped {dropped}"
+    )
+    m.nodes = [n for n in m.nodes if n.name in kept_names]  # original order
+    # validator_updates touching dropped nodes can never be applied
+    for h in sorted(m.validator_updates):
+        upd = {k: v for k, v in m.validator_updates[h].items() if k in kept_names}
+        removed = set(m.validator_updates[h]) - set(upd)
+        if removed:
+            notes.append(f"validator_update.{h}: dropped {sorted(removed)}")
+        if upd:
+            m.validator_updates[h] = upd
+        else:
+            del m.validator_updates[h]
+    return m
+
+
+def render_resolution(manifest: Manifest, timeline: SoakTimeline,
+                      notes: list[str], cores: int) -> str:
+    """Human dry-run view: the node table, the resolved timeline, and
+    every core-gate rewrite (tmsoak --dry-run prints this)."""
+    lines = [
+        f"manifest: chain_id={manifest.chain_id} app={manifest.app} "
+        f"nodes={len(manifest.nodes)} key_type={manifest.key_type} "
+        f"snapshot_interval={manifest.snapshot_interval} "
+        f"retain_blocks={manifest.retain_blocks}",
+        f"core gate: {cores} core(s) -> "
+        + ("full perturbation mix" if cores >= FULL_MIX_CORES
+           else "kill/pause/restart only")
+        + f", node cap {max_nodes_for(cores)}",
+    ]
+    for n in manifest.nodes:
+        bits = [n.mode]
+        if n.abci_protocol != "builtin":
+            bits.append(n.abci_protocol)
+        if n.start_at:
+            bits.append(f"start_at={n.start_at}" + ("+statesync" if n.state_sync else ""))
+        if n.perturb:
+            bits.append(f"perturb={n.perturb}")
+        lines.append(f"  node {n.name}: {' '.join(bits)}")
+    actions = timeline.resolve(manifest)
+    if actions:
+        lines.append(f"timeline ({len(actions)} event(s), {timeline.duration:g}s):")
+        for a in actions:
+            extra = "".join(
+                f" {k}={a[k]}" for k in ("txs", "gap") if a.get(k)
+            )
+            tgt = ",".join(a["nodes"]) if a["nodes"] else "-"
+            lines.append(f"  t={a['at']:>6g}s {a['kind']:<16} {tgt}{extra}")
+    else:
+        lines.append("timeline: empty (plain perturb-list run)")
+    for note in notes:
+        lines.append(f"  core-gate: {note}")
+    return "\n".join(lines)

@@ -544,6 +544,17 @@ class StateSyncMetrics:
         self.backfilled_blocks = reg.counter(
             f"{ns}_backfilled_blocks", "Light blocks backfilled after restore"
         )
+        # chunk-fetch resilience (no reference analog): re-requests by
+        # cause — "timeout" = an outstanding request expired (the
+        # escalating per-chunk backoff re-asks), "refetch" = the app
+        # rejected/failed-to-verify a delivered chunk, "peer_rotated" =
+        # a peer accumulated enough consecutive expiries that the
+        # fetch scheduler rotated away from it
+        self.chunk_retries = reg.counter(
+            f"{ns}_chunk_retries_total",
+            "Snapshot chunk re-requests by cause",
+            labels=("result",),
+        )
 
 
 class EvidenceMetrics:

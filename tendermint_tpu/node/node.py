@@ -17,7 +17,6 @@ import time
 from urllib.parse import urlparse
 
 from ..abci import LocalClient
-from ..abci.kvstore import KVStoreApplication
 from ..blocksync import BlockSyncReactor, blocksync_channel_descriptor
 from ..config import Config
 from ..consensus import WAL, ConsensusState, Handshaker
@@ -69,29 +68,44 @@ def _make_db(config: Config, name: str):
     return FileDB(os.path.join(config.db_dir, f"{name}.db"))
 
 
-def _make_app(proxy_app: str):
-    """ref: internal/proxy/client.go:26 ClientFactory. The builtin
-    kvstore accepts a snapshot-interval suffix:
-    builtin:kvstore:snapshot=N. tcp:// and unix:// addresses dial an
-    external app over the socket ABCI transport (abci/socket.py)."""
-    def _kvstore(**kw):
+def _make_app(proxy_app: str, app_db=None):
+    """ref: internal/proxy/client.go:26 ClientFactory. Builtin test
+    apps parse as builtin:<name>[:snapshot=N][:retain=M] — name in
+    e2e/app.py APP_NAMES (kvstore, bank), snapshot = app snapshot
+    interval, retain = ResponseCommit.retain_height window driving
+    blockstore/state pruning. `app_db` (the node's FileDB when called
+    from Node) persists builtin app state across restarts — without it
+    a killed node whose blockstore pruned past genesis can never
+    handshake again (app height 0, nothing to replay from). tcp:// and
+    unix:// addresses dial an external app over the socket ABCI
+    transport (abci/socket.py)."""
+    def _builtin(name: str, **kw):
         # the e2e harness's artificial ABCI-delay schedule applies to
         # builtin apps too (ref: manifest.go:80-86 — the reference test
-        # app delays regardless of transport)
+        # app delays regardless of transport); construction is shared
+        # with the external e2e app runner so `app = "bank"` means the
+        # same thing on every abci_protocol
         delays = os.environ.get("TM_E2E_DELAYS_MS")
         if delays:
             import json as _json
 
-            from ..e2e.app import DelayedKVStore
+            kw["delays_ms"] = _json.loads(delays)
+        from ..e2e.app import build_app
 
-            return DelayedKVStore(delays_ms=_json.loads(delays), **kw)
-        return KVStoreApplication(**kw)
+        return build_app(name, db=app_db, **kw)
 
-    if proxy_app.startswith("builtin:kvstore:snapshot="):
-        interval = int(proxy_app.rsplit("=", 1)[1])
-        return LocalClient(_kvstore(snapshot_interval=interval))
-    if proxy_app in ("builtin:kvstore", "kvstore", "builtin"):
-        return LocalClient(_kvstore())
+    if proxy_app.startswith("builtin:") and not proxy_app.startswith("builtin:noop"):
+        parts = proxy_app.split(":")[1:]  # [name, opt, opt...]
+        name, kw = parts[0], {}
+        opt_names = {"snapshot": "snapshot_interval", "retain": "retain_blocks"}
+        for opt in parts[1:]:
+            k, _, v = opt.partition("=")
+            if k not in opt_names:
+                raise ValueError(f"unknown builtin app option {opt!r} in {proxy_app!r}")
+            kw[opt_names[k]] = int(v)
+        return LocalClient(_builtin(name, **kw))
+    if proxy_app in ("kvstore", "builtin"):
+        return LocalClient(_builtin("kvstore"))
     if proxy_app in ("noop", "builtin:noop"):
         from ..abci.types import BaseApplication
 
@@ -184,7 +198,19 @@ class Node:
             self.state_store.save(state)
 
         # ---- app + handshake prerequisites (node/node.go:159)
-        self.app_client = app_client if app_client is not None else _make_app(config.base.proxy_app)
+        if app_client is not None:
+            self.app_client = app_client
+        else:
+            # builtin apps persist their state next to the node's other
+            # dbs — a kill+restart under retain_blocks pruning must
+            # handshake from the app's committed height, not replay a
+            # genesis the blockstore no longer has
+            builtin = config.base.proxy_app.split(":", 1)[0] in ("builtin", "kvstore") \
+                and "noop" not in config.base.proxy_app
+            self.app_client = _make_app(
+                config.base.proxy_app,
+                app_db=_make_db(config, "app") if builtin else None,
+            )
         from ..eventbus.eventlog import EventLog
 
         self.event_bus = EventBus(event_log=EventLog())
@@ -523,7 +549,9 @@ class Node:
         # saw at construction (crash between blockstore and state saves);
         # re-anchor the pool so it doesn't re-request an applied height
         # (the statesync path below resets it the same way).
-        self.blocksync_reactor.pool.height = max(state.last_block_height + 1, state.initial_height)
+        self.blocksync_reactor.pool.reanchor(
+            max(state.last_block_height + 1, state.initial_height)
+        )
 
         self.router.start()
         self.evidence_reactor.start()
@@ -598,7 +626,7 @@ class Node:
             self.statesync_reactor.backfill(state, lambda h: self._fetch_lb_quiet(primary, h))
             self.consensus.update_to_state(state)
             self.blocksync_reactor.state = state
-            self.blocksync_reactor.pool.height = state.last_block_height + 1
+            self.blocksync_reactor.pool.reanchor(state.last_block_height + 1)
             self.blocksync_reactor.start()
         except Exception:
             traceback.print_exc()

@@ -126,11 +126,19 @@ class StateStore:
         next_height = state.last_block_height + 1
         if state.last_block_height == 0:
             next_height = state.initial_height
-            # initial state: bootstrap both current and next sets
+            # initial state: bootstrap the current set
             self.save_validator_sets(state.initial_height, state.last_height_validators_changed, state.validators)
-            self.save_validator_sets(state.initial_height + 1, state.initial_height + 1, state.next_validators)
-        else:
-            self.save_validator_sets(next_height + 1, state.last_height_validators_changed, state.next_validators)
+        # The next-height entry carries last_height_validators_changed —
+        # a SPARSE pointer while the set is unchanged, exactly like the
+        # reference (store.go Save:169). Storing a full set here at
+        # genesis (the old behavior) made the initial+1 entry disagree
+        # with every later sparse entry about where the checkpoint
+        # lives, which broke prune_states' keep logic: it preserved the
+        # pointer target of the entry AT retain_height only, then
+        # deleted height 1 while heights above still pointed at it —
+        # the first post-prune LoadValidators crashed consensus (found
+        # by the ISSUE-14 soak harness driving retain_blocks).
+        self.save_validator_sets(next_height + 1, state.last_height_validators_changed, state.next_validators)
         self._save_params(next_height, state.last_height_consensus_params_changed, state.consensus_params)
         self._db.set(KEY_STATE, json.dumps(state_to_json(state)).encode())
 
@@ -141,7 +149,15 @@ class StateStore:
             self.save_validator_sets(height - 1, height - 1, state.last_validators)
         self.save_validator_sets(height, height, state.validators)
         self.save_validator_sets(height + 1, height + 1, state.next_validators)
-        self._save_params(height, state.last_height_consensus_params_changed, state.consensus_params)
+        # params PINNED at the bootstrap height like the validator
+        # entries above (ref store.go Bootstrap): a sparse pointer to
+        # last_height_consensus_params_changed references a height a
+        # statesync-fresh store never stored, so load_consensus_params
+        # at the restore height (rollback, the consensus_params RPC, a
+        # later joiner's ParamsRequest once the tip moved past it)
+        # would chase it to None — the dangling-sparse-pointer defect
+        # class the ISSUE-14 prune fixes closed for validator sets
+        self._save_params(height, height, state.consensus_params)
         self._db.set(KEY_STATE, json.dumps(state_to_json(state)).encode())
 
     # ------------------------------------------------- validator sets
@@ -264,15 +280,22 @@ class StateStore:
         if retain_height <= 0:
             raise ValueError(f"height {retain_height} must be greater than 0")
         pruned = 0
+        # Keep every below-retain height that a SURVIVING sparse entry
+        # still points at — not just the target of the entry at
+        # retain_height. Mixed full/sparse histories (a restarted node,
+        # a statesync bootstrap, the pre-fix genesis shape) can leave
+        # entries above retain_height referencing an older checkpoint
+        # than the retain_height entry does; deleting it strands every
+        # one of them (LoadValidators -> None -> consensus halt). The
+        # scan is bounded by the surviving window, which regular
+        # pruning keeps at ~retain_blocks entries.
         keep = set()
-        raw = self._db.get(_hkey(KEY_VALIDATORS, retain_height))
-        if raw is not None:
-            doc = json.loads(raw)
-            keep.add(doc.get("last_height_changed"))
-        rawp = self._db.get(_hkey(KEY_PARAMS, retain_height))
         keep_params = set()
-        if rawp is not None:
-            keep_params.add(json.loads(rawp).get("last_height_changed"))
+        for prefix, keepset in ((KEY_VALIDATORS, keep), (KEY_PARAMS, keep_params)):
+            for k, v in self._db.iterator(_hkey(prefix, retain_height), prefix + b"\xff" * 9):
+                target = json.loads(v).get("last_height_changed")
+                if target is not None and target < retain_height:
+                    keepset.add(target)
         batch = self._db.batch()
         for prefix, keepset in ((KEY_VALIDATORS, keep), (KEY_PARAMS, keep_params), (KEY_ABCI_RESPONSES, set())):
             for k, _ in list(self._db.iterator(prefix, _hkey(prefix, retain_height))):

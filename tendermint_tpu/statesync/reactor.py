@@ -346,9 +346,16 @@ class StateSyncReactor:
                              metrics=self.metrics)
         state, commit = self.syncer.sync_any(discovery_time=discovery_time, stop_event=self._stop)
 
-        # persist: bootstrap state + seen commit so consensus/blocksync
-        # can continue from the snapshot height (reactor.go:Sync end)
-        self.state_store.save(state)
+        # persist: BOOTSTRAP state + seen commit so consensus/blocksync
+        # can continue from the snapshot height (reactor.go:Sync end —
+        # the reference calls stateStore.Bootstrap, not Save, and the
+        # difference matters: Save writes the next-height validator
+        # entry as a sparse pointer to last_height_validators_changed,
+        # a height a statesync-fresh store never stored — the first
+        # post-restore apply_block then cannot load the validator set
+        # and halts the node (seen live; backfill usually papers over
+        # it, but a pruned provider can cut backfill short)
+        self.state_store.bootstrap(state)
         self.block_store.save_seen_commit(state.last_block_height, commit)
         return state, commit
 

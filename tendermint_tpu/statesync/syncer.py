@@ -76,23 +76,62 @@ class _SnapshotPool:
 
 
 class _ChunkQueue:
-    """Pending/received chunk bookkeeping (ref: chunks.go)."""
+    """Pending/received chunk bookkeeping (ref: chunks.go).
+
+    Re-requests carry ESCALATING per-chunk backoff: each expiry of an
+    outstanding request doubles that chunk's effective timeout (capped
+    at 2**BACKOFF_CAP) instead of hammering a dead/slow peer on a
+    fixed cadence, and the expiry is recorded against the peer the
+    request was assigned to (take_timeouts) so the syncer can rotate
+    away from it — the PR-9 redial-storm fix shape, applied to chunk
+    fetching."""
+
+    BACKOFF_CAP = 4  # 16x the base timeout at most
 
     def __init__(self, n_chunks: int):
         self.n = n_chunks
         self.chunks: list[bytes | None] = [None] * n_chunks
         self.senders: dict[int, str] = {}
         self._requested: dict[int, float] = {}
+        self._fails: dict[int, int] = {}  # expiries per chunk -> backoff exponent
+        self._assigned: dict[int, str] = {}  # chunk -> peer of the last request
+        self._timeouts: list[tuple[int, str]] = []  # drained by take_timeouts
         self._lock = threading.Lock()
 
-    def next_request(self, timeout: float = 10.0) -> int | None:
+    def next_request(self, timeout: float = 10.0, now: float | None = None) -> int | None:
         with self._lock:
-            now = time.monotonic()
+            now = time.monotonic() if now is None else now
             for i in range(self.n):
-                if self.chunks[i] is None and now - self._requested.get(i, 0) > timeout:
+                if self.chunks[i] is not None:
+                    continue
+                prev = self._requested.get(i)
+                if prev is None:
+                    self._requested[i] = now
+                    return i
+                backoff = timeout * (2 ** min(self._fails.get(i, 0), self.BACKOFF_CAP))
+                if now - prev > backoff:
+                    self._fails[i] = self._fails.get(i, 0) + 1
+                    peer = self._assigned.get(i)
+                    if peer:
+                        self._timeouts.append((i, peer))
                     self._requested[i] = now
                     return i
             return None
+
+    def mark_assigned(self, index: int, peer: str) -> None:
+        with self._lock:
+            self._assigned[index] = peer
+
+    def take_timeouts(self) -> list[tuple[int, str]]:
+        """Drain (chunk, peer) pairs whose outstanding request expired
+        since the last drain."""
+        with self._lock:
+            out, self._timeouts = self._timeouts, []
+            return out
+
+    def fail_count(self, index: int) -> int:
+        with self._lock:
+            return self._fails.get(index, 0)
 
     def add(self, index: int, chunk: bytes, sender: str) -> bool:
         with self._lock:
@@ -103,11 +142,17 @@ class _ChunkQueue:
             return True
 
     def refetch(self, indexes: list[int]) -> None:
+        """App-driven re-request (corrupt/rejected chunk): clear the
+        data and the request clock so the chunk is immediately
+        re-requestable. The backoff exponent survives — a chunk that
+        keeps timing out AND failing verification must not snap back
+        to the base cadence."""
         with self._lock:
             for i in indexes:
                 if 0 <= i < self.n:
                     self.chunks[i] = None
                     self._requested.pop(i, None)
+                    self._assigned.pop(i, None)
 
     def complete(self) -> bool:
         with self._lock:
@@ -126,6 +171,9 @@ class Syncer:
     DISCOVERY_WAIT = 2.0
     CHUNK_TIMEOUT = 5.0
     FETCH_STALL = 15.0
+    # rotate away from a peer once this many of its chunk requests
+    # expired without a response (one delivered chunk resets it)
+    PEER_ROTATE_TIMEOUTS = 3
 
     def __init__(self, app_client, state_provider, request_snapshots, request_chunk, logger=None,
                  metrics=None):
@@ -142,6 +190,15 @@ class Syncer:
         self._current: abci.Snapshot | None = None
         self._missing = False
         self._lock = threading.Lock()
+        # chunk-fetch peer scheduling: consecutive expired requests per
+        # peer; at PEER_ROTATE_TIMEOUTS the peer is passed over until a
+        # chunk it sent lands (guarded by _lock with the queue swap)
+        self._peer_timeouts: dict[str, int] = {}
+        self._rr = 0  # round-robin cursor over healthy peers
+
+    def _count_retry(self, result: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.chunk_retries.add(n, result)
 
     # ------------------------------------------------------------ inbound
 
@@ -155,7 +212,12 @@ class Syncer:
         with self._lock:
             if self.chunks is None:
                 return False
-            return self.chunks.add(index, chunk, sender)
+            added = self.chunks.add(index, chunk, sender)
+            if added and sender:
+                # a delivered chunk clears the peer's timeout strikes
+                # (the PR-9 one-success-resets discipline)
+                self._peer_timeouts.pop(sender, None)
+            return added
 
     def note_missing(self, height: int, format: int) -> None:
         """Peer no longer has a chunk of the current snapshot (pruned) —
@@ -166,6 +228,23 @@ class Syncer:
 
     def remove_peer(self, peer_id: str) -> None:
         self.snapshots.remove_peer(peer_id)
+
+    def _pick_peer(self, peers: list[str]) -> str:
+        """Round-robin over peers that have NOT accumulated
+        PEER_ROTATE_TIMEOUTS consecutive expired chunk requests; when
+        every peer is struck out, fall back to the full set with fresh
+        strikes (rotation must degrade a peer, never starve the
+        fetch)."""
+        with self._lock:
+            healthy = [
+                p for p in peers
+                if self._peer_timeouts.get(p, 0) < self.PEER_ROTATE_TIMEOUTS
+            ]
+            if not healthy:
+                self._peer_timeouts = {}
+                healthy = list(peers)
+            self._rr += 1
+            return healthy[self._rr % len(healthy)]
 
     # -------------------------------------------------------------- sync
 
@@ -217,6 +296,8 @@ class Syncer:
             self.chunks = _ChunkQueue(snapshot.chunks)
             self._current = snapshot
             self._missing = False
+            self._peer_timeouts = {}
+            self._rr = 0
 
         # 3. fetch + apply strictly in order (syncer.go:380 fetchChunks /
         #    applyChunks — the e2e app requires ordered apply). A stall
@@ -232,8 +313,19 @@ class Syncer:
             entry = self.chunks.next_unapplied(applied)
             if entry is None:
                 idx = self.chunks.next_request(self.CHUNK_TIMEOUT)
+                # account the expiries next_request just detected: each
+                # is a strike against the peer whose request went dark
+                for _i, peer in self.chunks.take_timeouts():
+                    self._count_retry("timeout")
+                    with self._lock:
+                        strikes = self._peer_timeouts.get(peer, 0) + 1
+                        self._peer_timeouts[peer] = strikes
+                    if strikes == self.PEER_ROTATE_TIMEOUTS:
+                        self._count_retry("peer_rotated")
                 if idx is not None and peers:
-                    self.request_chunk(snapshot, idx, peers)
+                    peer = self._pick_peer(peers)
+                    self.chunks.mark_assigned(idx, peer)
+                    self.request_chunk(snapshot, idx, [peer])
                 stop_event.wait(0.05)
                 continue
             index, chunk, sender = entry
@@ -250,9 +342,12 @@ class Syncer:
                 continue
             if resp.result == abci.CHUNK_RETRY:
                 self.chunks.refetch([index])
+                self._count_retry("refetch")
                 continue
             if resp.result == abci.CHUNK_RETRY_SNAPSHOT:
-                self.chunks.refetch(resp.refetch_chunks or list(range(snapshot.chunks)))
+                refetched = resp.refetch_chunks or list(range(snapshot.chunks))
+                self.chunks.refetch(refetched)
+                self._count_retry("refetch", len(refetched))
                 applied = 0
                 continue
             raise ErrRejectSnapshot(f"chunk apply failed: {resp.result}")
@@ -271,7 +366,18 @@ class Syncer:
                 f"app height mismatch after restore: {info.last_block_height} != {snapshot.height}"
             )
 
-        # 5. build the framework state + seen commit (syncer.go:500)
-        state = self.state_provider.state(snapshot.height)
-        commit = self.state_provider.commit(snapshot.height)
+        # 5. build the framework state + seen commit (syncer.go:500).
+        # Provider failures here — e.g. the +2 light block does not
+        # exist yet because the chain stalled right at the snapshot
+        # height — must REJECT the snapshot (sync_any rediscovers and
+        # retries, picking up a newer snapshot once the chain moves),
+        # not kill the statesync thread and strand the joiner at
+        # genesis (seen live).
+        try:
+            state = self.state_provider.state(snapshot.height)
+            commit = self.state_provider.commit(snapshot.height)
+        except Exception as e:
+            raise ErrRejectSnapshot(
+                f"failed to build state at snapshot height {snapshot.height}: {e}"
+            )
         return state, commit

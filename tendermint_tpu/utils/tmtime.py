@@ -62,6 +62,14 @@ class Time:
             s = head + tail
         if s.endswith("Z"):
             s = s[:-1] + "+00:00"
+        if s.index("-") < 4:
+            # unpadded year (glibc %Y renders year 1 — Go's zero time,
+            # an ABSENT commit signature's timestamp — as "1"):
+            # fromisoformat demands 4 digits. Well-formed timestamps
+            # have their first "-" at index 4 and skip this entirely
+            # (this parse sits on the hot header/commit path)
+            year, rest = s.split("-", 1)
+            s = f"{int(year):04d}-{rest}"
         dt = datetime.fromisoformat(s)
         base = cls.from_datetime(dt.replace(microsecond=0))
         return cls(base.seconds, frac_ns)
@@ -89,7 +97,11 @@ class Time:
             from datetime import timedelta
 
             dt = epoch + timedelta(seconds=self.seconds)
-        base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+        # %Y is NOT zero-padded on glibc: Go's zero time (0001-01-01,
+        # every absent commit signature) rendered as "1-01-01..." and
+        # could never be parsed back (found live: a statesync joiner
+        # crashed on the commit carrying its own absent signature)
+        base = f"{dt.year:04d}-" + dt.strftime("%m-%dT%H:%M:%S")
         if self.nanos:
             frac = f"{self.nanos:09d}".rstrip("0")
             return f"{base}.{frac}Z"

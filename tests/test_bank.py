@@ -1,0 +1,335 @@
+"""Bank ABCI app tests (abci/bank.py, ISSUE 14): signed transfers,
+strict nonces, supply conservation, deterministic merkle app hash,
+range queries, chunked snapshots, retain_blocks pruning handshake."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.bank import (
+    CODE_TYPE_INSUFFICIENT_FUNDS,
+    TREASURY_SUPPLY,
+    BankApplication,
+    make_transfer_tx,
+    transfer_sign_bytes,
+    treasury_priv,
+)
+from tendermint_tpu.abci.kvstore import (
+    CODE_TYPE_BAD_NONCE,
+    CODE_TYPE_ENCODING_ERROR,
+    CODE_TYPE_UNAUTHORIZED,
+)
+
+CHAIN = "bank-test"
+
+
+def _fresh(chain=CHAIN, **kw) -> BankApplication:
+    app = BankApplication(**kw)
+    app.init_chain(abci.RequestInitChain(chain_id=chain))
+    return app
+
+
+def _apply(app, height, txs):
+    res = app.finalize_block(abci.RequestFinalizeBlock(height=height, txs=txs))
+    commit = app.commit()
+    return res, commit
+
+
+def _supply(app) -> dict:
+    return json.loads(app.query(abci.RequestQuery(path="/supply", data=b"")).value)
+
+
+def test_treasury_is_deterministic_and_chain_bound():
+    assert treasury_priv(CHAIN).bytes() == treasury_priv(CHAIN).bytes()
+    assert treasury_priv(CHAIN).bytes() != treasury_priv("other").bytes()
+
+
+def test_transfer_roundtrip_events_and_supply_conservation():
+    app = _fresh()
+    t = treasury_priv(CHAIN)
+    to = os.urandom(20)
+    tx = make_transfer_tx(t, to, 75, 0, CHAIN)
+    assert app.check_tx(abci.RequestCheckTx(tx=tx, type=0)).code == abci.CODE_TYPE_OK
+    res, _ = _apply(app, 1, [tx])
+    (r,) = res.tx_results
+    assert r.code == abci.CODE_TYPE_OK
+    ev = r.events[0]
+    attrs = {a.key: a.value for a in ev.attributes}
+    assert ev.type == "transfer" and attrs["recipient"] == to.hex() and attrs["amount"] == "75"
+    acct = json.loads(app.query(abci.RequestQuery(path="/account", data=to)).value)
+    assert acct == {"balance": 75, "nonce": 0}
+    s = _supply(app)
+    assert s["supply"] == TREASURY_SUPPLY and s["accounts"] == 2
+
+
+def test_transfer_rejections():
+    app = _fresh()
+    t = treasury_priv(CHAIN)
+    to = os.urandom(20)
+    # bad signature: sign bytes for a different amount
+    doc = json.loads(make_transfer_tx(t, to, 5, 0, CHAIN)[len(b"bank:"):])
+    doc["amount"] = 6
+    forged = b"bank:" + json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+    assert app.check_tx(abci.RequestCheckTx(tx=forged, type=0)).code == CODE_TYPE_UNAUTHORIZED
+    res, _ = _apply(app, 1, [
+        forged,
+        make_transfer_tx(t, to, 5, 3, CHAIN),  # wrong nonce (want 0)
+        make_transfer_tx(t, to, TREASURY_SUPPLY + 1, 0, CHAIN),  # too big
+        b"bank:not json",
+        b"plain=kvstoretx",  # the kvstore's format is not bank's
+    ])
+    codes = [r.code for r in res.tx_results]
+    assert codes == [
+        CODE_TYPE_UNAUTHORIZED, CODE_TYPE_BAD_NONCE,
+        CODE_TYPE_INSUFFICIENT_FUNDS, CODE_TYPE_ENCODING_ERROR,
+        CODE_TYPE_ENCODING_ERROR,
+    ]
+    # unknown sender: an account with no balance record
+    stranger_seed = hashlib.sha256(b"stranger").digest()
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    stranger = Ed25519PrivKey.generate(seed=stranger_seed)
+    res2, _ = _apply(app, 2, [make_transfer_tx(stranger, to, 1, 0, CHAIN)])
+    assert res2.tx_results[0].code == CODE_TYPE_UNAUTHORIZED
+    # nothing committed state-wise: supply unchanged, only treasury exists
+    s = _supply(app)
+    assert s["supply"] == TREASURY_SUPPLY and s["accounts"] == 1
+
+
+def test_recheck_skips_signature_verification():
+    """Recheck (type=1) trusts the admission-time signature check —
+    re-verifying every pending tx after every block starved a 1-core
+    soak box. A recheck with a BAD signature still passes CheckTx
+    (FinalizeBlock remains the authoritative gate); a NEW tx with the
+    same bad signature is rejected."""
+    app = _fresh()
+    t = treasury_priv(CHAIN)
+    doc = json.loads(make_transfer_tx(t, os.urandom(20), 5, 0, CHAIN)[len(b"bank:"):])
+    doc["sig"] = "00" * 64
+    forged = b"bank:" + json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+    assert app.check_tx(abci.RequestCheckTx(tx=forged, type=0)).code == CODE_TYPE_UNAUTHORIZED
+    assert app.check_tx(abci.RequestCheckTx(tx=forged, type=1)).code == abci.CODE_TYPE_OK
+    # malformed txs fail either way — shape is always checked
+    assert app.check_tx(abci.RequestCheckTx(tx=b"bank:junk", type=1)).code == CODE_TYPE_ENCODING_ERROR
+
+
+def test_self_transfer_conserves():
+    app = _fresh()
+    t = treasury_priv(CHAIN)
+    taddr = t.pub_key().address()
+    res, _ = _apply(app, 1, [make_transfer_tx(t, taddr, 10, 0, CHAIN)])
+    assert res.tx_results[0].code == abci.CODE_TYPE_OK
+    acct = json.loads(app.query(abci.RequestQuery(path="/account", data=taddr)).value)
+    assert acct["balance"] == TREASURY_SUPPLY and acct["nonce"] == 1
+
+
+def test_sequential_nonces_one_block_and_replay_guard():
+    app = _fresh()
+    t = treasury_priv(CHAIN)
+    txs = [make_transfer_tx(t, os.urandom(20), 1, n, CHAIN) for n in range(20)]
+    res, _ = _apply(app, 1, txs)
+    assert all(r.code == abci.CODE_TYPE_OK for r in res.tx_results)
+    # replaying any of them fails with BAD_NONCE, changing nothing
+    res2, _ = _apply(app, 2, [txs[7]])
+    assert res2.tx_results[0].code == CODE_TYPE_BAD_NONCE
+    s = _supply(app)
+    assert s["supply"] == TREASURY_SUPPLY and s["accounts"] == 21
+
+
+def test_app_hash_deterministic_and_state_sensitive():
+    a, b = _fresh(), _fresh()
+    t = treasury_priv(CHAIN)
+    txs = [make_transfer_tx(t, os.urandom(20), 2, n, CHAIN) for n in range(5)]
+    ra, _ = _apply(a, 1, txs)
+    rb, _ = _apply(b, 1, txs)
+    assert ra.app_hash == rb.app_hash and len(ra.app_hash) == 32
+    # one more transfer -> different root
+    rc, _ = _apply(a, 2, [make_transfer_tx(t, os.urandom(20), 2, 5, CHAIN)])
+    assert rc.app_hash != ra.app_hash
+
+
+def test_range_query_pagination():
+    app = _fresh()
+    t = treasury_priv(CHAIN)
+    _apply(app, 1, [make_transfer_tx(t, os.urandom(20), 1, n, CHAIN) for n in range(30)])
+    got, start = [], ""
+    pages = 0
+    while True:
+        q = app.query(abci.RequestQuery(path="/range", data=f"{start}::7".encode()))
+        doc = json.loads(q.value)
+        got.extend(doc["accounts"])
+        pages += 1
+        if not doc["next"]:
+            break
+        start = doc["next"]
+    assert pages >= 5  # 31 accounts / 7 per page
+    assert len(got) == 31 and len({a["addr"] for a in got}) == 31
+    assert sum(a["balance"] for a in got) == TREASURY_SUPPLY
+    # malformed range data is an encoding error, not a crash
+    assert app.query(
+        abci.RequestQuery(path="/range", data=b"nonsense")
+    ).code == CODE_TYPE_ENCODING_ERROR
+
+
+def test_validator_txs_pass_through_under_bank():
+    """Manifest validator_updates keep working with app = 'bank': the
+    kvstore's val: machinery is inherited unchanged."""
+    from tendermint_tpu.abci.kvstore import make_validator_tx
+
+    app = _fresh()
+    pub = os.urandom(32)
+    res, _ = _apply(app, 1, [make_validator_tx(pub, 42)])
+    assert res.tx_results[0].code == abci.CODE_TYPE_OK
+    assert res.validator_updates and res.validator_updates[0].power == 42
+
+
+def _grown_app(n_accounts: int, chain=CHAIN, **kw) -> BankApplication:
+    """An app whose committed state holds n_accounts synthetic accounts
+    (written straight into the db — growing through signed txs would
+    cost ~2ms/signature; the snapshot machinery doesn't care how state
+    got there, the app hash is recomputed over the merged view)."""
+    app = _fresh(chain, **kw)
+    for i in range(n_accounts):
+        addr = hashlib.sha256(f"acct{i}".encode()).digest()[:20]
+        app.db.set(b"acct:" + addr.hex().encode(), b'{"balance":5,"nonce":0}')
+    app.size += n_accounts
+    _apply(app, 1, [])  # recompute app hash over the grown set + snapshot tick
+    return app
+
+
+def test_snapshot_restore_roundtrip_hundreds_of_chunks():
+    source = _grown_app(3000, snapshot_interval=1)
+    snaps = source.list_snapshots(abci.RequestListSnapshots()).snapshots
+    snap = snaps[-1]
+    assert snap.chunks >= 100, f"want a 100+ chunk snapshot, got {snap.chunks}"
+
+    target = BankApplication()  # NEVER saw init_chain: restore carries chain_id
+    assert target.offer_snapshot(
+        abci.RequestOfferSnapshot(snapshot=snap, app_hash=source.app_hash)
+    ).result == abci.SNAPSHOT_ACCEPT
+    for i in range(snap.chunks):
+        chunk = source.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=snap.height, format=snap.format, chunk=i)
+        ).chunk
+        res = target.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=i, chunk=chunk, sender="p")
+        )
+        assert res.result == abci.CHUNK_ACCEPT
+    info = target.info(abci.RequestInfo())
+    assert info.last_block_app_hash == source.app_hash
+    assert info.last_block_height == source.height
+    assert target.chain_id == CHAIN, "restored app lost its chain binding"
+    # the restored node VERIFIES and executes a fresh signed transfer —
+    # the regression that would otherwise fork it from its peers
+    t = treasury_priv(CHAIN)
+    res, _ = _apply(target, source.height + 1, [make_transfer_tx(t, os.urandom(20), 1, 0, CHAIN)])
+    assert res.tx_results[0].code == abci.CODE_TYPE_OK
+
+
+def test_retain_blocks_drives_retain_height():
+    app = _fresh(retain_blocks=5)
+    t = treasury_priv(CHAIN)
+    heights = []
+    for h in range(1, 8):
+        _res, commit = _apply(app, h, [make_transfer_tx(t, os.urandom(20), 1, h - 1, CHAIN)])
+        heights.append(commit.retain_height)
+    # below the window: no pruning ask; past it: height - retain + 1
+    assert heights[:4] == [0, 0, 0, 0]
+    assert heights[4:] == [1, 2, 3]
+
+
+def test_delayed_bank_mro_delays_and_executes():
+    import time
+
+    from tendermint_tpu.e2e.app import build_app
+
+    app = build_app("bank", delays_ms={"check_tx": 30})
+    app.init_chain(abci.RequestInitChain(chain_id=CHAIN))
+    t = treasury_priv(CHAIN)
+    tx = make_transfer_tx(t, os.urandom(20), 1, 0, CHAIN)
+    t0 = time.perf_counter()
+    resp = app.check_tx(abci.RequestCheckTx(tx=tx, type=0))
+    assert time.perf_counter() - t0 >= 0.03, "delay override not applied"
+    assert resp.code == abci.CODE_TYPE_OK and resp.sender, "bank handler not reached"
+
+
+def test_sign_bytes_are_chain_bound():
+    t = treasury_priv(CHAIN)
+    to = os.urandom(20)
+    tx = make_transfer_tx(t, to, 5, 0, "chain-A")
+    app = _fresh("chain-B")
+    # fund nothing; signature check fires before account lookup
+    res, _ = _apply(app, 1, [tx])
+    assert res.tx_results[0].code == CODE_TYPE_UNAUTHORIZED
+    assert transfer_sign_bytes("a", "p", "q", 1, 2) != transfer_sign_bytes("b", "p", "q", 1, 2)
+
+
+def test_bank_builtin_proxy_parse():
+    from tendermint_tpu.node.node import _make_app
+
+    client = _make_app("builtin:bank:snapshot=3:retain=7")
+    app = client._app
+    assert isinstance(app, BankApplication)
+    assert app.snapshot_interval == 3 and app.retain_blocks == 7
+
+
+def test_restore_voids_uncommitted_pending_state():
+    """Regression (found live): a statesync joiner runs InitChain —
+    writing the treasury + genesis validators into the PENDING buffer —
+    and then restores a snapshot without ever committing. The stale
+    pending entries must not overlay the restored db (merged reads
+    would recompute the treasury at full supply and fork the app hash
+    at the first post-restore block)."""
+    source = _grown_app(40, snapshot_interval=1)
+    t = treasury_priv(CHAIN)
+    _apply(source, 2, [make_transfer_tx(t, os.urandom(20), 7, 0, CHAIN)])
+    snap = source.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+
+    target = _fresh(CHAIN)  # init_chain ran: treasury sits in _pending, UNCOMMITTED
+    assert target._pending, "precondition: init_chain effects are pending"
+    assert target.offer_snapshot(
+        abci.RequestOfferSnapshot(snapshot=snap, app_hash=source.app_hash)
+    ).result == abci.SNAPSHOT_ACCEPT
+    for i in range(snap.chunks):
+        chunk = source.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=snap.height, format=snap.format, chunk=i)
+        ).chunk
+        assert target.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=i, chunk=chunk, sender="p")
+        ).result == abci.CHUNK_ACCEPT
+    assert not target._pending, "restore must void uncommitted pending effects"
+    # both apply the identical next block: the hashes must agree
+    tx = make_transfer_tx(t, os.urandom(20), 3, 1, CHAIN)
+    rs, _ = _apply(source, source.height + 1, [tx])
+    rt, _ = _apply(target, target.height + 1, [tx])
+    assert rs.app_hash == rt.app_hash, "restored node forked from its source"
+
+
+def test_restore_replaces_stale_state():
+    """A target with its OWN prior state (different chain) is fully
+    replaced by the restored snapshot — no leftover accounts."""
+    source = _grown_app(120, snapshot_interval=1)
+    snap = source.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+    target = _fresh("stale-chain")
+    t2 = treasury_priv("stale-chain")
+    _apply(target, 1, [make_transfer_tx(t2, os.urandom(20), 9, 0, "stale-chain")])
+    assert target.offer_snapshot(
+        abci.RequestOfferSnapshot(snapshot=snap, app_hash=source.app_hash)
+    ).result == abci.SNAPSHOT_ACCEPT
+    for i in range(snap.chunks):
+        chunk = source.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=snap.height, format=snap.format, chunk=i)
+        ).chunk
+        assert target.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=i, chunk=chunk, sender="p")
+        ).result == abci.CHUNK_ACCEPT
+    assert target.info(abci.RequestInfo()).last_block_app_hash == source.app_hash
+    assert target.chain_id == CHAIN
+    assert _supply(target) == _supply(source)

@@ -532,7 +532,7 @@ def test_generator_deterministic_and_valid():
     b = generate(seed=7)
     assert a == b, "same seed must generate identical manifests"
     assert generate(seed=8) != a
-    assert len(a) == 8  # 4 topologies x 2 abci modes
+    assert len(a) == 10  # 5 topologies x 2 abci modes
     for _, text in a:
         validate_generated(text)
 
@@ -543,24 +543,32 @@ def test_generator_covers_dimensions():
     heights, delays."""
     from tendermint_tpu.e2e.generator import generate, validate_generated
 
-    key_types, protocols, perturbs = set(), set(), set()
+    key_types, protocols, perturbs, apps, modes = set(), set(), set(), set(), set()
     saw_statesync = saw_late = saw_vx = saw_delay = saw_update = False
+    saw_retain = saw_scenario = False
     for seed in range(24):
         for _, text in generate(seed=seed):
             m = validate_generated(text)
             key_types.add(m.key_type)
+            apps.add(m.app)
             saw_vx = saw_vx or m.vote_extensions_enable_height > 0
             saw_delay = saw_delay or m.finalize_block_delay_ms > 0
             saw_update = saw_update or bool(m.validator_updates)
+            saw_retain = saw_retain or m.retain_blocks > 0
+            saw_scenario = saw_scenario or bool(m.scenario)
             for n in m.nodes:
+                modes.add(n.mode)
                 protocols.add(n.abci_protocol)
                 perturbs.update(n.perturb)
                 saw_statesync = saw_statesync or n.state_sync
                 saw_late = saw_late or n.start_at > 0
     assert key_types == {"ed25519", "secp256k1", "sr25519"}, key_types
+    assert apps == {"kvstore", "bank"}, apps
+    assert modes == {"validator", "full", "seed", "light"}, modes
     assert {"builtin", "tcp", "grpc", "unix"} <= protocols, protocols
     assert {"disconnect", "pause", "kill", "restart", "partition"} <= perturbs, perturbs
     assert saw_statesync and saw_late and saw_vx and saw_delay and saw_update
+    assert saw_retain and saw_scenario
 
 
 def test_generator_cli(tmp_path):
@@ -572,7 +580,7 @@ def test_generator_cli(tmp_path):
     import os
 
     files = sorted(os.listdir(out))
-    assert len(files) == 16 and all(f.endswith(".toml") for f in files)
+    assert len(files) == 20 and all(f.endswith(".toml") for f in files)
 
 
 @pytest.mark.slow
