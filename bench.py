@@ -611,6 +611,147 @@ def bench_hash():
         )
 
 
+def bench_proofs(ks=(1, 64, 256), n_leaves=16384):
+    """Device-free batched proof-serving stage (tmproof, ISSUE 15):
+    proofs/s against an n_leaves-leaf tree for each k, across four
+    serve paths — multiproof (ONE tm_merkle_multiproof call proving k
+    indices, build + prove), tree-cache-hot multiproof (pure node
+    assembly from held levels, zero hashing), per-proof (one full
+    proofs_from_byte_slices per requested index: the pre-tmproof
+    gateway behavior, which rebuilds the tree and all n aunt lists per
+    request), and the seed's recursive proof builder at k=1 (the
+    pre-plane baseline). Equivalence gate FIRST, like the mempool
+    stage: multiproof accept/reject byte-identical to the k independent
+    Proof.verify calls across a property sweep, native and Python node
+    sets agreeing byte-for-byte.
+
+    Emits one proofs_per_sec JSON line per k; vs_baseline is the ratio
+    against the per-proof path at the same k (the ISSUE-15 acceptance
+    number: >= 5x at k >= 64)."""
+    import random
+
+    from tendermint_tpu import native as N
+    from tendermint_tpu.crypto import merkle as MK
+
+    rng = random.Random(99)
+    lib = N.load_prep()
+    native_ok = lib is not None and hasattr(lib, "tm_merkle_multiproof")
+    backend = "native" if native_ok else "python"
+
+    # -- equivalence gate: multiproof == per-proof oracle, both backends
+    for n in (1, 2, 3, 13, 100, 257, 1000):
+        items = [rng.randbytes(rng.randrange(1, 120)) for _ in range(n)]
+        root, proofs = MK.proofs_from_byte_slices(items)
+        for k in sorted({1, max(1, n // 2), n}):
+            idxs = sorted(rng.sample(range(n), k))
+            mp_root, mp = MK.multiproof_from_byte_slices(items, idxs)
+            assert mp_root == root, (n, k)
+            leaves = [items[i] for i in idxs]
+            oracle = all(proofs[i].verify(root, items[i]) for i in idxs)
+            assert mp.verify(root, leaves) == oracle, (n, k)
+            assert not mp.verify(root, [lf + b"x" for lf in leaves]), (n, k)
+            levels = MK._levels_from_byte_slices_py(items)
+            assert mp.nodes == MK._multiproof_nodes_from_levels(levels, idxs), (
+                n, k, "native/python node-set divergence")
+    _log("proofs equivalence gate: multiproof == per-proof oracle "
+         f"(sweep, backend={backend})")
+
+    items = [rng.randbytes(40) for _ in range(n_leaves)]
+    tree = MK.TreeLevels.build(items)
+    seed_rate = None
+    headline = None
+    for k in ks:
+        idxs = sorted(rng.sample(range(n_leaves), k))
+
+        def multi():
+            MK.multiproof_from_byte_slices(items, idxs)
+            return k
+
+        def hot():
+            tree.multiproof(idxs)
+            return k
+
+        def per_proof():
+            # serve ONE index the pre-tmproof way: full rebuild, take
+            # one aunt list (each request pays the whole tree)
+            MK.proofs_from_byte_slices(items)
+            return 1
+
+        s_multi = _measure(multi)
+        s_hot = _measure(hot)
+        s_per = _measure(per_proof, min_time=0.5)
+        ratio = s_multi.median / s_per.median
+        _log(
+            f"proofs n={n_leaves} k={k} [{backend}]: multiproof "
+            f"{s_multi.format(0)} proofs/s, cache-hot {s_hot.format(0)}, "
+            f"per-proof {s_per.format(0)} ({ratio:.1f}x per-proof)"
+        )
+        for mode, s in (("multiproof", s_multi), ("cache_hot", s_hot),
+                        ("per_proof", s_per)):
+            _perf_record(
+                "proofs", "proofs_per_sec", "proofs/s", s,
+                params={"leaves": n_leaves, "k": k, "mode": mode,
+                        "backend": backend},
+            )
+        if k == 1 and seed_rate is None:
+            # the seed's recursive proof builder (O(n log n) list-slice
+            # copies), one full build per served proof — measured once
+            def seed_proofs(sub=items):
+                def rec(part):
+                    m = len(part)
+                    if m == 1:
+                        return MK.leaf_hash(part[0]), [[]]
+                    sp = MK._split_point(m)
+                    lroot, launts = rec(part[:sp])
+                    rroot, raunts = rec(part[sp:])
+                    return MK.inner_hash(lroot, rroot), (
+                        [a + [rroot] for a in launts]
+                        + [a + [lroot] for a in raunts]
+                    )
+                rec(sub)
+                return 1
+
+            s_seed = _measure(seed_proofs, min_time=0.5, repeats=3)
+            seed_rate = s_seed.median
+            _perf_record(
+                "proofs", "proofs_per_sec", "proofs/s", s_seed,
+                params={"leaves": n_leaves, "k": 1, "mode": "seed"},
+            )
+            _log(f"proofs n={n_leaves} seed-recursive: {s_seed.format(2)} proofs/s")
+        if k >= 64:
+            assert ratio >= 5.0, (
+                f"multiproof {s_multi.median:,.0f} proofs/s is under 5x the "
+                f"per-proof path {s_per.median:,.0f} at k={k} (acceptance)"
+            )
+        doc = {
+            "metric": "proofs_per_sec",
+            "value": round(s_multi.median, 1),
+            "unit": f"proofs/sec served ({n_leaves}-leaf tree, k={k} multiproof)",
+            "vs_baseline": round(ratio, 3),
+            "mad": round(s_multi.mad, 1),
+            "n_samples": len(s_multi),
+            "k": k,
+            "backend": backend,
+            "cache_hot_per_sec": round(s_hot.median, 1),
+            "per_proof_per_sec": round(s_per.median, 1),
+        }
+        if seed_rate:
+            doc["seed_per_sec"] = round(seed_rate, 2)
+        print(json.dumps(doc), flush=True)
+        headline = doc
+
+    # tree-cache hit/miss accounting under a hot-height request mix
+    from tendermint_tpu.crypto.merkle import TreeCache
+
+    cache = TreeCache(capacity=4)
+    heights = [1, 2, 3, 1, 2, 3, 1, 1, 4, 5, 6, 1]  # 1 stays hot
+    for h in heights:
+        cache.get_or_build(("txs", h), lambda: items[:1024])
+    _log(f"tree cache mix: {cache.hits} hits / {cache.misses} misses / "
+         f"{cache.evictions} evictions over {len(heights)} requests")
+    return headline
+
+
 def bench_mempool(floods=(1000, 10000, 50000)):
     """Device-free mempool admission stage (runs under JAX_PLATFORMS=cpu
     like the hash stage — BENCH_r02/r03 flaky-device note): admitted
@@ -895,6 +1036,14 @@ def main():
         bench_mempool()
         _write_bench_report()
         sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "proofs":
+        # targeted device-free run: `python bench.py proofs`
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _start_bench_flight()
+        _flight_mark("proofs")
+        bench_proofs()
+        _write_bench_report()
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "smoke":
         # CI-budget device-free perf smoke: micro hash + mempool
         # stages through the tmperf harness into the perf ledger
@@ -947,6 +1096,19 @@ def main():
             _log("hash stage hit deadline; continuing")
         except Exception as e:  # noqa: BLE001
             _log(f"hash stage failed: {type(e).__name__}: {e}")
+    # Stage 1.55 (no device): the batched proof-serving plane
+    # (tmproof) — device-free like the hash stage; failures never sink
+    # the run.
+    if os.environ.get("BENCH_PROOFS", "on") != "off":
+        try:
+            _flight_mark("proofs")
+            with stage_deadline(min(max(_remaining() - 60, 20), 120)):
+                bench_proofs()
+            _save_stage_trace("proofs")
+        except StageTimeout:
+            _log("proofs stage hit deadline; continuing")
+        except Exception as e:  # noqa: BLE001
+            _log(f"proofs stage failed: {type(e).__name__}: {e}")
     # Stage 1.6 (no device): the coalesced tx-admission pipeline —
     # device-free like the hash stage; failures never sink the run.
     if os.environ.get("BENCH_MEMPOOL", "on") != "off":

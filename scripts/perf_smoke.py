@@ -46,7 +46,7 @@ from tendermint_tpu.perf import (  # noqa: E402
     rate_samples,
 )
 
-SMOKE_STAGES = ("hash", "mempool")
+SMOKE_STAGES = ("hash", "mempool", "proofs")
 
 
 def default_ledger() -> str:
@@ -136,6 +136,51 @@ def _measure_mempool(repeats: int, min_time: float, flood: int) -> list[tuple]:
     ]
 
 
+def _measure_proofs(repeats: int, min_time: float) -> list[tuple]:
+    """Batched proof-serving smoke (tmproof, docs/observability.md
+    #tmproof): ONE multiproof proving k=64 indices against a 4096-leaf
+    tree — the build+prove path (native tm_merkle_multiproof when
+    available) and the tree-cache-hot assembly path (zero hashing).
+    Each fn returns k, so the samples read in proofs served per
+    second, the unit the full bench's proofs stage also records."""
+    import random
+
+    from tendermint_tpu import native as N
+    from tendermint_tpu.crypto import merkle as MK
+
+    n, k = 4096, 64
+    rng = random.Random(4242)
+    items = [rng.randbytes(40) for _ in range(n)]
+    idxs = sorted(rng.sample(range(n), k))
+    lib = N.load_prep()
+    backend = (
+        "native" if lib is not None and hasattr(lib, "tm_merkle_multiproof")
+        else "python"
+    )
+    tree = MK.TreeLevels.build(items)
+
+    def build_and_prove():
+        MK.multiproof_from_byte_slices(items, idxs)
+        return k
+
+    def hot_assemble():
+        tree.multiproof(idxs)
+        return k
+
+    return [
+        (
+            "multiproof_proofs_per_sec", "proofs/s",
+            {"leaves": n, "k": k, "mode": "build", "backend": backend},
+            rate_samples(build_and_prove, repeats=repeats, warmup=2, min_time=min_time),
+        ),
+        (
+            "multiproof_proofs_per_sec", "proofs/s",
+            {"leaves": n, "k": k, "mode": "cache_hot"},
+            rate_samples(hot_assemble, repeats=repeats, warmup=2, min_time=min_time),
+        ),
+    ]
+
+
 def run_smoke(
     stages=None,
     repeats: int = 5,
@@ -167,11 +212,12 @@ def run_smoke(
     fp = fingerprint(device="cpu")
     records = []
     for stage in stages:
-        rows = (
-            _measure_hash(repeats, min_time)
-            if stage == "hash"
-            else _measure_mempool(repeats, min_time, flood)
-        )
+        if stage == "hash":
+            rows = _measure_hash(repeats, min_time)
+        elif stage == "proofs":
+            rows = _measure_proofs(repeats, min_time)
+        else:
+            rows = _measure_mempool(repeats, min_time, flood)
         slow_frac = float((inject or {}).get(stage, 0.0))
         for metric, unit, params, samples in rows:
             if slow_frac:

@@ -21,6 +21,14 @@ Every build lands in HashMetrics (site/backend counters, leaf-count and
 latency histograms) and a `hash.merkle_build` tmtrace span, so the
 block lifecycle's hashing tax is visible in /metrics and Perfetto
 (docs/observability.md). `TM_TPU_NATIVE=0` pins the Python path.
+
+The tmproof plane (docs/observability.md#tmproof) rides the same two
+builders: `multiproof_from_byte_slices` proves k sorted distinct
+indices in one call (native `tm_merkle_multiproof` / level-iterative
+fallback) emitting the deduplicated shared-node set `MultiProof.verify`
+consumes, and `TreeLevels`/`TreeCache` hold built trees so repeated
+proof requests against hot heights are pure node assembly — committed
+trees are immutable, so the LRU needs no invalidation story.
 """
 
 from __future__ import annotations
@@ -181,6 +189,312 @@ def _compute_hash_from_aunts(index: int, total: int, leaf: bytes, aunts: list[by
     if right is None:
         return None
     return inner_hash(aunts[-1], right)
+
+
+class MultiProof:
+    """Batched inclusion proof (tmproof): k sorted distinct indices
+    against ONE tree, carrying the deduplicated shared-node set instead
+    of k aunt lists. The k independent proofs of a batch recompute and
+    re-transmit the same internal nodes near the root; the multiproof
+    ships each needed node once (the RFC-6962 port of the polynomial
+    multiproof shape — PAPERS.md, light-client DAS).
+
+    `nodes` is in canonical order — bottom-up levels, ascending index
+    within a level — exactly the order `verify` consumes, so two
+    builders agreeing byte-for-byte on `nodes` is the cross-backend
+    identity the property sweep pins."""
+
+    __slots__ = ("total", "indices", "leaf_hashes", "nodes")
+
+    def __init__(self, total: int, indices: list[int], leaf_hashes: list[bytes],
+                 nodes: list[bytes]):
+        self.total = total
+        self.indices = list(indices)
+        self.leaf_hashes = list(leaf_hashes)
+        self.nodes = list(nodes)
+
+    def _indices_ok(self) -> bool:
+        if not self.indices or self.total <= 0:
+            return False
+        prev = -1
+        for idx in self.indices:
+            if not isinstance(idx, int) or isinstance(idx, bool):
+                return False
+            if idx <= prev or idx >= self.total:
+                return False
+            prev = idx
+        return True
+
+    def compute_root_hash(self) -> bytes | None:
+        """Reconstruct the root from the proven leaf hashes + shared
+        nodes, or None on any malformed shape (the aunt-walk analog of
+        _compute_hash_from_aunts: structure errors are verdicts)."""
+        if not self._indices_ok() or len(self.leaf_hashes) != len(self.indices):
+            return None
+        sha = hashlib.sha256
+        cur = list(zip(self.indices, self.leaf_hashes))
+        count = self.total
+        pos = 0
+        while count > 1:
+            nxt = []
+            i, m = 0, len(cur)
+            while i < m:
+                idx, h = cur[i]
+                sib = idx ^ 1
+                if (idx & 1) == 0 and i + 1 < m and cur[i + 1][0] == sib:
+                    h = sha(INNER_PREFIX + h + cur[i + 1][1]).digest()
+                    i += 2
+                elif sib < count:
+                    if pos >= len(self.nodes):
+                        return None  # truncated node set
+                    other = self.nodes[pos]
+                    pos += 1
+                    h = sha(
+                        INNER_PREFIX + (other + h if idx & 1 else h + other)
+                    ).digest()
+                    i += 1
+                else:
+                    i += 1  # promoted odd tail: ancestor rises unchanged
+                nxt.append((idx >> 1, h))
+            cur = nxt
+            count = (count + 1) // 2
+        if pos != len(self.nodes):
+            return None  # surplus nodes: not the proof this tree emitted
+        return cur[0][1]
+
+    def verify(self, root_hash: bytes, leaves: list[bytes]) -> bool:
+        """Accept iff every (index, leaf) pair is proven under
+        root_hash — accept/reject identical to the k independent
+        `Proof.verify` calls the batch replaces."""
+        if len(leaves) != len(self.indices) or len(self.leaf_hashes) != len(self.indices):
+            return False
+        if not self._indices_ok():
+            return False
+        for lh, leaf in zip(self.leaf_hashes, leaves):
+            if leaf_hash(leaf) != lh:
+                return False
+        return self.compute_root_hash() == root_hash
+
+
+def _multiproof_nodes_from_levels(levels: list[list[bytes]], indices: list[int]) -> list[bytes]:
+    """The shared-node set for `indices` assembled from prebuilt tree
+    levels (bottom-up, leaf hashes first) — pure list walking, zero
+    hashing: the hot-tree-cache serve path. Mirrors tm_merkle_multiproof
+    exactly (same emission order, same pair/promote rules)."""
+    nodes: list[bytes] = []
+    cur = list(indices)
+    for level in levels[:-1]:
+        count = len(level)
+        nxt = []
+        i, m = 0, len(cur)
+        while i < m:
+            idx = cur[i]
+            if (idx & 1) == 0 and i + 1 < m and cur[i + 1] == idx + 1:
+                i += 2
+            else:
+                sib = idx ^ 1
+                if sib < count:
+                    nodes.append(level[sib])
+                i += 1
+            nxt.append(idx >> 1)
+        cur = nxt
+    return nodes
+
+
+def _levels_from_byte_slices_py(items: list[bytes]) -> list[list[bytes]]:
+    """Every tree level bottom-up (leaf hashes first, [root] last);
+    leaf hashing through the batched native plane when available."""
+    n = len(items)
+    if n == 0:
+        return [[_sha256(b"")]]
+    prefixed = [LEAF_PREFIX + it for it in items]
+    levels = [sha256_batch(prefixed)]
+    while len(levels[-1]) > 1:
+        levels.append(_hash_level(levels[-1]))
+    return levels
+
+
+def _validate_indices(total: int, indices) -> list[int]:
+    """Sorted-distinct-in-range contract shared by every multiproof
+    producer (generation RAISES where verification returns False: a
+    caller asking to prove garbage is a bug, not a forgery)."""
+    out = []
+    prev = -1
+    for idx in indices:
+        if not isinstance(idx, int) or isinstance(idx, bool):
+            raise ValueError(f"multiproof index {idx!r} is not an int")
+        if idx <= prev:
+            raise ValueError(
+                f"multiproof indices must be sorted strictly ascending "
+                f"(got {idx} after {prev})"
+            )
+        if idx >= total:
+            raise ValueError(f"multiproof index {idx} out of range for {total} leaves")
+        out.append(idx)
+        prev = idx
+    if not out:
+        raise ValueError("multiproof requires at least one index")
+    return out
+
+
+def multiproof_from_byte_slices(items: list[bytes], indices, site: str = "merkle") -> tuple[bytes, MultiProof]:
+    """Root plus ONE batched proof for the given sorted distinct
+    indices — the k-request analog of proofs_from_byte_slices that
+    shares internal nodes instead of recomputing them per index.
+    Native single-call when available (tm_merkle_multiproof), else the
+    level-iterative Python fallback, byte-identical."""
+    n = len(items)
+    idxs = _validate_indices(n, indices)
+    t0 = _time.perf_counter()
+    with _trace.span("hash.merkle_build", "hash", site=site, n=n, k=len(idxs), multiproof=True) as sp:
+        res = None
+        backend = "python"
+        if n >= 1:
+            res = _native.merkle_multiproof(items, idxs)
+            if res is not None:
+                backend = "native"
+        if res is None:
+            levels = _levels_from_byte_slices_py(items)
+            res = (
+                levels[-1][0],
+                [levels[0][i] for i in idxs],
+                _multiproof_nodes_from_levels(levels, idxs),
+            )
+        sp.annotate(backend=backend)
+    root, leaves, nodes = res
+    m = _hash_metrics()
+    m.merkle_builds.add(1, site, backend)
+    m.merkle_leaves.observe(n, site)
+    m.merkle_build_seconds.observe(_time.perf_counter() - t0, backend)
+    return root, MultiProof(n, idxs, leaves, nodes)
+
+
+# ------------------------------------------------------- hot-tree cache
+
+
+class TreeLevels:
+    """An immutable built tree: every level bottom-up (leaf hashes
+    first, [root] last). Committed trees never change, so holding the
+    levels turns every later proof request against the same tree into
+    pure node assembly — zero hashing (the tmproof serve path)."""
+
+    __slots__ = ("levels", "total", "root", "backend")
+
+    def __init__(self, levels: list[list[bytes]], total: int, backend: str = "python"):
+        self.levels = levels
+        self.total = total
+        self.root = levels[-1][0]
+        self.backend = backend
+
+    @classmethod
+    def build(cls, items: list[bytes], site: str = "merkle") -> "TreeLevels":
+        n = len(items)
+        t0 = _time.perf_counter()
+        # backend determined by EXERCISING the symbol, not predicting:
+        # a stale prep.so that loads but lacks tm_sha256_batch silently
+        # falls back to hashlib inside the level builder, and the label
+        # must say so (it feeds the gateway's served{backend} metric)
+        backend = "native" if (
+            n >= _NATIVE_MIN_LEAVES and _native.sha256_batch([b""]) is not None
+        ) else "python"
+        with _trace.span("hash.merkle_build", "hash", site=site, n=n, levels=True) as sp:
+            levels = _levels_from_byte_slices_py(items)
+            sp.annotate(backend=backend)
+        m = _hash_metrics()
+        m.merkle_builds.add(1, site, backend)
+        m.merkle_leaves.observe(n, site)
+        m.merkle_build_seconds.observe(_time.perf_counter() - t0, backend)
+        return cls(levels, n, backend)
+
+    def proof(self, index: int) -> Proof:
+        """One classic aunt-list proof assembled from the levels."""
+        if not 0 <= index < self.total:
+            raise ValueError(f"proof index {index} out of range for {self.total} leaves")
+        aunts = []
+        idx = index
+        for level in self.levels[:-1]:
+            sib = idx ^ 1
+            if sib < len(level):
+                aunts.append(level[sib])
+            idx >>= 1
+        return Proof(self.total, index, self.levels[0][index], aunts)
+
+    def multiproof(self, indices) -> MultiProof:
+        """Batched proof assembled from the levels — no hashing."""
+        idxs = _validate_indices(self.total, indices)
+        return MultiProof(
+            self.total,
+            idxs,
+            [self.levels[0][i] for i in idxs],
+            _multiproof_nodes_from_levels(self.levels, idxs),
+        )
+
+
+class TreeCache:
+    """LRU of recently built trees keyed by the caller's
+    (site, height, root)-style tuple. Values are TreeLevels — or
+    whatever immutable bundle the caller serves from (the RPC gateway
+    caches (TreeLevels, txs) so hits skip the block store too). Trees
+    are immutable once committed, so there is NO invalidation story —
+    only capacity eviction. Hits/misses/evictions land in ProofMetrics
+    (the pk-cache discipline: a cache whose hit rate is invisible is a
+    cache that silently stopped working)."""
+
+    def __init__(self, capacity: int = 32):
+        import collections
+        import threading
+
+        if capacity <= 0:
+            raise ValueError(f"tree cache capacity must be positive, got {capacity}")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._trees: "collections.OrderedDict" = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _metrics(self):
+        from ..metrics import proof_metrics
+
+        return proof_metrics()
+
+    def get(self, key):
+        with self._lock:
+            tree = self._trees.get(key)
+            if tree is not None:
+                self._trees.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+        self._metrics().tree_cache_events.add(1, "hit" if tree is not None else "miss")
+        return tree
+
+    def put(self, key, tree) -> None:
+        evicted = 0
+        with self._lock:
+            self._trees[key] = tree
+            self._trees.move_to_end(key)
+            while len(self._trees) > self.capacity:
+                self._trees.popitem(last=False)
+                evicted += 1
+            self.evictions += evicted
+        if evicted:
+            self._metrics().tree_cache_events.add(evicted, "evict")
+
+    def get_or_build(self, key, items_fn, site: str = "merkle") -> TreeLevels:
+        """Cached tree for `key`, building from items_fn() on a miss.
+        The build runs OUTSIDE the lock (two racing requests for one
+        cold height may both build; last insert wins — cheaper than
+        serializing every proof request behind one build)."""
+        tree = self.get(key)
+        if tree is None:
+            tree = TreeLevels.build(items_fn(), site=site)
+            self.put(key, tree)
+        return tree
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._trees)
 
 
 def _proofs_from_byte_slices_py(items: list[bytes]):

@@ -266,6 +266,23 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
             if admit and admit_t and admit_t.sum
             else None,
         }
+        # tmproof gateway (docs/observability.md#tmproof): the
+        # proof_serve_p99 gate judges the fleet-merged serve histogram;
+        # this is the per-node block (served totals, latency quantiles,
+        # hot-tree cache hit rate)
+        served = exp.total(f"{NS}_proofs_served_total")
+        serve_h = exp.histogram(f"{NS}_proofs_serve_seconds")
+        if served or (serve_h is not None and serve_h.count):
+            batch = exp.histogram(f"{NS}_proofs_multiproof_batch_size")
+            summary["proofs"] = {
+                "served_total": served,
+                "serve": _hist_stats(exp, f"{NS}_proofs_serve_seconds"),
+                "batch_size_p50": _round(batch.quantile(0.5), 1) if batch else None,
+                "tree_cache": {
+                    ev: exp.total(f"{NS}_proofs_tree_cache_events_total", event=ev)
+                    for ev in ("hit", "miss", "evict")
+                },
+            }
         peers = exp.value(f"{NS}_p2p_peers")
         connects = exp.total(f"{NS}_p2p_peer_connections_total")
         summary["p2p"] = {
@@ -406,6 +423,28 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
             pass  # foreign bucket layout (mixed-version fleet): skip
     fleet["step_p99_s"] = _round(merged.quantile(0.99)) if merged else None
     fleet["step_p50_s"] = _round(merged.quantile(0.5)) if merged else None
+
+    # tmproof fleet digest: merged gateway serve-latency histogram —
+    # the proof_serve_p99 gate's input (absent when no node served)
+    merged_proofs = None
+    for exp in exps:
+        h = exp.histogram(f"{NS}_proofs_serve_seconds") if exp else None
+        if h is None:
+            continue
+        try:
+            merged_proofs = h if merged_proofs is None else merged_proofs.merge(h)
+        except ValueError:
+            pass  # foreign bucket layout (mixed-version fleet): skip
+    fleet["nodes_with_proofs"] = sum(1 for s in summaries if s.get("proofs"))
+    if merged_proofs is not None and merged_proofs.count:
+        fleet["proofs"] = {
+            "served_total": sum(
+                s["proofs"]["served_total"] for s in summaries if s.get("proofs")
+            ),
+            "serve_count": merged_proofs.count,
+            "serve_p50_s": _round(merged_proofs.quantile(0.5)),
+            "serve_p99_s": _round(merged_proofs.quantile(0.99)),
+        }
 
     # lockcheck fleet digest (the lock_order_cycle gate reads per-node
     # blocks; this is the at-a-glance roll-up, overhead included so the

@@ -50,6 +50,12 @@ test assertions):
                      lockset violations — a hot-class field written
                      from >=2 threads with no common lock; the detail
                      names class, field, and the writing threads
+  proof_serve_p99    the fleet-merged tmproof gateway serve-latency
+                     histogram (tendermint_proofs_serve_seconds —
+                     proofs_batch + light_batch, rpc/core.py) has a p99
+                     over `proof_serve_p99_budget_s`; vacuous pass when
+                     no node served proofs (absence of traffic is not
+                     evidence of failure)
   perf_regression    the run dir's perf ledger (ledger.jsonl,
                      tendermint_tpu/perf/) shows the latest run's
                      median for some stage below its blessed baseline
@@ -83,6 +89,14 @@ DEFAULT_GATES = {
     # (perturbed, 2-core) e2e runs sit around 1-3s.
     "p99_step_budget_s": 9.5,
     "max_height_spread": 5,
+    # tmproof: fleet-merged proof-gateway serve p99. The serve
+    # histogram's top finite bucket is 1s (quantile estimates clamp
+    # there, like the step gate's 10s); just under it, the gate fails
+    # exactly when >=1% of serves spilled into the overflow bucket —
+    # generous for a saturated 2-core box serving hundreds of
+    # concurrent light clients, absurd for a healthy gateway whose
+    # cache-hit serves run sub-millisecond.
+    "proof_serve_p99_budget_s": 0.9,
     # every node that left a metrics.txt must carry the REQUIRED_SERIES
     # (analyze.py); flip this on to ALSO fail nodes that left no
     # metrics artifact at all
@@ -188,6 +202,24 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
             spread <= cfg["max_height_spread"],
             f"heights {fleet['min_height']}..{fleet['max_height']} "
             f"(spread {spread}, max {cfg['max_height_spread']})",
+        ))
+
+    # proof_serve_p99 (tmproof gateway; vacuous pass when no node
+    # served proofs — an idle gateway is not a failed one)
+    pf = fleet.get("proofs")
+    if not pf:
+        gates.append(_gate(
+            "proof_serve_p99", True,
+            "no proof-gateway serve histogram in any node's scrape (tmproof idle)",
+        ))
+    else:
+        p99p = pf.get("serve_p99_s")
+        gates.append(_gate(
+            "proof_serve_p99",
+            p99p is not None and p99p <= cfg["proof_serve_p99_budget_s"],
+            f"fleet proof serve p99 {p99p}s over {int(pf.get('serve_count') or 0)} "
+            f"serves ({int(pf.get('served_total') or 0)} proofs) vs budget "
+            f"{cfg['proof_serve_p99_budget_s']}s",
         ))
 
     # rate_stall + churn_storm (flight-recorder timelines; vacuous

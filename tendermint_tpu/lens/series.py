@@ -401,18 +401,32 @@ WATCH_DEFAULTS = {
     # redial-storm signature (a healthy 4-node net reconnects a
     # handful of times across a whole run)
     "max_connects_per_s": 5.0,
+    # tmproof rolling gates (docs/observability.md#tmproof): windowed
+    # fleet proof-gateway serve p99 (delta of bucket counts over the
+    # window, like the step gate) and a proofs/s rate stall.
+    # proof_stall_after_s = 0 DISABLES the stall gate: only a run that
+    # keeps proof clients up for its whole watched span (the proofs
+    # e2e scenario) can distinguish "gateway wedged" from "clients
+    # finished" — ordinary runs would false-trip the moment load ends.
+    "proof_p99_budget_s": 0.9,
+    "min_proof_samples": 20,
+    "proof_stall_after_s": 0.0,
 }
 
 
 class _NodeWindow:
-    __slots__ = ("first_t", "progress_t", "height", "age", "samples")
+    __slots__ = ("first_t", "progress_t", "height", "age", "samples",
+                 "proofs_total", "proofs_progress_t")
 
     def __init__(self):
         self.first_t: float | None = None
         self.progress_t: float | None = None  # last time height grew
         self.height: float | None = None
         self.age: float | None = None
-        self.samples: list = []  # (t, step_hist_snapshot|None, connects)
+        # (t, step_hist_snapshot|None, connects, proof_hist_snapshot|None)
+        self.samples: list = []
+        self.proofs_total: float | None = None  # served counter, None until first serve
+        self.proofs_progress_t: float | None = None  # last time it grew
 
 
 class RollingGates:
@@ -454,10 +468,54 @@ class RollingGates:
         h = exp.histogram(f"{NS}_consensus_step_duration_seconds")
         connects = sum(exp.total(name) for name in _CONNECT_PREFIXES)
         snap = (tuple(h.bounds), tuple(h.cumulative), h.count) if h is not None else None
-        w.samples.append((t, snap, connects))
+        # tmproof: the gateway serve histogram + served counter (the
+        # process-global registry rides every node's scrape)
+        ph = exp.histogram(f"{NS}_proofs_serve_seconds")
+        psnap = (tuple(ph.bounds), tuple(ph.cumulative), ph.count) if ph is not None else None
+        served = exp.total(f"{NS}_proofs_served_total")
+        # ANY change is progress — a served count BELOW the tracked
+        # total is a restarted node's fresh counter (the process-global
+        # registry died with it), not a wedge. A reset all the way to
+        # ZERO returns the node to the never-served state: this gate
+        # judges stalls, not idleness, and that applies to a restarted
+        # node waiting for its clients to reconnect too.
+        if served > 0:
+            if w.proofs_total is None or served != w.proofs_total:
+                w.proofs_total = served
+                w.proofs_progress_t = t
+        elif w.proofs_total:
+            w.proofs_total = None
+            w.proofs_progress_t = None
+        w.samples.append((t, snap, connects, psnap))
         cut = t - self.cfg["watch_window_s"] - 1e-9
         while len(w.samples) > 2 and w.samples[1][0] <= cut:
             w.samples.pop(0)
+
+    def _windowed_delta(self, snap_i: int):
+        """Fleet-merged DELTA of histogram bucket counts over the
+        window for the snapshot at sample position `snap_i` (1 = step
+        durations, 3 = proof serves). Returns (bounds, delta_cum,
+        delta_n); bounds is None when no node carried the family."""
+        bounds = None
+        delta_cum = None
+        delta_n = 0.0
+        for w in self.nodes.values():
+            first = next((s for s in w.samples if s[snap_i] is not None), None)
+            last = next((s for s in reversed(w.samples) if s[snap_i] is not None), None)
+            if first is None or last is None or first is last:
+                continue
+            (b0, c0, n0), (b1, c1, n1) = first[snap_i], last[snap_i]
+            if b0 != b1:
+                continue  # mid-run restart with foreign buckets: skip
+            if bounds is None:
+                bounds = list(b1)
+                delta_cum = [0.0] * len(bounds)
+            if list(b1) != bounds:
+                continue
+            for i in range(len(bounds)):
+                delta_cum[i] += max(0.0, c1[i] - c0[i])
+            delta_n += max(0.0, n1 - n0)
+        return bounds, delta_cum, delta_n
 
     # ---------------------------------------------------------- verdicts
 
@@ -503,25 +561,7 @@ class RollingGates:
         # windowed step p99: fleet-merged DELTA of bucket counts over
         # the window (the cumulative histogram would average the storm
         # away against the healthy head of the run)
-        bounds = None
-        delta_cum = None
-        delta_n = 0.0
-        for w in self.nodes.values():
-            first = next((s for s in w.samples if s[1] is not None), None)
-            last = next((s for s in reversed(w.samples) if s[1] is not None), None)
-            if first is None or last is None or first is last:
-                continue
-            (b0, c0, n0), (b1, c1, n1) = first[1], last[1]
-            if b0 != b1:
-                continue  # mid-run restart with foreign buckets: skip
-            if bounds is None:
-                bounds = list(b1)
-                delta_cum = [0.0] * len(bounds)
-            if list(b1) != bounds:
-                continue
-            for i in range(len(bounds)):
-                delta_cum[i] += max(0.0, c1[i] - c0[i])
-            delta_n += max(0.0, n1 - n0)
+        bounds, delta_cum, delta_n = self._windowed_delta(1)
         if bounds is not None and delta_n >= cfg["min_step_samples"]:
             p99 = bucket_quantile(0.99, bounds, delta_cum, delta_n)
             if p99 is not None and p99 > cfg["p99_step_budget_s"]:
@@ -531,10 +571,41 @@ class RollingGates:
                               f"{int(delta_n)} samples vs budget {cfg['p99_step_budget_s']}s",
                 })
 
+        # proof_serve_p99 (tmproof): same windowed-delta shape over the
+        # gateway serve histogram — judged only when the window carries
+        # real serve traffic, so idle gateways never trip
+        bounds, delta_cum, delta_n = self._windowed_delta(3)
+        if bounds is not None and delta_n >= cfg["min_proof_samples"]:
+            p99 = bucket_quantile(0.99, bounds, delta_cum, delta_n)
+            if p99 is not None and p99 > cfg["proof_p99_budget_s"]:
+                tripped.append({
+                    "name": "proof_serve_p99",
+                    "detail": f"windowed fleet proof serve p99 {round(p99, 3)}s over "
+                              f"{int(delta_n)} serves vs budget {cfg['proof_p99_budget_s']}s",
+                })
+
+        # proof_rate_stall (tmproof, OPT-IN via proof_stall_after_s>0):
+        # a node that HAS served proofs whose served counter then went
+        # flat — the gateway wedged under clients that are still asking
+        if cfg["proof_stall_after_s"] > 0:
+            stalled_proofs = []
+            for name, w in self.nodes.items():
+                if w.proofs_progress_t is None:
+                    continue  # never served: this gate judges stalls, not idleness
+                flat_for = now - w.proofs_progress_t
+                if flat_for >= cfg["proof_stall_after_s"]:
+                    stalled_proofs.append((name, round(flat_for, 1)))
+            if stalled_proofs:
+                tripped.append({
+                    "name": "proof_rate_stall",
+                    "detail": f"proofs served flat for >= "
+                              f"{cfg['proof_stall_after_s']}s: {stalled_proofs}",
+                })
+
         # churn_storm: per-node connect+dial rate over the window
         storms = []
         for name, w in self.nodes.items():
-            pts = [(t, c) for t, _s, c in w.samples]
+            pts = [(s[0], s[2]) for s in w.samples]
             if len(pts) < 2:
                 continue
             span = pts[-1][0] - pts[0][0]

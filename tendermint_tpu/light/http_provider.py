@@ -106,6 +106,11 @@ class HTTPProvider(Provider):
         self._chain_id = chain_id
         self.client = HTTPClient(base_url, timeout=timeout)
         self.base_url = base_url
+        # tmproof: whether the server speaks light_batch (one round
+        # trip per verification step). Probed on the first fetch; a
+        # pre-tmproof server answers Method-not-found ONCE and the
+        # provider pages commit+validators forever after.
+        self._light_batch_ok: bool | None = None
 
     def chain_id(self) -> str:
         return self._chain_id
@@ -113,21 +118,46 @@ class HTTPProvider(Provider):
     def id(self) -> str:
         return f"http{{{self.base_url}}}"
 
+    def _fetch_light_batch(self, height: int) -> tuple[dict, list[dict]] | None:
+        """(signed_header json, validators json) via the batched route,
+        or None when the server predates it. Method-not-found is
+        resolved HERE — the caller's not-found error mapping must never
+        see the string 'Method not found' (it pattern-matches
+        'not found' for missing-height errors)."""
+        try:
+            res = self.client.call("light_batch", height=height or None)
+        except RPCClientError as e:
+            if e.code == -32601:
+                self._light_batch_ok = False
+                return None
+            raise
+        self._light_batch_ok = True
+        return res["signed_header"], list(res["validators"])
+
     def light_block(self, height: int) -> LightBlock:
         try:
-            commit_res = self.client.commit(height=height or None)
-            h = int(commit_res["signed_header"]["header"]["height"])
-            vals_res = self.client.validators(height=h, per_page=100)
-            vals = list(vals_res["validators"])
-            total = int(vals_res["total"])
-            page = 2
-            while len(vals) < total:
-                more = self.client.validators(height=h, page=page, per_page=100)
-                got = more["validators"]
-                if not got:
-                    break
-                vals.extend(got)
-                page += 1
+            batched = (
+                self._fetch_light_batch(height)
+                if self._light_batch_ok is not False
+                else None
+            )
+            if batched is not None:
+                signed_header, vals = batched
+            else:
+                commit_res = self.client.commit(height=height or None)
+                signed_header = commit_res["signed_header"]
+                h = int(signed_header["header"]["height"])
+                vals_res = self.client.validators(height=h, per_page=100)
+                vals = list(vals_res["validators"])
+                total = int(vals_res["total"])
+                page = 2
+                while len(vals) < total:
+                    more = self.client.validators(height=h, page=page, per_page=100)
+                    got = more["validators"]
+                    if not got:
+                        break
+                    vals.extend(got)
+                    page += 1
         except RPCClientError as e:
             if "must be less than or equal" in str(e) or "not found" in str(e):
                 raise ErrLightBlockNotFound(str(e))
@@ -136,8 +166,8 @@ class HTTPProvider(Provider):
             raise ErrNoResponse(str(e))
         return LightBlock(
             signed_header=SignedHeader(
-                header=header_from_json(commit_res["signed_header"]["header"]),
-                commit=commit_from_json(commit_res["signed_header"]["commit"]),
+                header=header_from_json(signed_header["header"]),
+                commit=commit_from_json(signed_header["commit"]),
             ),
             validator_set=validator_set_from_json(vals),
         )

@@ -12,12 +12,18 @@ rewritten to the verified view.
 from __future__ import annotations
 
 import base64
+import hashlib
+import time as _time
 
 from ..rpc.client import HTTPClient
 from ..rpc.server import JSONRPCServer, RPCError
 from ..types.block import BlockID, Header, PartSetHeader, txs_hash
 from ..utils.log import new_logger
 from ..utils.tmtime import Time
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
 
 
 def _header_from_json(d: dict) -> Header:
@@ -157,6 +163,104 @@ class LightProxy:
                 }
             }
 
+        def _relay_verified_proofs(height, indices, route: str) -> dict:
+            """Relay the primary's batched multiproof ONLY after it
+            verifies against the light-verified header's data_hash —
+            the primary's tree root, leaf hashes, and shared nodes are
+            all attacker-controlled until they reconstruct the verified
+            root (tmproof gateway, docs/observability.md#tmproof).
+            Counts served/batch-size under `route` (matching the
+            full-node gateway's labeling for nested light_batch
+            serves); the caller owns serve_seconds."""
+            from ..metrics import proof_metrics
+            from ..rpc.core import multiproof_from_json
+
+            # client-input validation FIRST, with the full-node route's
+            # error semantics (-32602): bad params must never be
+            # misreported as a primary fault after a wasted round trip
+            if not isinstance(indices, (list, tuple)) or not indices:
+                raise RPCError(-32602, "indices must be a non-empty list of tx indices")
+            try:
+                req_idxs = [int(i) for i in indices]
+            except (TypeError, ValueError):
+                raise RPCError(-32602, f"invalid indices: {indices!r}")
+            lb = self._verified_header(int(height))
+            res = self.primary.call("proofs_batch", height=str(height), indices=indices)
+            try:
+                mp = multiproof_from_json(res.get("multiproof") or {})
+                txs = [base64.b64decode(t) for t in res.get("txs") or []]
+            except Exception as e:
+                raise RPCError(-32603, f"light proxy: malformed multiproof from primary: {e}")
+            # a validly-proven but DIFFERENT index set is still a
+            # substitution attack: the proof must cover exactly what
+            # the client asked for, not whatever the primary chose
+            self._require(
+                mp.indices == req_idxs,
+                "primary returned proofs for different indices than requested",
+            )
+            want = lb.signed_header.header.data_hash
+            self._require(
+                mp.verify(want, [_sha256(tx) for tx in txs]),
+                "primary multiproof does not verify against the verified data_hash",
+            )
+            # never relay the primary's self-reported root
+            res["root"] = want.hex().upper()
+            m = proof_metrics()
+            m.served.add(len(mp.indices), route, "proxy")
+            m.batch_size.observe(len(mp.indices))
+            return res
+
+        def proofs_batch(height=None, indices=None):
+            """k verified tx inclusion proofs relayed from the primary
+            (tmproof gateway behind the verified-header store)."""
+            from ..metrics import proof_metrics
+
+            self._require(height is not None, "light proxy requires an explicit height")
+            t0 = _time.perf_counter()
+            res = _relay_verified_proofs(height, indices, "proofs_batch")
+            proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "proofs_batch")
+            return res
+
+        def light_batch(height=None, indices=None):
+            """One verification step served from the proxy's OWN
+            verified-header store: the light-verified signed header +
+            the validator set whose signatures were already checked —
+            never the primary's copies. Heights past the verified head
+            are refused (a verifying proxy must not relay what it
+            cannot verify)."""
+            from ..rpc.core import commit_to_json, header_to_json, validator_to_json
+
+            self._require(height is not None, "light proxy requires an explicit height")
+            t0 = _time.perf_counter()
+            h = int(height)
+            head = None
+            try:
+                head = self.client.update()
+            except Exception:  # noqa: BLE001 - a dead primary: serve the stored head
+                pass
+            head = head or self.client.latest_trusted()
+            self._require(
+                head is not None and h <= head.height,
+                f"height {h} is past the verified head "
+                f"{head.height if head is not None else 0}",
+            )
+            lb = self._verified_header(h)
+            out = {
+                "signed_header": {
+                    "header": header_to_json(lb.signed_header.header),
+                    "commit": commit_to_json(lb.signed_header.commit),
+                },
+                "canonical": True,
+                "validators": [validator_to_json(v) for v in lb.validator_set.validators],
+                "total_validators": str(len(lb.validator_set.validators)),
+            }
+            if indices:
+                out["proofs"] = _relay_verified_proofs(height, indices, "light_batch")
+            from ..metrics import proof_metrics
+
+            proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "light_batch")
+            return out
+
         def validators(height=None):
             self._require(height is not None, "light proxy requires an explicit height")
             lb = self._verified_header(int(height))
@@ -185,6 +289,8 @@ class LightProxy:
             "block": block,
             "commit": commit,
             "header": header,
+            "proofs_batch": proofs_batch,
+            "light_batch": light_batch,
             "validators": validators,
         }
         for m in ("broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",

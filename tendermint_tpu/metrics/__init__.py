@@ -806,6 +806,49 @@ class HashMetrics:
         )
 
 
+class ProofMetrics:
+    """Telemetry for the batched proof-serving plane (tmproof,
+    docs/observability.md#tmproof): the `proofs_batch`/`light_batch`
+    gateway routes (rpc/core.py, light/proxy.py), the multiproof
+    builders (crypto/merkle.py, prep.c tm_merkle_multiproof), and the
+    hot-tree LRU (crypto/merkle.TreeCache).
+
+    No reference analog — the reference serves one proof per request
+    and rebuilds the tree every time. The served counter's `backend`
+    label proves which plane answered (cache assembly vs native vs
+    python build); the serve-latency histogram is what the
+    proof_serve_p99 gates (lens/gates.py, lens/series.py) judge; the
+    tree-cache counter is the pk-cache discipline (a cache whose hit
+    rate is invisible silently stopped working). Registered on the
+    process-global registry because the merkle plane is process-wide,
+    not per-node."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_proofs"
+        self.served = reg.counter(
+            f"{ns}_served_total",
+            "Proofs served by gateway route and answering backend",
+            labels=("route", "backend"),
+        )
+        self.batch_size = reg.histogram(
+            f"{ns}_multiproof_batch_size",
+            "Indices proven per multiproof request",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+        )
+        self.serve_seconds = reg.histogram(
+            f"{ns}_serve_seconds",
+            "Wall time serving one proof-gateway request",
+            labels=("route",),
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+        )
+        self.tree_cache_events = reg.counter(
+            f"{ns}_tree_cache_events_total",
+            "Hot-tree LRU events (hit/miss/evict)",
+            labels=("event",),
+        )
+
+
 class FlightMetrics:
     """Self-telemetry for the in-run flight recorder
     (metrics/flight.py): how many timeseries.jsonl records this node
@@ -839,6 +882,7 @@ class FlightMetrics:
 _GLOBAL_REGISTRY = Registry()
 _ENGINE_METRICS: EngineMetrics | None = None
 _HASH_METRICS: HashMetrics | None = None
+_PROOF_METRICS: ProofMetrics | None = None
 _ENGINE_LOCK = threading.Lock()
 
 
@@ -867,6 +911,17 @@ def hash_metrics() -> HashMetrics:
             if _HASH_METRICS is None:
                 _HASH_METRICS = HashMetrics(_GLOBAL_REGISTRY)
     return _HASH_METRICS
+
+
+def proof_metrics() -> ProofMetrics:
+    """Lazy process-wide ProofMetrics singleton (first multiproof
+    build, tree-cache touch, or gateway serve registers the families)."""
+    global _PROOF_METRICS
+    if _PROOF_METRICS is None:
+        with _ENGINE_LOCK:
+            if _PROOF_METRICS is None:
+                _PROOF_METRICS = ProofMetrics(_GLOBAL_REGISTRY)
+    return _PROOF_METRICS
 
 
 class PrometheusServer:

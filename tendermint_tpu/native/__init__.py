@@ -184,6 +184,22 @@ def load_prep():
                     ctypes.POINTER(ctypes.c_int32),  # counts_out (n)
                 ]
                 lib.tm_merkle_proofs.restype = None
+            # a stale .so may predate tm_merkle_multiproof (tmproof);
+            # absence degrades only the batched multiproof path to the
+            # level-iterative Python fallback, byte-identical
+            if hasattr(lib, "tm_merkle_multiproof"):
+                lib.tm_merkle_multiproof.argtypes = [
+                    ctypes.c_char_p,  # items (concatenated)
+                    i64p,  # offsets (n+1)
+                    ctypes.c_int64,  # n
+                    i64p,  # indices (k, sorted strictly ascending)
+                    ctypes.c_int64,  # k
+                    u8p,  # root_out (32)
+                    u8p,  # leaves_out (k*32)
+                    u8p,  # nodes_out (k*ceil(log2 n)*32)
+                    i64p,  # n_nodes_out (1)
+                ]
+                lib.tm_merkle_multiproof.restype = None
             _lib = lib
         except Exception:
             _load_failed = True
@@ -283,6 +299,50 @@ def merkle_proofs(items) -> tuple[bytes, list[bytes], list[list[bytes]]] | None:
             [aunt_buf[base + 32 * j : base + 32 * j + 32] for j in range(int(counts[i]))]
         )
     return bytes(root), leaf_hashes, aunt_lists
+
+
+def merkle_multiproof(items, indices) -> tuple[bytes, list[bytes], list[bytes]] | None:
+    """(root, proven leaf hashes, deduplicated shared-node list) for k
+    sorted distinct indices against one tree, in ONE GIL-released
+    native call — or None (callers take the level-iterative Python
+    fallback, byte-identical). Index validation (sorted, distinct, in
+    range) is the CALLER's contract (crypto/merkle raises before
+    dispatching here); this wrapper only refuses the trivial shapes the
+    C side does not handle (n == 0, k == 0)."""
+    lib = load_prep()
+    if lib is None or not hasattr(lib, "tm_merkle_multiproof"):
+        return None
+    import numpy as np
+
+    n = len(items)
+    k = len(indices)
+    if n == 0 or k == 0:
+        return None
+    max_nodes = k * max(1, (n - 1).bit_length())  # <=1 emission/ancestor/level
+    blob, offsets = _concat_offsets(items)
+    idx = np.asarray(indices, np.int64)
+    root = (ctypes.c_uint8 * 32)()
+    leaves = np.empty(k * 32, np.uint8)
+    nodes = np.empty(max_nodes * 32, np.uint8)
+    n_nodes = ctypes.c_int64(0)
+    lib.tm_merkle_multiproof(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        k,
+        root,
+        leaves.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        nodes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.byref(n_nodes),
+    )
+    leaf_buf = leaves.tobytes()
+    node_buf = nodes.tobytes()
+    return (
+        bytes(root),
+        [leaf_buf[32 * i : 32 * i + 32] for i in range(k)],
+        [node_buf[32 * i : 32 * i + 32] for i in range(int(n_nodes.value))],
+    )
 
 
 def host_verify_batch(pubkeys, msgs, sigs):

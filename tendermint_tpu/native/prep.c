@@ -760,6 +760,58 @@ void tm_merkle_proofs(const uint8_t *items, const int64_t *offsets, int64_t n,
     __builtin_free(idx);
 }
 
+/* Batched multiproof (tmproof): ONE call proving k sorted distinct
+ * indices against the tree over n items, emitting the deduplicated
+ * shared-node set instead of k aunt lists. The walk mirrors the Python
+ * fallback exactly — per level (bottom-up), each current ancestor
+ * index in ascending order either pairs with its sibling inside the
+ * ancestor set (shared: recomputed from the proven leaves at verify
+ * time, nothing emitted) or consumes one emitted sibling node; a
+ * promoted odd tail contributes nothing. Parent indices never collide
+ * outside the pair case (idx>>1 equal implies siblings), so the
+ * ancestor set stays strictly ascending with no dedup pass.
+ *
+ * Outputs: root_out[32]; leaves_out k*32 (the proven leaf hashes in
+ * index order); nodes_out (caller-sized to k*ceil(log2 n) slots — at
+ * most one emission per ancestor per level); *n_nodes_out = emitted
+ * count. Requires n >= 1 and indices strictly ascending in [0, n)
+ * (the ctypes wrapper validates; this side trusts its caller). */
+void tm_merkle_multiproof(const uint8_t *items, const int64_t *offsets, int64_t n,
+                          const int64_t *indices, int64_t k,
+                          uint8_t *root_out, uint8_t *leaves_out,
+                          uint8_t *nodes_out, int64_t *n_nodes_out) {
+    pthread_once(&ossl_once, ossl_resolve);
+    uint8_t *level = (uint8_t *)__builtin_malloc((u64)n * 32);
+    int64_t *cur = (int64_t *)__builtin_malloc((u64)(k > 0 ? k : 1) * sizeof(int64_t));
+    sha256_batch_threaded(items, offsets, n, 1, 0x00, level);
+    for (int64_t i = 0; i < k; i++) {
+        memcpy(leaves_out + 32 * i, level + 32 * indices[i], 32);
+        cur[i] = indices[i];
+    }
+    int64_t m = k, count = n, emitted = 0;
+    while (count > 1) {
+        int64_t w = 0;
+        for (int64_t i = 0; i < m; ) {
+            int64_t idx = cur[i];
+            if ((idx & 1) == 0 && i + 1 < m && cur[i + 1] == idx + 1) {
+                i += 2; /* both children proven: shared, nothing emitted */
+            } else {
+                int64_t sib = idx ^ 1;
+                if (sib < count)
+                    memcpy(nodes_out + 32 * emitted++, level + 32 * sib, 32);
+                i += 1;
+            }
+            cur[w++] = idx >> 1;
+        }
+        m = w;
+        count = merkle_halve(level, count);
+    }
+    *n_nodes_out = emitted;
+    memcpy(root_out, level, 32);
+    __builtin_free(level);
+    __builtin_free(cur);
+}
+
 /* Inputs: pks n*32, sigs n*64, msgs concatenated with offsets[n+1].
  * Output: out[i] = 1 iff OpenSSL accepts row i. Returns 1 when
  * libcrypto served the batch, 0 when it is unavailable (out untouched —

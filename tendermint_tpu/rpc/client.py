@@ -7,6 +7,7 @@ minimal RFC-6455 client for /websocket subscriptions.
 from __future__ import annotations
 
 import base64
+import http.client
 import itertools
 import json
 import os
@@ -14,7 +15,7 @@ import queue
 import socket
 import struct
 import threading
-import urllib.request
+from urllib.parse import urlsplit
 
 
 class RPCClientError(Exception):
@@ -25,12 +26,52 @@ class RPCClientError(Exception):
 
 
 class HTTPClient:
-    """ref: rpc/client/http/http.go."""
+    """ref: rpc/client/http/http.go.
+
+    Keep-alive: calls ride ONE persistent `http.client.HTTPConnection`
+    per calling thread instead of a fresh TCP connect (+ handshake) per
+    request — the per-call `urllib.request.urlopen` setup used to
+    dominate the proof gateway's serve time at high QPS (tmproof). A
+    stale keep-alive socket (the server closed an idle connection
+    between calls, or it died and restarted) is retried ONCE on a fresh
+    connection; a request that timed out is NOT retried (re-waiting the
+    full timeout would double every slow failure, and the caller's
+    retry policy owns that decision). Connections are per-thread
+    (threading.local), so concurrent callers never interleave on one
+    socket."""
 
     def __init__(self, base_url: str, timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._ids = itertools.count(1)
+        u = urlsplit(self.base_url if "//" in self.base_url else "//" + self.base_url)
+        if u.scheme not in ("", "http"):
+            # silently opening a plaintext port-80 connection to an
+            # https:// URL would be a downgrade, not a fallback
+            raise ValueError(
+                f"HTTPClient speaks plain http only, got scheme {u.scheme!r}"
+            )
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or 80
+        self._path = u.path or "/"
+        self._local = threading.local()
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self._host, self._port, timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def close(self) -> None:
+        """Drop this thread's persistent connection (idempotent)."""
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def call(self, method: str, **params):
         req = {
@@ -40,11 +81,41 @@ class HTTPClient:
             "params": params,
         }
         data = json.dumps(req).encode()
-        http_req = urllib.request.Request(
-            self.base_url, data=data, headers={"Content-Type": "application/json"}
-        )
-        with urllib.request.urlopen(http_req, timeout=self.timeout) as resp:
-            body = json.loads(resp.read())
+        headers = {"Content-Type": "application/json"}
+        raw = None
+        for attempt in (0, 1):
+            conn = self._conn()
+            reused = conn.sock is not None  # else request() connects fresh
+            try:
+                conn.request("POST", self._path, body=data, headers=headers)
+            except TimeoutError:
+                self.close()
+                raise
+            except (http.client.HTTPException, OSError):
+                # send-phase failure: the request was never delivered,
+                # so one retry on a fresh connection is always safe
+                self.close()
+                if attempt:
+                    raise
+                continue
+            try:
+                resp = conn.getresponse()
+                raw = resp.read()
+                break
+            except TimeoutError:
+                self.close()  # half-done exchange: the socket is unusable
+                raise
+            except (http.client.HTTPException, OSError):
+                self.close()
+                # response-phase failure: retry ONLY a reused keep-alive
+                # socket (the server reaped it idle before reading our
+                # bytes — the classic stale-socket shape). A FRESH
+                # connection that died mid-exchange may have processed
+                # the call; blindly re-POSTing would double-submit
+                # non-idempotent methods (broadcast_tx_*).
+                if attempt or not reused:
+                    raise
+        body = json.loads(raw)
         if "error" in body:
             e = body["error"]
             raise RPCClientError(e.get("code"), e.get("message"), e.get("data"))

@@ -121,6 +121,30 @@ def tx_result_to_json(r) -> dict:
     }
 
 
+def multiproof_to_json(mp) -> dict:
+    """Wire form of a crypto/merkle.MultiProof (tmproof gateway)."""
+    return {
+        "total": str(mp.total),
+        "indices": list(mp.indices),
+        "leaf_hashes": [_b64(h) for h in mp.leaf_hashes],
+        "nodes": [_b64(nd) for nd in mp.nodes],
+    }
+
+
+def multiproof_from_json(d: dict):
+    """Inverse of multiproof_to_json — the light client/proxy side
+    rebuilds the proof to verify it against a VERIFIED header's
+    data_hash before trusting anything the primary relayed."""
+    from ..crypto.merkle import MultiProof
+
+    return MultiProof(
+        int(d.get("total") or 0),
+        [int(i) for i in d.get("indices") or []],
+        [base64.b64decode(h) for h in d.get("leaf_hashes") or []],
+        [base64.b64decode(nd) for nd in d.get("nodes") or []],
+    )
+
+
 def event_to_json(data) -> dict:
     """Event payloads for ws subscriptions (ref: coretypes result events)."""
     if isinstance(data, EventDataNewBlock):
@@ -556,6 +580,112 @@ def build_routes(env: RPCEnvironment) -> dict:
             "canonical": canonical,
         }
 
+    # ------------------------------------------------------------- tmproof
+    # Batched proof-serving gateway (docs/observability.md#tmproof):
+    # proofs_batch proves k tx indices at a height in ONE multiproof —
+    # the internal nodes that k independent proofs recompute and
+    # re-transmit are emitted once — served from a hot-tree LRU of
+    # committed (immutable) tx trees; light_batch bundles a whole
+    # light-client verification step (header + commit + full validator
+    # set + optional proofs) into one round trip.
+
+    MAX_PROOF_INDICES = 1024
+    _tree_cache: list = []
+
+    def _get_tree_cache():
+        if not _tree_cache:
+            from ..crypto.merkle import TreeCache
+
+            _tree_cache.append(TreeCache(capacity=32))
+        return _tree_cache[0]
+
+    def _serve_tx_proofs(h: int, indices, route: str) -> dict:
+        """Multiproof over the data_hash tree at height h (leaves are
+        the txs' SHA-256 digests, types/tx.go Txs.Hash shape). Counts
+        ProofMetrics served/batch-size; the caller owns serve_seconds."""
+        from ..crypto import merkle as _merkle
+        from ..metrics import proof_metrics
+
+        if not isinstance(indices, (list, tuple)) or not indices:
+            raise RPCError(-32602, "indices must be a non-empty list of tx indices")
+        if len(indices) > MAX_PROOF_INDICES:
+            raise RPCError(
+                -32602, f"at most {MAX_PROOF_INDICES} indices per request, got {len(indices)}"
+            )
+        try:
+            idxs = [int(i) for i in indices]
+        except (TypeError, ValueError):
+            raise RPCError(-32602, f"invalid indices: {indices!r}")
+        cache = _get_tree_cache()
+        # get/put spelled out rather than TreeCache.get_or_build: the
+        # served counter's backend label needs the hit/miss outcome,
+        # which the helper hides. The entry caches the TXS alongside
+        # the tree — a hit must skip the block store entirely (a full
+        # block decode per request would dwarf the zero-hash assembly
+        # win); memory is bounded by capacity x consensus max_bytes.
+        entry = cache.get(("txs", h))
+        backend = "cache"
+        if entry is None:
+            blk = env.block_store.load_block(h)
+            if blk is None:
+                raise RPCError(-32603, f"no block at height {h}")
+            txs = list(blk.txs)
+            # committed tx trees are immutable: build once, serve from
+            # the LRU for every later request against this height
+            tree = _merkle.TreeLevels.build(
+                _merkle.sha256_batch(txs), site="proof_gateway"
+            )
+            cache.put(("txs", h), (tree, txs))
+            backend = tree.backend
+        else:
+            tree, txs = entry
+        try:
+            mp = tree.multiproof(idxs)
+        except ValueError as e:
+            raise RPCError(-32602, str(e))
+        m = proof_metrics()
+        m.served.add(len(idxs), route, backend)
+        m.batch_size.observe(len(idxs))
+        return {
+            "height": str(h),
+            "root": _hex(tree.root),
+            "multiproof": multiproof_to_json(mp),
+            "txs": [_b64(txs[i]) for i in idxs],
+        }
+
+    def proofs_batch(height=None, indices=None):
+        """k tx inclusion proofs at a height as ONE batched multiproof
+        over the block's data_hash tree (tmproof gateway); verify with
+        MultiProof.verify(data_hash, [sha256(tx), ...])."""
+        from ..metrics import proof_metrics
+
+        t0 = _time.perf_counter()
+        h = _height_or_latest(height)
+        out = _serve_tx_proofs(h, indices, "proofs_batch")
+        proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "proofs_batch")
+        return out
+
+    def light_batch(height=None, indices=None):
+        """A whole light-client verification step in one round trip:
+        signed header + commit + FULL validator set, plus an optional
+        tx multiproof when `indices` is given (tmproof gateway)."""
+        from ..metrics import proof_metrics
+
+        t0 = _time.perf_counter()
+        h = _height_or_latest(height)
+        # header + commit + canonical come from the commit route — ONE
+        # copy of the block-commit/seen-commit fallback semantics
+        out = commit(height=h)
+        vals = env.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validator set at height {h}")
+        out["validators"] = [validator_to_json(v) for v in vals.validators]
+        out["total_validators"] = str(vals.size())
+        if indices:
+            out["proofs"] = _serve_tx_proofs(h, indices, "light_batch")
+        proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "light_batch")
+        return out
+
     def validators(height=None, page=1, per_page=30):
         """Paginated validator set at a height."""
         h = _height_or_latest(height)
@@ -866,6 +996,8 @@ def build_routes(env: RPCEnvironment) -> dict:
         "flight_recorder": flight_recorder,
         "block_results": block_results,
         "commit": commit,
+        "proofs_batch": proofs_batch,
+        "light_batch": light_batch,
         "validators": validators,
         "consensus_params": consensus_params,
         "consensus_state": consensus_state,

@@ -248,6 +248,66 @@ def test_gate_overrides_and_unknown_keys(tmp_path):
     assert DEFAULT_GATES["max_height_spread"] == 5
 
 
+def _proofs_exposition(serves=200, slow=0, height=50):
+    """Exposition with the tmproof gateway families populated (the
+    process-global ProofMetrics rides every node's scrape)."""
+    from tendermint_tpu.metrics import ProofMetrics
+
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    cm.height.set(height)
+    for _ in range(40):
+        cm.step_duration.observe(0.2, "propose")
+    cm.last_block_age.mark(time.time() - 1.0)
+    P2PMetrics(reg)
+    pm = ProofMetrics(reg)
+    for _ in range(serves):
+        pm.served.add(8, "proofs_batch", "cache")
+        pm.batch_size.observe(8)
+        pm.serve_seconds.observe(0.002, "proofs_batch")
+    for _ in range(slow):
+        pm.serve_seconds.observe(5.0, "light_batch")  # overflow bucket
+    return reg.gather()
+
+
+def test_proof_serve_gate_pass_fail_and_vacuous(tmp_path):
+    """proof_serve_p99 (tmproof): vacuous pass when no node served,
+    pass on a healthy fleet-merged serve histogram, fail when >1% of
+    serves spilled past the top bucket — and the per-node/fleet proofs
+    blocks land in the report."""
+    # vacuous: ordinary expositions carry no proofs families
+    report = analyze_run(write_fleet(tmp_path / "idle", [node_exposition()] * 2))
+    (gate,) = [g for g in report["gates"] if g["name"] == "proof_serve_p99"]
+    assert gate["ok"] and "idle" in gate["detail"]
+
+    run = write_fleet(
+        tmp_path / "ok", [_proofs_exposition(), _proofs_exposition(serves=400)]
+    )
+    report = analyze_run(run)
+    assert report["verdict"] == "pass", report["gates"]
+    assert report["fleet"]["nodes_with_proofs"] == 2
+    assert report["fleet"]["proofs"]["served_total"] == 4800.0
+    assert report["fleet"]["proofs"]["serve_p99_s"] <= 0.01
+    node0 = report["nodes"][0]
+    assert node0["proofs"]["served_total"] == 1600.0
+    assert node0["proofs"]["tree_cache"] == {"hit": 0.0, "miss": 0.0, "evict": 0.0}
+    assert "batch_size_p50" in node0["proofs"]
+
+    # 5% of one node's serves past the 1s top bucket: fleet p99 clamps
+    # at 1.0 > the 0.9 budget
+    run = write_fleet(
+        tmp_path / "slow", [_proofs_exposition(), _proofs_exposition(slow=40)]
+    )
+    report = analyze_run(run)
+    failing = [g["name"] for g in report["gates"] if not g["ok"]]
+    assert failing == ["proof_serve_p99"], report["gates"]
+    (gate,) = [g for g in report["gates"] if g["name"] == "proof_serve_p99"]
+    assert "budget 0.9s" in gate["detail"]
+    # a loosened budget (per-run override) passes the same evidence:
+    # the serve histogram's top finite bucket is 1.0, where estimates clamp
+    assert analyze_run(run, gates={"proof_serve_p99_budget_s": 1.0})["verdict"] == "pass"
+
+
 def test_empty_run_dir_fails_all_unverifiable_gates(tmp_path):
     run = tmp_path / "empty"
     run.mkdir()
@@ -259,7 +319,8 @@ def test_empty_run_dir_fails_all_unverifiable_gates(tmp_path):
     # not fail for lacking them), like missing_series with
     # require_metrics_from_all unset
     vacuous = ("missing_series", "rate_stall", "churn_storm", "journey_stall",
-               "lock_order_cycle", "shared_state_race", "perf_regression")
+               "lock_order_cycle", "shared_state_race", "perf_regression",
+               "proof_serve_p99")
     assert all(not g["ok"] for g in report["gates"] if g["name"] not in vacuous)
     assert all(g["ok"] for g in report["gates"] if g["name"] in vacuous)
 

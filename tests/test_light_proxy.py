@@ -99,6 +99,94 @@ def test_proxy_requires_height(proxy):
         c.call("block")
 
 
+def test_proxy_light_batch_serves_verified_store(proxy, node):
+    """light_batch comes from the proxy's OWN verified store — header,
+    commit, and validator set the light client already checked — in
+    one round trip (tmproof gateway)."""
+    c = _client(proxy)
+    res = c.call("light_batch", height="2")
+    direct = HTTPClient(
+        f"http://{node.rpc_address[0]}:{node.rpc_address[1]}"
+    ).call("commit", height="2")
+    assert res["signed_header"]["header"]["height"] == "2"
+    assert res["canonical"] is True
+    assert (
+        res["signed_header"]["commit"]["block_id"]["hash"]
+        == direct["signed_header"]["commit"]["block_id"]["hash"]
+    )
+    assert int(res["total_validators"]) == len(res["validators"]) == 1
+
+
+def test_proxy_light_batch_refuses_past_verified_head(proxy):
+    """A verifying proxy must not relay heights it cannot verify: a
+    request past the (updated) verified head is an error, never a
+    pass-through."""
+    c = _client(proxy)
+    with pytest.raises(RPCClientError, match="past the verified head"):
+        c.call("light_batch", height=str(10**6))
+
+
+def test_proxy_proofs_batch_verifies_before_relaying(proxy, node, monkeypatch):
+    """proofs_batch relays the primary's multiproof only after it
+    reconstructs the LIGHT-VERIFIED header's data_hash; a primary that
+    tampers one shared node (or one tx byte) is rejected."""
+    import base64
+    import hashlib
+
+    from tendermint_tpu.rpc.core import multiproof_from_json
+
+    # commit a burst of txs so ONE height carries a multi-leaf tree
+    # (the index-substitution case below needs >= 2 provable indices)
+    direct = HTTPClient(f"http://{node.rpc_address[0]}:{node.rpc_address[1]}")
+    for i in range(3):
+        res = direct.call("broadcast_tx_sync", tx=f"lpk{i}=lpv{i}".encode().hex())
+        assert res["code"] == 0
+    height = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and height is None:
+        head = int(direct.call("status")["sync_info"]["latest_block_height"])
+        for h in range(head, 0, -1):
+            blk = direct.call("block", height=h)
+            if len((blk["block"]["data"] or {}).get("txs") or []) >= 2:
+                height = h
+                break
+        time.sleep(0.2)
+    assert height is not None, "tx burst never landed >= 2 txs in one block"
+
+    c = _client(proxy)
+    out = c.call("proofs_batch", height=str(height), indices=[0])
+    mp = multiproof_from_json(out["multiproof"])
+    txs = [base64.b64decode(t) for t in out["txs"]]
+    assert mp.verify(
+        bytes.fromhex(out["root"]), [hashlib.sha256(tx).digest() for tx in txs]
+    )
+
+    real = proxy.primary.call
+
+    def tampering_call(method, **params):
+        resp = real(method, **params)
+        if method == "proofs_batch":
+            resp["txs"] = [base64.b64encode(b"spoofed").decode()]
+        return resp
+
+    monkeypatch.setattr(proxy.primary, "call", tampering_call)
+    with pytest.raises(RPCClientError, match="multiproof does not verify"):
+        c.call("proofs_batch", height=str(height), indices=[0])
+
+    # index substitution: a VALIDLY-proven but different index set is
+    # still an attack — the primary answers the client's [0] with its
+    # own genuine proof for [1]
+    def substituting_call(method, **params):
+        if method == "proofs_batch":
+            return real(method, **dict(params, indices=[1]))
+        return real(method, **params)
+
+    monkeypatch.setattr(proxy.primary, "call", substituting_call)
+    with pytest.raises(RPCClientError, match="different indices"):
+        c.call("proofs_batch", height=str(height), indices=[0])
+    monkeypatch.setattr(proxy.primary, "call", real)
+
+
 def test_proxy_rejects_spoofed_block(proxy, node, monkeypatch):
     """A primary that self-reports the verified hash but returns a
     tampered body must be rejected — the proxy recomputes hashes

@@ -154,6 +154,151 @@ def test_proof_roundtrip_against_batched_builder():
                 assert not proofs[i].verify(root, items[(i + 1) % n])
 
 
+# ------------------------- multiproofs (tmproof) -------------------------
+
+
+def test_multiproof_property_sweep_vs_per_proof_oracle():
+    """Across the RFC-6962 edge zoo with k in {1, n/2, n}: the batched
+    multiproof must (a) reconstruct the same root, (b) accept exactly
+    when the k independent Proof.verify calls accept, (c) reject
+    tampered leaves and cross-index swaps, and (d) emit the SAME node
+    set from the active backend as the pure-Python level walk."""
+    rng = random.Random(21)
+    for n in SWEEP_NS:
+        if n == 0:
+            continue  # no valid index exists; generation raises (below)
+        items = _sweep_items(n, rng)
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        for k in sorted({1, max(1, n // 2), n}):
+            idxs = sorted(rng.sample(range(n), k))
+            mp_root, mp = merkle.multiproof_from_byte_slices(items, idxs)
+            assert mp_root == root, (n, k)
+            leaves = [items[i] for i in idxs]
+            assert mp.verify(root, leaves) == all(
+                proofs[i].verify(root, items[i]) for i in idxs
+            ), (n, k)
+            assert not mp.verify(root, [lf + b"x" for lf in leaves]), (n, k)
+            if k >= 2:
+                swapped = [leaves[1], leaves[0]] + leaves[2:]
+                if swapped != leaves:
+                    assert not mp.verify(root, swapped), (n, k)
+            levels = merkle._levels_from_byte_slices_py(items)
+            assert mp.nodes == merkle._multiproof_nodes_from_levels(levels, idxs), (n, k)
+            assert mp.leaf_hashes == [levels[0][i] for i in idxs], (n, k)
+
+
+def test_multiproof_index_rejection():
+    """Generation RAISES on dup/out-of-range/unsorted/empty indices;
+    verification returns False for the same shapes (a forged proof is
+    a verdict, not a bug)."""
+    items = [bytes([i]) * 8 for i in range(16)]
+    root, _ = merkle.proofs_from_byte_slices(items)
+    for bad in ([], [3, 3], [5, 2], [16], [-1], [0, 1, 1], [True]):
+        with pytest.raises(ValueError):
+            merkle.multiproof_from_byte_slices(items, bad)
+    _, mp = merkle.multiproof_from_byte_slices(items, [2, 7])
+    good = [items[2], items[7]]
+    assert mp.verify(root, good)
+    for indices in ([7, 2], [2, 2], [2, 16], [-1, 7], []):
+        forged = merkle.MultiProof(16, indices, mp.leaf_hashes[: len(indices)], mp.nodes)
+        assert not forged.verify(root, good[: len(indices)])
+    # truncated and surplus shared-node sets both reject
+    assert not merkle.MultiProof(16, [2, 7], mp.leaf_hashes, mp.nodes[:-1]).verify(root, good)
+    assert not merkle.MultiProof(16, [2, 7], mp.leaf_hashes, mp.nodes + [b"\x00" * 32]).verify(root, good)
+    # a tampered shared node must flip the reconstructed root
+    bad_nodes = [b"\xff" * 32] + mp.nodes[1:]
+    assert not merkle.MultiProof(16, [2, 7], mp.leaf_hashes, bad_nodes).verify(root, good)
+
+
+def test_multiproof_native_flip_byte_identity(monkeypatch):
+    """TM_TPU_NATIVE=0 pins the level-iterative Python path; flipping
+    it must not change a single byte of (root, leaf_hashes, nodes) —
+    the mirror of the tree-builder three-way sweep."""
+    rng = random.Random(22)
+    items = [rng.randbytes(40) for _ in range(257)]
+    idxs = sorted(rng.sample(range(257), 64))
+    root_a, mp_a = merkle.multiproof_from_byte_slices(items, idxs)
+    monkeypatch.setenv("TM_TPU_NATIVE", "0")
+    assert native.merkle_multiproof(items, idxs) is None
+    root_b, mp_b = merkle.multiproof_from_byte_slices(items, idxs)
+    assert root_a == root_b
+    assert mp_a.leaf_hashes == mp_b.leaf_hashes
+    assert mp_a.nodes == mp_b.nodes
+    monkeypatch.delenv("TM_TPU_NATIVE")
+
+
+def test_multiproof_single_leaf_and_shared_node_savings():
+    # total == 1: the leaf IS the root, zero shared nodes
+    root, mp = merkle.multiproof_from_byte_slices([b"only"], [0])
+    assert mp.nodes == [] and mp.verify(root, [b"only"])
+    # a full-tree multiproof needs NO shared nodes at all
+    items = [bytes([i]) for i in range(8)]
+    root, mp = merkle.multiproof_from_byte_slices(items, list(range(8)))
+    assert mp.nodes == [] and mp.verify(root, items)
+    # the dedup claim itself: k proofs re-transmit strictly more nodes
+    items = [bytes([i]) * 4 for i in range(256)]
+    idxs = sorted(random.Random(3).sample(range(256), 32))
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    _, mp = merkle.multiproof_from_byte_slices(items, idxs)
+    per_proof_nodes = sum(len(proofs[i].aunts) for i in idxs)
+    assert len(mp.nodes) < per_proof_nodes / 2, (
+        f"multiproof shipped {len(mp.nodes)} nodes vs {per_proof_nodes} across "
+        "independent proofs — the shared-node dedup is the whole point"
+    )
+
+
+def test_tree_levels_match_classic_proofs():
+    rng = random.Random(23)
+    for n in [1, 2, 3, 13, 100, 257]:
+        items = _sweep_items(n, rng)
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        tree = merkle.TreeLevels.build(items)
+        assert tree.root == root and tree.total == n
+        for i in (0, n // 2, n - 1):
+            p = tree.proof(i)
+            assert p.aunts == proofs[i].aunts and p.leaf_hash == proofs[i].leaf_hash
+            assert p.verify(root, items[i])
+        idxs = sorted(rng.sample(range(n), max(1, n // 2)))
+        mp = tree.multiproof(idxs)
+        assert mp.verify(root, [items[i] for i in idxs])
+    with pytest.raises(ValueError):
+        merkle.TreeLevels.build([b"a", b"b"]).proof(2)
+
+
+def test_tree_cache_hit_miss_and_eviction():
+    """LRU invariants: hot keys stay, cold keys evict oldest-first,
+    and the hit/miss/eviction counters account for every request."""
+    cache = merkle.TreeCache(capacity=2)
+    builds = []
+
+    def loader(tag):
+        def build():
+            builds.append(tag)
+            return [bytes([tag])] * 4
+        return build
+
+    t1 = cache.get_or_build(("txs", 1), loader(1))
+    assert cache.misses == 1 and cache.hits == 0 and builds == [1]
+    assert cache.get_or_build(("txs", 1), loader(1)) is t1  # hot: no rebuild
+    assert cache.hits == 1 and builds == [1]
+    cache.get_or_build(("txs", 2), loader(2))
+    cache.get_or_build(("txs", 1), loader(1))  # refresh 1's recency
+    cache.get_or_build(("txs", 3), loader(3))  # evicts 2 (LRU), not 1
+    assert cache.evictions == 1 and len(cache) == 2
+    assert cache.get_or_build(("txs", 1), loader(1)) is t1
+    cache.get_or_build(("txs", 2), loader(2))  # 2 was evicted: rebuilt
+    assert builds == [1, 2, 3, 2]
+    # the cached tree serves byte-identical multiproofs to a fresh build
+    items = [bytes([i]) * 6 for i in range(64)]
+    cache.get_or_build(("txs", 9), lambda: items)
+    mp_cached = cache.get(("txs", 9)).multiproof([1, 7, 40])
+    _, mp_fresh = merkle.multiproof_from_byte_slices(items, [1, 7, 40])
+    assert mp_cached.nodes == mp_fresh.nodes
+    assert mp_cached.leaf_hashes == mp_fresh.leaf_hashes
+    with pytest.raises(ValueError):
+        merkle.TreeCache(capacity=0)
+
+
 def test_tm_tpu_native_opt_out(monkeypatch):
     """TM_TPU_NATIVE=0 pins every builder to the Python fallback and is
     read per-call (A/B runs flip it live, docs/observability.md)."""

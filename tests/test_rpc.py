@@ -123,6 +123,98 @@ def test_broadcast_tx_commit_and_tx_search(live_node):
     assert int(found["total_count"]) >= 1
 
 
+def test_proofs_batch_and_light_batch_round_trip(live_node):
+    """tmproof gateway round-trips through the live JSONRPCServer: one
+    multiproof for k tx indices verifies against the block header's
+    data_hash, light_batch bundles header+commit+validators (+proofs)
+    into one response, and repeated requests hit the hot-tree cache."""
+    import base64
+    import hashlib
+
+    from tendermint_tpu.metrics import proof_metrics
+    from tendermint_tpu.rpc.core import multiproof_from_json
+
+    node, client, _ = live_node
+    txs = [b"pfa=1", b"pfb=2", b"pfc=3"]
+    height = None
+    for tx in txs:
+        res = client.broadcast_tx_commit(tx=tx.hex())
+        assert res["tx_result"]["code"] == 0
+        height = int(res["height"])
+    # find a height with >= 2 txs (the flood may coalesce into one block)
+    for h in range(1, height + 1):
+        blk = client.block(height=h)
+        committed = [base64.b64decode(t) for t in blk["block"]["data"]["txs"]]
+        if len(committed) >= 2:
+            height = h
+            break
+    else:
+        committed = [base64.b64decode(t) for t in client.block(height=height)["block"]["data"]["txs"]]
+    idxs = sorted({0, len(committed) - 1})
+    res = client.proofs_batch(height=height, indices=idxs)
+    mp = multiproof_from_json(res["multiproof"])
+    got_txs = [base64.b64decode(t) for t in res["txs"]]
+    assert got_txs == [committed[i] for i in idxs]
+    data_hash = bytes.fromhex(client.header(height=height)["header"]["data_hash"])
+    assert bytes.fromhex(res["root"]) == data_hash
+    # leaves of the data_hash tree are the txs' SHA-256 digests
+    assert mp.verify(data_hash, [hashlib.sha256(tx).digest() for tx in got_txs])
+    assert not mp.verify(data_hash, [b"forged" for _ in got_txs])
+
+    # second request against the same height: served from the tree cache
+    before = proof_metrics().tree_cache_events.samples()
+    hit_before = next((v for _n, lbl, v in before if lbl.get("event") == "hit"), 0)
+    client.proofs_batch(height=height, indices=idxs)
+    after = proof_metrics().tree_cache_events.samples()
+    hit_after = next((v for _n, lbl, v in after if lbl.get("event") == "hit"), 0)
+    assert hit_after > hit_before, "repeat request did not hit the hot-tree cache"
+
+    # light_batch: one round trip = header + commit + full validator set
+    lb = client.light_batch(height=height, indices=idxs)
+    assert lb["signed_header"]["header"]["height"] == str(height)
+    assert lb["signed_header"]["commit"]["height"] == str(height)
+    assert int(lb["total_validators"]) == len(lb["validators"]) == 1
+    mp2 = multiproof_from_json(lb["proofs"]["multiproof"])
+    assert mp2.verify(data_hash, [hashlib.sha256(tx).digest() for tx in got_txs])
+
+    # invalid index shapes are -32602, not internal errors
+    for bad in ([], [5, 2], [0, 0], [10_000], "nope"):
+        with pytest.raises(RPCClientError) as ei:
+            client.proofs_batch(height=height, indices=bad)
+        assert ei.value.code == -32602, bad
+
+
+def test_http_client_keep_alive_single_accept(live_node):
+    """The keep-alive regression pin (tmproof satellite): N calls from
+    one thread ride ONE accepted TCP connection, and a server-closed
+    idle socket is retried once on a fresh connection instead of
+    surfacing a stale-socket error."""
+    node, client, (host, port) = live_node
+    server = live_node_server[0]
+    accepts = [0]
+    orig_get_request = server._httpd.get_request
+
+    def counting_get_request():
+        accepts[0] += 1
+        return orig_get_request()
+
+    server._httpd.get_request = counting_get_request
+    try:
+        fresh = HTTPClient(f"http://{host}:{port}")
+        for _ in range(10):
+            assert fresh.call("health") == {}
+        assert accepts[0] == 1, (
+            f"10 keep-alive calls accepted {accepts[0]} connections"
+        )
+        # stale-socket retry: close the server side of the persistent
+        # connection; the next call must transparently reconnect
+        fresh._conn().sock.close()  # simulate a dropped keep-alive socket
+        assert fresh.call("health") == {}
+        assert accepts[0] == 2
+    finally:
+        server._httpd.get_request = orig_get_request
+
+
 def test_broadcast_tx_sync_and_mempool_endpoints(live_node):
     node, client, _ = live_node
     res = client.broadcast_tx_sync(tx=b"synckey=1".hex())

@@ -350,6 +350,108 @@ def test_rolling_gates_windowed_p99_trips_on_fresh_regression():
     assert h.quantile(0.99) < 9.5
 
 
+def test_rolling_gates_proof_serve_p99_windowed_trip():
+    """tmproof: the windowed delta of the gateway serve histogram
+    trips proof_serve_p99 on a FRESH latency regression; an idle
+    gateway (no serve family at all) is never judged."""
+    from tendermint_tpu.metrics import ProofMetrics
+
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    cm.last_block_age.mark()
+    P2PMetrics(reg)
+    pm = ProofMetrics(reg)
+    for _ in range(4000):
+        pm.serve_seconds.observe(0.002, "proofs_batch")  # healthy history
+
+    def snap(height):
+        cm.height.set(height)
+        return parse_exposition(reg.gather())
+
+    g = RollingGates({"min_proof_samples": 20, "watch_window_s": 30.0})
+    g.observe("a", snap(50), t=1000.0)
+    for _ in range(30):
+        pm.serve_seconds.observe(5.0, "proofs_batch")  # overflow bucket
+    g.observe("a", snap(51), t=1010.0)
+    tripped = g.evaluate(now=1010.0)
+    assert [x["name"] for x in tripped] == ["proof_serve_p99"], tripped
+    # sanity: the run-cumulative estimate would NOT have tripped
+    h = parse_exposition(reg.gather()).histogram("tendermint_proofs_serve_seconds")
+    assert h.quantile(0.99) < 0.9
+    # idle gateway: plain consensus expositions never reach the gate
+    g2 = RollingGates({"min_proof_samples": 1})
+    for i in range(5):
+        g2.observe("a", _exposition(height=50 + i), t=1000.0 + i * 2.0)
+    assert g2.evaluate(now=1010.0) == []
+
+
+def test_rolling_gates_proof_rate_stall_opt_in():
+    """tmproof: proofs/s rate stall is OPT-IN (proof_stall_after_s=0
+    disables it); enabled, it trips only for a node that HAS served
+    proofs and then went flat — never for one that never served."""
+    from tendermint_tpu.metrics import ProofMetrics
+
+    reg = Registry()
+    cm = ConsensusMetrics(reg)
+    cm.last_block_age.mark()
+    P2PMetrics(reg)
+    pm = ProofMetrics(reg)
+
+    def snap(height, serves=0):
+        cm.height.set(height)
+        pm.served.add(serves, "proofs_batch", "cache") if serves else None
+        return parse_exposition(reg.gather())
+
+    # default config: the stall gate is off even for a flat server
+    g = RollingGates()
+    g.observe("a", snap(50, serves=10), t=1000.0)
+    for i in range(8):
+        g.observe("a", snap(51 + i), t=1002.0 + i * 2.0)
+    assert g.evaluate(now=1040.0) == []
+
+    # opted in: served-then-flat trips; never-served does not
+    reg2 = Registry()
+    cm2 = ConsensusMetrics(reg2)
+    cm2.last_block_age.mark()
+    P2PMetrics(reg2)
+    idle = parse_exposition(reg2.gather())
+    g = RollingGates({"proof_stall_after_s": 10.0})
+    g.observe("a", snap(60, serves=10), t=2000.0)
+    g.observe("b", idle, t=2000.0)
+    for i in range(8):
+        g.observe("a", snap(61 + i), t=2002.0 + i * 2.0)
+        g.observe("b", idle, t=2002.0 + i * 2.0)
+    tripped = g.evaluate(now=2016.0)
+    assert [x["name"] for x in tripped] == ["proof_rate_stall"], tripped
+    assert "'a'" in tripped[0]["detail"] or "a" in tripped[0]["detail"]
+    assert "b" not in str([t for t in tripped[0]["detail"].split(",") if "'b'" in t])
+    # progress resets the clock
+    g.observe("a", snap(70, serves=5), t=2017.0)
+    assert g.evaluate(now=2018.0) == []
+    # a RESTARTED node's fresh (lower) counter is progress too — the
+    # process-global registry died with the old process, and freezing
+    # the clock until the new counter outgrows the old maximum would
+    # trip the gate on a node that is actively serving
+    reg3 = Registry()
+    cm3 = ConsensusMetrics(reg3)
+    cm3.last_block_age.mark()
+    P2PMetrics(reg3)
+    pm3 = ProofMetrics(reg3)
+    pm3.served.add(2, "proofs_batch", "cache")  # 2 << the pre-restart 15
+    g.observe("a", parse_exposition(reg3.gather()), t=2030.0)
+    assert g.evaluate(now=2035.0) == [], "restart counter reset read as a stall"
+    # a reset all the way to ZERO returns the node to never-served:
+    # idle-after-restart (clients still reconnecting) is not a stall,
+    # no matter how long it lasts
+    reg4 = Registry()
+    cm4 = ConsensusMetrics(reg4)
+    cm4.last_block_age.mark()
+    P2PMetrics(reg4)
+    ProofMetrics(reg4)  # served stays 0: fresh process, no serves yet
+    g.observe("a", parse_exposition(reg4.gather()), t=2040.0)
+    assert g.evaluate(now=2090.0) == [], "zero-reset restart read as a stall"
+
+
 def test_rolling_gates_churn_storm_trips():
     reg = Registry()
     cm = ConsensusMetrics(reg)
