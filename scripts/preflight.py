@@ -7,9 +7,12 @@ Chains, in order:
   2. metricsgen --check    docs/metrics.md byte-drift gate
   3. tmsoak --dry-run      the committed soak manifests parse, validate,
                            and core-gate for this box (nothing launches)
-  4. bench.py smoke        device-free perf smoke (~seconds) — records
+  4. tmsoak --dry-run      same, for the byzantine adversary manifest
+                           (byz-small.toml: roles parse, fault
+                           tolerance holds, timeline resolves)
+  5. bench.py smoke        device-free perf smoke (~seconds) — records
                            a fresh run into .bench_runs/ledger.jsonl
-  5. tmperf gate --check   noise-aware regression gate over the run
+  6. tmperf gate --check   noise-aware regression gate over the run
                            smoke just recorded, plus blessed-key
                            coverage drift
 
@@ -39,6 +42,8 @@ STAGES = (
     ("metricsgen", [sys.executable, "scripts/metricsgen.py", "--check"]),
     ("soak-dry", [sys.executable, "scripts/tmsoak.py", "--dry-run",
                   "e2e-manifests/soak-small.toml", "e2e-manifests/soak-large.toml"]),
+    ("byz-dry", [sys.executable, "scripts/tmsoak.py", "--dry-run",
+                 "e2e-manifests/byz-small.toml"]),
     ("smoke", [sys.executable, "bench.py", "smoke"]),
     ("perf-gate", [sys.executable, "scripts/tmperf.py", "gate", "--check"]),
 )

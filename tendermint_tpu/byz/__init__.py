@@ -1,0 +1,138 @@
+"""tmbyz — protocol-level Byzantine adversary roles (ISSUE 17).
+
+faultnet lies at the packet level (drops, delays, partitions) and
+tmsoak at the process level (kills, pauses, restarts); nothing there
+ever makes a node LIE at the protocol level, so the evidence plane
+(`evidence/pool.py`, `verify.py`, `reactor.py`), the light client's
+attack detection, and the tmproof gateway's refusal paths had never
+faced a live adversary. This package is that adversary: each role is a
+node-local behavior switch armed by `TM_TPU_BYZ=<role[,role...]>` in
+the node environment — the e2e runner sets it from the manifest's
+per-node `byzantine = "..."` key (docs/byzantine.md).
+
+Roles (module per attack surface):
+
+  double_sign        consensus.py  broadcast a second, conflicting
+                                   prevote per attacked height (raw-key
+                                   signed — FilePV's guard never sees it)
+  equivocate         consensus.py  sign + broadcast two distinct
+                                   proposals for the same (height, round)
+  header_forge       headers.py    serve forged data_hash/validators_hash
+                                   headers and index-substituted
+                                   multiproofs on light_batch/proofs_batch
+  statesync_corrupt  statesync.py  serve corrupted snapshot chunks and
+                                   forged snapshot manifests to peers
+
+Install happens in `cli.py cmd_start` (and `cmd_light` never installs —
+light nodes are targets, not adversaries) BEFORE the node-runtime
+imports, the same pre-import contract as lockcheck/racecheck: the roles
+monkeypatch class methods / module functions, so they must be in place
+before `node/node.py` binds them. Every attack event streams to
+`<home>/byz.jsonl`, where the e2e artifact sweep and tmlens's
+`byzantine` summary row find them.
+
+Adversary code is deliberately quarantined here: nothing under byz/ is
+imported unless TM_TPU_BYZ is set, and FilePV's own double-sign guard
+(journaled since ISSUE 17 — file_pv.py) cannot be weakened by it, only
+bypassed via signer.UnsafeSigner's raw key access.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class ByzRole:
+    """One armed adversary role writing events to <home>/byz.jsonl."""
+
+    name = "byz"
+
+    def __init__(self, home: str):
+        self.home = home
+        self.out_path = os.path.join(home, "byz.jsonl")
+        self._lock = threading.Lock()
+        self.events = 0
+
+    def install(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one attack event; best-effort (an adversary must not
+        crash its host node over a full disk)."""
+        doc = {"at": time.time(), "role": self.name, "kind": kind, **fields}
+        try:
+            with self._lock:
+                self.events += 1
+                with open(self.out_path, "a") as f:
+                    f.write(json.dumps(doc, separators=(",", ":")) + "\n")
+        except OSError:
+            pass
+
+
+def _registry() -> dict:
+    # imported lazily: arming a role pulls in its target modules
+    # (consensus/rpc/statesync), which must not load for honest nodes
+    from .consensus import DoubleSignRole, EquivocateRole
+    from .headers import HeaderForgeRole
+    from .statesync import StatesyncCorruptRole
+
+    return {
+        "double_sign": DoubleSignRole,
+        "equivocate": EquivocateRole,
+        "header_forge": HeaderForgeRole,
+        "statesync_corrupt": StatesyncCorruptRole,
+    }
+
+
+ROLE_NAMES = frozenset({"double_sign", "equivocate", "header_forge", "statesync_corrupt"})
+
+# roles that attack consensus itself (count against fault tolerance and
+# the small-box core gate in e2e/scenario.py); the rest lie only on
+# serving surfaces and are safe at any scale
+CONSENSUS_ROLES = frozenset({"double_sign", "equivocate"})
+
+# roles whose attack produces committable evidence on the honest side —
+# the lens `evidence_committed` gate expects >=1 committed item iff one
+# of these is armed anywhere in the fleet (gates.py)
+EVIDENCE_ROLES = frozenset({"double_sign"})
+
+
+def parse_roles(spec: str) -> list[str]:
+    """Validate a manifest/env role spec ('a,b') into role names."""
+    roles = [r.strip() for r in (spec or "").split(",") if r.strip()]
+    for r in roles:
+        if r not in ROLE_NAMES:
+            raise ValueError(
+                f"unknown byzantine role {r!r} (expected one of {sorted(ROLE_NAMES)})"
+            )
+    return roles
+
+
+class ByzHarness:
+    """The installed role set for this process (what cmd_start prints)."""
+
+    def __init__(self, home: str, roles: list[ByzRole]):
+        self.roles = roles
+        self.roles_str = ",".join(r.name for r in roles)
+        self.out_path = os.path.join(home, "byz.jsonl")
+
+
+def maybe_install(home: str) -> ByzHarness | None:
+    """Arm the roles named in TM_TPU_BYZ, or nothing (the common case).
+    Unknown role names raise — a typoed adversary silently running an
+    honest node would void the whole run's conclusions."""
+    spec = os.environ.get("TM_TPU_BYZ", "").strip()
+    if not spec:
+        return None
+    names = parse_roles(spec)
+    registry = _registry()
+    installed: list[ByzRole] = []
+    for name in names:
+        role = registry[name](home)
+        role.install()
+        role.record("armed")
+        installed.append(role)
+    return ByzHarness(home, installed)

@@ -1,0 +1,172 @@
+"""Consensus-plane adversaries: double-signer + equivocating proposer.
+
+Both roles wrap ConsensusState methods so the HONEST path runs first
+and unchanged — the node keeps its real vote/proposal, FilePV's guard
+state stays truthful — and the conflicting artifact is an extra,
+raw-key-signed message broadcast to peers only (never sent internally:
+the adversary node must not confuse itself, and `_try_add_vote`
+deliberately refuses to self-report its own conflicts — honest PEERS
+are the ones that must detect, verify, gossip, and commit the
+evidence).
+
+Attack cadence is bounded: a byz node that equivocates every height
+turns a soak into a liveness test of nothing but timeout escalation,
+drowning the signal (the evidence round-trip) in noise. A handful of
+conflicting artifacts is enough for the `evidence_committed` gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from . import ByzRole
+from .signer import UnsafeSigner
+
+
+def _sha(tag: str) -> bytes:
+    return hashlib.sha256(tag.encode()).digest()
+
+
+def _signer_for(cs) -> UnsafeSigner | None:
+    pv = cs.priv_validator
+    if pv is None or getattr(pv, "priv_key", None) is None:
+        return None  # remote signer: these roles need the raw key
+    return UnsafeSigner(pv)
+
+
+class DoubleSignRole(ByzRole):
+    """Broadcast a second, conflicting prevote for attacked heights.
+
+    The conflicting vote reuses every honest field (height, round,
+    validator address/index, timestamp) and swaps the BlockID for a
+    fabricated one, so honest peers' VoteSets raise ConflictingVoteError
+    → report_conflicting_votes → DuplicateVoteEvidence. Prevotes (not
+    precommits) keep the fault equivocation-shaped without risking a
+    conflicting commit on a starved box."""
+
+    name = "double_sign"
+
+    # attack heights h where h % PERIOD == OFFSET, at most MAX_EVENTS
+    PERIOD = 5
+    OFFSET = 2
+    MAX_EVENTS = 6
+
+    def install(self) -> None:
+        from ..consensus import state as cs_mod
+        from ..consensus.messages import VoteMessage
+        from ..types.block import BlockID, PartSetHeader
+        from ..types.vote import PREVOTE, Vote
+
+        role = self
+        orig = cs_mod.ConsensusState._sign_add_vote
+
+        def byz_sign_add_vote(cs, msg_type, hash_, header):
+            vote = orig(cs, msg_type, hash_, header)
+            if (
+                vote is None
+                or msg_type != PREVOTE
+                or vote.round != 0
+                or vote.block_id.is_nil()
+                or role.events > role.MAX_EVENTS
+                or vote.height % role.PERIOD != role.OFFSET
+            ):
+                return vote
+            signer = _signer_for(cs)
+            if signer is None:
+                return vote
+            fake = BlockID(
+                hash=_sha(f"tmbyz/double_sign/{vote.height}/{vote.round}"),
+                part_set_header=PartSetHeader(
+                    total=1, hash=_sha(f"tmbyz/psh/{vote.height}/{vote.round}")
+                ),
+            )
+            if fake.key() == vote.block_id.key():  # astronomically unlikely
+                return vote
+            vote2 = Vote(
+                type=vote.type,
+                height=vote.height,
+                round=vote.round,
+                block_id=fake,
+                timestamp=vote.timestamp,
+                validator_address=vote.validator_address,
+                validator_index=vote.validator_index,
+            )
+            try:
+                signer.sign_vote_unsafe(cs.state.chain_id, vote2)
+                cs.broadcast(VoteMessage(vote2))
+                role.record(
+                    "double_sign", height=vote.height, round=vote.round,
+                    block_a=vote.block_id.hash.hex()[:16], block_b=fake.hash.hex()[:16],
+                )
+            except Exception:  # noqa: BLE001 - adversary must not kill its host
+                pass
+            return vote
+
+        cs_mod.ConsensusState._sign_add_vote = byz_sign_add_vote
+
+
+class EquivocateRole(ByzRole):
+    """Sign and broadcast TWO distinct proposals for the same
+    (height, round) when this node is the proposer. The second block is
+    rebuilt with a later block time (different hash, different part
+    set) and signed with the raw key — FilePV would refuse the
+    conflicting STEP_PROPOSE signature outright. Honest peers keep
+    whichever proposal arrived first; the split resolves by round
+    escalation, so cadence is kept low."""
+
+    name = "equivocate"
+
+    PERIOD = 6
+    OFFSET = 3
+    MAX_EVENTS = 3
+
+    def install(self) -> None:
+        from ..consensus import state as cs_mod
+        from ..consensus.messages import BlockPartMessage, ProposalMessage
+        from ..types.block import BLOCK_PART_SIZE_BYTES, BlockID, Commit
+        from ..types.part_set import PartSet
+        from ..types.proposal import Proposal
+
+        role = self
+        orig = cs_mod.ConsensusState._decide_proposal
+
+        def byz_decide_proposal(cs, height, round_):
+            orig(cs, height, round_)
+            if role.events > role.MAX_EVENTS or height % role.PERIOD != role.OFFSET:
+                return
+            signer = _signer_for(cs)
+            if signer is None:
+                return
+            try:
+                rs = cs.rs
+                if height == cs.state.initial_height:
+                    commit = Commit(height=0)
+                elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+                    commit = rs.last_commit.make_commit()
+                else:
+                    return  # the honest path refused too — nothing to fork
+                # +1ms block time: a deterministic, visibly distinct block
+                block2 = cs.block_exec.create_proposal_block(
+                    height, cs.state, commit, cs.priv_pub_key.address(),
+                    block_time=cs.now().add(1_000_000),
+                )
+                parts2 = PartSet.from_data(block2.to_proto().encode(), BLOCK_PART_SIZE_BYTES)
+                proposal2 = Proposal(
+                    height=height,
+                    round=round_,
+                    pol_round=rs.valid_round,
+                    block_id=BlockID(hash=block2.hash(), part_set_header=parts2.header),
+                    timestamp=block2.header.time,
+                )
+                signer.sign_proposal_unsafe(cs.state.chain_id, proposal2)
+                cs.broadcast(ProposalMessage(proposal2))
+                for i in range(parts2.total()):
+                    cs.broadcast(BlockPartMessage(height, round_, parts2.get_part(i)))
+                role.record(
+                    "equivocate", height=height, round=round_,
+                    block_b=block2.hash().hex()[:16],
+                )
+            except Exception:  # noqa: BLE001 - adversary must not kill its host
+                pass
+
+        cs_mod.ConsensusState._decide_proposal = byz_decide_proposal

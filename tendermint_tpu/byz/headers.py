@@ -1,0 +1,103 @@
+"""Header-forging adversary: lunatic/amnesia headers + proof index
+substitution on the RPC serving surfaces a light client consumes.
+
+The role wraps `rpc.core.build_routes` so the node's own consensus
+stays fully honest (it signs and commits real blocks) while its RPC
+façade intermittently LIES to verifiers:
+
+  * light_batch — the signed header's `data_hash` (lunatic shape: a
+    header whose ABCI-derived fields don't match the chain) or
+    `validators_hash` (amnesia/wrong-valset shape) is replaced with a
+    forged digest. A bisecting light client recomputes the header hash
+    and finds it no longer matches the commit's block_id → verification
+    error; the light proxy records a divergence instead of relaying.
+  * proofs_batch — a validly-proven but DIFFERENT index set is served
+    (index substitution): the multiproof verifies against the real
+    data_hash, but covers txs the client never asked about. The light
+    proxy's `mp.indices == req_idxs` defense must refuse it.
+
+Forgery is delayed (the first GRACE calls per route are honest, so
+trust bootstrap and statesync trust fetches succeed) and intermittent
+(every PERIOD-th call after that), so targets keep making progress and
+the run shows BOTH verified heads and divergences. The verdict-plane
+routes the e2e harness itself trusts (status/block/commit/header used
+by waits, consistency checks, and evidence scans) are left honest —
+this adversary targets light verifiers, not the test harness.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from . import ByzRole
+
+
+def _forged_hex(tag: str) -> str:
+    return hashlib.sha256(tag.encode()).hexdigest().upper()
+
+
+class HeaderForgeRole(ByzRole):
+    name = "header_forge"
+
+    GRACE = 12   # honest calls per route before the first forgery
+    PERIOD = 3   # then forge every PERIOD-th call
+
+    def install(self) -> None:
+        import tendermint_tpu.rpc as rpc_pkg
+
+        from ..rpc import core as rpc_core
+
+        role = self
+        orig_build = rpc_core.build_routes
+        calls = {"light_batch": 0, "proofs_batch": 0}
+
+        def _attack(route: str) -> bool:
+            calls[route] += 1
+            n = calls[route]
+            return n > role.GRACE and n % role.PERIOD == 0
+
+        def byz_build_routes(env):
+            routes = orig_build(env)
+            honest_light_batch = routes.get("light_batch")
+            honest_proofs_batch = routes.get("proofs_batch")
+
+            def forged_light_batch(height=None, indices=None, **kw):
+                res = honest_light_batch(height=height, indices=indices, **kw)
+                if not _attack("light_batch"):
+                    return res
+                hdr = res.get("signed_header", {}).get("header")
+                if not hdr:
+                    return res
+                # alternate the two attack shapes the evidence plane
+                # distinguishes: lunatic (data_hash) / wrong valset
+                if calls["light_batch"] % (2 * role.PERIOD) == 0:
+                    field, tag = "validators_hash", "tmbyz/valset"
+                else:
+                    field, tag = "data_hash", "tmbyz/lunatic"
+                hdr[field] = _forged_hex(f"{tag}/{hdr.get('height')}")
+                role.record("forge_header", route="light_batch",
+                            height=int(hdr.get("height") or 0), field=field)
+                return res
+
+            def substituted_proofs_batch(height=None, indices=None, **kw):
+                res = honest_proofs_batch(height=height, indices=indices, **kw)
+                if not _attack("proofs_batch") or not isinstance(indices, (list, tuple)):
+                    return res
+                try:
+                    idxs = [int(i) for i in indices]
+                    subst = sorted({(i + 1) for i in idxs})
+                    forged = honest_proofs_batch(height=height, indices=subst, **kw)
+                except Exception:  # noqa: BLE001 - +1 may run off the tx count
+                    return res
+                role.record("substitute_indices", route="proofs_batch",
+                            asked=idxs, served=subst)
+                return forged
+
+            if honest_light_batch is not None:
+                routes["light_batch"] = forged_light_batch
+            if honest_proofs_batch is not None:
+                routes["proofs_batch"] = substituted_proofs_batch
+            return routes
+
+        rpc_core.build_routes = byz_build_routes
+        rpc_pkg.build_routes = byz_build_routes

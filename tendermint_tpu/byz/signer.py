@@ -1,0 +1,37 @@
+"""UnsafeSigner — the guard-bypassing privval wrapper (ISSUE 17).
+
+FilePV persists a last-sign-state (plus, since ISSUE 17, an append-only
+sign journal) and refuses conflicting same-HRS signatures; a real
+double-signer therefore cannot exist by accident. This wrapper is the
+deliberate construction: it reaches past the FilePV interface to the
+raw private key and signs WITHOUT consulting or advancing the guard.
+The honest signing path of the host node keeps using FilePV unchanged —
+the adversary's conflicting artifacts are EXTRA signatures layered on
+top, which is exactly the double-sign shape the evidence plane must
+detect and punish.
+"""
+
+from __future__ import annotations
+
+
+class UnsafeSigner:
+    """Raw-key signing over the same canonical sign-bytes FilePV uses.
+
+    Only FilePV (or anything exposing `.priv_key`) can back it: a remote
+    signer process holds its key out of reach, which is the deployment
+    answer to this very wrapper."""
+
+    def __init__(self, pv):
+        priv = getattr(pv, "priv_key", None)
+        if priv is None:
+            raise TypeError(
+                f"UnsafeSigner needs a key-bearing privval (FilePV), got {type(pv).__name__}"
+            )
+        self.priv_key = priv
+
+    def sign_vote_unsafe(self, chain_id: str, vote) -> None:
+        """Sign `vote` in place, skipping every double-sign check."""
+        vote.signature = self.priv_key.sign(vote.sign_bytes(chain_id))
+
+    def sign_proposal_unsafe(self, chain_id: str, proposal) -> None:
+        proposal.signature = self.priv_key.sign(proposal.sign_bytes(chain_id))

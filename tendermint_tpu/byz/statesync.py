@@ -1,0 +1,99 @@
+"""Malicious statesync provider: corrupted chunks + forged snapshot
+manifests served to restoring peers.
+
+The role wraps the app-facing serve calls inside
+`StateSyncReactor._recv_snapshot` / `_recv_chunk` (the node's OWN
+restore path, `syncer.py`, is untouched — this adversary lies to
+others, it does not wound itself):
+
+  * chunk responses have their payload bit-flipped, so the restoring
+    app's chunk-hash verification rejects them → the PR-14 hardening
+    must refetch (`chunk_retries{result="refetch"}`) and eventually
+    rotate away from this peer (`result="peer_rotated"`), completing
+    the restore from honest providers;
+  * snapshot manifests are re-advertised with a forged `hash`, so a
+    joiner that adopts the forged manifest can never verify a single
+    chunk against it and must abandon the snapshot and fall back to an
+    honestly-advertised one.
+
+Every corrupted response is an event in byz.jsonl, which is what the
+slow byz e2e test correlates with the joiner's retry counters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from . import ByzRole
+
+
+class StatesyncCorruptRole(ByzRole):
+    name = "statesync_corrupt"
+
+    MAX_EVENTS = 500  # plenty to poison a restore; bounds the artifact
+
+    def install(self) -> None:
+        from ..abci import types as abci
+        from ..statesync import reactor as ss_mod
+
+        role = self
+        orig_list = ss_mod.StateSyncReactor._recv_snapshot
+        orig_chunk = ss_mod.StateSyncReactor._recv_chunk
+
+        # corruption happens at the app boundary: the serve loops call
+        # `self.app.list_snapshots(...)` / `self.app.load_snapshot_chunk(...)`
+        # on the reactor's app handle, so wrapping the handle poisons
+        # every response without copying the loop bodies
+        class _LyingApp:
+            def __init__(self, app):
+                self._app = app
+
+            def __getattr__(self, name):
+                return getattr(self._app, name)
+
+            def list_snapshots(self, req):
+                res = self._app.list_snapshots(req)
+                forged = []
+                for s in res.snapshots:
+                    if role.events < role.MAX_EVENTS:
+                        fake_hash = hashlib.sha256(b"tmbyz/manifest/" + s.hash).digest()
+                        forged.append(abci.Snapshot(
+                            height=s.height, format=s.format, chunks=s.chunks,
+                            hash=fake_hash, metadata=s.metadata,
+                        ))
+                        role.record("forge_manifest", height=s.height,
+                                    chunks=s.chunks)
+                    else:
+                        forged.append(s)
+                res.snapshots = forged
+                return res
+
+            def load_snapshot_chunk(self, req):
+                res = self._app.load_snapshot_chunk(req)
+                if res.chunk and role.events < role.MAX_EVENTS:
+                    # flip the first 64 bytes: enough to fail any
+                    # content hash while keeping the size plausible
+                    head = bytes(b ^ 0xFF for b in res.chunk[:64])
+                    res.chunk = head + res.chunk[64:]
+                    role.record("corrupt_chunk", height=req.height,
+                                chunk=req.chunk)
+                return res
+
+        def _ensure_lying(reactor):
+            # both serve loops run concurrently; the isinstance check
+            # keeps a racing double-wrap (which would XOR chunks back
+            # to honest) impossible — worst case both threads wrap the
+            # same honest handle and one assignment wins
+            if not isinstance(reactor.app, _LyingApp):
+                reactor.app = _LyingApp(reactor.app)
+
+        def lying_recv_snapshot(reactor, ch):
+            _ensure_lying(reactor)
+            orig_list(reactor, ch)
+
+        def lying_recv_chunk(reactor, ch):
+            _ensure_lying(reactor)
+            orig_chunk(reactor, ch)
+
+        ss_mod.StateSyncReactor._recv_snapshot = lying_recv_snapshot
+        ss_mod.StateSyncReactor._recv_chunk = lying_recv_chunk

@@ -86,6 +86,29 @@ HOT_CLASSES = (
 _STATE_SLOT = "_tmrace_fields_"
 IGNORE_SLOT = "_tmrace_ignore_"
 
+# Writer identity. threading.get_ident() is the pthread id, and glibc
+# caches thread stacks: a thread created right after another was
+# join()ed routinely gets the dead thread's ident back. Two distinct
+# sequential writers would then collapse into one in shared_writers
+# and the race would be silently missed. Instead each live Thread
+# object is stamped once with a process-monotonic writer id; a Thread
+# object never represents two threads, so the id is never reused.
+_WID_SLOT = "_tmrace_wid"
+_wid_mu = _lockcheck._REAL_LOCK()
+_wid_next = 0
+
+
+def _writer_id() -> int:
+    t = threading.current_thread()
+    wid = getattr(t, _WID_SLOT, None)
+    if wid is None:
+        global _wid_next
+        with _wid_mu:
+            _wid_next += 1
+            wid = _wid_next
+        setattr(t, _WID_SLOT, wid)
+    return wid
+
 
 def enabled_in_env(env=None) -> bool:
     v = (env if env is not None else os.environ).get("TM_TPU_RACECHECK", "")
@@ -102,7 +125,7 @@ class _FieldState:
                  "reported")
 
     def __init__(self, owner: int):
-        self.owner = owner          # thread ident of the first writer
+        self.owner = owner          # writer id of the first writer
         self.candidate = None       # frozenset once SHARED, None while EXCLUSIVE
         self.shared_writers: set = set()
         # names captured at write time — a writer may be dead by the
@@ -189,7 +212,7 @@ class RaceCheck:
     def _on_write(self, obj, cls_name: str, field: str) -> None:
         self.counts["writes"] += 1  # benign int bump; exactness via GIL
         states = obj.__dict__.get(_STATE_SLOT)
-        tid = threading.get_ident()
+        tid = _writer_id()
         if states is None:
             with self._mu:
                 states = obj.__dict__.get(_STATE_SLOT)

@@ -118,6 +118,19 @@ def cmd_start(args) -> int:
     if racecheck is not None:
         print(f"racecheck sanitizer on -> {racecheck.out_path}")
 
+    # TM_TPU_BYZ=<role[,role...]> (the e2e runner sets it from the
+    # manifest's per-node `byzantine` key): arm protocol-level
+    # adversary roles (docs/byzantine.md). Same pre-import contract as
+    # the sanitizers above — the roles monkeypatch consensus/rpc/
+    # statesync classes, so they must land before node/node.py binds
+    # them. Events stream to <home>/byz.jsonl for the artifact sweep.
+    # Unset: imports nothing from byz/.
+    from .byz import maybe_install as maybe_install_byz
+
+    byz = maybe_install_byz(args.home)
+    if byz is not None:
+        print(f"byzantine role(s) armed: {byz.roles_str} -> {byz.out_path}")
+
     from .config import load_config
     from .lens.profiler import maybe_start_profiler
     from .node import Node
@@ -434,12 +447,54 @@ def cmd_light(args) -> int:
         print(f"verifying RPC proxy listening on http://{host}:{port}")
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    # tmbyz divergence report (--report): every primary response the
+    # light plane REFUSED — bisection/update errors here, proxy relay
+    # refusals from LightProxy.divergence_report() — lands in one JSON
+    # artifact the e2e sweep and tmlens can read (docs/byzantine.md).
+    from .light.client import LightClientError
+
+    report_path = getattr(args, "report", None)
+    verified_heads, update_errors, update_divergences, recent_errors = 0, 0, 0, []
+
+    def _write_report():
+        if not report_path:
+            return
+        proxy_rep = proxy.divergence_report() if proxy is not None else {}
+        doc = {
+            "verified_heads": verified_heads,
+            "update_errors": update_errors,
+            "update_divergences": update_divergences,
+            "recent_errors": recent_errors[-32:],
+            "proxy": proxy_rep,
+            # the headline number: refused primary responses across BOTH
+            # surfaces (update-loop bisection + proxy relays)
+            "divergences": update_divergences + int(proxy_rep.get("divergences", 0)),
+        }
+        try:
+            with open(report_path, "w") as f:
+                json.dump(doc, f, indent=1)
+        except OSError:
+            pass
+
     while not stop:
         try:
             head = client.update()
+            verified_heads += 1
             print(f"verified head {head.height} {head.signed_header.hash().hex().upper()[:16]}")
         except Exception as e:
+            update_errors += 1
+            recent_errors.append(str(e))
+            # a verification-shaped refusal means the primary LIED (a
+            # forged header failing validate_basic / commit checks); an
+            # IO error just means it is dead or restarting — only the
+            # former is a divergence
+            if isinstance(e, (ValueError, OverflowError, LightClientError)):
+                if proxy is not None:  # one ring for both surfaces
+                    proxy.record_divergence(f"update: {e}")
+                else:
+                    update_divergences += 1
             print(f"update error: {e}")
+        _write_report()
         time.sleep(args.interval)
     if proxy is not None:
         proxy.stop()
@@ -935,6 +990,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--interval", type=float, default=2.0)
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888",
                     help="serve a verifying RPC proxy here (ref: light/proxy)")
+    sp.add_argument("--report", default="",
+                    help="write a JSON divergence report here every update "
+                         "cycle (refused primary responses; docs/byzantine.md)")
     sp.set_defaults(fn=cmd_light)
 
     return p

@@ -276,6 +276,36 @@ def validate_generated(text: str) -> Manifest:
         n.mode in ("validator", "full") and n.start_at == 0 for n in m.nodes
     ):
         raise ValueError("light proxies need a genesis validator/full as primary")
+    # tmbyz invariants (docs/byzantine.md): roles must be spellable, sit
+    # on nodes that can actually mount them, and — for consensus-
+    # attacking roles — stay inside BFT fault tolerance, or the HONEST
+    # side of the run proves nothing
+    from ..byz import CONSENSUS_ROLES, parse_roles
+
+    byz_consensus_vals = 0
+    for n in m.nodes:
+        roles = parse_roles(n.byzantine)  # raises on unknown role names
+        if not roles:
+            continue
+        if n.mode not in ("validator", "full"):
+            raise ValueError(
+                f"{n.name}: byzantine roles need a consensus node (mode {n.mode!r})"
+            )
+        if n.start_at > 0:
+            raise ValueError(f"{n.name}: byzantine late joiners are not supported")
+        if CONSENSUS_ROLES & set(roles):
+            if n.mode != "validator":
+                raise ValueError(
+                    f"{n.name}: {sorted(CONSENSUS_ROLES & set(roles))} need a validator"
+                )
+            byz_consensus_vals += 1
+    genesis_vals = [n for n in m.validators if n.start_at == 0]
+    if byz_consensus_vals > max(0, (len(genesis_vals) - 1) // 3):
+        raise ValueError(
+            f"{byz_consensus_vals} consensus-attacking byzantine validator(s) exceed "
+            f"fault tolerance f={max(0, (len(genesis_vals) - 1) // 3)} "
+            f"for {len(genesis_vals)} genesis validators"
+        )
     for height, upd in m.validator_updates.items():
         for name in upd:
             if name not in names:

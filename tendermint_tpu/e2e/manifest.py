@@ -41,6 +41,11 @@ class NodeManifest:
     start_at: int = 0  # join later, at this height
     state_sync: bool = False  # late joiner restores an app snapshot first
     send_rate: int = 5_000_000  # p2p flow-control bytes/sec for tests
+    # tmbyz adversary role(s) for this node, comma-separated ("" =
+    # honest): double_sign | equivocate | header_forge |
+    # statesync_corrupt (byz/__init__.py ROLE_NAMES; docs/byzantine.md).
+    # The runner exports it as TM_TPU_BYZ to the node process.
+    byzantine: str = ""
 
 
 # perturbation kinds that require every link proxied through faultnet
@@ -183,6 +188,7 @@ class Manifest:
                     start_at=int(nd.get("start_at", 0)),
                     state_sync=bool(nd.get("state_sync", False)),
                     send_rate=int(nd.get("send_rate", NodeManifest.send_rate)),
+                    byzantine=str(nd.get("byzantine", "")),
                 )
             )
         if not m.nodes:

@@ -482,9 +482,17 @@ class Runner:
         """Point a late joiner at a live node's RPC for the light-client
         trust root so it restores an app snapshot instead of replaying
         from genesis (ref: runner/setup.go state-sync config)."""
-        source = next(
+        candidates = [
             n for n in self._rpc_nodes() if n is not node and n.height() > 0
-        )
+        ]
+        if not candidates:
+            raise RuntimeError(f"{node.m.name}: no live statesync trust source")
+        # the trust root must come from an HONEST node: chunk traffic is
+        # p2p (a statesync_corrupt provider gets rotated away by the
+        # joiner's own hardening, which is the point of the byz run),
+        # but a poisoned trust HASH would wedge the restore before the
+        # hardening ever gets a say
+        source = next((n for n in candidates if not n.m.byzantine), candidates[0])
         # trust root: the source's CURRENT HEAD. Genesis is the obvious
         # choice but a retain_blocks provider prunes it away — and any
         # fixed low height races the advancing prune window between
@@ -576,6 +584,10 @@ class Runner:
                 raise TimeoutError(f"{node.m.name}: ABCI app never came up")
         log_f = open(os.path.join(node.home, "node.log"), "ab")
         node_env = self._env()
+        if node.m.byzantine:
+            # arms tendermint_tpu.byz.maybe_install inside cmd_start,
+            # before the node binds the classes the roles monkeypatch
+            node_env["TM_TPU_BYZ"] = node.m.byzantine
         if node.m.abci_protocol == "builtin" and self._delays_env():
             # builtin apps are constructed inside the node process
             # (node/node.py _make_app) — same env contract as the
@@ -593,8 +605,14 @@ class Runner:
         """Spawn the verifying light proxy (`tendermint_tpu light`)
         against the first live consensus node; its rpc_port serves the
         proxied, light-verified RPC surface."""
+        live = [n for n in self._rpc_nodes() if n is not node and n.height() > 0]
+        # a header-forging adversary is the PREFERRED primary: the whole
+        # point of running a light proxy next to one is watching the
+        # proxy refuse its forged light_batch headers and log them into
+        # the divergence report
         primary = next(
-            (n for n in self._rpc_nodes() if n is not node and n.height() > 0), None
+            (n for n in live if "header_forge" in n.m.byzantine),
+            live[0] if live else None,
         )
         if primary is None:
             raise RuntimeError(f"{node.m.name}: no live primary for the light proxy")
@@ -603,7 +621,8 @@ class Runner:
             [sys.executable, "-m", "tendermint_tpu", "light",
              self.manifest.chain_id, primary.rpc_url,
              "--laddr", f"tcp://127.0.0.1:{node.rpc_port}",
-             "--interval", "1.0"],
+             "--interval", "1.0",
+             "--report", os.path.join(node.home, "light_divergence.json")],
             env=self._env(),
             stdout=log_f,
             stderr=subprocess.STDOUT,
@@ -1410,7 +1429,19 @@ class Runner:
                     heads = sum(1 for line in f if line.startswith("verified head"))
             except OSError:
                 pass
-            out["light"].append({"node": node.m.name, "verified_heads": heads})
+            row = {"node": node.m.name, "verified_heads": heads}
+            # the cmd_light --report file: proxy divergences (refused
+            # forged headers / substituted proofs) + update errors —
+            # the byz acceptance surface for header_forge runs
+            try:
+                with open(os.path.join(node.home, "light_divergence.json")) as f:
+                    rep = json.load(f)
+                row["divergences"] = int(rep.get(
+                    "divergences", rep.get("proxy", {}).get("divergences", 0)))
+                row["update_errors"] = int(rep.get("update_errors", 0))
+            except (OSError, ValueError):
+                pass
+            out["light"].append(row)
         return out
 
     # ------------------------------------------------------------------ wait

@@ -253,6 +253,20 @@ def resolve_for_cores(
                     f"{n.name}: dropped {dropped} ({cores} cores < "
                     f"{FULL_MIX_CORES}: kill/pause/restart only)"
                 )
+        # equivocate is the byz role with a round-escalation surface
+        # (two proposals -> split prevotes -> timeout escalation every
+        # attack height) — the same saturation the kill/pause-only rule
+        # exists to avoid. The other roles (double_sign forges one
+        # extra vote; header_forge/statesync_corrupt never touch
+        # consensus) stay armed on any box.
+        for n in m.nodes:
+            roles = [r.strip() for r in n.byzantine.split(",") if r.strip()]
+            if "equivocate" in roles:
+                n.byzantine = ",".join(r for r in roles if r != "equivocate")
+                notes.append(
+                    f"{n.name}: dropped byzantine role 'equivocate' "
+                    f"({cores} cores < {FULL_MIX_CORES}: round-escalation surface)"
+                )
         # ...and the storm-kind timeline events (churn is a disconnect
         # wave — same dial-storm surface)
         kept_events = []
@@ -305,14 +319,24 @@ def _clamp_nodes(m: Manifest, cap: int, notes: list[str], cores: int) -> Manifes
     # halts outright during every rolling-restart step (2/4 < 2/3+,
     # seen live), so the cap must hold 4 genesis validators.
     ss_late = [n for n in late_all if n.state_sync]
+    # a light observer likewise rides ONE slot above the cap when a
+    # header_forge adversary is aboard: the forger only proves anything
+    # against a light verifier consuming its light_batch route, the
+    # proxy is a mostly-idle process, and silently clamping it away
+    # would turn the byz run's divergence evidence into a no-op
+    forge_aboard = any(
+        "header_forge" in n.byzantine for n in genesis_vals[:cap]
+    )
+    byz_light = [n for n in rest if n.mode == "light"][:1] if forge_aboard else []
     ordered = (
         genesis_vals[:cap]
         + ss_late[:1]
+        + byz_light
         + [n for n in genesis_vals if n not in genesis_vals[:cap]]
         + [n for n in late_all if n not in ss_late[:1]]
-        + rest
+        + [n for n in rest if n not in byz_light]
     )
-    keep = ordered[: cap + (1 if ss_late else 0)]
+    keep = ordered[: cap + (1 if ss_late else 0) + (1 if byz_light else 0)]
 
     # quorum: with v validators kept, at most (v-1)//3 may start late
     vals = [n for n in keep if n.mode == "validator"]
@@ -373,6 +397,8 @@ def render_resolution(manifest: Manifest, timeline: SoakTimeline,
             bits.append(f"start_at={n.start_at}" + ("+statesync" if n.state_sync else ""))
         if n.perturb:
             bits.append(f"perturb={n.perturb}")
+        if n.byzantine:
+            bits.append(f"byz={n.byzantine}")
         lines.append(f"  node {n.name}: {' '.join(bits)}")
     actions = timeline.resolve(manifest)
     if actions:

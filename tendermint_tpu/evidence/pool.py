@@ -30,6 +30,16 @@ def _key(prefix: bytes, ev) -> bytes:
     return prefix + ev.height.to_bytes(8, "big") + ev.hash()
 
 
+def _ev_type(ev) -> str:
+    """Label value for evidence_total/evidence_pending — the two proto
+    oneof arms, or the class name for anything foreign."""
+    name = type(ev).__name__
+    return {
+        "DuplicateVoteEvidence": "duplicate_vote",
+        "LightClientAttackEvidence": "light_client_attack",
+    }.get(name, name)
+
+
 class EvidencePool:
     """ref: evidence.Pool (pool.go:42)."""
 
@@ -74,14 +84,20 @@ class EvidencePool:
             if h in self._pending or self._is_committed(ev):
                 return  # idempotent
             try:
-                verify_evidence(ev, self._state, self.state_store, self.block_store)
+                verify_evidence(ev, self._state, self.state_store,
+                                self.block_store, metrics=self.metrics)
             except EvidenceABCIError as e:
                 # Structurally valid but the ABCI component is wrong:
                 # regenerate it, store the rectified evidence, and still
                 # reject the original (ref: verify.go:76-81,:136-142).
+                self._count_outcome(ev, "rejected")
                 e.regenerate()
                 self._add_pending(ev)
                 raise
+            except EvidenceVerifyError:
+                self._count_outcome(ev, "rejected")
+                raise
+            self._count_outcome(ev, "verified")
             self._add_pending(ev)
 
     def report_conflicting_votes(self, vote_a, vote_b) -> None:
@@ -105,9 +121,12 @@ class EvidencePool:
                     raise EvidenceError("evidence was already committed")
                 if h not in self._pending:
                     try:
-                        verify_evidence(ev, self._state, self.state_store, self.block_store)
+                        verify_evidence(ev, self._state, self.state_store,
+                                        self.block_store, metrics=self.metrics)
                     except EvidenceVerifyError as e:
+                        self._count_outcome(ev, "rejected")
                         raise EvidenceError(str(e))
+                    self._count_outcome(ev, "verified")
                     self._add_pending(ev)
 
     def update(self, state, ev_list: list) -> None:
@@ -123,12 +142,12 @@ class EvidencePool:
             self._state = state
             for ev in ev_list:
                 self._mark_committed(ev)
+                self._count_outcome(ev, "committed")
             if ev_list and self.metrics is not None:
                 self.metrics.committed.add(len(ev_list))
             self._process_consensus_buffer(state)
             self._prune_expired()
-            if self.metrics is not None:
-                self.metrics.num_evidence.set(len(self._pending))
+            self._set_pending_gauges()
 
     # ------------------------------------------------------------ internals
 
@@ -142,8 +161,21 @@ class EvidencePool:
     def _add_pending(self, ev) -> None:
         self._pending[ev.hash()] = ev
         self.db.set(_key(_PENDING_PREFIX, ev), evidence_to_proto(ev).encode())
+        self._set_pending_gauges()
+
+    def _count_outcome(self, ev, outcome: str) -> None:
         if self.metrics is not None:
-            self.metrics.num_evidence.set(len(self._pending))
+            self.metrics.total.add(1, _ev_type(ev), outcome)
+
+    def _set_pending_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.num_evidence.set(len(self._pending))
+        counts = {"duplicate_vote": 0, "light_client_attack": 0}
+        for ev in self._pending.values():
+            counts[_ev_type(ev)] = counts.get(_ev_type(ev), 0) + 1
+        for t, n in counts.items():
+            self.metrics.pending.set(n, t)
 
     def _mark_committed(self, ev) -> None:
         h = ev.hash()
@@ -183,3 +215,4 @@ class EvidencePool:
             if expired_height and expired_time:
                 self._pending.pop(h, None)
                 self.db.delete(_key(_PENDING_PREFIX, ev))
+                self._count_outcome(ev, "expired")

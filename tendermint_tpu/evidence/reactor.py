@@ -84,6 +84,8 @@ class EvidenceReactor:
                         continue
                     if self.channel.send_to(nid, ev, timeout=1.0):
                         sent.add(h)
+                        if self.pool.metrics is not None:
+                            self.pool.metrics.gossiped.add(1)
             self._stop.wait(self.BROADCAST_INTERVAL)
 
     def _recv_loop(self) -> None:

@@ -19,6 +19,7 @@ from ..types.validation import (
     verify_commit_light,
     verify_commit_light_trusting,
 )
+from ..types.validator_set import NotEnoughVotingPowerError
 
 
 class EvidenceVerifyError(Exception):
@@ -36,7 +37,7 @@ class EvidenceABCIError(EvidenceVerifyError):
         self.regenerate = regenerate  # () -> None, fixes ev in place
 
 
-def verify_evidence(ev, state, state_store, block_store) -> None:
+def verify_evidence(ev, state, state_store, block_store, metrics=None) -> None:
     """Full contextual verification (ref: verify.go:34 verify).
 
     Runs the evidence's stateless ValidateBasic FIRST — the reference's
@@ -48,7 +49,23 @@ def verify_evidence(ev, state, state_store, block_store) -> None:
     those verify against commit.block_id. Then checks age (both height
     AND time window must be exceeded for expiry, verify.go:59) and
     dispatches by type.
+
+    `metrics` (an EvidenceMetrics, optional) gets the wall-clock latency
+    of the whole check — refusals included, since an adversary feeding
+    the pool forged evidence shows up as verify TIME, not just outcome
+    counts (the tmbyz harness watches both).
     """
+    import time as _time
+
+    t0 = _time.perf_counter()
+    try:
+        _verify_evidence(ev, state, state_store, block_store)
+    finally:
+        if metrics is not None:
+            metrics.verify_seconds.observe(_time.perf_counter() - t0)
+
+
+def _verify_evidence(ev, state, state_store, block_store) -> None:
     try:
         ev.validate_basic()
     except ValueError as e:
@@ -205,30 +222,39 @@ def verify_light_client_attack(
 ) -> None:
     """ref: verify.go:115 VerifyLightClientAttack."""
     sh = ev.conflicting_block.signed_header
-    # Lunatic attack: conflicting header descends from an earlier common
-    # header → a third of the COMMON val set must have signed (:160-166)
-    if common_header is not None and common_header.height != sh.header.height:
-        verify_commit_light_trusting(
-            chain_id,
-            common_vals,
-            sh.commit,
-            Fraction(1, 3),
-        )
-    else:
-        # Equivocation/amnesia: same height → conflicting validator set
-        # hash must match the trusted one (:142-150)
-        if sh.header.validators_hash != trusted_header.validators_hash:
-            raise EvidenceVerifyError(
-                f"validator hash of conflicting block ({sh.header.validators_hash.hex()}) "
-                f"does not match trusted ({trusted_header.validators_hash.hex()})"
+    # Commit-check failures (forged signatures, short power, wrong chain
+    # id) surface as the evidence plane's OWN error type: every consumer
+    # of this path — the pool, the reactor's gossip recv loop — catches
+    # EvidenceVerifyError, and a raw ValueError from the validation
+    # plane would escape those handlers.
+    try:
+        # Lunatic attack: conflicting header descends from an earlier
+        # common header → a third of the COMMON val set must have
+        # signed (:160-166)
+        if common_header is not None and common_header.height != sh.header.height:
+            verify_commit_light_trusting(
+                chain_id,
+                common_vals,
+                sh.commit,
+                Fraction(1, 3),
             )
-        verify_commit_light(
-            chain_id,
-            ev.conflicting_block.validator_set,
-            sh.commit.block_id,
-            sh.header.height,
-            sh.commit,
-        )
+        else:
+            # Equivocation/amnesia: same height → conflicting validator
+            # set hash must match the trusted one (:142-150)
+            if sh.header.validators_hash != trusted_header.validators_hash:
+                raise EvidenceVerifyError(
+                    f"validator hash of conflicting block ({sh.header.validators_hash.hex()}) "
+                    f"does not match trusted ({trusted_header.validators_hash.hex()})"
+                )
+            verify_commit_light(
+                chain_id,
+                ev.conflicting_block.validator_set,
+                sh.commit.block_id,
+                sh.header.height,
+                sh.commit,
+            )
+    except (ValueError, OverflowError, NotEnoughVotingPowerError) as e:
+        raise EvidenceVerifyError(f"verifying conflicting commit: {e}") from e
 
     # Forward lunatic: a conflicting block past our head must VIOLATE
     # monotonically increasing time to be an attack (ref: verify.go:183);

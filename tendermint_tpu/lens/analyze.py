@@ -65,7 +65,7 @@ def discover_nodes(run_dir: str) -> list[tuple[str, str]]:
             os.path.exists(os.path.join(d, f))
             for f in ("metrics.txt", "trace.json", "profile.collapsed",
                       "timeseries.jsonl", "lockcheck.jsonl",
-                      "racecheck.jsonl")
+                      "racecheck.jsonl", "byz.jsonl")
         ):
             out.append((entry, d))
     return out
@@ -283,6 +283,28 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
                     for ev in ("hit", "miss", "evict")
                 },
             }
+        # tmbyz evidence plane (docs/byzantine.md): the outcome-labelled
+        # totals are what the evidence_committed gate judges; the block
+        # only appears when the node actually saw evidence traffic
+        ev_samples = list(exp.samples(f"{NS}_evidence_total"))
+        ev_gossiped = exp.total(f"{NS}_evidence_gossiped_total")
+        ev_pending = exp.value(f"{NS}_evidence_pool_num_evidence")
+        if ev_samples or ev_gossiped or ev_pending:
+            outcomes: dict = {}
+            committed_by_type: dict = {}
+            for labels, v in ev_samples:
+                t = labels.get("evidence_type", "?")
+                o = labels.get("outcome", "?")
+                outcomes[o] = outcomes.get(o, 0) + int(v)
+                if o == "committed":
+                    committed_by_type[t] = committed_by_type.get(t, 0) + int(v)
+            summary["evidence"] = {
+                "pending": int(ev_pending or 0),
+                "outcomes": outcomes,
+                "committed_by_type": committed_by_type,
+                "gossiped": int(ev_gossiped or 0),
+                "verify": _hist_stats(exp, f"{NS}_evidence_verify_seconds"),
+            }
         peers = exp.value(f"{NS}_p2p_peers")
         connects = exp.total(f"{NS}_p2p_peer_connections_total")
         summary["p2p"] = {
@@ -327,6 +349,38 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
             # report (same breadth as the timeline path above)
             summary["lockcheck"] = None
             summary["lockcheck_error"] = f"{type(e).__name__}: {e}"
+
+    # tmbyz adversary journal (byz/__init__.py ByzRole.record): which
+    # roles this node ran and how often each fired. The
+    # evidence_committed gate derives its EXPECTATION from this block —
+    # an armed evidence-producing role obligates the honest side to
+    # commit the evidence.
+    bpath = os.path.join(node_dir, "byz.jsonl")
+    if os.path.exists(bpath):
+        summary["artifacts"].append("byz.jsonl")
+        try:
+            roles: dict = {}
+            with open(bpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail (SIGKILL mid-append)
+                    if isinstance(rec, dict) and rec.get("role"):
+                        roles.setdefault(rec["role"], 0)
+                        if rec.get("kind") != "armed":
+                            roles[rec["role"]] += 1
+            summary["byzantine"] = {
+                "roles": sorted(roles),
+                "events": sum(roles.values()),
+                "events_by_role": roles,
+            }
+        except OSError as e:
+            summary["byzantine"] = None
+            summary["byzantine_error"] = f"{type(e).__name__}: {e}"
 
     # racecheck sanitizer stream (TM_TPU_RACECHECK=1 nodes,
     # check/racecheck.py): the shared_state_race gate reads this
@@ -492,6 +546,26 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
             ),
         }
 
+    # tmbyz fleet digest: which adversaries were armed + the honest
+    # side's aggregate evidence outcomes (the round-trip at a glance)
+    byz = [(s["name"], s["byzantine"]) for s in summaries if s.get("byzantine")]
+    if byz:
+        fleet["byzantine_nodes"] = [
+            {"node": n, "roles": b.get("roles"), "events": b.get("events")}
+            for n, b in byz
+        ]
+    evs = [s["evidence"] for s in summaries if s.get("evidence")]
+    if evs:
+        committed: dict = {}
+        for ev in evs:
+            for t, n in (ev.get("committed_by_type") or {}).items():
+                committed[t] = committed.get(t, 0) + n
+        fleet["evidence"] = {
+            "committed_by_type": committed,
+            "pending": sum(ev.get("pending") or 0 for ev in evs),
+            "gossiped": sum(ev.get("gossiped") or 0 for ev in evs),
+        }
+
     # tmpath fleet digest: where the time went, fleet-wide
     from .journey import fleet_critical_path
 
@@ -644,6 +718,19 @@ def render_summary(report: dict) -> str:
                 f"    racecheck: {len(rc['races'])} shared-state races, "
                 f"{rc.get('fields')} fields / {rc.get('writes')} writes "
                 f"tracked, overhead est {rc.get('overhead_s_est')}s"
+            )
+        bz = s.get("byzantine")
+        if bz:
+            lines.append(
+                f"    byzantine: roles={','.join(bz.get('roles') or [])} "
+                f"({bz.get('events')} adversarial events)"
+            )
+        ev = s.get("evidence")
+        if ev:
+            lines.append(
+                f"    evidence: committed={ev.get('committed_by_type') or {}} "
+                f"outcomes={ev.get('outcomes') or {}} pending={ev.get('pending')} "
+                f"gossiped={ev.get('gossiped')}"
             )
         cp = (s.get("critical_path") or {}).get("totals")
         if cp and cp.get("heights"):

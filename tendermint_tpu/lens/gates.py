@@ -56,6 +56,15 @@ test assertions):
                      over `proof_serve_p99_budget_s`; vacuous pass when
                      no node served proofs (absence of traffic is not
                      evidence of failure)
+  evidence_committed a run with an evidence-PRODUCING byzantine role
+                     armed (byz.jsonl roles intersecting
+                     byz.EVIDENCE_ROLES, or `expect_evidence` forced
+                     on) must show >=1 evidence item of
+                     `expect_evidence_type` COMMITTED somewhere in the
+                     fleet (tendermint_evidence_total{outcome=
+                     "committed"}) — the full detect → verify → gossip
+                     → commit round-trip, not just detection; vacuous
+                     pass for honest runs (docs/byzantine.md)
   perf_regression    the run dir's perf ledger (ledger.jsonl,
                      tendermint_tpu/perf/) shows the latest run's
                      median for some stage below its blessed baseline
@@ -125,6 +134,14 @@ DEFAULT_GATES = {
     # fields belong in the class's _tmrace_ignore_ declaration, not in
     # a raised allowance
     "max_shared_state_races": 0,
+    # tmbyz: force the evidence_committed gate to EXPECT committed
+    # evidence even without a byz.jsonl artifact naming an
+    # evidence-producing role (a run that injected evidence by hand);
+    # normally the expectation is derived from the armed roles
+    "expect_evidence": False,
+    # evidence type the byz run is expected to commit
+    # (duplicate_vote | light_client_attack)
+    "expect_evidence_type": "duplicate_vote",
     # tmperf compare thresholds (perf/compare.py COMPARE_DEFAULTS —
     # the values here are the verdict plane's own defaults and may be
     # overridden per run like any gate): fewer samples than
@@ -135,6 +152,13 @@ DEFAULT_GATES = {
     "perf_noise_mads": 5.0,
     "perf_min_rel_delta": 0.10,
 }
+
+
+# Mirror of byz.EVIDENCE_ROLES — the roles whose attack must end in
+# committed evidence. The lens plane is import-isolated from
+# node-runtime packages (byz included), so the set is duplicated here
+# and pinned against drift by tests/test_byz.py.
+EVIDENCE_ROLES = frozenset({"double_sign"})
 
 
 def _gate(name: str, ok: bool, detail: str) -> dict:
@@ -395,6 +419,42 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
         gates.append(_gate(
             "shared_state_race", total <= cfg["max_shared_state_races"],
             detail,
+        ))
+
+    # evidence_committed (tmbyz): when an evidence-producing adversary
+    # was armed, detection alone is not enough — the round-trip has to
+    # END with committed evidence, or the pipeline silently dropped it
+    # somewhere between detect / verify / gossip / propose.
+    byz_nodes = [
+        (s["name"], (s["byzantine"].get("roles") or []))
+        for s in nodes if s.get("byzantine")
+    ]
+    expect_ev = bool(cfg["expect_evidence"]) or any(
+        EVIDENCE_ROLES & set(roles) for _n, roles in byz_nodes
+    )
+    etype = cfg["expect_evidence_type"]
+    committed_by_node = {}
+    for s in nodes:
+        ev = s.get("evidence") or {}
+        n = (ev.get("committed_by_type") or {}).get(etype, 0)
+        if n:
+            committed_by_node[s["name"]] = int(n)
+    if not expect_ev:
+        gates.append(_gate(
+            "evidence_committed", True,
+            f"no evidence-producing byz role armed; committed evidence "
+            f"observed anyway: {committed_by_node}"
+            if committed_by_node
+            else "no evidence-producing byzantine role armed (vacuous pass)",
+        ))
+    else:
+        total_committed = sum(committed_by_node.values())
+        armed = {n: sorted(r) for n, r in byz_nodes} or "expect_evidence forced"
+        gates.append(_gate(
+            "evidence_committed",
+            total_committed >= 1,
+            f"{total_committed} {etype} evidence item(s) committed "
+            f"across {committed_by_node or 'NO node'} (byz: {armed})",
         ))
 
     # perf_regression (tmperf ledger in the run dir; vacuous pass when

@@ -58,10 +58,19 @@ def _header_from_json(d: dict) -> Header:
 class LightProxy:
     """ref: light/proxy/proxy.go Proxy."""
 
+    # divergence-report ring bound: enough to show the attack shape
+    # without an adversary growing proxy memory without limit
+    MAX_DIVERGENCES = 256
+
     def __init__(self, client, primary_addr: str, host: str = "127.0.0.1", port: int = 0, logger=None):
         self.client = client  # LightClient
         self.primary = HTTPClient(primary_addr)
         self.logger = logger or new_logger("light-proxy")
+        # every refused relay, newest last: [{"at": unix_s, "msg": ...}]
+        # — the tmbyz divergence report (docs/byzantine.md); a forged
+        # header from the primary must land HERE, never in a response
+        self.divergences: list[dict] = []
+        self.divergence_count = 0
         self.server = JSONRPCServer(self._routes(), host=host, port=port)
 
     # ------------------------------------------------------------ lifecycle
@@ -85,9 +94,34 @@ class LightProxy:
         return lb
 
     @staticmethod
-    def _require(cond: bool, msg: str) -> None:
+    def _check_input(cond: bool, msg: str) -> None:
+        """Client-input validation — a caller mistake, not a primary
+        divergence (kept out of the divergence report)."""
         if not cond:
             raise RPCError(-32603, f"light proxy verification failed: {msg}")
+
+    def record_divergence(self, msg: str) -> None:
+        """One refused primary response. Also the entry point for the
+        host's update loop (cli.py cmd_light): a forged header caught
+        by bisection verification is the same attack surface as a
+        forged relay, and belongs in the same report."""
+        self.divergence_count += 1
+        self.divergences.append({"at": _time.time(), "msg": msg})
+        del self.divergences[: -self.MAX_DIVERGENCES]
+        self.logger.error(f"divergence: {msg}")
+
+    def _require(self, cond: bool, msg: str) -> None:
+        if not cond:
+            self.record_divergence(msg)
+            raise RPCError(-32603, f"light proxy verification failed: {msg}")
+
+    def divergence_report(self) -> dict:
+        """The proxy's half of the tmbyz divergence report: refusals it
+        issued instead of relaying unverifiable primary responses."""
+        return {
+            "divergences": self.divergence_count,
+            "recent": list(self.divergences),
+        }
 
     # ------------------------------------------------------------ routes
 
@@ -101,7 +135,7 @@ class LightProxy:
             return res
 
         def block(height=None):
-            self._require(height is not None, "light proxy requires an explicit height")
+            self._check_input(height is not None, "light proxy requires an explicit height")
             res = self.primary.call("block", height=str(height))
             lb = self._verified_header(int(height))
             want = lb.signed_header.hash()
@@ -133,7 +167,7 @@ class LightProxy:
             would hand back attacker-controlled signatures
             (ref: light/rpc/client.go Commit serves the trusted copy for
             verified heights)."""
-            self._require(height is not None, "light proxy requires an explicit height")
+            self._check_input(height is not None, "light proxy requires an explicit height")
             lb = self._verified_header(int(height))
             sh = lb.signed_header
             from ..rpc.core import commit_to_json, header_to_json
@@ -147,7 +181,7 @@ class LightProxy:
             }
 
         def header(height=None):
-            self._require(height is not None, "light proxy requires an explicit height")
+            self._check_input(height is not None, "light proxy requires an explicit height")
             lb = self._verified_header(int(height))
             h = lb.signed_header.header
             return {
@@ -215,7 +249,7 @@ class LightProxy:
             (tmproof gateway behind the verified-header store)."""
             from ..metrics import proof_metrics
 
-            self._require(height is not None, "light proxy requires an explicit height")
+            self._check_input(height is not None, "light proxy requires an explicit height")
             t0 = _time.perf_counter()
             res = _relay_verified_proofs(height, indices, "proofs_batch")
             proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "proofs_batch")
@@ -230,7 +264,7 @@ class LightProxy:
             cannot verify)."""
             from ..rpc.core import commit_to_json, header_to_json, validator_to_json
 
-            self._require(height is not None, "light proxy requires an explicit height")
+            self._check_input(height is not None, "light proxy requires an explicit height")
             t0 = _time.perf_counter()
             h = int(height)
             head = None
@@ -262,7 +296,7 @@ class LightProxy:
             return out
 
         def validators(height=None):
-            self._require(height is not None, "light proxy requires an explicit height")
+            self._check_input(height is not None, "light proxy requires an explicit height")
             lb = self._verified_header(int(height))
             vs = lb.validator_set
             return {

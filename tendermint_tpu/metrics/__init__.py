@@ -558,12 +558,33 @@ class StateSyncMetrics:
 
 
 class EvidenceMetrics:
-    """ref: internal/evidence/metrics.go."""
+    """ref: internal/evidence/metrics.go (num_evidence/committed are the
+    reference pair; the rest is the tmbyz adversary-plane extension —
+    the byz harness judges the honest evidence round-trip off these)."""
 
     def __init__(self, reg: Registry):
         ns = f"{NAMESPACE}_evidence"
         self.num_evidence = reg.gauge(f"{ns}_pool_num_evidence", "Pending evidence")
         self.committed = reg.counter(f"{ns}_committed", "Evidence committed in blocks")
+        self.pending = reg.gauge(
+            f"{ns}_pending",
+            "Pending evidence items in the pool by type",
+            labels=("evidence_type",),
+        )
+        self.total = reg.counter(
+            f"{ns}_total",
+            "Evidence observed by the pool, by type and outcome "
+            "(verified / rejected / committed / expired)",
+            labels=("evidence_type", "outcome"),
+        )
+        self.verify_seconds = reg.histogram(
+            f"{ns}_verify_seconds",
+            "Full contextual evidence verification latency",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+        )
+        self.gossiped = reg.counter(
+            f"{ns}_gossiped_total", "Evidence items sent to peers by the reactor"
+        )
 
 
 class StateMetrics:

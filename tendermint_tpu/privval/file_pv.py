@@ -74,17 +74,66 @@ class LastSignState:
                     raise DoubleSignError("no SignBytes found")
         return False
 
-    def save(self) -> None:
-        if not self.file_path:
-            return
-        doc = {
+    # journal compaction threshold: one line per signed step, rewritten
+    # down to the single latest record once it grows past this
+    _JOURNAL_MAX_LINES = 512
+
+    def _doc(self) -> dict:
+        return {
             "height": str(self.height),
             "round": self.round,
             "step": self.step,
             "signature": self.signature.hex(),
             "signbytes": self.sign_bytes.hex(),
         }
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        doc = self._doc()
         _atomic_write(self.file_path, json.dumps(doc, indent=2).encode())
+        self._journal_append(doc)
+
+    def _journal_append(self, doc: dict) -> None:
+        """Defense against last-sign-state rollback: the state file is a
+        single atomically-replaced snapshot, so an operator (or a crash-
+        looping supervisor restoring from backup) replaying a STALE copy
+        silently lowers the double-sign guard — check_hrs sees an older
+        height and hands out a fresh conflicting signature. The journal
+        is append-only; `load` adopts its tail whenever the tail is
+        ahead of the snapshot, so only deleting BOTH files (or the tmbyz
+        UnsafeSigner, which skips FilePV entirely) can double-sign."""
+        path = self.file_path + ".journal"
+        line = json.dumps(doc, separators=(",", ":")) + "\n"
+        with open(path, "a") as f:
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            with open(path) as f:
+                n = sum(1 for _ in f)
+        except OSError:
+            return
+        if n > self._JOURNAL_MAX_LINES:
+            _atomic_write(path, line.encode())
+
+    @staticmethod
+    def _journal_tail(path: str) -> dict | None:
+        """Last parseable journal record (a torn final line — crash mid
+        append — falls back to the previous one)."""
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return None
+        for raw in reversed(lines):
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                return doc
+        return None
 
     @classmethod
     def load(cls, path: str) -> "LastSignState":
@@ -92,6 +141,12 @@ class LastSignState:
             return cls(file_path=path)
         with open(path, "rb") as f:
             doc = json.loads(f.read() or b"{}")
+        tail = cls._journal_tail(path + ".journal")
+        if tail is not None:
+            snap_hrs = (int(doc.get("height", "0")), doc.get("round", 0), doc.get("step", STEP_NONE))
+            tail_hrs = (int(tail.get("height", "0")), tail.get("round", 0), tail.get("step", STEP_NONE))
+            if tail_hrs > snap_hrs:
+                doc = tail  # stale snapshot replayed under a newer journal
         return cls(
             height=int(doc.get("height", "0")),
             round=doc.get("round", 0),

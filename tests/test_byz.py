@@ -1,0 +1,371 @@
+"""tmbyz role unit tests — node-free, device-free (docs/byzantine.md).
+
+Every role.install() captures its patch target at install time, so the
+tests monkeypatch the target with a STUB first, then install: the role
+wraps the stub, the assertions drive the wrapper directly, and pytest's
+monkeypatch teardown restores the real methods — no byz patch ever
+leaks into the rest of the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from helpers import make_block_id, make_keys, make_validator_set
+from tendermint_tpu.byz import (
+    CONSENSUS_ROLES,
+    EVIDENCE_ROLES,
+    ROLE_NAMES,
+    maybe_install,
+    parse_roles,
+)
+from tendermint_tpu.byz.signer import UnsafeSigner
+from tendermint_tpu.privval import DoubleSignError, FilePV
+from tendermint_tpu.types.vote import PRECOMMIT, PREVOTE, Vote
+from tendermint_tpu.utils.tmtime import Time
+
+CHAIN = "byz-test-chain"
+T = Time.from_unix_ns(1_700_000_000 * 10**9)
+
+
+def read_events(home):
+    path = os.path.join(home, "byz.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ------------------------------------------------------------- role spec
+
+
+def test_parse_roles():
+    assert parse_roles("double_sign") == ["double_sign"]
+    assert parse_roles(" header_forge , statesync_corrupt ") == [
+        "header_forge", "statesync_corrupt",
+    ]
+    assert parse_roles("") == []
+    with pytest.raises(ValueError, match="unknown byzantine role"):
+        parse_roles("double_sign,flub")
+
+
+def test_role_sets_are_consistent():
+    assert CONSENSUS_ROLES <= ROLE_NAMES
+    assert EVIDENCE_ROLES <= CONSENSUS_ROLES
+    # the lens plane mirrors EVIDENCE_ROLES (import isolation keeps it
+    # from importing byz directly) — the two copies must not drift
+    from tendermint_tpu.lens import gates as lens_gates
+
+    assert lens_gates.EVIDENCE_ROLES == EVIDENCE_ROLES
+
+
+def test_maybe_install_is_a_noop_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("TM_TPU_BYZ", raising=False)
+    assert maybe_install(str(tmp_path)) is None
+    assert read_events(str(tmp_path)) == []
+
+
+def test_maybe_install_rejects_unknown_role(tmp_path, monkeypatch):
+    monkeypatch.setenv("TM_TPU_BYZ", "definitely_not_a_role")
+    with pytest.raises(ValueError, match="unknown byzantine role"):
+        maybe_install(str(tmp_path))
+
+
+# ---------------------------------------------------------- UnsafeSigner
+
+
+def test_unsafe_signer_requires_key_bearing_privval():
+    with pytest.raises(TypeError, match="key-bearing"):
+        UnsafeSigner(SimpleNamespace())
+
+
+def test_unsafe_signer_bypasses_the_double_sign_guard(tmp_path):
+    """The raw-key path signs CONFLICTING same-HRS votes FilePV refuses,
+    and both signatures verify — exactly the artifact pair the evidence
+    plane must turn into DuplicateVoteEvidence."""
+    pv = FilePV.generate(
+        os.path.join(tmp_path, "k.json"), os.path.join(tmp_path, "s.json"),
+        seed=b"\x21" * 32,
+    )
+
+    def vote(bid):
+        return Vote(
+            type=PREVOTE, height=3, round=0, block_id=bid, timestamp=T,
+            validator_address=pv.get_pub_key().address(), validator_index=0,
+        )
+
+    va, vb = vote(make_block_id(b"\x0a" * 32)), vote(make_block_id(b"\x0b" * 32))
+    pv.sign_vote(CHAIN, va)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, vb)  # the guard holds on the honest path
+
+    signer = UnsafeSigner(pv)
+    signer.sign_vote_unsafe(CHAIN, vb)
+    pub = pv.get_pub_key()
+    assert pub.verify_signature(va.sign_bytes(CHAIN), va.signature)
+    assert pub.verify_signature(vb.sign_bytes(CHAIN), vb.signature)
+    # the bypass must not have advanced the guard state either
+    assert pv.last_sign_state.height == 3
+
+
+# --------------------------------------------------------- double_sign
+
+
+def _fake_cs(key, sent):
+    return SimpleNamespace(
+        priv_validator=SimpleNamespace(priv_key=key),
+        state=SimpleNamespace(chain_id=CHAIN),
+        broadcast=sent.append,
+    )
+
+
+def _honest_vote(key, vals, height, vtype=PREVOTE, round_=0, bid=None):
+    addr = key.pub_key().address()
+    idx, _ = vals.get_by_address(addr)
+    v = Vote(
+        type=vtype, height=height, round=round_,
+        block_id=bid if bid is not None else make_block_id(b"\xaa" * 32),
+        timestamp=T, validator_address=addr, validator_index=idx,
+    )
+    v.signature = key.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+def _install_double_sign(tmp_path, monkeypatch):
+    from tendermint_tpu.byz.consensus import DoubleSignRole
+    from tendermint_tpu.consensus import state as cs_mod
+
+    def stub(cs, msg_type, hash_, header):  # the "honest" signing path
+        return cs.honest_vote
+
+    monkeypatch.setattr(cs_mod.ConsensusState, "_sign_add_vote", stub)
+    role = DoubleSignRole(str(tmp_path))
+    role.install()
+    return role, cs_mod.ConsensusState._sign_add_vote
+
+
+def test_double_sign_broadcasts_conflicting_prevote(tmp_path, monkeypatch):
+    from tendermint_tpu.consensus.messages import VoteMessage
+    from tendermint_tpu.evidence.verify import verify_duplicate_vote
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+    role, sign_add_vote = _install_double_sign(tmp_path, monkeypatch)
+    keys = make_keys(3)
+    vals = make_validator_set(keys)
+    sent = []
+    cs = _fake_cs(keys[0], sent)
+    height = role.OFFSET + role.PERIOD  # smallest attacked height > 0
+    cs.honest_vote = _honest_vote(keys[0], vals, height)
+
+    got = sign_add_vote(cs, PREVOTE, None, None)
+    assert got is cs.honest_vote  # honest path's return value untouched
+    assert len(sent) == 1 and isinstance(sent[0], VoteMessage)
+    vote2 = sent[0].vote
+    assert vote2.height == height and vote2.round == 0 and vote2.type == PREVOTE
+    assert vote2.validator_address == cs.honest_vote.validator_address
+    assert vote2.block_id.key() != cs.honest_vote.block_id.key()
+    pub = keys[0].pub_key()
+    assert pub.verify_signature(vote2.sign_bytes(CHAIN), vote2.signature)
+
+    # the pair is committable evidence on the honest side
+    ev = DuplicateVoteEvidence.new(cs.honest_vote, vote2, T, vals)
+    verify_duplicate_vote(ev, CHAIN, vals)
+
+    evs = read_events(str(tmp_path))
+    assert [e["kind"] for e in evs] == ["double_sign"]
+    assert evs[0]["height"] == height
+
+
+def test_double_sign_skips_non_attack_votes(tmp_path, monkeypatch):
+    role, sign_add_vote = _install_double_sign(tmp_path, monkeypatch)
+    keys = make_keys(3)
+    vals = make_validator_set(keys)
+    sent = []
+    cs = _fake_cs(keys[0], sent)
+    h_hit = role.OFFSET + role.PERIOD
+
+    for vote in (
+        None,                                               # no honest vote
+        _honest_vote(keys[0], vals, h_hit + 1),             # off-cadence height
+        _honest_vote(keys[0], vals, h_hit, vtype=PRECOMMIT),  # never precommits
+        _honest_vote(keys[0], vals, h_hit, round_=1),       # round 0 only
+    ):
+        cs.honest_vote = vote
+        msg_type = PRECOMMIT if vote is not None and vote.type == PRECOMMIT else PREVOTE
+        assert sign_add_vote(cs, msg_type, None, None) is vote
+    assert sent == []
+
+    # a remote signer (no raw key) starves the role entirely
+    cs_remote = _fake_cs(keys[0], sent)
+    cs_remote.priv_validator = SimpleNamespace()  # no .priv_key
+    cs_remote.honest_vote = _honest_vote(keys[0], vals, h_hit)
+    sign_add_vote(cs_remote, PREVOTE, None, None)
+    assert sent == []
+    assert read_events(str(tmp_path)) == []
+
+
+# --------------------------------------------------------- header_forge
+
+
+def _install_header_forge(tmp_path, monkeypatch):
+    import tendermint_tpu.rpc as rpc_pkg
+
+    from tendermint_tpu.byz.headers import HeaderForgeRole
+    from tendermint_tpu.rpc import core as rpc_core
+
+    served = []  # (route, height, indices) per honest call
+
+    def honest_light_batch(height=None, indices=None, **kw):
+        served.append(("light_batch", height, indices))
+        return {"signed_header": {"header": {
+            "height": str(height or 9),
+            "data_hash": "DA" * 16,
+            "validators_hash": "VA" * 16,
+        }}}
+
+    def honest_proofs_batch(height=None, indices=None, **kw):
+        served.append(("proofs_batch", height, list(indices or ())))
+        return {"indices": list(indices or ())}
+
+    def stub_build_routes(env):
+        return {
+            "light_batch": honest_light_batch,
+            "proofs_batch": honest_proofs_batch,
+        }
+
+    monkeypatch.setattr(rpc_core, "build_routes", stub_build_routes)
+    monkeypatch.setattr(rpc_pkg, "build_routes", stub_build_routes)
+    role = HeaderForgeRole(str(tmp_path))
+    role.GRACE = 1   # per-instance: first call per route honest,
+    role.PERIOD = 2  # then forge every 2nd call
+    role.install()
+    routes = rpc_core.build_routes(None)
+    return role, routes, served
+
+
+def test_header_forge_grace_then_alternating_forgeries(tmp_path, monkeypatch):
+    role, routes, _served = _install_header_forge(tmp_path, monkeypatch)
+    lb = routes["light_batch"]
+
+    h1 = lb(height=5)["signed_header"]["header"]
+    assert h1["data_hash"] == "DA" * 16 and h1["validators_hash"] == "VA" * 16
+
+    # call 2: n>GRACE and n%PERIOD==0, n%(2*PERIOD)!=0 → lunatic shape
+    h2 = lb(height=6)["signed_header"]["header"]
+    assert h2["data_hash"] != "DA" * 16
+    assert h2["data_hash"] == hashlib.sha256(b"tmbyz/lunatic/6").hexdigest().upper()
+    assert h2["validators_hash"] == "VA" * 16
+
+    h3 = lb(height=7)["signed_header"]["header"]
+    assert h3["data_hash"] == "DA" * 16  # off-period: honest again
+
+    # call 4: n%(2*PERIOD)==0 → wrong-valset shape
+    h4 = lb(height=8)["signed_header"]["header"]
+    assert h4["validators_hash"] != "VA" * 16
+    assert h4["data_hash"] == "DA" * 16
+
+    kinds = [(e["kind"], e["field"]) for e in read_events(str(tmp_path))]
+    assert kinds == [("forge_header", "data_hash"), ("forge_header", "validators_hash")]
+
+
+def test_header_forge_substitutes_proof_indices(tmp_path, monkeypatch):
+    """The index-substitution attack against the tmproof gateway: a
+    validly-proven but DIFFERENT index set is served. The light proxy's
+    `mp.indices == req_idxs` defense (test_light_proxy.py) refuses it —
+    here we pin the adversary half: what it serves vs what was asked."""
+    role, routes, served = _install_header_forge(tmp_path, monkeypatch)
+    pb = routes["proofs_batch"]
+
+    assert pb(height=5, indices=[1, 2])["indices"] == [1, 2]  # grace call
+
+    res = pb(height=5, indices=[1, 2])
+    assert res["indices"] == [2, 3]  # substituted, still "validly proven"
+    # the forged response came from the honest route for the WRONG set
+    assert served[-1] == ("proofs_batch", 5, [2, 3])
+
+    evs = [e for e in read_events(str(tmp_path)) if e["kind"] == "substitute_indices"]
+    assert len(evs) == 1
+    assert evs[0]["asked"] == [1, 2] and evs[0]["served"] == [2, 3]
+
+    # non-list indices (malformed request) never trip the forger
+    out = pb(height=5, indices=None)
+    assert out["indices"] == []
+
+
+# --------------------------------------------------- statesync_corrupt
+
+
+class _FakeApp:
+    def __init__(self, abci):
+        self._abci = abci
+        self.honest_hash = b"\x5a" * 32
+        self.honest_chunk = bytes(range(128))
+
+    def list_snapshots(self, req):
+        return SimpleNamespace(snapshots=[self._abci.Snapshot(
+            height=3, format=1, chunks=2, hash=self.honest_hash, metadata=b"m",
+        )])
+
+    def load_snapshot_chunk(self, req):
+        return SimpleNamespace(chunk=self.honest_chunk)
+
+    def other_method(self):
+        return "passthrough"
+
+
+def _install_statesync_corrupt(tmp_path, monkeypatch):
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.byz.statesync import StatesyncCorruptRole
+    from tendermint_tpu.statesync import reactor as ss_mod
+
+    def stub(reactor, ch):  # the serve loop bodies don't matter here
+        pass
+
+    monkeypatch.setattr(ss_mod.StateSyncReactor, "_recv_snapshot", stub)
+    monkeypatch.setattr(ss_mod.StateSyncReactor, "_recv_chunk", stub)
+    role = StatesyncCorruptRole(str(tmp_path))
+    role.install()
+    reactor = SimpleNamespace(app=_FakeApp(abci))
+    return role, reactor, ss_mod
+
+
+def test_statesync_corrupt_forges_manifests_and_chunks(tmp_path, monkeypatch):
+    role, reactor, ss_mod = _install_statesync_corrupt(tmp_path, monkeypatch)
+    app = reactor.app
+
+    ss_mod.StateSyncReactor._recv_snapshot(reactor, None)
+    ss_mod.StateSyncReactor._recv_chunk(reactor, None)
+    # the isinstance guard makes the racing double-wrap impossible:
+    # the honest app is wrapped exactly once
+    assert reactor.app is not app and reactor.app._app is app
+
+    snaps = reactor.app.list_snapshots(None).snapshots
+    want = hashlib.sha256(b"tmbyz/manifest/" + app.honest_hash).digest()
+    assert snaps[0].hash == want and snaps[0].hash != app.honest_hash
+    assert (snaps[0].height, snaps[0].format, snaps[0].chunks) == (3, 1, 2)
+
+    res = reactor.app.load_snapshot_chunk(SimpleNamespace(height=3, chunk=0))
+    assert res.chunk != app.honest_chunk
+    assert res.chunk[:64] == bytes(b ^ 0xFF for b in app.honest_chunk[:64])
+    assert res.chunk[64:] == app.honest_chunk[64:]  # size stays plausible
+
+    assert reactor.app.other_method() == "passthrough"
+    kinds = [e["kind"] for e in read_events(str(tmp_path))]
+    assert kinds == ["forge_manifest", "corrupt_chunk"]
+
+
+def test_statesync_corrupt_honors_event_budget(tmp_path, monkeypatch):
+    role, reactor, ss_mod = _install_statesync_corrupt(tmp_path, monkeypatch)
+    role.MAX_EVENTS = 0  # budget exhausted: the provider turns honest
+    ss_mod.StateSyncReactor._recv_chunk(reactor, None)
+
+    app = reactor.app._app
+    assert reactor.app.list_snapshots(None).snapshots[0].hash == app.honest_hash
+    res = reactor.app.load_snapshot_chunk(SimpleNamespace(height=3, chunk=0))
+    assert res.chunk == app.honest_chunk
+    assert read_events(str(tmp_path)) == []

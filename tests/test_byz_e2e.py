@@ -1,0 +1,103 @@
+"""tmbyz slow acceptance: the byz-small adversary net, live (ISSUE 17).
+
+Three of four genesis validators carry byzantine roles
+(e2e-manifests/byz-small.toml): validator04 double-signs (and, with
+cores, equivocates), validator01 forges light_batch headers and
+substitutes proofs_batch index sets while serving as the light proxy's
+deliberately-chosen primary, validator03 serves corrupted snapshot
+chunks and forged manifests to the statesync joiner. The honest side
+must finish the whole evidence round-trip — detect (ConflictingVote →
+report_conflicting_votes), verify, gossip, COMMIT, index — and the run
+must PASS the verdict plane with the `evidence_committed` gate judged
+non-vacuously, while the light client's divergence report shows forged
+headers refused and the joiner restores anyway.
+
+Kill/pause-only per the core gate in e2e/scenario.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+BYZ_SMALL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "e2e-manifests", "byz-small.toml",
+)
+
+
+@pytest.mark.slow
+def test_e2e_byz_small(tmp_path):
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip(
+            "byz-small live run needs >=2 cores: 6 node processes + a "
+            "statesync restore under adversarial chunk corruption cannot "
+            "hold consensus cadence on 1 core (ROADMAP 2-core note; run "
+            "scripts/tmsoak.py run e2e-manifests/byz-small.toml manually "
+            "run-alone)"
+        )
+    from tendermint_tpu.e2e.runner import run_soak
+
+    runner, summary = run_soak(
+        BYZ_SMALL, str(tmp_path / "net"), duration=50.0,
+        logger=lambda *a: None,
+    )
+    report = runner.last_report
+    assert report is not None and report["verdict"] == "pass", (
+        report and report["gates"]
+    )
+
+    # the evidence_committed gate judged on real adversarial evidence,
+    # not the honest-run vacuous pass
+    gate = next(g for g in report["gates"] if g["name"] == "evidence_committed")
+    assert gate["ok"], gate
+    assert "vacuous" not in gate["detail"], (
+        "gate passed vacuously — the double_sign role never armed", gate
+    )
+
+    fleet = report["fleet"]
+    ev = fleet.get("evidence") or {}
+    assert ev.get("committed_by_type", {}).get("duplicate_vote", 0) >= 1, (
+        "no duplicate-vote evidence committed fleet-wide", ev
+    )
+    byz_armed = {
+        row["name"]: row["roles"] for row in fleet.get("byzantine_nodes", [])
+    }
+    assert "double_sign" in byz_armed.get("validator04", []), byz_armed
+    assert "header_forge" in byz_armed.get("validator01", []), byz_armed
+    assert "statesync_corrupt" in byz_armed.get("validator03", []), byz_armed
+
+    # the adversaries actually ATTACKED (armed-only byz.jsonl would make
+    # every assertion above vacuous): validator04 double-signed and
+    # validator03 corrupted at least one serve response
+    by_node = {s["name"]: s for s in report["nodes"]}
+    assert by_node["validator04"]["byzantine"]["events_by_role"].get(
+        "double_sign", 0) >= 1, by_node["validator04"]["byzantine"]
+    assert by_node["validator03"]["byzantine"]["events"] >= 1, (
+        by_node["validator03"]["byzantine"]
+    )
+
+    sr = summary["soak_report"]
+    # the joiner restored THROUGH the malicious provider (refetch +
+    # peer rotation, PR-14 hardening) — corrupted chunks notwithstanding
+    assert sr["statesync_restored"], sr
+    # the light client made progress AND refused forged material: its
+    # primary is the forger, so divergences must show up in the report
+    light = {row["node"]: row for row in sr["light"]}
+    assert light["light01"]["verified_heads"] >= 1, sr["light"]
+    assert light["light01"].get("divergences", 0) >= 1, (
+        "header forger never tripped the light proxy's defenses",
+        sr["light"],
+    )
+    # every scheduled action fired (the timeline is the test plan)
+    assert {a["kind"] for a in summary["actions"]} == {
+        "kill", "pause", "flood", "statesync_join"}
+    # the run dir carries the per-node byz.jsonl artifacts for forensics
+    for name in ("validator01", "validator03", "validator04"):
+        path = os.path.join(runner.base_dir, name, "byz.jsonl")
+        assert os.path.exists(path), f"missing {path}"
+        with open(path) as f:
+            kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        assert kinds and kinds[0] == "armed", kinds

@@ -319,3 +319,204 @@ def test_verify_light_client_attack_contextual():
         raise AssertionError("unknown common height accepted")
     except EvidenceVerifyError:
         pass
+
+
+# ------------------------------------------------------- tmbyz negatives
+# Forged-evidence refusal paths (docs/byzantine.md): every shape the
+# byz adversary roles can emit must die in verification with a named
+# EvidenceVerifyError — on the stateless check AND the contextual one.
+
+
+def test_verify_duplicate_vote_rejects_wrong_validator():
+    keys = make_keys(3)
+    vals = make_validator_set(keys)
+    outsider = make_keys(4)[3]  # deterministic key NOT in the set
+    t = Time.from_unix_ns(1_700_000_000 * 10**9)
+    va = make_vote(keys[0], vals, 5, 0, make_block_id(b"\xaa" * 32), t)
+    vb = make_vote(keys[0], vals, 5, 0, make_block_id(b"\xbb" * 32), t)
+    for v in (va, vb):
+        v.validator_address = outsider.pub_key().address()
+        v.signature = outsider.sign(v.sign_bytes(CHAIN))
+    ev = DuplicateVoteEvidence(
+        vote_a=va, vote_b=vb, total_voting_power=30, validator_power=10,
+        timestamp=t,
+    )
+    with pytest.raises(EvidenceVerifyError, match="was not a validator"):
+        verify_duplicate_vote(ev, CHAIN, vals)
+
+
+def test_verify_duplicate_vote_rejects_mismatched_chain_id():
+    # signatures cover the chain id: evidence replayed across chains is
+    # an invalid-signature refusal, not a cross-chain slash
+    keys = make_keys(3)
+    vals = make_validator_set(keys)
+    t = Time.from_unix_ns(1_700_000_000 * 10**9)
+    ev = make_duplicate_vote_evidence(keys, vals, 5, t)
+    with pytest.raises(EvidenceVerifyError, match="VoteA: invalid signature"):
+        verify_duplicate_vote(ev, "some-other-chain", vals)
+
+
+def test_verify_duplicate_vote_rejects_mismatched_hrs():
+    keys = make_keys(3)
+    vals = make_validator_set(keys)
+    t = Time.from_unix_ns(1_700_000_000 * 10**9)
+    va = make_vote(keys[0], vals, 5, 0, make_block_id(b"\xaa" * 32), t)
+    vb = make_vote(keys[0], vals, 6, 0, make_block_id(b"\xbb" * 32), t)
+    ev = DuplicateVoteEvidence(
+        vote_a=va, vote_b=vb, total_voting_power=30, validator_power=10,
+        timestamp=t,
+    )
+    with pytest.raises(EvidenceVerifyError, match="h/r/s does not match"):
+        verify_duplicate_vote(ev, CHAIN, vals)
+
+
+def test_verify_evidence_rejects_expired_duplicate_vote():
+    import copy as _copy
+
+    from tendermint_tpu.evidence.verify import verify_evidence
+
+    keys = make_keys(1)
+    node = _committed_chain(keys, n_heights=4)
+    state = node.state
+    meta = node.block_store.load_block_meta(1)
+    ev = make_duplicate_vote_evidence(keys, state.validators, 1, meta.header.time)
+    # shrink the evidence window until height-1 evidence falls out of
+    # BOTH the height AND the duration budget (verify.go:59 needs both)
+    import dataclasses
+
+    state = _copy.deepcopy(state)
+    state.consensus_params = dataclasses.replace(
+        state.consensus_params,
+        evidence=dataclasses.replace(
+            state.consensus_params.evidence,
+            max_age_num_blocks=1, max_age_duration=1,  # 1 block / 1 ns
+        ),
+    )
+    with pytest.raises(EvidenceVerifyError, match="too old; min height"):
+        verify_evidence(ev, state, node.block_exec.store, node.block_store)
+
+
+def test_verify_evidence_rejects_unknown_height():
+    from tendermint_tpu.evidence.verify import verify_evidence
+
+    keys = make_keys(1)
+    node = _committed_chain(keys)
+    state = node.state
+    far = node.block_store.height() + 50
+    t = Time.from_unix_ns(1_700_000_000 * 10**9)
+    ev = make_duplicate_vote_evidence(keys, state.validators, far, t)
+    with pytest.raises(EvidenceVerifyError, match="don't have header at height"):
+        verify_evidence(ev, state, node.block_exec.store, node.block_store)
+
+
+def test_verify_light_client_attack_rejects_forged_signature():
+    """A byz role that REWRITES commit signatures (instead of re-signing
+    like the EvilProvider) must die in the commit check — wrapped as the
+    evidence plane's own EvidenceVerifyError, not a raw ValueError that
+    would escape the pool/reactor handlers."""
+    import copy as _copy
+
+    from tendermint_tpu.evidence.verify import verify_evidence
+
+    node, ev = _forge_lca_evidence()
+    state = node.block_exec.store.load()
+    bad = _copy.deepcopy(ev)
+    sigs = bad.conflicting_block.signed_header.commit.signatures
+    sigs[0].signature = bytes(64)
+    with pytest.raises(EvidenceVerifyError, match="verifying conflicting commit"):
+        verify_evidence(bad, state, node.block_exec.store, node.block_store)
+
+
+def test_verify_light_client_attack_rejects_mismatched_chain_id():
+    from tendermint_tpu.evidence.verify import verify_light_client_attack
+
+    node, ev = _forge_lca_evidence()
+    common_h = ev.common_height
+    common_header = node.block_store.load_block_meta(common_h).header
+    trusted_header = node.block_store.load_block_meta(
+        ev.conflicting_block.height
+    ).header
+    common_vals = node.block_exec.store.load_validators(common_h)
+    with pytest.raises(EvidenceVerifyError, match="verifying conflicting commit"):
+        verify_light_client_attack(
+            ev, common_header, trusted_header, common_vals, "some-other-chain"
+        )
+
+
+def test_verify_light_client_attack_rejects_wrong_valset_hash():
+    """Equivocation-shaped evidence (same height as the trusted header)
+    whose conflicting header names a FOREIGN validator set — the
+    wrong-validator refusal on the LCA path."""
+    import copy as _copy
+
+    from tendermint_tpu.evidence.verify import verify_light_client_attack
+
+    node, ev = _forge_lca_evidence()
+    h = ev.conflicting_block.height
+    trusted_header = node.block_store.load_block_meta(h).header
+    common_vals = node.block_exec.store.load_validators(ev.common_height)
+    bad = _copy.deepcopy(ev)
+    bad.conflicting_block.signed_header.header.validators_hash = b"\x13" * 32
+    # common_header at the SAME height as the conflicting block forces
+    # the equivocation branch (valset-hash equality check)
+    with pytest.raises(EvidenceVerifyError, match="does not match trusted"):
+        verify_light_client_attack(
+            bad, trusted_header, trusted_header, common_vals, node.state.chain_id
+        )
+
+
+def test_verify_light_client_attack_rejects_equal_headers():
+    import copy as _copy
+
+    from test_light import CHAIN as LCHAIN
+
+    from tendermint_tpu.evidence.verify import verify_light_client_attack
+
+    node, ev = _forge_lca_evidence()
+    h = ev.conflicting_block.height
+    trusted_header = node.block_store.load_block_meta(h).header
+    common_header = node.block_store.load_block_meta(ev.common_height).header
+    common_vals = node.block_exec.store.load_validators(ev.common_height)
+    same = _copy.deepcopy(ev)
+    # replace the conflicting header with the honest one and re-sign:
+    # "no attack" must be a refusal, not a slash
+    same.conflicting_block.signed_header.header = _copy.deepcopy(trusted_header)
+    from helpers import sign_commit
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+
+    keys = make_keys(1)
+    bid = BlockID(hash=trusted_header.hash(),
+                  part_set_header=PartSetHeader(total=1, hash=b"\x67" * 32))
+    same.conflicting_block.signed_header.commit = sign_commit(
+        LCHAIN, same.conflicting_block.validator_set, keys, h,
+        same.conflicting_block.signed_header.commit.round, bid,
+    )
+    with pytest.raises(EvidenceVerifyError, match="headers are equal"):
+        verify_light_client_attack(
+            same, common_header, trusted_header, common_vals, LCHAIN
+        )
+
+
+def test_verify_evidence_times_into_metrics():
+    """The EvidenceMetrics verify histogram observes every contextual
+    check — refusals included (an adversary flooding the pool with junk
+    is visible as verify TIME, not just outcome counts)."""
+    from tendermint_tpu.evidence.verify import verify_evidence
+    from tendermint_tpu.metrics import EvidenceMetrics, Registry
+
+    keys = make_keys(1)
+    node = _committed_chain(keys)
+    state = node.state
+    reg = Registry()
+    metrics = EvidenceMetrics(reg)
+    meta = node.block_store.load_block_meta(1)
+    good = make_duplicate_vote_evidence(keys, state.validators, 1, meta.header.time)
+    verify_evidence(good, state, node.block_exec.store, node.block_store,
+                    metrics=metrics)
+    bad = make_duplicate_vote_evidence(keys, state.validators, 1, meta.header.time)
+    bad.vote_b.signature = bytes(64)
+    with pytest.raises(EvidenceVerifyError):
+        verify_evidence(bad, state, node.block_exec.store, node.block_store,
+                        metrics=metrics)
+    # two observations: the accept and the refusal
+    assert "tendermint_evidence_verify_seconds_count 2" in reg.gather()

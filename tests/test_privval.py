@@ -116,3 +116,57 @@ def test_persistence_across_restart(tmp_path):
     v3 = make_vote(bid=make_block_id(b"\x0a" * 32))
     pv2.sign_vote(CHAIN, v3)
     assert v3.signature == v1.signature
+
+
+def test_journal_defeats_stale_state_file_replay(tmp_path):
+    """tmbyz hardening (docs/byzantine.md): replaying a STALE
+    priv_validator_state.json (ops restore, fs rollback, crash-looping
+    supervisor) must NOT lower the double-sign guard — the append-only
+    .journal's tail is adopted whenever it is ahead of the snapshot, so
+    the byz UnsafeSigner stays the ONLY way to double-sign."""
+    import shutil
+
+    key_file = os.path.join(tmp_path, "priv_validator_key.json")
+    state_file = os.path.join(tmp_path, "priv_validator_state.json")
+    pv = FilePV.generate(key_file, state_file, seed=b"\x0a" * 32)
+    pv.sign_vote(CHAIN, make_vote(height=1, bid=make_block_id(b"\x0a" * 32)))
+    shutil.copy(state_file, state_file + ".stale")  # crash snapshot @ h=1
+    v2 = make_vote(height=2, bid=make_block_id(b"\x0a" * 32))
+    pv.sign_vote(CHAIN, v2)
+
+    # replay the stale snapshot; without the journal, check_hrs would see
+    # height 1 and happily sign a CONFLICTING height-2 vote
+    shutil.copy(state_file + ".stale", state_file)
+    pv2 = FilePV.load_or_generate(key_file, state_file)
+    assert pv2.last_sign_state.height == 2, "journal tail not adopted"
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN, make_vote(height=2, bid=make_block_id(b"\x0b" * 32)))
+    # the honest same-bytes re-sign still reuses the journaled signature
+    v2b = make_vote(height=2, bid=make_block_id(b"\x0a" * 32))
+    pv2.sign_vote(CHAIN, v2b)
+    assert v2b.signature == v2.signature
+
+
+def test_journal_tolerates_torn_tail_and_compacts(tmp_path):
+    key_file = os.path.join(tmp_path, "k.json")
+    state_file = os.path.join(tmp_path, "s.json")
+    pv = FilePV.generate(key_file, state_file, seed=b"\x0b" * 32)
+    for h in (1, 2, 3):
+        pv.sign_vote(CHAIN, make_vote(height=h, bid=make_block_id(b"\x0a" * 32)))
+    # torn final line (crash mid-append): the previous record must win
+    with open(state_file + ".journal", "a") as f:
+        f.write('{"height": "9", "round"')
+    pv2 = FilePV.load_or_generate(key_file, state_file)
+    assert pv2.last_sign_state.height == 3
+    # compaction: blow past the line cap, the journal collapses to the
+    # single latest record and the guard state survives
+    from tendermint_tpu.privval.file_pv import LastSignState
+
+    pv2.last_sign_state._JOURNAL_MAX_LINES = 4
+    for h in (4, 5, 6, 7, 8):
+        pv2.sign_vote(CHAIN, make_vote(height=h, bid=make_block_id(b"\x0a" * 32)))
+    with open(state_file + ".journal") as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    assert len(lines) <= 4
+    pv3 = FilePV.load_or_generate(key_file, state_file)
+    assert pv3.last_sign_state.height == 8

@@ -977,3 +977,36 @@ def test_deliberate_race_caught_twice(tmp_path):
     )
     assert r.returncode == 1, r.stdout + r.stderr
     assert "shared_state_race" in r.stdout and "Pool.pending" in r.stdout
+
+
+def test_writer_ids_survive_pthread_ident_reuse():
+    """glibc caches thread stacks, so a thread created right after
+    another was join()ed routinely inherits the dead thread's
+    threading.get_ident(). Writer identity must not collapse with it:
+    each live Thread object gets its own monotonic writer id, so the
+    sanitizer still sees N distinct sequential writers (the failure
+    mode was shared_writers stuck at 1 and the race never reported)."""
+    from tendermint_tpu.check import racecheck as rc_mod
+
+    wids, idents = [], []
+
+    def w():
+        wids.append(rc_mod._writer_id())
+        idents.append(threading.get_ident())
+
+    for _ in range(6):
+        t = threading.Thread(target=w)
+        t.start()
+        t.join()
+    assert len(wids) == 6 and len(set(wids)) == 6, (wids, idents)
+    # a thread asking twice gets the same stamp back
+    again = []
+
+    def w2():
+        again.append((rc_mod._writer_id(), rc_mod._writer_id()))
+
+    t = threading.Thread(target=w2)
+    t.start()
+    t.join()
+    assert again[0][0] == again[0][1]
+    assert again[0][0] not in wids
