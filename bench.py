@@ -752,6 +752,144 @@ def bench_proofs(ks=(1, 64, 256), n_leaves=16384):
     return headline
 
 
+def bench_state(counts=None, dirty=64, k_proof=16):
+    """Device-free incremental app-state stage (tmstate, ISSUE 18):
+    commits/s and proofs/s against the statetree at 1k/100k/1M
+    accounts. Per account count, three commit modes — incremental
+    (dirty-path-only rehash of a `dirty`-account write set, the bank's
+    per-block cost after the rewire), full (hash_from_byte_slices over
+    every leaf: the pre-tmstate `_compute_app_hash`, measured as the
+    vs_baseline denominator), and structural (insert batches that
+    reshape the tree; memo-copied subtrees bound the rehash) — plus
+    k-account multiproof serves from the live view (the `state_batch`
+    route's hot path). Equivalence gate FIRST, like the proofs stage:
+    the incremental root must equal the full recompute across a
+    randomized update/insert/delete sweep before anything is timed.
+
+    Acceptance (ISSUE 18): incremental commits/s at 100k accounts
+    >= 10x the full-recompute baseline. BENCH_STATE_COUNTS trims the
+    account axis (preflight's state-dry runs '1000')."""
+    import random
+
+    from tendermint_tpu.crypto.merkle import hash_from_byte_slices
+    from tendermint_tpu.statetree import StateTree, state_leaf
+
+    if counts is None:
+        raw = os.environ.get("BENCH_STATE_COUNTS", "1000,100000,1000000")
+        counts = tuple(int(c) for c in raw.split(",") if c.strip())
+    rng = random.Random(1234)
+    val = b'{"balance":%d,"nonce":0}'
+
+    # -- equivalence gate: incremental dirty-path root == full recompute
+    model: dict = {}
+    gate_tree = StateTree()
+    for rounds in range(12):
+        batch: dict = {}
+        live = list(model)
+        for _ in range(rng.randrange(0, 24)):
+            op = rng.randrange(3)
+            if op == 0 and live:
+                batch[rng.choice(live)] = rng.randbytes(20)
+            elif op == 1:
+                batch[b"acct:%08x" % rng.randrange(1 << 24)] = rng.randbytes(20)
+            elif live:
+                batch[rng.choice(live)] = None
+        for key, v in batch.items():
+            if v is None:
+                model.pop(key, None)
+            else:
+                model[key] = v
+        got = gate_tree.apply(batch)
+        want = hash_from_byte_slices(
+            [state_leaf(key, v) for key, v in sorted(model.items())]
+        )
+        assert got == want, f"incremental/full root divergence at round {rounds}"
+    _log("state equivalence gate: incremental dirty-path root == full recompute (sweep)")
+
+    headline = None
+    for n in counts:
+        keys = [b"acct:%012x" % i for i in range(n)]
+        items = [(key, val % i) for i, key in enumerate(keys)]
+        t0 = time.monotonic()
+        tree = StateTree(items)
+        _log(f"state n={n}: tree built in {time.monotonic() - t0:.2f}s")
+        ctr = [0]
+
+        def inc_commit():
+            # one block's worth of balance updates: dirty paths only
+            ctr[0] += 1
+            tree.apply({keys[rng.randrange(n)]: val % (n + ctr[0])
+                        for _ in range(dirty)})
+            return 1
+
+        leaves = [state_leaf(key, v) for key, v in items]
+
+        def full_commit():
+            # the pre-tmstate app hash: every leaf re-hashed per block
+            # (leaf list pre-built — the old path also re-serialized it,
+            # so this baseline is conservative)
+            hash_from_byte_slices(leaves, site="bank")
+            return 1
+
+        def struct_commit():
+            # account creation reshapes the tree (two-pointer merge +
+            # memo-copied unchanged subtrees)
+            ctr[0] += 1
+            base = ctr[0] * dirty
+            tree.apply({b"acct:new%012x" % (base + j): b"1" for j in range(dirty)})
+            return 1
+
+        s_inc = _measure(inc_commit)
+        s_full = _measure(full_commit, repeats=3)
+        s_struct = _measure(struct_commit, repeats=3) if n <= 200_000 else None
+        view = tree.latest()
+        idxs = sorted(rng.sample(range(len(view)), min(k_proof, len(view))))
+
+        def serve():
+            view.multiproof(idxs)
+            return len(idxs)
+
+        s_proofs = _measure(serve)
+        ratio = s_inc.median / s_full.median
+        _log(
+            f"state n={n} dirty={dirty}: incremental {s_inc.format(1)} commits/s, "
+            f"full {s_full.format(2)} commits/s ({ratio:.1f}x), "
+            + (f"structural {s_struct.format(2)} commits/s, " if s_struct else "")
+            + f"proofs k={len(idxs)} {s_proofs.format(0)} proofs/s"
+        )
+        modes = [("incremental", s_inc), ("full", s_full)]
+        if s_struct is not None:
+            modes.append(("structural", s_struct))
+        for mode, s in modes:
+            _perf_record(
+                "state", "commits_per_sec", "commits/s", s,
+                params={"accounts": n, "dirty": dirty, "mode": mode},
+            )
+        _perf_record(
+            "state", "proofs_per_sec", "proofs/s", s_proofs,
+            params={"accounts": n, "k": len(idxs)},
+        )
+        if n == 100_000:
+            assert ratio >= 10.0, (
+                f"incremental commits/s {s_inc.median:,.1f} is under 10x the "
+                f"full-recompute baseline {s_full.median:,.1f} at 100k accounts "
+                "(ISSUE-18 acceptance)"
+            )
+        headline = {
+            "metric": "state_commits_per_sec",
+            "value": round(s_inc.median, 1),
+            "unit": f"commits/sec ({n} accounts, {dirty} dirty)",
+            "vs_baseline": round(ratio, 3),
+            "mad": round(s_inc.mad, 1),
+            "n_samples": len(s_inc),
+            "accounts": n,
+            "full_per_sec": round(s_full.median, 3),
+            "proofs_per_sec": round(s_proofs.median, 1),
+        }
+        print(json.dumps(headline), flush=True)
+    return headline
+
+
 def bench_mempool(floods=(1000, 10000, 50000)):
     """Device-free mempool admission stage (runs under JAX_PLATFORMS=cpu
     like the hash stage — BENCH_r02/r03 flaky-device note): admitted
@@ -1044,6 +1182,18 @@ def main():
         bench_proofs()
         _write_bench_report()
         sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "state":
+        # targeted device-free run: `python bench.py state [counts]` —
+        # an argv counts list overrides BENCH_STATE_COUNTS (preflight's
+        # state-dry stage runs `state 1000`)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if len(sys.argv) > 2:
+            os.environ["BENCH_STATE_COUNTS"] = sys.argv[2]
+        _start_bench_flight()
+        _flight_mark("state")
+        bench_state()
+        _write_bench_report()
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "smoke":
         # CI-budget device-free perf smoke: micro hash + mempool
         # stages through the tmperf harness into the perf ledger
@@ -1109,6 +1259,19 @@ def main():
             _log("proofs stage hit deadline; continuing")
         except Exception as e:  # noqa: BLE001
             _log(f"proofs stage failed: {type(e).__name__}: {e}")
+    # Stage 1.57 (no device): the incremental app-state plane
+    # (tmstate) — device-free like the hash stage; failures never sink
+    # the run.
+    if os.environ.get("BENCH_STATE", "on") != "off":
+        try:
+            _flight_mark("state")
+            with stage_deadline(min(max(_remaining() - 60, 20), 240)):
+                bench_state()
+            _save_stage_trace("state")
+        except StageTimeout:
+            _log("state stage hit deadline; continuing")
+        except Exception as e:  # noqa: BLE001
+            _log(f"state stage failed: {type(e).__name__}: {e}")
     # Stage 1.6 (no device): the coalesced tx-admission pipeline —
     # device-free like the hash stage; failures never sink the run.
     if os.environ.get("BENCH_MEMPOOL", "on") != "off":

@@ -46,7 +46,7 @@ from tendermint_tpu.perf import (  # noqa: E402
     rate_samples,
 )
 
-SMOKE_STAGES = ("hash", "mempool", "proofs")
+SMOKE_STAGES = ("hash", "mempool", "proofs", "state")
 
 
 def default_ledger() -> str:
@@ -181,6 +181,47 @@ def _measure_proofs(repeats: int, min_time: float) -> list[tuple]:
     ]
 
 
+def _measure_state(repeats: int, min_time: float) -> list[tuple]:
+    """Incremental app-state smoke (tmstate, docs/state.md): one
+    dirty-path commit (32 updated accounts in a 4096-account tree)
+    per call, and the hot k=16 multiproof serve from the published
+    view — the bank app-hash write path and the state_batch read
+    path at CI budget. The micro workload is pinned in params, so
+    it never gates against bench.py's 100k/1M tiers."""
+    import random
+
+    from tendermint_tpu.statetree import StateTree
+
+    n, dirty_n, k = 4096, 32, 16
+    rng = random.Random(77)
+    tree = StateTree((b"acct:%08x" % i, b"v%d" % i) for i in range(n))
+    ctr = [0]
+
+    def commit():
+        ctr[0] += 1
+        picks = rng.sample(range(n), dirty_n)
+        tree.apply({b"acct:%08x" % i: b"v%d-%d" % (i, ctr[0]) for i in picks})
+
+    idxs = sorted(rng.sample(range(n), k))
+
+    def serve():
+        tree.latest().multiproof(idxs)
+        return k
+
+    return [
+        (
+            "commits_per_sec", "commits/s",
+            {"accounts": n, "dirty": dirty_n, "mode": "path"},
+            rate_samples(commit, repeats=repeats, warmup=2, min_time=min_time),
+        ),
+        (
+            "proofs_per_sec", "proofs/s",
+            {"accounts": n, "k": k},
+            rate_samples(serve, repeats=repeats, warmup=2, min_time=min_time),
+        ),
+    ]
+
+
 def run_smoke(
     stages=None,
     repeats: int = 5,
@@ -216,6 +257,8 @@ def run_smoke(
             rows = _measure_hash(repeats, min_time)
         elif stage == "proofs":
             rows = _measure_proofs(repeats, min_time)
+        elif stage == "state":
+            rows = _measure_state(repeats, min_time)
         else:
             rows = _measure_mempool(repeats, min_time, flood)
         slow_frac = float((inject or {}).get(stage, 0.0))

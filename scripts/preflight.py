@@ -10,9 +10,12 @@ Chains, in order:
   4. tmsoak --dry-run      same, for the byzantine adversary manifest
                            (byz-small.toml: roles parse, fault
                            tolerance holds, timeline resolves)
-  5. bench.py smoke        device-free perf smoke (~seconds) — records
+  5. bench.py state 1000   tmstate dry stage: the incremental==full
+                           app-hash equivalence sweep plus a 1k-account
+                           commit/proof smoke (docs/state.md)
+  6. bench.py smoke        device-free perf smoke (~seconds) — records
                            a fresh run into .bench_runs/ledger.jsonl
-  6. tmperf gate --check   noise-aware regression gate over the run
+  7. tmperf gate --check   noise-aware regression gate over the run
                            smoke just recorded, plus blessed-key
                            coverage drift
 
@@ -44,6 +47,7 @@ STAGES = (
                   "e2e-manifests/soak-small.toml", "e2e-manifests/soak-large.toml"]),
     ("byz-dry", [sys.executable, "scripts/tmsoak.py", "--dry-run",
                  "e2e-manifests/byz-small.toml"]),
+    ("state-dry", [sys.executable, "bench.py", "state", "1000"]),
     ("smoke", [sys.executable, "bench.py", "smoke"]),
     ("perf-gate", [sys.executable, "scripts/tmperf.py", "gate", "--check"]),
 )

@@ -46,7 +46,7 @@ import hashlib
 import json
 
 from ..crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey, address_hash
-from ..crypto.merkle import hash_from_byte_slices
+from ..statetree import StateTree
 from . import types as abci
 from .kvstore import (
     CODE_TYPE_BAD_NONCE,
@@ -58,6 +58,8 @@ from .kvstore import (
 
 ACCT_PREFIX = b"acct:"
 ACCT_END = b"acct;"  # ';' = ':' + 1 — the half-open prefix range bound
+VAL_PREFIX = b"val:"
+VAL_END = b"val;"
 BANK_TX_PREFIX = b"bank:"
 TREASURY_SUPPLY = 1_000_000_000_000
 
@@ -115,6 +117,27 @@ class BankApplication(KVStoreApplication):
     # multi-chunk statesync paths are exercised by every bank restore
     SNAPSHOT_CHUNK_SIZE = 4 * 1024
 
+    # retained statetree versions: a light client's verified header
+    # trails the live tree by the finalize->commit->header pipeline, so
+    # state_batch reads land a few roots behind the head (docs/state.md)
+    STATE_HISTORY_DEPTH = 8
+
+    # the incremental app-state tree (statetree/, ISSUE 18). None means
+    # "resync from the committed db on next use" — the invalidation
+    # every reload/rollback/restore path funnels through
+    # _load_bank_state. NOTE: out-of-band writes straight into self.db
+    # after the tree exists require reload_committed() to resync.
+    _state_tree: StateTree | None = None
+    _state_metrics = None
+
+    def __init__(self, db=None, retain_blocks: int = 0, snapshot_interval: int = 0,
+                 genesis_accounts: int = 0):
+        # synthetic genesis ballast (soak scale knob): init_chain seeds
+        # this many deterministic accounts, balances carved from the
+        # treasury so /supply conservation holds unchanged
+        self.genesis_accounts = int(genesis_accounts)
+        super().__init__(db=db, retain_blocks=retain_blocks, snapshot_interval=snapshot_interval)
+
     # ------------------------------------------------------------ state io
     # chain_id is persisted in the db (written by init_chain) so a
     # RESTARTED out-of-process app — and a statesync-RESTORED one that
@@ -126,6 +149,10 @@ class BankApplication(KVStoreApplication):
     def _load_bank_state(self) -> None:
         raw = self.db.get(b"bank:chain_id")
         self.chain_id = raw.decode() if raw else ""
+        # the committed db is the ground truth again (fresh start,
+        # rollback, snapshot restore): drop the incremental tree, it is
+        # rebuilt lazily from the db at the next hash or proof serve
+        self._state_tree = None
 
     def _load_state(self) -> None:
         super()._load_state()
@@ -149,8 +176,24 @@ class BankApplication(KVStoreApplication):
             treasury = treasury_priv(req.chain_id)
             pub = treasury.pub_key().bytes()
             addr = address_hash(pub)
+            # genesis ballast first: addresses and balances derived from
+            # (chain_id, index) alone, so every validator synthesizes the
+            # IDENTICAL account set (statesync restorers skip InitChain
+            # entirely and inherit it from the snapshot). Each holds 1
+            # unit carved out of the treasury — /supply conservation and
+            # the tests pinning it hold at any genesis_accounts.
+            seeded = 0
+            for i in range(self.genesis_accounts):
+                g_addr = hashlib.sha256(
+                    b"tmsoak-bank-genesis|%s|%d" % (req.chain_id.encode(), i)
+                ).digest()[:20]
+                key = _acct_key(g_addr)
+                if not self._db_has(key):
+                    self._pending[key] = _acct_value(1, 0, None)
+                    self.size += 1
+                    seeded += 1
             if not self._db_has(_acct_key(addr)):
-                self._pending[_acct_key(addr)] = _acct_value(TREASURY_SUPPLY, 0, pub)
+                self._pending[_acct_key(addr)] = _acct_value(TREASURY_SUPPLY - seeded, 0, pub)
                 self.size += 1
         return resp
 
@@ -275,18 +318,99 @@ class BankApplication(KVStoreApplication):
 
     # ------------------------------------------------------------ app hash
 
+    def _state_items_committed(self):
+        """COMMITTED (key, value) pairs of the two hashed ranges in
+        leaf order: `acct:` then `val:` (also plain lexicographic)."""
+        yield from self.db.iterator(ACCT_PREFIX, ACCT_END)
+        yield from self.db.iterator(VAL_PREFIX, VAL_END)
+
+    def _ensure_state_tree_locked(self) -> StateTree:
+        """The live statetree, rebuilt from the committed db when a
+        reload/rollback/restore invalidated it. Called under _mu."""
+        tree = self._state_tree
+        if tree is None:
+            tree = StateTree(
+                self._state_items_committed(),
+                history_depth=self.STATE_HISTORY_DEPTH,
+                metrics=self._state_metrics,
+                site="bank",
+            )
+            self._state_tree = tree
+        return tree
+
     def _compute_app_hash(self) -> bytes:
         """Merkle root over every account and validator entry (sorted
-        key order = deterministic leaf order). Routed through the PR-5
-        batched hash plane — the soak workload doubles as load on the
-        native merkle path."""
-        leaves = [
-            k + b"=" + v
-            for k, v in self._iter_merged(ACCT_PREFIX, ACCT_END)
-        ] + [
-            k + b"=" + v for k, v in self._iter_merged(b"val:", b"val;")
-        ]
-        return hash_from_byte_slices(leaves, site="bank")
+        key order = deterministic leaf order) — served by the statetree
+        as a DIRTY-PATH incremental recompute: only the block's pending
+        writes rehash, each level batched through the PR-5 native hash
+        plane. Byte-identical to the full `hash_from_byte_slices` over
+        the merged ranges (pinned by tests/test_statetree.py +
+        test_bank.py); called under _mu at the end of FinalizeBlock, so
+        the dirty set IS this block's _pending buffer."""
+        tree = self._ensure_state_tree_locked()
+        dirty = {
+            k: v
+            for k, v in self._pending.items()
+            if ACCT_PREFIX <= k < ACCT_END or VAL_PREFIX <= k < VAL_END
+        }
+        return tree.apply(dirty)
+
+    def state_view_at(self, app_hash: bytes):
+        """Retained statetree version whose root is `app_hash`, or None
+        once it aged out — the rpc `state_batch` height binding (a
+        header at height h names the root finalize(h-1) produced; by
+        the time the header exists the live tree has advanced, so
+        serves go through the root-keyed history). Thread-safe; the
+        returned view is immutable and served without the app lock."""
+        with self._mu:
+            return self._ensure_state_tree_locked().view_at(app_hash)
+
+    def set_state_metrics(self, metrics) -> None:
+        """Wire the node's StateMetrics group into the tree (node.py
+        does this right after constructing the builtin app client)."""
+        with self._mu:
+            self._state_metrics = metrics
+            if self._state_tree is not None:
+                self._state_tree.metrics = metrics
+
+    # ----------------------------------------------------------- snapshots
+
+    def _iter_state_items(self):
+        """Streaming snapshot walker: the hashed `acct:`/`val:` ranges
+        come from the statetree's committed view (no db re-scan, no
+        materialized item list), interleaved with the db ranges outside
+        the tree in raw byte order — "acct:" < "bank:" < "kvPairKey:" <
+        "stateKey" < "val:". Byte-identical output to the chassis's
+        whole-db scan, which stays the fallback while the tree is cold
+        or (defensively) out of step with the committed app hash."""
+        tree = self._state_tree
+        view = tree.latest() if tree is not None else None
+        if view is None or view.root != self.app_hash:
+            yield from super()._iter_state_items()
+            return
+        entries = view.iter_entries()
+        carried = None  # first val: entry pulled while draining acct:
+        yield from self.db.iterator(None, ACCT_PREFIX)
+        for k, v in entries:
+            if k >= ACCT_END:
+                carried = (k, v)
+                break
+            yield k, v
+        yield from self.db.iterator(ACCT_END, VAL_PREFIX)
+        if carried is not None:
+            yield carried
+        yield from entries
+        yield from self.db.iterator(VAL_END, None)
+
+    def _take_snapshot(self) -> None:
+        super()._take_snapshot()
+        m = self._state_metrics
+        entry = self._snapshots.get(self.height)
+        if m is not None and entry is not None:
+            try:
+                m.snapshot_chunks.add(entry[0].chunks)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- queries
 

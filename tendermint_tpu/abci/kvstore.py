@@ -250,22 +250,58 @@ class KVStoreApplication(abci.Application):
     # ref: test/e2e/app/snapshots.go — the e2e app's chunked state export
 
     def _serialize_state(self) -> bytes:
+        """The full snapshot document in one contiguous byte string.
+        Kept as the byte-layout ORACLE the streaming generator is
+        property-tested against (tests/test_bank.py) and for small
+        fixtures; the snapshot path itself streams through
+        _iter_serialized_state and never materializes this."""
         items = sorted((k.hex(), v.hex()) for k, v in self.db.iterator(None, None))
         doc = {"height": self.height, "size": self.size, "app_hash": self.app_hash.hex(), "items": items}
         return json.dumps(doc, sort_keys=True).encode()
 
+    def _iter_state_items(self):
+        """(key, value) pairs of the COMMITTED state in key order — the
+        snapshot walker. The db iterator already streams sorted from
+        the store; the bank app overrides the account/validator ranges
+        to walk its statetree views instead (docs/state.md)."""
+        yield from self.db.iterator(None, None)
+
+    def _iter_serialized_state(self):
+        """Byte pieces of EXACTLY _serialize_state()'s output, generated
+        incrementally: format-1 snapshots stay byte-compatible with
+        every pre-streaming peer while the full state string never
+        exists in memory. Key order in the JSON doc is the sorted-keys
+        order (app_hash < height < items < size); the item list rides
+        on key order from the walker, which matches the old
+        sorted-by-hex order because hex encoding preserves byte order."""
+        yield ('{"app_hash": "%s", "height": %d, "items": ['
+               % (self.app_hash.hex(), self.height)).encode()
+        first = True
+        for k, v in self._iter_state_items():
+            piece = '["%s", "%s"]' % (k.hex(), v.hex())
+            yield (piece if first else ", " + piece).encode()
+            first = False
+        yield ('], "size": %d}' % self.size).encode()
+
     def _take_snapshot(self) -> None:
         import hashlib
 
-        data = self._serialize_state()
-        chunks = [
-            data[i : i + self.SNAPSHOT_CHUNK_SIZE] for i in range(0, len(data), self.SNAPSHOT_CHUNK_SIZE)
-        ] or [b""]
+        hasher = hashlib.sha256()
+        chunks: list[bytes] = []
+        buf = bytearray()
+        for piece in self._iter_serialized_state():
+            hasher.update(piece)
+            buf += piece
+            while len(buf) >= self.SNAPSHOT_CHUNK_SIZE:
+                chunks.append(bytes(buf[: self.SNAPSHOT_CHUNK_SIZE]))
+                del buf[: self.SNAPSHOT_CHUNK_SIZE]
+        if buf or not chunks:
+            chunks.append(bytes(buf))
         snap = abci.Snapshot(
             height=self.height,
             format=1,
             chunks=len(chunks),
-            hash=hashlib.sha256(data).digest(),
+            hash=hasher.digest(),
         )
         self._snapshots[self.height] = (snap, chunks)
         # keep a bounded window (snapshots.go keeps a bounded set); wide

@@ -4,7 +4,8 @@ test/e2e/app/; manifest abci_protocol in {builtin, tcp, unix, grpc},
 manifest `app` in {kvstore, bank}).
 
 Usage: python -m tendermint_tpu.e2e.app tcp://127.0.0.1:PORT \
-           [snapshot_interval] [app_name] [retain_blocks]
+           [snapshot_interval] [app_name] [retain_blocks] [state_dir] \
+           [genesis_accounts]
        python -m tendermint_tpu.e2e.app grpc://127.0.0.1:PORT
 """
 
@@ -59,7 +60,7 @@ class DelayedKVStore(KVStoreApplication):
 
 
 def build_app(name: str, snapshot_interval: int = 0, retain_blocks: int = 0,
-              delays_ms: dict | None = None, db=None):
+              delays_ms: dict | None = None, db=None, genesis_accounts: int = 0):
     """Construct a builtin test app by manifest name. ONE factory shared
     by the node's in-process path (node.py _make_app) and this external
     app runner, so `app = "bank"` means the same thing on every
@@ -89,6 +90,10 @@ def build_app(name: str, snapshot_interval: int = 0, retain_blocks: int = 0,
         kw["db"] = db
     if delays_ms:
         kw["delays_ms"] = delays_ms
+    if genesis_accounts:
+        if name != "bank":
+            raise ValueError("genesis_accounts is a bank-app knob")
+        kw["genesis_accounts"] = genesis_accounts
     return cls(**kw)
 
 
@@ -101,6 +106,7 @@ def main() -> int:
     app_name = sys.argv[3] if len(sys.argv) > 3 else "kvstore"
     retain_blocks = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     state_dir = sys.argv[5] if len(sys.argv) > 5 else ""
+    genesis_accounts = int(sys.argv[6]) if len(sys.argv) > 6 else 0
     delays = json.loads(os.environ.get("TM_E2E_DELAYS_MS", "{}"))
     db = None
     if state_dir:
@@ -108,7 +114,8 @@ def main() -> int:
 
         db = FileDB(os.path.join(state_dir, "app.db"))
     app = build_app(app_name, snapshot_interval=snapshot_interval,
-                    retain_blocks=retain_blocks, delays_ms=delays or None, db=db)
+                    retain_blocks=retain_blocks, delays_ms=delays or None, db=db,
+                    genesis_accounts=genesis_accounts)
     if addr.startswith("grpc://"):
         from ..abci.grpc import GRPCServer
 

@@ -115,6 +115,12 @@ class Manifest:
     # signed transfers, merkle app hash, hundreds-of-chunks snapshots —
     # so statesync/pruning/indexer paths see non-trivial state
     app: str = "kvstore"
+    # bank-only state ballast: seed this many deterministic accounts at
+    # InitChain (abci/bank.py genesis_accounts) so the authenticated
+    # state plane (statetree, snapshots, state_batch) runs at scale
+    # from height 1. Core-gated by scenario.resolve_for_cores — small
+    # boxes clamp it (docs/state.md#scale)
+    genesis_accounts: int = 0
     # app ResponseCommit.retain_height window: every Commit past this
     # many blocks asks the node to prune blocks/states below
     # height - retain_blocks + 1 (state/execution.py). 0 = keep all
@@ -158,6 +164,7 @@ class Manifest:
             app=doc.get("app", "kvstore"),
             empty_blocks_interval=float(doc.get("empty_blocks_interval", 0.0)),
             block_max_bytes=int(doc.get("block_max_bytes", 0)),
+            genesis_accounts=int(doc.get("genesis_accounts", 0)),
             retain_blocks=int(doc.get("retain_blocks", 0)),
             snapshot_interval=int(doc.get("snapshot_interval", 0)),
             vote_extensions_enable_height=int(doc.get("vote_extensions_enable_height", 0)),

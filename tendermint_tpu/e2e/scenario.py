@@ -242,6 +242,16 @@ def resolve_for_cores(
     cap = max_nodes_for(cores)
     small = cores < FULL_MIX_CORES
 
+    if small and m.genesis_accounts > 1000:
+        # a six-figure account plane is a big-box scenario: InitChain
+        # seeding + first snapshot generation alone eat tens of CPU
+        # seconds a saturated small box pays out of consensus cadence
+        notes.append(
+            f"genesis_accounts: clamped {m.genesis_accounts} -> 1000 "
+            f"({cores} cores < {FULL_MIX_CORES})"
+        )
+        m.genesis_accounts = 1000
+
     if small:
         # the kill/pause-only rule (docs/e2e.md#core-gating): strip
         # every storm-surface perturbation from the node lists...
@@ -383,7 +393,9 @@ def render_resolution(manifest: Manifest, timeline: SoakTimeline,
         f"manifest: chain_id={manifest.chain_id} app={manifest.app} "
         f"nodes={len(manifest.nodes)} key_type={manifest.key_type} "
         f"snapshot_interval={manifest.snapshot_interval} "
-        f"retain_blocks={manifest.retain_blocks}",
+        f"retain_blocks={manifest.retain_blocks}"
+        + (f" genesis_accounts={manifest.genesis_accounts}"
+           if manifest.genesis_accounts else ""),
         f"core gate: {cores} core(s) -> "
         + ("full perturbation mix" if cores >= FULL_MIX_CORES
            else "kill/pause/restart only")

@@ -295,6 +295,64 @@ class LightProxy:
             proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "light_batch")
             return out
 
+        def state_batch(height=None, keys=None):
+            """The light client's VERIFIED state read (tmstate,
+            docs/state.md): relay the primary's batched account
+            multiproof only after it reconstructs the app_hash of a
+            light-verified header. Each leaf is key + "=" + value, so
+            a substituted key OR value changes the leaf bytes and the
+            proof stops verifying — the header_forge-style index
+            substitution the tx plane refuses is refused here on state
+            keys too. Heights past the verified head are refused."""
+            from ..metrics import proof_metrics
+            from ..rpc.core import multiproof_from_json
+
+            self._check_input(height is not None, "light proxy requires an explicit height")
+            # client-input validation FIRST with the full-node route's
+            # -32602 semantics: caller mistakes are not divergences
+            if not isinstance(keys, (list, tuple)) or not keys:
+                raise RPCError(-32602, "keys must be a non-empty list of hex-encoded state keys")
+            try:
+                req_keys = [bytes.fromhex(k) for k in keys]
+            except (TypeError, ValueError):
+                raise RPCError(-32602, f"invalid state keys: {keys!r}")
+            t0 = _time.perf_counter()
+            h = int(height)
+            head = None
+            try:
+                head = self.client.update()
+            except Exception:  # noqa: BLE001 - a dead primary: serve the stored head
+                pass
+            head = head or self.client.latest_trusted()
+            self._require(
+                head is not None and h <= head.height,
+                f"height {h} is past the verified head "
+                f"{head.height if head is not None else 0}",
+            )
+            lb = self._verified_header(h)
+            res = self.primary.call("state_batch", height=str(h), keys=list(keys))
+            try:
+                mp = multiproof_from_json(res.get("multiproof") or {})
+                got_keys = [bytes.fromhex(k) for k in res.get("keys") or []]
+                values = [bytes.fromhex(v) for v in res.get("values") or []]
+            except Exception as e:
+                raise RPCError(-32603, f"light proxy: malformed state proof from primary: {e}")
+            # a validly-proven but DIFFERENT key set is a substitution
+            # attack: the proof must cover exactly the requested keys
+            self._require(
+                got_keys == req_keys and len(values) == len(req_keys),
+                "primary returned state proofs for different keys than requested",
+            )
+            want = lb.signed_header.header.app_hash
+            self._require(
+                mp.verify(want, [k + b"=" + v for k, v in zip(got_keys, values)]),
+                "primary state multiproof does not verify against the verified app_hash",
+            )
+            # never relay the primary's self-reported root
+            res["root"] = want.hex().upper()
+            proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "state_batch")
+            return res
+
         def validators(height=None):
             self._check_input(height is not None, "light proxy requires an explicit height")
             lb = self._verified_header(int(height))
@@ -325,6 +383,7 @@ class LightProxy:
             "header": header,
             "proofs_batch": proofs_batch,
             "light_batch": light_batch,
+            "state_batch": state_batch,
             "validators": validators,
         }
         for m in ("broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",

@@ -588,7 +588,9 @@ class EvidenceMetrics:
 
 
 class StateMetrics:
-    """ref: internal/state/metrics.go."""
+    """ref: internal/state/metrics.go (block timings); the rest is the
+    tmstate app-state plane (statetree/, docs/state.md) — dirty-path
+    commit shape, rehash cost by mode, and verified state reads."""
 
     def __init__(self, reg: Registry):
         ns = f"{NAMESPACE}_state"
@@ -597,6 +599,35 @@ class StateMetrics:
         )
         self.block_verify_time = reg.histogram(
             f"{ns}_block_verify_time", "Time of LastCommit verification", buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1)
+        )
+        # statetree commit modes: "full" (cold rebuild), "path" (pure
+        # updates, dirty root-paths only), "structural" (insert/delete
+        # reshapes the tree; unchanged subtrees are memo-copied)
+        self.dirty_path_size = reg.histogram(
+            f"{ns}_dirty_path_size",
+            "Dirty leaves per statetree commit by mode",
+            labels=("mode",),
+            buckets=(1, 4, 16, 64, 256, 1024, 4096),
+        )
+        self.rehash_seconds = reg.histogram(
+            f"{ns}_rehash_seconds",
+            "Statetree commit rehash latency by mode",
+            labels=("mode",),
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+        )
+        self.nodes_rehashed = reg.counter(
+            f"{ns}_nodes_rehashed_total",
+            "Merkle nodes rehashed by statetree commits, by mode",
+            labels=("mode",),
+        )
+        self.proofs_served = reg.counter(
+            f"{ns}_proofs_served_total",
+            "Authenticated state reads served, by route",
+            labels=("route",),
+        )
+        self.snapshot_chunks = reg.counter(
+            f"{ns}_snapshot_chunks_total",
+            "Snapshot chunks generated by the streaming exporter",
         )
 
     def observe(self, name: str, value: float) -> None:

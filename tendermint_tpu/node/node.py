@@ -97,7 +97,8 @@ def _make_app(proxy_app: str, app_db=None):
     if proxy_app.startswith("builtin:") and not proxy_app.startswith("builtin:noop"):
         parts = proxy_app.split(":")[1:]  # [name, opt, opt...]
         name, kw = parts[0], {}
-        opt_names = {"snapshot": "snapshot_interval", "retain": "retain_blocks"}
+        opt_names = {"snapshot": "snapshot_interval", "retain": "retain_blocks",
+                     "accounts": "genesis_accounts"}
         for opt in parts[1:]:
             k, _, v = opt.partition("=")
             if k not in opt_names:
@@ -211,6 +212,12 @@ class Node:
                 config.base.proxy_app,
                 app_db=_make_db(config, "app") if builtin else None,
             )
+        # in-process apps with an authenticated state plane (bank's
+        # statetree) report dirty-path sizes / rehash latencies into the
+        # node's tendermint_state_* series
+        _app = getattr(self.app_client, "_app", None)
+        if _app is not None and hasattr(_app, "set_state_metrics"):
+            _app.set_state_metrics(self.state_metrics)
         from ..eventbus.eventlog import EventLog
 
         self.event_bus = EventBus(event_log=EventLog())

@@ -686,6 +686,69 @@ def build_routes(env: RPCEnvironment) -> dict:
         proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "light_batch")
         return out
 
+    def state_batch(height=None, keys=None):
+        """k authenticated app-STATE reads at a height as ONE batched
+        multiproof over the application's account/validator merkle tree
+        (tmstate, docs/state.md). The proof root IS the header's
+        app_hash at `height` — which commits the state FinalizeBlock
+        (height-1) produced — so a light client that verified the
+        header can verify the values with no extra trust. `keys` are
+        hex-encoded raw state keys (e.g. the bytes of `acct:<addr-hex>`),
+        sorted and distinct (the multiproof index contract, shared with
+        proofs_batch via crypto/merkle._validate_indices). Verify with
+        MultiProof.verify(app_hash, [key + b"=" + value, ...])."""
+        from ..metrics import proof_metrics
+
+        t0 = _time.perf_counter()
+        h = _height_or_latest(height)
+        if not isinstance(keys, (list, tuple)) or not keys:
+            raise RPCError(-32602, "keys must be a non-empty list of hex-encoded state keys")
+        if len(keys) > MAX_PROOF_INDICES:
+            raise RPCError(
+                -32602, f"at most {MAX_PROOF_INDICES} keys per request, got {len(keys)}"
+            )
+        raw_keys = [_as_bytes_hex(k, "keys") for k in keys]
+        meta = env.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no header at height {h}")
+        # in-process apps expose the statetree's root-keyed history;
+        # external/socket apps (and the kvstore's varint hash) don't
+        app = getattr(env.app_client, "_app", None)
+        view_at = getattr(app, "state_view_at", None)
+        if view_at is None:
+            raise RPCError(-32603, "app does not serve an authenticated state plane")
+        view = view_at(meta.header.app_hash)
+        if view is None:
+            raise RPCError(
+                -32603,
+                f"state at height {h} is not retained "
+                f"(app hash {_hex(meta.header.app_hash)} aged out of the history window)",
+            )
+        idxs = []
+        for k_hex, rk in zip(keys, raw_keys):
+            try:
+                idxs.append(view.index_of(rk))
+            except KeyError:
+                raise RPCError(-32602, f"unknown state key {k_hex!r} at height {h}")
+        try:
+            # unsorted / duplicate keys surface here as the shared
+            # _validate_indices contract (key order == leaf order)
+            mp = view.multiproof(idxs)
+        except ValueError as e:
+            raise RPCError(-32602, str(e))
+        m = getattr(app, "_state_metrics", None)
+        if m is not None:
+            m.proofs_served.add(len(idxs), "state_batch")
+        proof_metrics().serve_seconds.observe(_time.perf_counter() - t0, "state_batch")
+        return {
+            "height": str(h),
+            "root": _hex(view.root),
+            "total": str(len(view)),
+            "keys": [rk.hex() for rk in raw_keys],
+            "values": [view.value_at(i).hex() for i in idxs],
+            "multiproof": multiproof_to_json(mp),
+        }
+
     def validators(height=None, page=1, per_page=30):
         """Paginated validator set at a height."""
         h = _height_or_latest(height)
@@ -998,6 +1061,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         "commit": commit,
         "proofs_batch": proofs_batch,
         "light_batch": light_batch,
+        "state_batch": state_batch,
         "validators": validators,
         "consensus_params": consensus_params,
         "consensus_state": consensus_state,

@@ -1,0 +1,470 @@
+"""tmstate — persistent authenticated merkle layer over key->value
+application state with dirty-path-only recompute (docs/state.md).
+
+The bank app's original commit path recomputed the RFC-6962 root over
+the ENTIRE account set every block (abci/bank.py `_compute_app_hash`) —
+O(n) hashing per block no matter how few accounts the block touched.
+This module keeps the full tree (every level, bottom-up — the
+`TreeLevels` shape the tmproof gateway serves from) alive across
+commits and restates a block's work as its dirty set:
+
+  - a pure update (k existing keys change value) rehashes only the k
+    root paths — each level's dirty parents batched through ONE
+    `tm_sha256_batch` call, so a commit costs O(k log n) hashes in
+    O(log n) native calls;
+  - a structural commit (insert/delete) reuses every unchanged LEAF
+    hash and rebuilds inner levels with a content-keyed pair memo: a
+    parent whose (left, right) children both existed as a pair in the
+    previous tree is copied, not rehashed;
+  - the resulting root is byte-identical to
+    `hash_from_byte_slices([k + b"=" + v, ...])` over the full sorted
+    item list — pinned by the tests/test_statetree.py property sweep.
+
+Every commit publishes an immutable `StateView` into a bounded
+root-keyed history. Path commits share structure: each version is a
+sparse overlay (`_PatchedList`) over the previous version's levels —
+O(k log n) new pointers per commit, periodically flattened back to
+plain lists — so persistence costs nothing like n copies. That persistence is what makes the
+plane servable: a header at height H carries the app hash produced by
+finalizing H-1, so by the time a light client can name H the live tree
+has advanced past it — `state_batch` (rpc/core.py) looks the header's
+app_hash up in this history and assembles account multiproofs with
+zero hashing (`TreeLevels.multiproof` node assembly), no app lock held.
+
+The `StateMetrics` hook (dirty sizes, rehash seconds by mode, proofs
+served) is optional and never raises — trees built in tests and
+benches run bare.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import time as _time
+from typing import Iterable, Mapping
+
+from ..crypto import merkle as _merkle
+from ..crypto.merkle import (
+    INNER_PREFIX,
+    LEAF_PREFIX,
+    MultiProof,
+    TreeLevels,
+    _validate_indices,
+)
+
+__all__ = ["StateTree", "StateView", "state_leaf"]
+
+# Bounded per-commit view history. Sized for the serve window: a light
+# client chasing the head asks for roots at most a few blocks stale
+# (its verified header trails the live tree by the finalize->commit->
+# header pipeline depth), not for archaeology.
+DEFAULT_HISTORY_DEPTH = 8
+
+# Path commits publish overlay levels (`_PatchedList`); every this-many
+# of them the overlays are materialized back to plain lists so patch
+# dicts stay bounded and reads stay O(1) with no chain to walk.
+_FLATTEN_EVERY = 8
+
+# Below this batch size the native sha256 plane loses: its per-call
+# ctypes marshalling (~0.2ms) costs more than hashing the whole batch
+# with hashlib. Dirty-path commits issue O(log n) small batches, so
+# routing them through hashlib is a ~6x commit-latency win.
+_NATIVE_BATCH_MIN = 256
+
+_EMPTY_ROOT = hashlib.sha256(b"").digest()
+
+
+def sha256_batch(items: list[bytes]) -> list[bytes]:
+    """Size-dispatched batch hashing: big batches (full/structural
+    rebuilds) go to the native plane, small ones (per-level dirty
+    parents) to a plain hashlib loop. Module-global so tests can
+    intercept every hash the tree performs."""
+    if len(items) >= _NATIVE_BATCH_MIN:
+        return _merkle.sha256_batch(items)
+    sha = hashlib.sha256
+    return [sha(x).digest() for x in items]
+
+
+class _PatchedList:
+    """List-like overlay: a shared plain-list base plus a sparse
+    {index: value} patch. `TreeLevels` reads its level objects only
+    through len() and integer indexing, so a path commit can publish
+    patched levels — O(dirty · log n) new pointers — instead of
+    pointer-copying all O(n) of them. Bases are always plain lists
+    (never another overlay): composing a new commit on a patched level
+    copies the patch dict, so published views stay immutable."""
+
+    __slots__ = ("base", "patch")
+
+    def __init__(self, base: list[bytes], patch: dict[int, bytes]):
+        self.base = base
+        self.patch = patch
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def __getitem__(self, i: int) -> bytes:
+        if i < 0:
+            i += len(self.base)
+        p = self.patch
+        return p[i] if i in p else self.base[i]
+
+    def __iter__(self):
+        patch = self.patch
+        if not patch:
+            yield from self.base
+            return
+        base = self.base
+        for i in range(len(base)):
+            yield patch[i] if i in patch else base[i]
+
+
+def _overlay(level, patch: dict[int, bytes]) -> _PatchedList:
+    """`level` with `patch` applied on top, flattening overlay-on-
+    overlay into one patch dict over the shared plain base."""
+    if type(level) is _PatchedList:
+        merged = dict(level.patch)
+        merged.update(patch)
+        return _PatchedList(level.base, merged)
+    return _PatchedList(level, patch)
+
+
+def _materialize(level) -> list[bytes]:
+    """A plain list with the overlay (if any) applied — the flatten
+    step, and the fast path for O(n) walks over possibly-patched
+    levels (a raw list copy beats n __getitem__ dispatches)."""
+    if type(level) is not _PatchedList:
+        return level
+    out = list(level.base)
+    for i, v in level.patch.items():
+        out[i] = v
+    return out
+
+
+def state_leaf(key: bytes, value: bytes) -> bytes:
+    """The leaf byte layout the bank app hashes: key '=' value. Shared
+    so provers (rpc), verifiers (light proxy) and the tree agree on
+    one encoding."""
+    return key + b"=" + value
+
+
+class StateView:
+    """One committed tree version: sorted keys, raw leaves, and the
+    built levels. Immutable once published — proof serving needs no
+    lock, and the snapshot walker can stream from it while the live
+    tree advances."""
+
+    __slots__ = ("keys", "leaves", "tree")
+
+    def __init__(self, keys: list[bytes], leaves: list[bytes], tree: TreeLevels):
+        self.keys = keys
+        self.leaves = leaves
+        self.tree = tree
+
+    @property
+    def root(self) -> bytes:
+        return self.tree.root
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def index_of(self, key: bytes) -> int:
+        """Leaf index of `key`, or KeyError — the state_batch route maps
+        requested keys to tree indices through this."""
+        i = bisect.bisect_left(self.keys, key)
+        if i == len(self.keys) or self.keys[i] != key:
+            raise KeyError(key)
+        return i
+
+    def get(self, key: bytes) -> bytes | None:
+        try:
+            i = self.index_of(key)
+        except KeyError:
+            return None
+        return self.leaves[i][len(key) + 1:]
+
+    def value_at(self, index: int) -> bytes:
+        return self.leaves[index][len(self.keys[index]) + 1:]
+
+    def multiproof(self, indices) -> MultiProof:
+        """Batched proof for sorted distinct leaf indices — pure node
+        assembly from the held levels (raises ValueError on a
+        contract violation, like every multiproof producer)."""
+        idxs = _validate_indices(len(self.keys), indices)
+        return self.tree.multiproof(idxs)
+
+    def iter_entries(self) -> Iterable[tuple[bytes, bytes]]:
+        """(key, value) in key order — the streaming snapshot walker."""
+        for k, leaf in zip(self.keys, self.leaves):
+            yield k, leaf[len(k) + 1:]
+
+
+class StateTree:
+    """The live persistent tree. `apply` advances it by one commit's
+    dirty set; every version's view is retained (root-keyed, bounded)
+    for proof serving against recent headers."""
+
+    def __init__(
+        self,
+        items: Iterable[tuple[bytes, bytes]] = (),
+        history_depth: int = DEFAULT_HISTORY_DEPTH,
+        metrics=None,
+        site: str = "state",
+    ):
+        if history_depth < 1:
+            raise ValueError(f"history_depth must be >= 1, got {history_depth}")
+        self.history_depth = history_depth
+        self.metrics = metrics
+        self.site = site
+        self._root_hash: bytes | None = None
+        self._history: dict[bytes, StateView] = {}
+        self._history_order: list[bytes] = []
+        self._path_commits = 0
+        self.rebuild(items)
+
+    # ------------------------------------------------------------- reads
+
+    def hash(self) -> bytes:
+        """Current root. The memo is invalidated by every mutator
+        (`apply`/`rebuild` assign None before publishing a version),
+        so a served root can never be stale."""
+        if self._root_hash is None:
+            self._root_hash = self._view.root
+        return self._root_hash
+
+    def __len__(self) -> int:
+        return len(self._view.keys)
+
+    def latest(self) -> StateView:
+        return self._view
+
+    def view_at(self, root: bytes) -> StateView | None:
+        """The retained version whose root is `root` (the state_batch
+        height binding: header(h).app_hash names a version), or None
+        once it ages out of the history window."""
+        return self._history.get(root)
+
+    # ---------------------------------------------------------- mutators
+
+    def rebuild(self, items: Iterable[tuple[bytes, bytes]]) -> bytes:
+        """Full resync from sorted (key, value) pairs — node start,
+        rollback, and snapshot restore. Returns the new root."""
+        t0 = _time.perf_counter()
+        keys: list[bytes] = []
+        leaves: list[bytes] = []
+        prev = None
+        for k, v in items:
+            if prev is not None and k <= prev:
+                raise ValueError(
+                    f"statetree items must be sorted strictly ascending "
+                    f"(got {k!r} after {prev!r})"
+                )
+            prev = k
+            keys.append(k)
+            leaves.append(state_leaf(k, v))
+        self._root_hash = None
+        self._path_commits = 0
+        tree = TreeLevels.build(leaves, site=self.site)
+        self._publish(keys, leaves, tree)
+        self._observe("full", len(keys), len(keys), _time.perf_counter() - t0)
+        return self.hash()
+
+    def apply(self, dirty: Mapping[bytes, bytes | None]) -> bytes:
+        """Advance by one commit: `dirty` maps key -> new value (None =
+        delete). Existing-key updates take the batched dirty-path walk;
+        inserts/deletes rebuild structure but reuse unchanged leaf
+        hashes and memoized unchanged inner pairs. Returns the new
+        root. An empty (or no-op) dirty set returns the current root
+        unchanged — no new version is published."""
+        t0 = _time.perf_counter()
+        keys = self._view.keys
+        updates: dict[int, bytes] = {}
+        inserts: dict[bytes, bytes] = {}
+        deletes: set[int] = set()
+        for k, v in dirty.items():
+            i = bisect.bisect_left(keys, k)
+            present = i < len(keys) and keys[i] == k
+            if v is None:
+                if present:
+                    deletes.add(i)
+            elif present:
+                leaf = state_leaf(k, v)
+                if self._view.leaves[i] != leaf:
+                    updates[i] = leaf
+            else:
+                inserts[k] = state_leaf(k, v)
+        if not updates and not inserts and not deletes:
+            return self.hash()
+        self._root_hash = None
+        if inserts or deletes:
+            hashed = self._apply_structural(updates, inserts, deletes)
+            mode = "structural"
+        else:
+            hashed = self._apply_paths(updates)
+            mode = "path"
+        dirty_n = len(updates) + len(inserts) + len(deletes)
+        self._observe(mode, dirty_n, hashed, _time.perf_counter() - t0)
+        return self.hash()
+
+    # ------------------------------------------------------------ internals
+
+    def _publish(self, keys, leaves, tree: TreeLevels) -> None:
+        view = StateView(keys, leaves, tree)
+        self._view = view
+        root = tree.root
+        if root in self._history:
+            self._history_order.remove(root)
+        self._history[root] = view
+        self._history_order.append(root)
+        while len(self._history_order) > self.history_depth:
+            old = self._history_order.pop(0)
+            del self._history[old]
+
+    def _apply_paths(self, updates: dict[int, bytes]) -> int:
+        """k existing leaves changed: rehash exactly the k root paths.
+        Leaf hashes in one batch, then each level's dirty parents in
+        one batch — O(log n) sha256_batch calls total. New versions
+        are sparse overlays over the previous version's levels
+        (O(k log n) new pointers, not O(n) copies); every
+        _FLATTEN_EVERY path commits the overlays are materialized so
+        patch dicts stay bounded."""
+        old = self._view
+        positions = sorted(updates)
+        new_hashes = sha256_batch([LEAF_PREFIX + updates[p] for p in positions])
+        hashed = len(positions)
+        leaves = _overlay(old.leaves, {p: updates[p] for p in positions})
+        old_levels = old.tree.levels
+        levels = [_overlay(old_levels[0], dict(zip(positions, new_hashes)))]
+        dirty = positions
+        for li in range(len(old_levels) - 1):
+            child = levels[li]
+            n_child = len(child)
+            parents = sorted({p >> 1 for p in dirty})
+            patch: dict[int, bytes] = {}
+            todo = []
+            for p in parents:
+                if 2 * p + 1 < n_child:
+                    todo.append(p)
+                else:
+                    patch[p] = child[2 * p]  # promoted odd tail
+            if todo:
+                digs = sha256_batch(
+                    [INNER_PREFIX + child[2 * p] + child[2 * p + 1] for p in todo]
+                )
+                for p, d in zip(todo, digs):
+                    patch[p] = d
+                hashed += len(todo)
+            levels.append(_overlay(old_levels[li + 1], patch))
+            dirty = parents
+        self._path_commits += 1
+        if self._path_commits >= _FLATTEN_EVERY:
+            self._path_commits = 0
+            leaves = _materialize(leaves)
+            levels = [_materialize(lv) for lv in levels]
+        self._publish(old.keys, leaves, TreeLevels(levels, len(leaves), backend=old.tree.backend))
+        return hashed
+
+    def _apply_structural(
+        self, updates: dict[int, bytes], inserts: dict[bytes, bytes], deletes: set[int]
+    ) -> int:
+        """Insert/delete shifts leaf positions, so the level structure
+        changes — but leaf hashes of unchanged keys are position-free
+        and inner pairs are content-addressed: both are reused."""
+        old = self._view
+        old_keys = old.keys
+        old_leaves = _materialize(old.leaves)
+        old_hashes = _materialize(old.tree.levels[0]) if old_leaves else []
+        self._path_commits = 0
+        ins_keys = sorted(inserts)
+        keys: list[bytes] = []
+        leaves: list[bytes] = []
+        hashes: list[bytes | None] = []
+        i, j, n_old, n_ins = 0, 0, len(old_keys), len(ins_keys)
+        while i < n_old or j < n_ins:
+            if j >= n_ins or (i < n_old and old_keys[i] < ins_keys[j]):
+                if i in deletes:
+                    i += 1
+                    continue
+                keys.append(old_keys[i])
+                if i in updates:
+                    leaves.append(updates[i])
+                    hashes.append(None)
+                else:
+                    leaves.append(old_leaves[i])
+                    hashes.append(old_hashes[i])
+                i += 1
+            else:
+                k = ins_keys[j]
+                keys.append(k)
+                leaves.append(inserts[k])
+                hashes.append(None)
+                j += 1
+        missing = [p for p, h in enumerate(hashes) if h is None]
+        if missing:
+            digs = sha256_batch([LEAF_PREFIX + leaves[p] for p in missing])
+            for p, d in zip(missing, digs):
+                hashes[p] = d
+        hashed = len(missing)
+        levels, inner_hashed = self._rebuild_inner(hashes, old.tree.levels if old_leaves else None)
+        self._publish(keys, leaves, TreeLevels(levels, len(leaves), backend=old.tree.backend))
+        return hashed + inner_hashed
+
+    @staticmethod
+    def _rebuild_inner(
+        leaf_hashes: list[bytes], old_levels: list[list[bytes]] | None
+    ) -> tuple[list[list[bytes]], int]:
+        """Inner levels over `leaf_hashes`, copying any parent whose
+        concatenated children also formed a pair in `old_levels` (the
+        content-keyed memo: dict lookups on 64-byte keys are ~100x
+        cheaper than the sha256 they skip). Returns (levels, hashed)."""
+        if not leaf_hashes:
+            return [[_EMPTY_ROOT]], 0
+        levels = [leaf_hashes]
+        hashed = 0
+        li = 0
+        while len(levels[-1]) > 1:
+            child = levels[-1]
+            memo: dict[bytes, bytes] | None = None
+            if old_levels is not None and li + 1 < len(old_levels):
+                oc, op = _materialize(old_levels[li]), _materialize(old_levels[li + 1])
+                memo = {}
+                for p in range(len(op)):
+                    if 2 * p + 1 < len(oc):
+                        memo[oc[2 * p] + oc[2 * p + 1]] = op[p]
+            half = (len(child) + 1) // 2
+            nxt: list[bytes | None] = [None] * half
+            todo = []
+            for p in range(half):
+                if 2 * p + 1 >= len(child):
+                    nxt[p] = child[2 * p]  # promoted odd tail
+                    continue
+                pair = child[2 * p] + child[2 * p + 1]
+                hit = memo.get(pair) if memo is not None else None
+                if hit is not None:
+                    nxt[p] = hit
+                else:
+                    todo.append((p, pair))
+            if todo:
+                digs = sha256_batch([INNER_PREFIX + pair for _p, pair in todo])
+                for (p, _pair), d in zip(todo, digs):
+                    nxt[p] = d
+                hashed += len(todo)
+            levels.append(nxt)
+            li += 1
+        return levels, hashed
+
+    def _observe(self, mode: str, dirty: int, hashed: int, seconds: float) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        # StateMetrics fields; writes are _never_raise on their side,
+        # the getattr guards an older metrics object without the group
+        h = getattr(m, "dirty_path_size", None)
+        if h is not None:
+            h.observe(dirty, mode)
+        h = getattr(m, "rehash_seconds", None)
+        if h is not None:
+            h.observe(seconds, mode)
+        c = getattr(m, "nodes_rehashed", None)
+        if c is not None:
+            c.add(hashed, mode)

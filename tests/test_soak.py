@@ -148,6 +148,21 @@ def test_core_gate_small_box_strips_storms_and_clamps():
     tl.resolve(small)
 
 
+def test_genesis_accounts_axis():
+    """tmstate ballast knob (ISSUE 18): parses, rides the builtin
+    proxy-app spec, is refused off the bank app, and core-gates."""
+    text = "app = 'bank'\ngenesis_accounts = 100000\n[node.validator01]"
+    m = Manifest.parse(text)
+    assert m.genesis_accounts == 100000
+    # small box: clamped to 1000 with a note; big box: untouched
+    small, _tl, notes = resolve_for_cores(m, cores=1)
+    assert small.genesis_accounts == 1000
+    assert any("genesis_accounts" in n for n in notes)
+    big, _tl, notes = resolve_for_cores(m, cores=FULL_MIX_CORES)
+    assert big.genesis_accounts == 100000 and notes == []
+    assert m.genesis_accounts == 100000  # input never mutated
+
+
 def test_core_gate_big_box_is_identity_and_deterministic():
     m = Manifest.parse(MIXED)
     big, tl, notes = resolve_for_cores(m, cores=FULL_MIX_CORES * 4)
@@ -552,6 +567,10 @@ def test_builtin_proxy_app_composition(tmp_path):
     assert spec(
         "retain_blocks = 5\n[node.validator01]"
     ) == "builtin:kvstore:retain=5"
+    assert spec(
+        "app = 'bank'\nsnapshot_interval = 3\ngenesis_accounts = 1000\n"
+        "[node.validator01]"
+    ) == "builtin:bank:snapshot=3:accounts=1000"
 
 
 def test_runner_setup_validates_new_axes(tmp_path):
@@ -569,6 +588,9 @@ def test_runner_setup_validates_new_axes(tmp_path):
     lonely_light = Manifest.parse("[node.light01]\nmode = 'light'")
     with pytest.raises(ValueError, match="light proxies need"):
         Runner(lonely_light, str(tmp_path / "c")).setup()
+    ballast_kv = Manifest.parse("genesis_accounts = 100\n[node.validator01]")
+    with pytest.raises(ValueError, match="genesis_accounts requires"):
+        Runner(ballast_kv, str(tmp_path / "d")).setup()
 
 
 # ------------------------------------------------------------------ tmsoak
@@ -647,3 +669,52 @@ def test_e2e_soak_small(tmp_path):
     # every scheduled action fired (the timeline is the test plan)
     assert {a["kind"] for a in summary["actions"]} == {
         "rolling_restart", "kill", "pause", "flood", "statesync_join"}
+
+
+@pytest.mark.slow
+def test_e2e_soak_state_plane(tmp_path):
+    """The ISSUE-18 acceptance run: the soak-large net with its
+    genesis-account ballast — every node's bank app carries the
+    authenticated state plane from height 1, the statesync joiner
+    restores it from STREAMED snapshot chunks, every consensus node
+    emits nonzero tendermint_state_ series, and (when the core gate
+    keeps a light proxy aboard) the proxy serves a verified
+    state_batch read. Six-figure accounts need >= FULL_MIX_CORES
+    cores; smaller boxes run the clamped 1000-account shape of the
+    same plane (e2e/scenario.py resolve_for_cores)."""
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        pytest.skip(
+            "soak-large live run needs >=2 cores (docs/e2e.md"
+            "#core-gating; run scripts/tmsoak.py run "
+            "e2e-manifests/soak-large.toml manually run-alone)"
+        )
+    from tendermint_tpu.e2e.runner import run_soak
+
+    runner, summary = run_soak(
+        SOAK_LARGE, str(tmp_path / "net"), duration=75.0,
+        logger=lambda *a: None,
+    )
+    report = runner.last_report
+    assert report is not None and report["verdict"] == "pass", (
+        report and report["gates"]
+    )
+    sr = summary["soak_report"]
+    # the joiner restored real streamed state: with the six-figure
+    # ballast that is hundreds of chunks, clamped boxes still multi-chunk
+    assert sr["statesync_restored"], sr
+    min_chunks = 100 if cores >= FULL_MIX_CORES else 2
+    assert sr["statesync_restored"][0]["chunks_applied"] >= min_chunks, sr
+    st = sr["state"]
+    assert st["nodes"], st
+    assert all(row["series"] > 0 for row in st["nodes"]), (
+        "a consensus node ran with a silent tmstate plane", st)
+    if any(n.m.mode == "light" for n in runner.nodes):
+        lr = st["light_read"]
+        assert lr and "error" not in lr, st
+        assert lr["keys"] == 1 and lr["root"], st
+    from tendermint_tpu.abci.bank import TREASURY_SUPPLY
+
+    assert sr["bank"] and sr["bank"].get("supply") == TREASURY_SUPPLY, sr
+    expected_ballast = 100000 if cores >= FULL_MIX_CORES else 1000
+    assert sr["bank"]["accounts"] >= expected_ballast, sr

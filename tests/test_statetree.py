@@ -1,0 +1,221 @@
+"""tmstate statetree tests (statetree/__init__.py, ISSUE 18): the
+dirty-path incremental root must be byte-identical to the full
+recompute across randomized update/insert/delete batches, history
+views must serve verifiable multiproofs for recent roots, and the
+walker must stream entries in key order."""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import pytest
+
+from tendermint_tpu.crypto.merkle import hash_from_byte_slices
+from tendermint_tpu.statetree import StateTree, state_leaf
+
+
+def _full_root(model: dict[bytes, bytes]) -> bytes:
+    return hash_from_byte_slices([state_leaf(k, v) for k, v in sorted(model.items())])
+
+
+def _key(i: int) -> bytes:
+    return b"acct:%08x" % i
+
+
+def test_empty_tree_matches_full_recompute():
+    tree = StateTree()
+    assert tree.hash() == _full_root({})
+    assert len(tree) == 0
+
+
+def test_build_matches_full_recompute():
+    model = {_key(i): b"v%d" % i for i in range(97)}
+    tree = StateTree(sorted(model.items()))
+    assert tree.hash() == _full_root(model)
+
+
+def test_rebuild_rejects_unsorted_items():
+    with pytest.raises(ValueError):
+        StateTree([(b"b", b"1"), (b"a", b"2")])
+    with pytest.raises(ValueError):
+        StateTree([(b"a", b"1"), (b"a", b"2")])
+
+
+def test_empty_dirty_set_is_noop():
+    model = {_key(i): b"v" for i in range(10)}
+    tree = StateTree(sorted(model.items()))
+    root = tree.hash()
+    view = tree.latest()
+    assert tree.apply({}) == root
+    assert tree.latest() is view, "no-op commit must not publish a version"
+    # a delete of an absent key and a same-value write are no-ops too
+    assert tree.apply({b"missing": None, _key(3): b"v"}) == root
+    assert tree.latest() is view
+
+
+def test_whole_tree_dirty_update():
+    model = {_key(i): b"v%d" % i for i in range(64)}
+    tree = StateTree(sorted(model.items()))
+    dirty = {k: b"w" + v for k, v in model.items()}
+    model.update(dirty)
+    assert tree.apply(dirty) == _full_root(model)
+
+
+def test_pure_update_single_path():
+    model = {_key(i): b"v" for i in range(1000)}
+    tree = StateTree(sorted(model.items()))
+    model[_key(123)] = b"changed"
+    assert tree.apply({_key(123): b"changed"}) == _full_root(model)
+
+
+def test_insert_into_empty_and_delete_to_empty():
+    tree = StateTree()
+    model: dict[bytes, bytes] = {}
+    model[_key(1)] = b"a"
+    assert tree.apply({_key(1): b"a"}) == _full_root(model)
+    model[_key(2)] = b"b"
+    assert tree.apply({_key(2): b"b"}) == _full_root(model)
+    assert tree.apply({_key(1): None, _key(2): None}) == _full_root({})
+    assert len(tree) == 0
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_property_sweep_incremental_equals_full(seed):
+    """Randomized mixed batches: after every commit the incremental
+    root equals hash_from_byte_slices over the full sorted item list
+    (the byte-identity the bank app-hash rewire rests on)."""
+    rng = random.Random(0xBEEF + seed)
+    model = {_key(i): b"v%d" % i for i in range(rng.randrange(0, 200))}
+    tree = StateTree(sorted(model.items()))
+    for _round in range(25):
+        dirty: dict[bytes, bytes | None] = {}
+        live = list(model)
+        for _ in range(rng.randrange(0, 12)):
+            op = rng.randrange(3)
+            if op == 0 and live:  # update
+                dirty[rng.choice(live)] = b"u%d" % rng.randrange(1 << 30)
+            elif op == 1:  # insert
+                dirty[_key(rng.randrange(1 << 20) + 1000)] = b"i%d" % rng.randrange(1 << 30)
+            elif live:  # delete
+                dirty[rng.choice(live)] = None
+        for k, v in dirty.items():
+            if v is None:
+                model.pop(k, None)
+            else:
+                model[k] = v
+        assert tree.apply(dirty) == _full_root(model), f"diverged on round {_round}"
+    assert sorted(model) == list(tree.latest().keys)
+
+
+def test_history_serves_recent_roots():
+    model = {_key(i): b"v" for i in range(50)}
+    tree = StateTree(sorted(model.items()), history_depth=4)
+    roots = [tree.hash()]
+    for r in range(6):
+        roots.append(tree.apply({_key(r): b"r%d" % r}))
+    # the newest history_depth roots are retained, older ones aged out
+    for root in roots[-4:]:
+        assert tree.view_at(root) is not None
+    for root in roots[:-4]:
+        assert tree.view_at(root) is None
+
+
+def test_view_multiproof_verifies_including_historical():
+    model = {_key(i): b"v%d" % i for i in range(100)}
+    tree = StateTree(sorted(model.items()))
+    old_root = tree.hash()
+    old_view = tree.view_at(old_root)
+    tree.apply({_key(7): b"new"})
+    # the historical view still proves the OLD values under the OLD root
+    idxs = [old_view.index_of(_key(i)) for i in (3, 7, 42)]
+    mp = old_view.multiproof(sorted(idxs))
+    leaves = [state_leaf(old_view.keys[i], old_view.value_at(i)) for i in sorted(idxs)]
+    assert mp.verify(old_root, leaves)
+    assert not mp.verify(tree.hash(), leaves), "old proof must not verify under the new root"
+    # and the live view proves the new value under the new root
+    view = tree.latest()
+    i = view.index_of(_key(7))
+    mp2 = view.multiproof([i])
+    assert mp2.verify(tree.hash(), [state_leaf(_key(7), b"new")])
+
+
+def test_view_multiproof_index_contract():
+    tree = StateTree([(b"a", b"1"), (b"b", b"2"), (b"c", b"3")])
+    view = tree.latest()
+    with pytest.raises(ValueError):
+        view.multiproof([])
+    with pytest.raises(ValueError):
+        view.multiproof([2, 1])
+    with pytest.raises(ValueError):
+        view.multiproof([0, 3])
+
+
+def test_view_lookups_and_walker():
+    items = [(b"a", b"1"), (b"b", b""), (b"c", b"3=4")]
+    tree = StateTree(items)
+    view = tree.latest()
+    assert view.get(b"a") == b"1"
+    assert view.get(b"b") == b""
+    assert view.get(b"c") == b"3=4", "values containing '=' must round-trip"
+    assert view.get(b"zz") is None
+    with pytest.raises(KeyError):
+        view.index_of(b"zz")
+    assert list(view.iter_entries()) == items
+
+
+def test_structural_commit_reuses_unchanged_leaf_hashes(monkeypatch):
+    """An insert must not rehash the unchanged leaves: count what goes
+    through sha256_batch during the commit."""
+    import tendermint_tpu.statetree as st
+
+    model = {_key(i): b"v" for i in range(1024)}
+    tree = StateTree(sorted(model.items()))
+    counted = []
+    real = st.sha256_batch
+    monkeypatch.setattr(st, "sha256_batch", lambda items: counted.append(len(items)) or real(items))
+    model[_key(99999)] = b"new"
+    assert tree.apply({_key(99999): b"new"}) == _full_root(model)
+    assert sum(counted) < 256, f"structural commit rehashed {sum(counted)} nodes for 1 insert in 1024"
+
+
+def test_path_commit_hashes_only_the_dirty_paths(monkeypatch):
+    import tendermint_tpu.statetree as st
+
+    model = {_key(i): b"v" for i in range(4096)}
+    tree = StateTree(sorted(model.items()))
+    counted = []
+    real = st.sha256_batch
+    monkeypatch.setattr(st, "sha256_batch", lambda items: counted.append(len(items)) or real(items))
+    model[_key(5)] = b"w"
+    assert tree.apply({_key(5): b"w"}) == _full_root(model)
+    # one leaf + at most one inner node per level (12 levels at 4096)
+    assert sum(counted) <= 13, f"path commit hashed {sum(counted)} nodes for 1 update in 4096"
+
+
+def test_metrics_hook_observes_modes():
+    class _H:
+        def __init__(self):
+            self.rows = []
+
+        def observe(self, v, *labels):
+            self.rows.append((v, labels))
+
+        def add(self, v, *labels):
+            self.rows.append((v, labels))
+
+    class _M:
+        def __init__(self):
+            self.dirty_path_size = _H()
+            self.rehash_seconds = _H()
+            self.nodes_rehashed = _H()
+
+    m = _M()
+    tree = StateTree([(b"a", b"1"), (b"b", b"2")], metrics=m)
+    tree.apply({b"a": b"x"})          # path
+    tree.apply({b"c": b"3"})          # structural
+    modes = [labels for _v, labels in m.dirty_path_size.rows]
+    assert ("full",) in modes and ("path",) in modes and ("structural",) in modes
