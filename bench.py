@@ -163,6 +163,28 @@ def _flight_mark(stage: str) -> None:
         _FLIGHT.mark(stage)
 
 
+def _install_devobs() -> None:
+    """tmdev (tendermint_tpu/devobs): the device observatory rides
+    the FULL bench run by default — compile counts, transfer bytes and
+    live-buffer residency land in the bench report next to the rates,
+    so a BENCH_r02/r03-style postmortem starts from evidence instead
+    of XLA error tails. The targeted device-free subcommands (mempool/
+    proofs/state/smoke) do NOT install it: install() imports jax, and
+    those paths must stay jax-free so their perf records keep the
+    host-plane fingerprint their blessed floors were recorded under.
+    BENCH_DEVOBS=off opts out; a jax without the monitoring API
+    degrades to a warn-once no-op inside install()."""
+    if os.environ.get("BENCH_DEVOBS", "on") == "off":
+        return
+    try:
+        from tendermint_tpu import devobs
+
+        if devobs.install() is not None:
+            _log("devobs device observatory on -> tendermint_device_* metrics")
+    except Exception as e:  # noqa: BLE001 - telemetry must not sink the run
+        _log(f"devobs install failed: {type(e).__name__}: {e}")
+
+
 def _write_bench_report() -> None:
     """Persist a tmlens-style fleet report for THIS bench process:
     dump the process-global registry (engine/hash/mempool telemetry the
@@ -1143,6 +1165,84 @@ def bench_mempool(floods=(1000, 10000, 50000)):
     return last
 
 
+def bench_device_obs():
+    """tmdev device-observatory cost + correctness on the CPU backend
+    (docs/observability.md#tmdev). Device-free by design — the
+    observatory's own cost is backend-independent Python (listener
+    dispatch, live_arrays walk), so the 1% budget is provable in CI.
+
+    Two halves, mirroring the flight-recorder overhead stage:
+      1. round-trip: a fresh jit probe under attribution must land an
+         attributed compile event + h2d/d2h transfer bytes — proof the
+         listener chain is live on this jax, not silently no-opped
+         (the monitoring-API-drift failure mode).
+      2. overhead: N residency samples against the live buffer set,
+         amortized over the recorder's default 1s cadence; enabled
+         must cost <= 1% of wall time. Disabled is zero-cost by
+         construction (no listener registered, attribution and
+         transfer spans short-circuit to plain yields).
+    """
+    from tendermint_tpu import devobs
+
+    devobs.install()
+    assert devobs.enabled(), "devobs install failed (jax.monitoring missing?)"
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _probe(x):
+        return (x * 3 + 1).sum()
+
+    n = 64
+    with devobs.attribution(fn="bench_probe", rows=n):
+        with devobs.transfer_span("h2d", n * 4):
+            xd = jnp.arange(n, dtype=jnp.int32)
+        ok = _probe(xd)
+        with devobs.transfer_span("d2h", 4):
+            float(ok)
+    st = devobs.status()
+    assert st["enabled"] and st["compiles"] >= 1, f"no compiles observed: {st}"
+    assert any(r.get("fn") == "bench_probe" for r in st["tail"]), (
+        f"probe compile not attributed: {st['tail'][-4:]}"
+    )
+    assert st["transfer_bytes"]["h2d"] >= n * 4, f"h2d bytes unaccounted: {st}"
+
+    devobs.sample_residency()  # warm: first live_arrays walk
+    n_ticks = 200
+    t0 = time.perf_counter()
+    for _ in range(n_ticks):
+        devobs.sample_residency()
+    per_sample_s = (time.perf_counter() - t0) / n_ticks
+    overhead_pct = 100.0 * per_sample_s / 1.0
+    _log(
+        f"device obs: {per_sample_s * 1e6:,.0f}us/residency sample vs 1s "
+        f"cadence = {overhead_pct:.3f}% steady-state overhead "
+        f"({st['compiles']} compiles attributed)"
+    )
+    assert overhead_pct <= 1.0, (
+        f"device observatory overhead {overhead_pct:.2f}% exceeds the 1% budget"
+    )
+    s = _measure(devobs.sample_residency, min_time=0.25)
+    _perf_record(
+        "device-obs", "residency_samples_per_sec", "samples/s", s,
+        params={"cadence_s": 1.0},
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "device_obs_sample_overhead_pct",
+                "value": round(overhead_pct, 4),
+                "unit": "% of wall time at the default 1s cadence",
+                "per_sample_us": round(per_sample_s * 1e6, 1),
+                "compiles_attributed": st["compiles"],
+            }
+        ),
+        flush=True,
+    )
+    return overhead_pct
+
+
 def bench_fastsync(chain, repeats: int | None = None):
     """Sequential verify_commit_light over the prebuilt chain — the
     per-block work of blocksync replay (reactor.go:582) on the device
@@ -1166,6 +1266,16 @@ def bench_fastsync(chain, repeats: int | None = None):
 
 def main():
     global BATCHES, PIPELINE_ITERS, _DEVICE
+    if len(sys.argv) > 1 and sys.argv[1] == "device-obs":
+        # targeted device-free run: `python bench.py device-obs`
+        # (preflight's device-obs dry stage) — observatory round-trip +
+        # residency-sampler overhead budget on the CPU backend
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _start_bench_flight()
+        _flight_mark("device-obs")
+        bench_device_obs()
+        _write_bench_report()
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "mempool":
         # targeted device-free run: `python bench.py mempool`
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -1209,6 +1319,7 @@ def main():
             "unit": f"ledger records (run {run_id})",
         }), flush=True)
         sys.exit(0)
+    _install_devobs()
     from tendermint_tpu import trace as _tmtrace
 
     if os.environ.get("BENCH_TRACE", "").strip().lower() in ("1", "on", "true", "yes"):
@@ -1377,6 +1488,20 @@ def main():
     dev = jax.devices()[0]
     _DEVICE = f"{dev.platform}:{dev.device_kind}"
     _log(f"claimed: {_DEVICE}")
+
+    # Stage 2.5: tmdev observatory round-trip + sampler overhead budget
+    # — AFTER the claim (a jit before it would initialize a backend
+    # outside the probe discipline above); failures never sink the run.
+    if os.environ.get("BENCH_DEVOBS", "on") != "off":
+        try:
+            _flight_mark("device-obs")
+            with stage_deadline(min(max(_remaining() - 60, 20), 60)):
+                bench_device_obs()
+            _save_stage_trace("device-obs")
+        except StageTimeout:
+            _log("device-obs stage hit deadline; continuing")
+        except Exception as e:  # noqa: BLE001
+            _log(f"device-obs stage failed: {type(e).__name__}: {e}")
 
     # Stage 3: bank batches smallest-first; each success re-emits the
     # best rate so far. A stage timeout or error stops escalation but

@@ -5,7 +5,10 @@ The full bench (bench.py) needs a device claim and most of a
 These stages time the HOST planes (structural hash, mempool
 admission) with micro workloads and small repeat counts through the
 shared tmperf harness, appending canonical records to the perf
-ledger. Two back-to-back runs of unchanged code must compare clean;
+ledger. The one exception is the trailing `device-obs` stage, which
+rates the tmdev residency sampler on the pinned CPU jax backend —
+still no accelerator, but its records carry a live-backend
+fingerprint (see _measure_device_obs). Two back-to-back runs of unchanged code must compare clean;
 a real hot-path regression (the memoization breaking, the batched
 admission path degrading to per-tx) lands far outside the noise
 threshold even at this scale.
@@ -46,7 +49,7 @@ from tendermint_tpu.perf import (  # noqa: E402
     rate_samples,
 )
 
-SMOKE_STAGES = ("hash", "mempool", "proofs", "state")
+SMOKE_STAGES = ("hash", "mempool", "proofs", "state", "device-obs")
 
 
 def default_ledger() -> str:
@@ -222,6 +225,33 @@ def _measure_state(repeats: int, min_time: float) -> list[tuple]:
     ]
 
 
+def _measure_device_obs(repeats: int, min_time: float) -> list[tuple]:
+    """Residency-sampler steady-state cost through the observatory
+    (tmdev, docs/observability.md#tmdev): install the jax.monitoring
+    listener, park one live device buffer on the CPU backend, and rate
+    the FlightRecorder sampler tick (jax.live_arrays walk + per-plane
+    gauge updates). This is the ONE smoke stage that imports jax —
+    it runs last (SMOKE_STAGES order) so the import cannot perturb the
+    host-plane timings, and run_smoke stamps its records with a fresh
+    live-backend fingerprint instead of the jax-free host one."""
+    import jax.numpy as jnp
+
+    from tendermint_tpu import devobs
+
+    devobs.install()
+    keep = jnp.zeros(1024, jnp.float32)  # a live buffer so the walk is non-trivial
+    keep.block_until_ready()
+
+    def tick():
+        devobs.sample_residency()
+
+    samples = rate_samples(tick, repeats=repeats, warmup=2, min_time=min_time)
+    del keep
+    # cadence_s pins the workload identity: the floor is "sampler cost
+    # vs a 1s flight cadence", same key the full bench records
+    return [("residency_samples_per_sec", "samples/s", {"cadence_s": 1.0}, samples)]
+
+
 def run_smoke(
     stages=None,
     repeats: int = 5,
@@ -259,8 +289,15 @@ def run_smoke(
             rows = _measure_proofs(repeats, min_time)
         elif stage == "state":
             rows = _measure_state(repeats, min_time)
+        elif stage == "device-obs":
+            rows = _measure_device_obs(repeats, min_time)
         else:
             rows = _measure_mempool(repeats, min_time, flood)
+        # device-obs pulls jax in, so its records carry the live-backend
+        # fingerprint (jax version + actual backend device) — computed
+        # AFTER the measurement, never contaminating the jax-free fp the
+        # host-plane floors were blessed under
+        stage_fp = fingerprint(device="cpu") if stage == "device-obs" else fp
         slow_frac = float((inject or {}).get(stage, 0.0))
         for metric, unit, params, samples in rows:
             if slow_frac:
@@ -271,7 +308,7 @@ def run_smoke(
             rec = make_record(
                 stage, metric, unit, samples,
                 run_id=run_id, t=time.time(), params=params,
-                provenance="smoke", fingerprint=fp,
+                provenance="smoke", fingerprint=stage_fp,
                 note=note or (f"injected {slow_frac:.0%} slowdown" if slow_frac else None),
             )
             records.append(rec)

@@ -13,9 +13,13 @@ Chains, in order:
   5. bench.py state 1000   tmstate dry stage: the incremental==full
                            app-hash equivalence sweep plus a 1k-account
                            commit/proof smoke (docs/state.md)
-  6. bench.py smoke        device-free perf smoke (~seconds) — records
+  6. bench.py device-obs   tmdev dry stage: observatory round-trip on
+                           the CPU backend (an attributed compile must
+                           land) + the residency sampler's 1% overhead
+                           budget (docs/observability.md#tmdev)
+  7. bench.py smoke        device-free perf smoke (~seconds) — records
                            a fresh run into .bench_runs/ledger.jsonl
-  7. tmperf gate --check   noise-aware regression gate over the run
+  8. tmperf gate --check   noise-aware regression gate over the run
                            smoke just recorded, plus blessed-key
                            coverage drift
 
@@ -48,6 +52,7 @@ STAGES = (
     ("byz-dry", [sys.executable, "scripts/tmsoak.py", "--dry-run",
                  "e2e-manifests/byz-small.toml"]),
     ("state-dry", [sys.executable, "bench.py", "state", "1000"]),
+    ("device-obs", [sys.executable, "bench.py", "device-obs"]),
     ("smoke", [sys.executable, "bench.py", "smoke"]),
     ("perf-gate", [sys.executable, "scripts/tmperf.py", "gate", "--check"]),
 )

@@ -25,6 +25,22 @@ Usage:
                      gate's 60s)
       --json         print the {node: critical_path} JSON instead
 
+  python scripts/tmlens.py device <run-dir>
+  python scripts/tmlens.py device --addrs host:port,host:port
+      tmdev: device-plane report from each node's persisted
+      tendermint_device_* series + live-buffer residency timeline
+      (docs/observability.md#tmdev), or from live /metrics scrapes
+      (--addrs; counters only — a point sample carries no timeline,
+      so only the recompile verdict applies). Prints per-node compile
+      counts with their fn/rows attribution, transfer bytes, cache-
+      plane residency, then judges the SAME trip conditions as the
+      recompile_storm / device_mem_growth gates. Exit code: 0 = clean,
+      1 = a trip condition fired, 2 = usage / no node exposed device
+      evidence (TM_TPU_DEVOBS off everywhere).
+      --slack N      extra compiles tolerated per bucket (default: the
+                     recompile_storm gate's 0)
+      --json         print {node: {device, residency_points}} JSON
+
   python scripts/tmlens.py watch <run-dir>
   python scripts/tmlens.py watch --addrs host:port,host:port
       Live terminal view with the SAME rolling gates the e2e collector
@@ -234,6 +250,146 @@ def _watch(args) -> int:
         time.sleep(interval)
 
 
+def _device(args) -> int:
+    from tendermint_tpu.lens.analyze import discover_nodes
+    from tendermint_tpu.lens.device import (
+        live_buffer_points,
+        device_digest,
+        mem_growth_offenders,
+        recompile_offenders,
+    )
+    from tendermint_tpu.lens.gates import DEFAULT_GATES
+    from tendermint_tpu.lens.prom import parse_exposition
+    from tendermint_tpu.lens.series import TIMESERIES_NAME, parse_timeseries
+
+    run_dir = None
+    addrs: list[str] = []
+    slack = DEFAULT_GATES["recompile_slack"]
+    tail_points = DEFAULT_GATES["device_mem_growth_points"]
+    min_growth = DEFAULT_GATES["device_mem_growth_min_bytes"]
+    as_json = False
+    i = 0
+    try:
+        while i < len(args):
+            a = args[i]
+            if a == "--addrs":
+                addrs = [s.strip() for s in args[i + 1].split(",") if s.strip()]
+                i += 2
+            elif a == "--slack":
+                slack = int(args[i + 1])
+                i += 2
+            elif a == "--json":
+                as_json = True
+                i += 1
+            elif a.startswith("-"):
+                print(f"unknown device flag {a!r}", file=sys.stderr)
+                return 2
+            elif run_dir is None:
+                run_dir = a
+                i += 1
+            else:
+                print(f"unexpected argument {a!r}", file=sys.stderr)
+                return 2
+    except (IndexError, ValueError) as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+    if not addrs and (run_dir is None or not os.path.isdir(run_dir)):
+        print(f"device needs --addrs or a run directory (got {run_dir!r})",
+              file=sys.stderr)
+        return 2
+
+    # (name, digest-or-None, [(t, bytes)] residency points)
+    nodes: list[tuple[str, dict | None, list]] = []
+    if addrs:  # live mode: one scrape per node, counters only (no
+        # timeline => no mem-growth verdict from a point sample)
+        from tendermint_tpu.lens.series import scrape_metrics
+
+        for a in addrs:
+            url = a if "://" in a else f"http://{a}/metrics"
+            try:
+                _text, exp = scrape_metrics(url)
+            except Exception as e:  # noqa: BLE001 - a dead node is a data point
+                print(f"  {a}: scrape failed ({type(e).__name__})", file=sys.stderr)
+                continue
+            nodes.append((a, device_digest(exp), []))
+    else:
+        found = discover_nodes(run_dir)
+        if not found and any(
+            os.path.exists(os.path.join(run_dir, f))
+            for f in ("metrics.txt", TIMESERIES_NAME)
+        ):
+            # flat one-node artifact dir (a bench run's BENCH_REPORT_DIR
+            # dumps metrics.txt at the root, no per-node subdirs)
+            found = [(os.path.basename(os.path.abspath(run_dir)), run_dir)]
+        for name, d in found:
+            dev = None
+            mpath = os.path.join(d, "metrics.txt")
+            if os.path.exists(mpath):
+                try:
+                    with open(mpath) as f:
+                        dev = device_digest(parse_exposition(f.read()))
+                except (OSError, ValueError) as e:
+                    print(f"  {name}: unreadable metrics.txt ({e})", file=sys.stderr)
+            pts: list = []
+            spath = os.path.join(d, TIMESERIES_NAME)
+            if os.path.exists(spath):
+                try:
+                    pts = live_buffer_points(parse_timeseries(spath))
+                except (OSError, ValueError) as e:
+                    print(f"  {name}: unreadable timeseries ({e})", file=sys.stderr)
+            if dev is not None or pts:
+                nodes.append((name, dev, pts))
+    if not nodes or all(dev is None and not pts for _n, dev, pts in nodes):
+        print("no node exposed tendermint_device_* evidence "
+              "(run nodes with TM_TPU_DEVOBS=1)", file=sys.stderr)
+        return 2
+
+    if as_json:
+        print(json.dumps({
+            name: {"device": dev,
+                   "residency_points": [[round(t, 3), v] for t, v in pts]}
+            for name, dev, pts in nodes
+        }, indent=1))
+    else:
+        for name, dev, pts in nodes:
+            if dev is None:
+                print(f"{name}: no device series (residency points only: {len(pts)})")
+                continue
+            tb = dev.get("transfer_bytes") or {}
+            print(
+                f"{name}: {dev['compiles']} compiles "
+                f"({dev['compile_seconds_total']}s), h2d {tb.get('h2d', 0)}B "
+                f"d2h {tb.get('d2h', 0)}B, live {dev.get('live_buffer_bytes')}B "
+                f"(high water {dev.get('high_water_bytes')}B)"
+            )
+            for cell in dev.get("bucket_compiles") or []:
+                flag = "  <-- recompiles" if cell["count"] > 1 + slack else ""
+                print(f"    {cell['fn']:<24} rows={cell['rows']:<7} "
+                      f"compiles={cell['count']}{flag}")
+            for plane, pv in sorted((dev.get("cache_planes") or {}).items()):
+                print(f"    cache {plane}: {pv.get('bytes', 0)}B "
+                      f"/ {pv.get('entries', 0)} entries")
+
+    # ONE copy of each trip condition, shared with the recompile_storm
+    # / device_mem_growth gates (lens/device.py) — CLI rc and gate
+    # verdict cannot drift
+    rc = 0
+    storms = recompile_offenders(
+        [(n, dev) for n, dev, _p in nodes if dev], slack=slack)
+    if storms:
+        print(f"RECOMPILE STORM (> {1 + slack} compiles/bucket): {storms}",
+              file=sys.stderr)
+        rc = 1
+    growth = mem_growth_offenders(
+        [(n, pts) for n, _dev, pts in nodes if pts],
+        tail_points=tail_points, min_growth_bytes=min_growth)
+    if growth:
+        print(f"DEVICE MEM GROWTH (monotone over last {tail_points} samples, "
+              f">= {min_growth}B): {growth}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _critical_path(args) -> int:
     from tendermint_tpu.lens.analyze import discover_nodes
     from tendermint_tpu.lens.gates import DEFAULT_GATES
@@ -338,6 +494,8 @@ def main(argv) -> int:
         return 0 if argv else 2
     if argv[0] == "critical-path":
         return _critical_path(argv[1:])
+    if argv[0] == "device":
+        return _device(argv[1:])
     if argv[0] == "watch":
         try:
             return _watch(argv[1:])
@@ -345,7 +503,8 @@ def main(argv) -> int:
             return 0
     if argv[0] != "analyze":
         print(f"unknown command {argv[0]!r} "
-              "(try: analyze <run-dir> | critical-path <run-dir> | watch ...)",
+              "(try: analyze <run-dir> | critical-path <run-dir> | "
+              "device <run-dir> | watch ...)",
               file=sys.stderr)
         return 2
     args = argv[1:]

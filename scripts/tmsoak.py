@@ -23,7 +23,10 @@ Usage:
       --base-dir D   testnet directory (default <repo>/soak-net)
       --cores N      override the detected core count for gating
       --gates ...    tmlens gate overrides (lens/gates.py
-                     DEFAULT_GATES), inline JSON or a file path
+                     DEFAULT_GATES), inline JSON or a file path;
+                     keys the live watch recognizes (lens/series.py
+                     WATCH_DEFAULTS, e.g. stall_after_s) widen the
+                     rolling watch budgets too
 
 The core gate (e2e/scenario.py) is always applied: on a <4-core box
 storm-surface perturbations (partition/disconnect/churn/...) are

@@ -796,7 +796,10 @@ _ISOLATED_FILES = ("tendermint_tpu/metrics/flight.py",)
 # Absolute top-level packages the isolated set must never touch.
 _FORBIDDEN_TOP = {"jax", "jaxlib"}
 # tendermint_tpu subpackages the isolated set MAY import; everything
-# else under tendermint_tpu is node runtime.
+# else under tendermint_tpu is node runtime. devobs is deliberately
+# NOT here: it is the jax-facing runtime half of tmdev — the analysis
+# half (lens/device.py, covered by the lens/ prefix above) reads only
+# persisted artifacts and must stay jax-free.
 _ALLOWED_SUBPACKAGES = {"lens", "check", "metrics", "perf", "trace", "utils"}
 
 

@@ -131,6 +131,20 @@ def cmd_start(args) -> int:
     if byz is not None:
         print(f"byzantine role(s) armed: {byz.roles_str} -> {byz.out_path}")
 
+    # TM_TPU_DEVOBS=1 (same e2e passthrough): device-plane observatory
+    # (docs/observability.md#tmdev). Installed BEFORE the node-runtime
+    # imports so the jax.monitoring listener is live for the very first
+    # kernel compile (warmup compiles are exactly the ones a post-
+    # mortem needs attributed). Degrades to a warn-once no-op when jax
+    # or its monitoring API is absent — the import chain never breaks.
+    # Compiles/transfers land in tendermint_device_* metrics and the
+    # trace ring; the HBM-residency sampler rides the flight-recorder
+    # cadence (node/node.py). Unset: installs nothing.
+    from . import devobs
+
+    if devobs.maybe_install() is not None:
+        print("devobs device observatory on -> tendermint_device_* metrics")
+
     from .config import load_config
     from .lens.profiler import maybe_start_profiler
     from .node import Node

@@ -1250,8 +1250,16 @@ class Runner:
         actions = tl.resolve(self.manifest)
         self.start_watch(gates=watch_gates)
         # deferred statesync_join nodes are not running yet: every wait
-        # until the convergence phase judges only STARTED nodes
-        self.wait_for_height(2, nodes=self._rpc_nodes_started())
+        # until the convergence phase judges only STARTED nodes. The
+        # initial wait never judges tighter than the caller's declared
+        # live stall tolerance: a run that legitimately pauses at start
+        # (first XLA compile/cache-load with the device crypto plane
+        # forced on) widens stall_after_s, and this wait must not abort
+        # what the watch was told to allow.
+        self.wait_for_height(
+            2, nodes=self._rpc_nodes_started(),
+            timeout=max(120.0, float((watch_gates or {}).get("stall_after_s", 0.0))),
+        )
         load_thread = None
         if load and self.manifest.load_tx_rate > 0:
             load_thread = threading.Thread(
@@ -1386,7 +1394,7 @@ class Runner:
         import urllib.request
 
         out: dict = {"pruned": [], "statesync_restored": [], "bank": None, "light": [],
-                     "state": {"nodes": [], "light_read": None}}
+                     "state": {"nodes": [], "light_read": None}, "device": []}
         for node in self._rpc_nodes():
             try:
                 st = node.client().call("status")["sync_info"]
@@ -1472,6 +1480,43 @@ class Runner:
                     }
                 except Exception as e:  # noqa: BLE001
                     out["state"]["light_read"] = {"error": f"{type(e).__name__}: {e}"}
+        if os.environ.get("TM_TPU_DEVOBS", "").strip().lower() in (
+            "1", "on", "true", "yes",
+        ):
+            # tmdev evidence (docs/observability.md#tmdev): every
+            # consensus node's device observatory exposed nonzero
+            # tendermint_device_* series (the verify engine compiled
+            # and moved bytes), plus its compile count + transfer
+            # bytes for the report
+            for node in self._rpc_nodes():
+                if not node.prom_port:
+                    continue
+                try:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{node.prom_port}/metrics", timeout=5
+                    ).read().decode()
+                except Exception:  # noqa: BLE001 - report is evidence, not a gate
+                    continue
+                series = 0
+                compiles = 0.0
+                xfer = 0.0
+                for line in body.splitlines():
+                    if not line.startswith("tendermint_device_") or line.startswith("#"):
+                        continue
+                    try:
+                        v = float(line.rsplit(" ", 1)[1])
+                    except ValueError:
+                        continue
+                    if v > 0:
+                        series += 1
+                    if line.startswith("tendermint_device_compiles_total"):
+                        compiles += v
+                    elif line.startswith("tendermint_device_transfer_bytes_total"):
+                        xfer += v
+                out["device"].append({
+                    "node": node.m.name, "series": series,
+                    "compiles": int(compiles), "transfer_bytes": int(xfer),
+                })
         for node in self.nodes:
             if node.m.mode != "light":
                 continue
@@ -1728,9 +1773,22 @@ def run_soak(manifest_path: str, base_dir: str, duration: float = 30.0,
                "pinned to the host crypto plane (no jax import)")
     # budget half of core-aware resolution: stall/head-age budgets
     # scaled to the box (docs/e2e.md#core-gating); explicit caller
-    # gates still win
+    # gates still win. Caller keys the rolling watch recognizes
+    # (WATCH_DEFAULTS) override the LIVE budgets too — a run that
+    # legitimately pauses longer than the scaled stall window (first
+    # XLA compile with the device crypto plane forced on a small box)
+    # needs the live gate widened, not just the post-mortem one.
+    # Watch-only keys never reach gates.evaluate, which refuses
+    # unknown keys loudly.
+    from ..lens.gates import DEFAULT_GATES
+    from ..lens.series import WATCH_DEFAULTS
+
     post_gates, watch_gates = gate_overrides_for(eff_cores)
-    post_gates.update(gates or {})
+    for k, v in (gates or {}).items():
+        if k in WATCH_DEFAULTS:
+            watch_gates[k] = v
+        if k in DEFAULT_GATES or k not in WATCH_DEFAULTS:
+            post_gates[k] = v
     if watch_gates:
         logger(f"core-gate: budgets scaled for {eff_cores} core(s): "
                f"post-mortem {post_gates}, live {watch_gates}")

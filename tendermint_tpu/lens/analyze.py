@@ -305,6 +305,14 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
                 "gossiped": int(ev_gossiped or 0),
                 "verify": _hist_stats(exp, f"{NS}_evidence_verify_seconds"),
             }
+        # tmdev device plane (docs/observability.md#tmdev): compile /
+        # transfer / residency digest — the recompile_storm gate judges
+        # the per-bucket compile cells in this block
+        from .device import device_digest
+
+        dev = device_digest(exp)
+        if dev is not None:
+            summary["device"] = dev
         peers = exp.value(f"{NS}_p2p_peers")
         connects = exp.total(f"{NS}_p2p_peer_connections_total")
         summary["p2p"] = {
@@ -329,13 +337,35 @@ def analyze_node(node_dir: str, name: str = "", exp: Exposition | None = None) -
     spath = os.path.join(node_dir, "timeseries.jsonl")
     if os.path.exists(spath):
         summary["artifacts"].append("timeseries.jsonl")
+        records: list = []
         try:
             from .series import parse_timeseries, summarize_timeseries
 
-            summary["timeline"] = summarize_timeseries(parse_timeseries(spath))
+            records = parse_timeseries(spath)
+            summary["timeline"] = summarize_timeseries(records)
         except (ValueError, KeyError, TypeError) as e:
             summary["timeline"] = None
             summary["timeline_error"] = f"{type(e).__name__}: {e}"
+        # tmdev residency timeline: the streamed live-buffer gauge is
+        # what the device_mem_growth gate judges (a SIGKILL'd leaker
+        # still convicts — the final scrape can't see growth at all)
+        try:
+            from .device import MEMORY_TAIL_KEEP, live_buffer_points
+
+            pts = live_buffer_points(records)
+            if pts:
+                summary["device_memory"] = {
+                    "points": len(pts),
+                    "first_bytes": int(pts[0][1]),
+                    "last_bytes": int(pts[-1][1]),
+                    "peak_bytes": int(max(v for _t, v in pts)),
+                    "tail": [
+                        [round(t, 3), v] for t, v in pts[-MEMORY_TAIL_KEEP:]
+                    ],
+                }
+        except (ValueError, KeyError, TypeError) as e:
+            summary["device_memory"] = None
+            summary["device_memory_error"] = f"{type(e).__name__}: {e}"
 
     # lockcheck sanitizer stream (TM_TPU_LOCKCHECK=1 nodes,
     # check/lockcheck.py): the lock_order_cycle gate reads this
@@ -546,6 +576,38 @@ def analyze_run(run_dir: str, gates: dict | None = None) -> dict:
             ),
         }
 
+    # tmdev fleet digest (the recompile_storm / device_mem_growth
+    # gates read the per-node blocks; this is the at-a-glance roll-up)
+    devs = [s["device"] for s in summaries if s.get("device")]
+    fleet["nodes_with_device"] = len(devs)
+    if devs:
+        xfer: dict = {}
+        for d in devs:
+            for k, v in (d.get("transfer_bytes") or {}).items():
+                xfer[k] = xfer.get(k, 0) + v
+        fleet["device"] = {
+            "compiles": sum(d.get("compiles") or 0 for d in devs),
+            "compile_seconds_total": round(
+                sum(d.get("compile_seconds_total") or 0.0 for d in devs), 6
+            ),
+            "transfer_bytes": xfer,
+            "high_water_bytes": max(
+                (d["high_water_bytes"] for d in devs
+                 if d.get("high_water_bytes") is not None),
+                default=None,
+            ),
+            # cells that compiled more than once = shape churn evidence
+            "hot_buckets": sorted(
+                (
+                    {"node": s["name"], **cell}
+                    for s in summaries if s.get("device")
+                    for cell in s["device"].get("bucket_compiles") or []
+                    if cell.get("count", 0) > 1
+                ),
+                key=lambda c: -c["count"],
+            )[:16],
+        }
+
     # tmbyz fleet digest: which adversaries were armed + the honest
     # side's aggregate evidence outcomes (the round-trip at a glance)
     byz = [(s["name"], s["byzantine"]) for s in summaries if s.get("byzantine")]
@@ -718,6 +780,24 @@ def render_summary(report: dict) -> str:
                 f"    racecheck: {len(rc['races'])} shared-state races, "
                 f"{rc.get('fields')} fields / {rc.get('writes')} writes "
                 f"tracked, overhead est {rc.get('overhead_s_est')}s"
+            )
+        dev = s.get("device")
+        if dev:
+            lines.append(
+                f"    device: {dev.get('compiles')} compiles "
+                f"({dev.get('compile_seconds_total')}s) by "
+                f"{sorted(dev.get('compiles_by_fn') or {})}, "
+                f"transfers h2d={(dev.get('transfer_bytes') or {}).get('h2d')}B "
+                f"d2h={(dev.get('transfer_bytes') or {}).get('d2h')}B, "
+                f"live={dev.get('live_buffer_bytes')}B "
+                f"(high water {dev.get('high_water_bytes')}B)"
+            )
+        dm = s.get("device_memory")
+        if dm:
+            lines.append(
+                f"    device memory: {dm.get('points')} residency samples, "
+                f"{dm.get('first_bytes')}B -> {dm.get('last_bytes')}B "
+                f"(peak {dm.get('peak_bytes')}B)"
             )
         bz = s.get("byzantine")
         if bz:

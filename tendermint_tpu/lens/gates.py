@@ -65,6 +65,24 @@ test assertions):
                      "committed"}) — the full detect → verify → gossip
                      → commit round-trip, not just detection; vacuous
                      pass for honest runs (docs/byzantine.md)
+  recompile_storm    a tmdev-enabled node's scrape shows some
+                     (fn, rows) cell of
+                     tendermint_device_bucket_compiles_total over
+                     `1 + recompile_slack` compiles — the rows label
+                     is the dispatch site's INTENDED pow2 batch
+                     bucket, so a repeat compile on one cell means
+                     shapes churned INSIDE a bucket (the silent
+                     engine-throughput killer; lens/device.py holds
+                     the one shared trip condition). The detail names
+                     the node, fn, and bucket.
+  device_mem_growth  a node's streamed live-buffer residency timeline
+                     (tendermint_device_live_buffer_bytes in
+                     timeseries.jsonl) shows the trailing
+                     `device_mem_growth_points` samples monotone
+                     nondecreasing with total growth over
+                     `device_mem_growth_min_bytes` — the buffer-leak
+                     signature, judged from the stream so a SIGKILL'd
+                     leaker still convicts
   perf_regression    the run dir's perf ledger (ledger.jsonl,
                      tendermint_tpu/perf/) shows the latest run's
                      median for some stage below its blessed baseline
@@ -77,9 +95,10 @@ test assertions):
 rate_stall / churn_storm pass vacuously when no node left a
 timeseries.jsonl (flight recorder off), journey_stall when no node
 left journey spans (tracing off), lock_order_cycle / shared_state_race
-when no node ran the respective sanitizer, and perf_regression when
-the run dir carries no perf ledger: absence of an artifact is not
-evidence of a failure.
+when no node ran the respective sanitizer, recompile_storm /
+device_mem_growth when no node exposed tendermint_device_* evidence
+(TM_TPU_DEVOBS off), and perf_regression when the run dir carries no
+perf ledger: absence of an artifact is not evidence of a failure.
 """
 
 from __future__ import annotations
@@ -151,6 +170,18 @@ DEFAULT_GATES = {
     "perf_min_samples": 3,
     "perf_noise_mads": 5.0,
     "perf_min_rel_delta": 0.10,
+    # tmdev: repeat compiles tolerated per (fn, rows-bucket) cell
+    # before the verdict fails. Zero — the engine's pow2 bucketing
+    # exists so each kernel compiles ONCE per bucket; raise only for a
+    # run that deliberately varies a kernel's non-shape static args
+    "recompile_slack": 0,
+    # tmdev: how many trailing residency samples must be monotone
+    # nondecreasing (at the 1s flight cadence, 8 samples = 8s of
+    # uninterrupted growth — steady-state verify traffic plateaus
+    # inside one or two ticks), and the total-growth floor that
+    # separates a leak from jit/cache warmup churn
+    "device_mem_growth_points": 8,
+    "device_mem_growth_min_bytes": 1 << 20,
 }
 
 
@@ -455,6 +486,70 @@ def evaluate(report: dict, config: dict | None = None) -> tuple[list[dict], str]
             total_committed >= 1,
             f"{total_committed} {etype} evidence item(s) committed "
             f"across {committed_by_node or 'NO node'} (byz: {armed})",
+        ))
+
+    # recompile_storm (tmdev; vacuous pass when no node exposed
+    # device-plane series — TM_TPU_DEVOBS off)
+    devs = [(s["name"], s["device"]) for s in nodes if s.get("device")]
+    if not devs:
+        gates.append(_gate(
+            "recompile_storm", True,
+            "no tendermint_device_* series in any scrape (tmdev off)",
+        ))
+    else:
+        # the trip condition lives in lens/device.py — one copy shared
+        # with the `tmlens device` CLI, so gate and CLI can't drift
+        # apart on identical evidence
+        from .device import recompile_offenders
+
+        offenders = recompile_offenders(devs, cfg["recompile_slack"])
+        total_compiles = sum(d.get("compiles") or 0 for _n, d in devs)
+        gates.append(_gate(
+            "recompile_storm",
+            not offenders,
+            "shape churn — buckets compiled more than "
+            f"{1 + cfg['recompile_slack']}x (node, fn, rows, compiles): {offenders}"
+            if offenders
+            else f"every (fn, rows) bucket compiled once across "
+            f"{len(devs)} node(s) ({total_compiles} compiles)",
+        ))
+
+    # device_mem_growth (tmdev residency timelines; vacuous pass when
+    # no node streamed the live-buffer gauge)
+    dmem = [
+        (s["name"], s["device_memory"].get("tail") or [])
+        for s in nodes if s.get("device_memory")
+    ]
+    dmem_errors = [
+        (s["name"], s["device_memory_error"])
+        for s in nodes if s.get("device_memory_error")
+    ]
+    if not dmem:
+        gates.append(_gate(
+            "device_mem_growth", True,
+            # evidence LOSS must not masquerade as tmdev-disabled
+            # (the lockcheck precedent)
+            f"device-memory timelines present but unreadable: {dmem_errors}"
+            if dmem_errors
+            else "no device live-buffer timeline in any timeseries.jsonl (tmdev off)",
+        ))
+    else:
+        from .device import mem_growth_offenders
+
+        offenders = mem_growth_offenders(
+            dmem,
+            tail_points=cfg["device_mem_growth_points"],
+            min_growth_bytes=cfg["device_mem_growth_min_bytes"],
+        )
+        gates.append(_gate(
+            "device_mem_growth",
+            not offenders,
+            f"monotone live-buffer growth over the trailing "
+            f"{cfg['device_mem_growth_points']} samples "
+            f"(node, growth bytes, samples): {offenders}"
+            if offenders
+            else f"no monotone live-buffer growth in the run tail across "
+            f"{len(dmem)} node(s) (floor {cfg['device_mem_growth_min_bytes']}B)",
         ))
 
     # perf_regression (tmperf ledger in the run dir; vacuous pass when

@@ -928,6 +928,83 @@ class FlightMetrics:
         )
 
 
+class DeviceMetrics:
+    """Telemetry for the device plane itself (tmdev, devobs/): XLA
+    backend compiles attributed to the dispatching kernel fn, host<->
+    device transfer bytes per launch, and HBM/live-buffer residency
+    sampled on the flight-recorder cadence.
+
+    No reference analog — the reference never touches an accelerator.
+    The recompile counter's `rows` label is the engine's INTENDED
+    pow2 batch bucket (ops/verify._pad_pow2), so a second compile
+    landing on the same (fn, rows) cell is direct evidence of shape
+    churn — the regression class the recompile_storm gate
+    (lens/gates.py) trips on. Residency gauges are re-emitted into
+    timeseries.jsonl by the flight recorder, which is how the
+    high-water mark and the device_mem_growth gate survive SIGKILL.
+    Registered on the process-global registry because the dispatch
+    plane is process-wide, not per-node."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_device"
+        self.compiles = reg.counter(
+            f"{ns}_compiles_total",
+            "XLA backend compiles by dispatching kernel fn",
+            labels=("fn",),
+        )
+        self.bucket_compiles = reg.counter(
+            f"{ns}_bucket_compiles_total",
+            "Backend compiles by kernel fn and intended batch bucket (rows)",
+            labels=("fn", "rows"),
+        )
+        self.compile_seconds = reg.histogram(
+            f"{ns}_compile_seconds",
+            "Wall time of one XLA backend compile",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60),
+        )
+        self.compile_cache_events = reg.counter(
+            f"{ns}_compile_cache_events_total",
+            "Persistent compilation-cache events (hit/miss/task)",
+            labels=("event",),
+        )
+        self.transfer_bytes = reg.counter(
+            f"{ns}_transfer_bytes_total",
+            "Host<->device transfer bytes by direction (h2d/d2h)",
+            labels=("dir",),
+        )
+        self.transfers = reg.counter(
+            f"{ns}_transfers_total",
+            "Host<->device transfers by direction (h2d/d2h)",
+            labels=("dir",),
+        )
+        self.live_buffer_bytes = reg.gauge(
+            f"{ns}_live_buffer_bytes",
+            "Device-resident bytes at last residency sample "
+            "(memory_stats bytes_in_use, else sum of live-array nbytes)",
+        )
+        self.live_buffers = reg.gauge(
+            f"{ns}_live_buffers", "Live device arrays at last residency sample"
+        )
+        self.live_buffer_high_water = reg.gauge(
+            f"{ns}_live_buffer_high_water_bytes",
+            "Peak device-resident bytes observed by any residency sample",
+        )
+        self.cache_resident_bytes = reg.gauge(
+            f"{ns}_cache_resident_bytes",
+            "Device bytes held by a cache plane's resident tables",
+            labels=("plane",),
+        )
+        self.cache_resident_entries = reg.gauge(
+            f"{ns}_cache_resident_entries",
+            "Occupied LRU slots in a cache plane's resident tables",
+            labels=("plane",),
+        )
+        self.residency_samples = reg.counter(
+            f"{ns}_residency_samples_total",
+            "HBM-residency sampler ticks taken",
+        )
+
+
 # Process-global registry: subsystems that are process-wide rather than
 # per-node (the verification engine, the dispatch planes) register
 # here; PrometheusServer exports it alongside each node's registry.
@@ -935,6 +1012,7 @@ _GLOBAL_REGISTRY = Registry()
 _ENGINE_METRICS: EngineMetrics | None = None
 _HASH_METRICS: HashMetrics | None = None
 _PROOF_METRICS: ProofMetrics | None = None
+_DEVICE_METRICS: DeviceMetrics | None = None
 _ENGINE_LOCK = threading.Lock()
 
 
@@ -974,6 +1052,17 @@ def proof_metrics() -> ProofMetrics:
             if _PROOF_METRICS is None:
                 _PROOF_METRICS = ProofMetrics(_GLOBAL_REGISTRY)
     return _PROOF_METRICS
+
+
+def device_metrics() -> DeviceMetrics:
+    """Lazy process-wide DeviceMetrics singleton (first devobs
+    install or residency sample registers the families)."""
+    global _DEVICE_METRICS
+    if _DEVICE_METRICS is None:
+        with _ENGINE_LOCK:
+            if _DEVICE_METRICS is None:
+                _DEVICE_METRICS = DeviceMetrics(_GLOBAL_REGISTRY)
+    return _DEVICE_METRICS
 
 
 class PrometheusServer:

@@ -74,10 +74,16 @@ class FlightRecorder:
         metrics: FlightMetrics | None = None,
         full_every: int = 120,
         tail_keep: int = 256,
+        samplers=(),
     ):
         if interval <= 0:
             raise ValueError("flight interval must be positive (0 disables at the call site)")
         self.registries: list[Registry] = list(registries)
+        # opaque callables invoked before each registry sweep so other
+        # planes can refresh gauges on the recorder's cadence (the
+        # devobs HBM-residency sampler rides here). Callables keep this
+        # module import-isolated from whatever plane supplies them.
+        self.samplers = list(samplers)
         self.path = path
         self.interval = float(interval)
         self.metrics = metrics
@@ -123,6 +129,11 @@ class FlightRecorder:
         """Take one sample and append the record. Returns the record
         (None when an I/O failure dropped it)."""
         t0 = time.perf_counter()
+        for sampler in self.samplers:
+            try:
+                sampler()
+            except Exception:  # noqa: BLE001 - a broken sampler must not kill the tick
+                continue
         cum, gauges = self._collect()
         with self._lock:
             now = time.time()

@@ -176,11 +176,20 @@ class Node:
             from ..metrics import FlightMetrics, global_registry
             from ..metrics.flight import TIMESERIES_NAME, FlightRecorder
 
+            # tmdev: when the device observatory is live, its HBM-
+            # residency sampler rides the recorder's cadence so the
+            # live-buffer timeline (and the device_mem_growth verdict
+            # built on it) survives SIGKILL. Off: empty sampler list,
+            # flight.py stays devobs-free (import isolation).
+            from .. import devobs
+
+            samplers = [devobs.sample_residency] if devobs.enabled() else []
             self.flight_recorder = FlightRecorder(
                 [self.metrics_registry, global_registry()],
                 os.path.join(config.base.home, TIMESERIES_NAME),
                 interval=config.instrumentation.flight_interval,
                 metrics=FlightMetrics(self.metrics_registry),
+                samplers=samplers,
             )
         self.logger = Logger(level=parse_level(config.base.log_level),
                              fmt=config.base.log_format).with_fields(

@@ -51,9 +51,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import curve as C
+from .. import devobs as _devobs
 from .. import trace as _trace
 from ..metrics import engine_metrics as _engine_metrics
-from .verify import L, pad_pow2_rows, prepare_batch
+from .verify import L, _pad_pow2, pad_pow2_rows, prepare_batch
 
 # Parallel point-streams. 128 fills the VPU lane axis for the table
 # builds; the accumulate add then runs at width 64*G. Batches smaller
@@ -352,18 +353,25 @@ def _dispatch_rlc(prepare, kernel, pubkeys, msgs, sigs, z_raw):
     n = len(sigs)
     if n == 0:
         return None
-    with _trace.span("ops.msm_dispatch", "ops", kernel="rlc", rows=n) as sp:
+    fid = _devobs.next_flow() if _devobs.enabled() else 0
+    with _trace.span("ops.msm_dispatch", "ops", kernel="rlc", rows=n, flow=fid) as sp:
         a_enc, r_enc, s_rows, k_rows, precheck = prepare(pubkeys, msgs, sigs)
         if not precheck.all():
             sp.annotate(refused="precheck")
             return None
         z_raw = _ensure_z_raw(n, z_raw)
         zk, z_out, zs_row = _rlc_scalars(s_rows, k_rows, n, z_raw)
-        a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
-        handle = kernel(
-            jnp.asarray(a_enc), jnp.asarray(r_enc),
-            jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+        a_enc, r_enc, zk, z_out = pad_pow2_rows(
+            [a_enc, r_enc, zk, z_out], n, churnable=False,
         )
+        nbytes = a_enc.nbytes + r_enc.nbytes + zk.nbytes + z_out.nbytes + zs_row.nbytes
+        with _devobs.transfer_span("h2d", nbytes, flow=fid):
+            dev_args = (
+                jnp.asarray(a_enc), jnp.asarray(r_enc),
+                jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+            )
+        with _devobs.attribution(fn="rlc", rows=_pad_pow2(n), flow=fid):
+            handle = kernel(*dev_args)
     _engine_metrics().kernel_launches.add(1, "rlc")
     return handle
 
@@ -388,7 +396,8 @@ def verify_batch_rlc_cached_async(pubkeys, msgs, sigs, z_raw: bytes | None = Non
     cache = pubkey_cache()
     if cache.tables.ndim != 5:
         return verify_batch_rlc_async(pubkeys, msgs, sigs, z_raw)
-    with _trace.span("ops.msm_dispatch", "ops", kernel="rlc_cached", rows=n) as sp:
+    fid = _devobs.next_flow() if _devobs.enabled() else 0
+    with _trace.span("ops.msm_dispatch", "ops", kernel="rlc_cached", rows=n, flow=fid) as sp:
         # prep/precheck BEFORE touching the cache: this path REFUSES any
         # batch with a malformed row, so inserting its keys first would
         # build zero-byte entries into the HBM cache (possibly evicting
@@ -408,22 +417,32 @@ def verify_batch_rlc_cached_async(pubkeys, msgs, sigs, z_raw: bytes | None = Non
             # kernel, reusing the prep + scalar math already done instead
             # of re-dispatching through verify_batch_rlc_async
             sp.annotate(cache="overflow")
-            a_enc, r_enc, zk, z_out = pad_pow2_rows([a_enc, r_enc, zk, z_out], n)
-            handle = msm_verify_kernel(
-                jnp.asarray(a_enc), jnp.asarray(r_enc),
-                jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+            a_enc, r_enc, zk, z_out = pad_pow2_rows(
+                [a_enc, r_enc, zk, z_out], n, churnable=False,
             )
+            nbytes = a_enc.nbytes + r_enc.nbytes + zk.nbytes + z_out.nbytes + zs_row.nbytes
+            with _devobs.transfer_span("h2d", nbytes, flow=fid):
+                dev_args = (
+                    jnp.asarray(a_enc), jnp.asarray(r_enc),
+                    jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+                )
+            with _devobs.attribution(fn="rlc", rows=_pad_pow2(n), flow=fid):
+                handle = msm_verify_kernel(*dev_args)
             _engine_metrics().kernel_launches.add(1, "rlc")
             return handle
-        r_enc, zk, z_out = pad_pow2_rows([r_enc, zk, z_out], n)
+        r_enc, zk, z_out = pad_pow2_rows([r_enc, zk, z_out], n, churnable=False)
         # padded rows carry zero scalars (identity contributions), but their
         # slot must point at a VALID cached key: slot 0 may hold a key whose
         # encoding fails decode, which would sink all_ok for a valid batch
         slots = np.pad(slots, (0, len(r_enc) - n), mode="edge")
-        handle = msm_verify_kernel_cached(
-            tables, oks, jnp.asarray(slots),
-            jnp.asarray(r_enc), jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
-        )
+        nbytes = slots.nbytes + r_enc.nbytes + zk.nbytes + z_out.nbytes + zs_row.nbytes
+        with _devobs.transfer_span("h2d", nbytes, flow=fid):
+            dev_args = (
+                jnp.asarray(slots), jnp.asarray(r_enc),
+                jnp.asarray(zk), jnp.asarray(z_out), jnp.asarray(zs_row),
+            )
+        with _devobs.attribution(fn="rlc_cached", rows=_pad_pow2(n), flow=fid):
+            handle = msm_verify_kernel_cached(tables, oks, *dev_args)
     _engine_metrics().kernel_launches.add(1, "rlc_cached")
     return handle
 
@@ -432,7 +451,8 @@ def collect_rlc(dispatched) -> bool:
     """Block on a verify_batch_rlc_async handle -> all-valid bool."""
     if dispatched is None:
         return False
-    return bool(dispatched)
+    with _devobs.transfer_span("d2h", int(getattr(dispatched, "nbytes", 1) or 1)):
+        return bool(dispatched)
 
 
 def verify_batch_rlc(pubkeys, msgs, sigs, z_raw: bytes | None = None) -> bool:

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from . import curve as C
+from .. import devobs as _devobs
 from .. import trace as _trace
 from ..metrics import engine_metrics as _engine_metrics
 
@@ -167,11 +168,13 @@ class PubkeyCache:
     which creates a NEW device array — in-flight async batches keep
     referencing the buffers they were dispatched with."""
 
-    def __init__(self, capacity: int = 4096, build_fn=None, entry_shape=(16, 4, 32)):
+    def __init__(self, capacity: int = 4096, build_fn=None, entry_shape=(16, 4, 32),
+                 plane: str = "pk"):
         import collections
         import threading
 
         self.capacity = capacity
+        self.plane = plane  # devobs compile-attribution + residency label
         self._build = build_fn or build_pk_tables  # sr25519 plugs in its decoder
         self._lock = threading.Lock()  # reactors verify concurrently
         self._lru: "collections.OrderedDict[bytes, int]" = collections.OrderedDict()
@@ -272,8 +275,15 @@ class PubkeyCache:
             try:
                 enc = np.frombuffer(b"".join(missing), np.uint8).reshape(-1, 32)
                 (enc_p,) = pad_pow2_rows([enc], len(missing))
-                with _trace.span("ops.pk_cache_fill", "ops", misses=len(missing)):
-                    new_tables, new_oks = self._build(jnp.asarray(enc_p))
+                fid = _devobs.next_flow() if _devobs.enabled() else 0
+                with _trace.span("ops.pk_cache_fill", "ops", misses=len(missing), flow=fid):
+                    with _devobs.transfer_span("h2d", enc_p.nbytes, flow=fid):
+                        enc_dev = jnp.asarray(enc_p)
+                    with _devobs.attribution(
+                        fn=f"{self.plane}_table_build",
+                        rows=_pad_pow2(len(missing)), flow=fid,
+                    ):
+                        new_tables, new_oks = self._build(enc_dev)
                 _engine_metrics().kernel_launches.add(1, "pk_table_build")
             except BaseException:
                 with self._lock:
@@ -317,9 +327,10 @@ def pubkey_cache() -> PubkeyCache:
             _PK_CACHE = PubkeyCache(
                 build_fn=build_pk_tables_split,
                 entry_shape=(PK_SPLITS, 16, 4, 32),
+                plane="ed25519_pk",
             )
         else:
-            _PK_CACHE = PubkeyCache()
+            _PK_CACHE = PubkeyCache(plane="ed25519_pk")
     return _PK_CACHE
 
 
@@ -330,12 +341,26 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return size
 
 
-def pad_pow2_rows(arrays, n: int):
+def _shape_churn() -> bool:
+    """TM_TPU_SHAPE_CHURN=1 disables pow2 padding on the bitmap-plane
+    dispatch paths — a fault-injection knob that turns every distinct
+    batch size into a fresh XLA program, the regression the
+    recompile_storm verdict (lens/gates.py, tmdev) exists to catch.
+    Never applied to the MSM plane: its kernels require the row count
+    to divide the stream count and would raise on raw sizes."""
+    return os.environ.get("TM_TPU_SHAPE_CHURN", "").strip().lower() in (
+        "1", "on", "true", "yes",
+    )
+
+
+def pad_pow2_rows(arrays, n: int, churnable: bool = True):
     """Pad (n, 32) uint8 arrays up to the next power-of-two row count so
     jit caches a small set of program shapes (shared by the ed25519 and
-    sr25519 planes)."""
+    sr25519 planes). `churnable=False` call sites (the MSM plane, whose
+    kernels require padded row counts) are exempt from the
+    TM_TPU_SHAPE_CHURN fault injection."""
     size = _pad_pow2(n)
-    if size == n:
+    if size == n or (churnable and _shape_churn()):
         return arrays
     pad = size - n
     return [np.pad(a, ((0, pad), (0, 0))) for a in arrays]
@@ -426,24 +451,32 @@ def verify_batch_async(pubkeys, msgs, sigs):
     applied at the host->chip boundary."""
     n = len(sigs)
     if n == 0:
-        return None, np.zeros((0,), bool), 0
-    with _trace.span("ops.verify_dispatch", "ops", kernel="bitmap", rows=n):
+        return None, np.zeros((0,), bool), 0, 0
+    fid = _devobs.next_flow() if _devobs.enabled() else 0
+    with _trace.span("ops.verify_dispatch", "ops", kernel="bitmap", rows=n, flow=fid):
         a_enc, r_enc, s_bytes, k_bytes, precheck = prepare_batch(pubkeys, msgs, sigs)
         a_enc, r_enc, s_bytes, k_bytes = pad_pow2_rows([a_enc, r_enc, s_bytes, k_bytes], n)
-        ok_dev = verify_kernel(
-            jnp.asarray(a_enc), jnp.asarray(r_enc),
-            jnp.asarray(s_bytes), jnp.asarray(k_bytes),
-        )
+        nbytes = a_enc.nbytes + r_enc.nbytes + s_bytes.nbytes + k_bytes.nbytes
+        with _devobs.transfer_span("h2d", nbytes, flow=fid):
+            a_dev, r_dev, s_dev, k_dev = (
+                jnp.asarray(a_enc), jnp.asarray(r_enc),
+                jnp.asarray(s_bytes), jnp.asarray(k_bytes),
+            )
+        with _devobs.attribution(fn="ed25519_bitmap", rows=_pad_pow2(n), flow=fid):
+            ok_dev = verify_kernel(a_dev, r_dev, s_dev, k_dev)
     _engine_metrics().kernel_launches.add(1, "bitmap")
-    return ok_dev, precheck, n
+    return ok_dev, precheck, n, fid
 
 
 def collect(dispatched) -> np.ndarray:
     """Block on a verify_batch_async result and fold in the precheck."""
-    ok_dev, precheck, n = dispatched
+    ok_dev, precheck, n = dispatched[:3]
     if n == 0:
         return np.zeros((0,), bool)
-    return np.asarray(ok_dev)[:n] & precheck
+    fid = dispatched[3] if len(dispatched) > 3 else 0
+    with _devobs.transfer_span("d2h", int(getattr(ok_dev, "nbytes", n) or n), flow=fid):
+        host = np.asarray(ok_dev)
+    return host[:n] & precheck
 
 
 def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
@@ -455,7 +488,8 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     return collect(verify_batch_async(pubkeys, msgs, sigs))
 
 
-def dispatch_cached(cache, prepare, cached_kernel, uncached_async, pubkeys, msgs, sigs):
+def dispatch_cached(cache, prepare, cached_kernel, uncached_async, pubkeys, msgs, sigs,
+                    fn_label: str = "bitmap_cached"):
     """Shared cache-path orchestration for both signature planes:
     slot lookup/insert (atomic snapshot), fallback when the batch has
     more distinct keys than the cache, shape padding, kernel dispatch.
@@ -464,8 +498,9 @@ def dispatch_cached(cache, prepare, cached_kernel, uncached_async, pubkeys, msgs
     key for them."""
     n = len(sigs)
     if n == 0:
-        return None, np.zeros((0,), bool), 0
-    with _trace.span("ops.verify_dispatch", "ops", kernel="bitmap_cached", rows=n) as sp:
+        return None, np.zeros((0,), bool), 0, 0
+    fid = _devobs.next_flow() if _devobs.enabled() else 0
+    with _trace.span("ops.verify_dispatch", "ops", kernel="bitmap_cached", rows=n, flow=fid) as sp:
         keys = [pk if len(pk) == 32 else b"\x00" * 32 for pk in pubkeys]
         slots, tables, oks = cache.ensure_snapshot(keys)
         if slots is None:
@@ -474,12 +509,16 @@ def dispatch_cached(cache, prepare, cached_kernel, uncached_async, pubkeys, msgs
         _, r_enc, s_bytes, k_bytes, precheck = prepare(pubkeys, msgs, sigs)
         r_enc, s_bytes, k_bytes = pad_pow2_rows([r_enc, s_bytes, k_bytes], n)
         slots = np.pad(slots, (0, len(r_enc) - n))
-        ok_dev = cached_kernel(
-            tables, oks, jnp.asarray(slots),
-            jnp.asarray(r_enc), jnp.asarray(s_bytes), jnp.asarray(k_bytes),
-        )
+        nbytes = slots.nbytes + r_enc.nbytes + s_bytes.nbytes + k_bytes.nbytes
+        with _devobs.transfer_span("h2d", nbytes, flow=fid):
+            slots_dev, r_dev, s_dev, k_dev = (
+                jnp.asarray(slots), jnp.asarray(r_enc),
+                jnp.asarray(s_bytes), jnp.asarray(k_bytes),
+            )
+        with _devobs.attribution(fn=fn_label, rows=_pad_pow2(n), flow=fid):
+            ok_dev = cached_kernel(tables, oks, slots_dev, r_dev, s_dev, k_dev)
     _engine_metrics().kernel_launches.add(1, "bitmap_cached")
-    return ok_dev, precheck, n
+    return ok_dev, precheck, n, fid
 
 
 def verify_batch_cached_async(pubkeys, msgs, sigs):
@@ -494,6 +533,7 @@ def verify_batch_cached_async(pubkeys, msgs, sigs):
     return dispatch_cached(
         cache, prepare_batch, kern,
         verify_batch_async, pubkeys, msgs, sigs,
+        fn_label="ed25519_bitmap_cached",
     )
 
 

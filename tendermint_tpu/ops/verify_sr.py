@@ -123,9 +123,10 @@ def sr_pubkey_cache():
             _SR_CACHE = PubkeyCache(
                 build_fn=build_sr_tables_split,
                 entry_shape=(PK_SPLITS, 16, 4, 32),
+                plane="sr25519_pk",
             )
         else:
-            _SR_CACHE = PubkeyCache(build_fn=build_sr_tables)
+            _SR_CACHE = PubkeyCache(build_fn=build_sr_tables, plane="sr25519_pk")
     return _SR_CACHE
 
 
@@ -165,17 +166,24 @@ def prepare_batch(pubkeys, msgs, sigs):
 
 def verify_batch_async(pubkeys, msgs, sigs):
     """Dispatch one batch without blocking (host prep + H2D + launch),
-    returning (device_bitmap, precheck, n) — same pipelining contract
-    as the ed25519 plane (ops/verify.py verify_batch_async)."""
-    from .verify import pad_pow2_rows
+    returning (device_bitmap, precheck, n, flow) — same pipelining
+    contract as the ed25519 plane (ops/verify.py verify_batch_async)."""
+    from .. import devobs as _devobs
+    from .verify import _pad_pow2, pad_pow2_rows
 
     n = len(sigs)
     if n == 0:
-        return None, np.zeros((0,), bool), 0
+        return None, np.zeros((0,), bool), 0, 0
+    fid = _devobs.next_flow() if _devobs.enabled() else 0
     a, r, s, k, precheck = prepare_batch(pubkeys, msgs, sigs)
     a, r, s, k = pad_pow2_rows([a, r, s, k], n)
-    ok_dev = verify_sr_kernel(jnp.asarray(a), jnp.asarray(r), jnp.asarray(s), jnp.asarray(k))
-    return ok_dev, precheck, n
+    with _devobs.transfer_span("h2d", a.nbytes + r.nbytes + s.nbytes + k.nbytes, flow=fid):
+        a_dev, r_dev, s_dev, k_dev = (
+            jnp.asarray(a), jnp.asarray(r), jnp.asarray(s), jnp.asarray(k)
+        )
+    with _devobs.attribution(fn="sr25519_bitmap", rows=_pad_pow2(n), flow=fid):
+        ok_dev = verify_sr_kernel(a_dev, r_dev, s_dev, k_dev)
+    return ok_dev, precheck, n, fid
 
 
 def verify_batch_cached_async(pubkeys, msgs, sigs):
@@ -192,6 +200,7 @@ def verify_batch_cached_async(pubkeys, msgs, sigs):
     return dispatch_cached(
         cache, prepare_batch, kern,
         verify_batch_async, pubkeys, msgs, sigs,
+        fn_label="sr25519_bitmap_cached",
     )
 
 
@@ -202,10 +211,15 @@ def verify_batch_cached(pubkeys, msgs, sigs) -> np.ndarray:
 
 def collect(dispatched) -> np.ndarray:
     """Block on a verify_batch_async result and fold in the precheck."""
-    ok_dev, precheck, n = dispatched
+    from .. import devobs as _devobs
+
+    ok_dev, precheck, n = dispatched[:3]
     if n == 0:
         return np.zeros((0,), bool)
-    return np.asarray(ok_dev)[:n] & precheck
+    fid = dispatched[3] if len(dispatched) > 3 else 0
+    with _devobs.transfer_span("d2h", int(getattr(ok_dev, "nbytes", n) or n), flow=fid):
+        host = np.asarray(ok_dev)
+    return host[:n] & precheck
 
 
 def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:

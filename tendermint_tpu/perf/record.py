@@ -70,16 +70,55 @@ def _git_rev(root: str | None = None) -> str | None:
     return None
 
 
+def _live_device(jax_mod) -> str | None:
+    """The device kind jax ACTUALLY initialized a backend for, or None
+    when no backend exists yet. Reads jax's private backend cache
+    first so an un-initialized process is never forced to pick a
+    platform just to be fingerprinted (backend init is exactly the
+    side effect a read-only fingerprint must not have)."""
+    if jax_mod is None:
+        return None
+    try:
+        backends = getattr(
+            sys.modules.get("jax._src.xla_bridge"), "_backends", None
+        )
+        if not backends:
+            return None
+        dev = jax_mod.devices()[0]
+        plat = getattr(dev, "platform", None)
+        kind = getattr(dev, "device_kind", None)
+        if plat and kind:
+            # same shape as bench.py's claim, so honest claims match
+            return f"{plat}:{kind}"
+        return str(plat or kind) if (plat or kind) else None
+    except Exception:
+        return None
+
+
 def fingerprint(device: str | None = None, root: str | None = None) -> dict:
     """Environment fingerprint: cores, platform, python, the JAX
     version *if the process already imported it* (this module never
     imports jax itself — sys.modules is a read, not an import), the
     device the measurement ran on ("cpu", "tpu:TPU v4", ...), and the
     git rev. `fp` is the comparability id (git_rev excluded — see the
-    module docstring)."""
+    module docstring).
+
+    `device` is the caller's CLAIM; when jax already initialized a
+    backend the fingerprint reports what the backend actually is
+    (the BENCH_r02/r03 class: a "tpu" run that silently fell back to
+    CPU emulation must not mint tpu-fingerprinted ledger records).
+    A contradicted claim rides along as `device_claimed` so the
+    post-mortem is one line, and changes fp_id — such records never
+    gate against honest ones."""
     import platform as _platform
 
     jax_mod = sys.modules.get("jax")
+    live = _live_device(jax_mod)
+    claimed = device
+    if live is not None and (
+        claimed is None or live.lower() != str(claimed).lower()
+    ):
+        device = live
     fp = {
         "os": sys.platform,
         "machine": _platform.machine(),
@@ -89,6 +128,8 @@ def fingerprint(device: str | None = None, root: str | None = None) -> dict:
         "device": device,
         "git_rev": _git_rev(root),
     }
+    if claimed is not None and device != claimed:
+        fp["device_claimed"] = claimed
     fp["fp"] = fp_id(fp)
     return fp
 

@@ -524,6 +524,20 @@ def build_routes(env: RPCEnvironment) -> dict:
             "tail": fr.tail(n),
         }
 
+    def device_stats(tail=None):
+        """Device-plane counters + recent compile events from the tmdev
+        observatory (tendermint_tpu.devobs): compiles, compile seconds,
+        h2d/d2h transfer bytes, live-buffer residency and high water,
+        plus the last `tail` (default 32, max 256) compile events with
+        their fn/rows attribution — the flight_recorder-style live tail
+        for `tmlens device` against a running node. Read-only;
+        enabled/disabled is process env (TM_TPU_DEVOBS)."""
+        from .. import devobs
+
+        n = _as_int(tail, "tail")
+        n = 32 if n is None else max(0, min(n, 256))
+        return devobs.status(tail=n)
+
     def block_results(height=None):
         """FinalizeBlock results (tx results, events, updates) at a height."""
         h = _height_or_latest(height)
@@ -1057,6 +1071,7 @@ def build_routes(env: RPCEnvironment) -> dict:
         "debug_threads": debug_threads,
         "dump_traces": dump_traces,
         "flight_recorder": flight_recorder,
+        "device_stats": device_stats,
         "block_results": block_results,
         "commit": commit,
         "proofs_batch": proofs_batch,

@@ -121,7 +121,7 @@ def test_e2e_perturbed_testnet(tmp_path):
         "liveness_stall", "p99_step_duration", "height_spread", "missing_series",
         "rate_stall", "churn_storm", "journey_stall", "lock_order_cycle",
         "shared_state_race", "perf_regression", "proof_serve_p99",
-        "evidence_committed",
+        "evidence_committed", "recompile_storm", "device_mem_growth",
     }
     # tmperf fingerprint surfacing: the runner persisted the run-time
     # environment fingerprint and the report carries it (slow box vs

@@ -320,7 +320,8 @@ def test_empty_run_dir_fails_all_unverifiable_gates(tmp_path):
     # require_metrics_from_all unset
     vacuous = ("missing_series", "rate_stall", "churn_storm", "journey_stall",
                "lock_order_cycle", "shared_state_race", "perf_regression",
-               "proof_serve_p99", "evidence_committed")
+               "proof_serve_p99", "evidence_committed", "recompile_storm",
+               "device_mem_growth")
     assert all(not g["ok"] for g in report["gates"] if g["name"] not in vacuous)
     assert all(g["ok"] for g in report["gates"] if g["name"] in vacuous)
 
